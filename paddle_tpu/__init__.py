@@ -1,0 +1,47 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Brand-new design on JAX/XLA/Pallas — see SURVEY.md at the repo root for the mapping to
+the reference (`/root/reference`, PaddlePaddle ~v2.4). The public surface mirrors
+`paddle.*` so reference user code ports with an import swap.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Paddle dtype semantics need real int64/float64 (python ints -> int64 tensors).
+# Weak typing keeps python scalars from promoting compute dtypes, and all perf-path
+# code is explicit f32/bf16, so this does not drag float64 onto the MXU.
+_jax.config.update("jax_enable_x64", True)
+
+from .framework import (  # noqa: F401
+    Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+    seed, get_rng_state, set_rng_state, set_flags, get_flags,
+    set_default_dtype, get_default_dtype,
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128,
+)
+from .framework.tensor import Parameter  # noqa: F401
+from .framework.dtype import bool_ as bool  # noqa: F401  (paddle.bool)
+
+from .ops import *  # noqa: F401,F403  — the paddle.* tensor-op surface
+from . import ops  # noqa: F401
+
+# submodules populated by later milestones are imported lazily to keep import light
+from . import framework  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    _lazy = {
+        "nn", "optimizer", "amp", "autograd", "io", "vision", "static", "jit",
+        "distributed", "incubate", "models", "kernels", "profiler", "utils",
+        "metric", "device",
+    }
+    if name in _lazy:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,141 @@
+"""Dtype system for paddle_tpu.
+
+Capability parity with the reference's dtype enum (``/root/reference/paddle/phi/common/
+data_type.h``) exposed in Python as ``paddle.float32`` etc. Here dtypes are thin wrappers
+over numpy/jax dtypes so they flow straight into XLA without conversion tables.
+
+TPU note: bfloat16 is the native matmul dtype on the MXU; float64 is emulated and slow on
+TPU — supported for parity but discouraged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+
+class DType:
+    """A framework dtype: interns one instance per name, comparable against
+    numpy/jax dtypes and strings."""
+
+    _registry: dict = {}
+
+    def __new__(cls, name: str, np_dtype):
+        if name in cls._registry:
+            return cls._registry[name]
+        self = super().__new__(cls)
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        cls._registry[name] = self
+        return self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            other_l = other.lower()
+            return self.name == other_l or _STR_ALIASES.get(other_l) is self
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("int8", "int16", "int32", "int64", "uint8")
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+}
+
+_NP_TO_DTYPE = {d.np_dtype: d for d in DType._registry.values()}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spec (DType, str, numpy/jax dtype, python type) to a DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in DType._registry:
+            return DType._registry[key]
+        if key in _STR_ALIASES:
+            return _STR_ALIASES[key]
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return float32
+    if dtype is complex:
+        return complex64
+    npd = np.dtype(dtype)
+    if npd in _NP_TO_DTYPE:
+        return _NP_TO_DTYPE[npd]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """DType (or anything convert_dtype accepts) -> jnp dtype object."""
+    d = convert_dtype(dtype)
+    return None if d is None else jnp.dtype(d.np_dtype)
+
+
+# default dtype management (paddle.set_default_dtype / get_default_dtype)
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def default_dtype() -> DType:
+    return _default_dtype

@@ -1,0 +1,57 @@
+"""Global flags registry.
+
+Parity with the reference's exported-gflags registry (``/root/reference/paddle/phi/core/
+flags.cc`` surfaced via ``pybind/global_value_getter_setter.cc:53`` as
+``paddle.set_flags``/``get_flags``). Flags also initialize from ``FLAGS_*`` environment
+variables, matching the reference's env contract.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_FLAGS: dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get(name)
+    _FLAGS[name] = _coerce(default, env) if env is not None else default
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        _FLAGS[k] = v
+
+
+def get_flags(flags) -> dict:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        if k not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        out[k] = _FLAGS[k]
+    return out
+
+
+# Core flags (subset of phi/core/flags.cc relevant to this build).
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for NaN/Inf (debug)")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; higher: warn")
+define_flag("FLAGS_benchmark", False, "sync after every op for timing")
+define_flag("FLAGS_use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on MXU")
+define_flag("FLAGS_eager_mode", True, "op-at-a-time eager execution (vs traced)")
+define_flag("FLAGS_jit_cache_dir", "", "persistent XLA compile cache directory")
+define_flag("FLAGS_allocator_strategy", "xla", "memory allocator strategy (informational)")
+define_flag("FLAGS_log_level", 0, "framework verbosity")

@@ -1,0 +1,127 @@
+"""Device/place system.
+
+Parity with the reference's Place hierarchy (``/root/reference/paddle/phi/common/place.h``)
+and ``paddle.device.set_device`` (``python/paddle/device/__init__.py:329``). On this stack a
+"place" resolves to a jax.Device; ``set_device`` installs a default that creation ops honor.
+
+TPU-first: the accelerator place is TPUPlace; CUDAPlace is accepted as an alias so reference
+user code runs unchanged.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Alias: reference user code says CUDAPlace / gpu; map onto the accelerator.
+class CUDAPlace(TPUPlace):
+    pass
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+_current_place: Place | None = None
+
+
+def _accelerator_devices():
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return []
+    return [d for d in devs if d.platform != "cpu"]
+
+
+def is_compiled_with_cuda() -> bool:  # parity shim; we are a TPU build
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def get_device() -> str:
+    p = _get_current_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _get_current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = TPUPlace(0) if _accelerator_devices() else CPUPlace()
+    return _current_place
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device parity. Accepts 'cpu', 'tpu', 'tpu:0', 'gpu'/'gpu:0'
+    (aliased to tpu), or a Place."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    s = str(device).lower()
+    if s == "cpu":
+        _current_place = CPUPlace()
+    else:
+        kind, _, idx = s.partition(":")
+        if kind not in ("tpu", "gpu", "cuda", "xpu", "npu"):
+            raise ValueError(f"unsupported device {device!r}")
+        _current_place = TPUPlace(int(idx) if idx else 0)
+    return _current_place
+
+
+def to_jax_device(place: Place | None = None):
+    """Resolve a Place to a concrete jax.Device (None if default should be used)."""
+    place = place or _get_current_place()
+    if isinstance(place, CPUPlace):
+        cpus = [d for d in jax.devices("cpu")] if _has_platform("cpu") else []
+        return cpus[0] if cpus else None
+    accel = _accelerator_devices()
+    if not accel:
+        return None  # CPU-only environment (tests): fall through to default device
+    return accel[min(place.device_id, len(accel) - 1)]
+
+
+def _has_platform(name: str) -> bool:
+    try:
+        return bool(jax.devices(name))
+    except RuntimeError:
+        return False
+
+
+def device_count() -> int:
+    accel = _accelerator_devices()
+    return len(accel) if accel else len(jax.devices())

@@ -1,0 +1,84 @@
+"""Random state management.
+
+Parity targets: ``paddle.seed`` (``/root/reference/python/paddle/framework/random.py``) and
+the model-parallel ``RNGStatesTracker`` (``python/paddle/distributed/fleet/layers/mpu/
+random.py:35``). TPU-native design: state is a jax.random key. Stateful eager semantics are
+provided by splitting a process-global key; compiled training steps thread an explicit key
+via ``rng_guard`` so randomness advances across jitted steps instead of being baked at trace
+time.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+_DEFAULT_SEED = 34342423252
+
+
+class _GlobalGenerator:
+    def __init__(self, seed: int = _DEFAULT_SEED):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        self._key = jax.random.key(self._seed)
+
+    def split(self):
+        """Return a fresh subkey, advancing the stateful global key."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def set_key(self, key):
+        self._key = key
+
+    def get_key(self):
+        return self._key
+
+
+_generator = _GlobalGenerator()
+# numpy generator for host-side randomness (DataLoader shuffling etc.)
+_np_rng = np.random.default_rng(_DEFAULT_SEED)
+
+
+def seed(s: int):
+    """paddle.seed parity: seeds device RNG and host numpy RNG."""
+    global _np_rng
+    _generator.seed(s)
+    _np_rng = np.random.default_rng(int(s))
+    return _generator
+
+
+def get_rng_state():
+    return _generator.get_key()
+
+
+def set_rng_state(key):
+    _generator.set_key(key)
+
+
+def next_key():
+    """Fresh jax PRNG subkey from the ambient generator (innermost rng_guard wins)."""
+    return _generator.split()
+
+
+def np_rng():
+    return _np_rng
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Run a region with RNG derived from `key` (may be a tracer inside jit).
+
+    Compiled step functions use this to thread per-step randomness:
+        with rng_guard(step_key):
+            loss = model(x)   # dropout etc. draw from step_key
+    """
+    saved = _generator.get_key()
+    _generator.set_key(key)
+    try:
+        yield
+    finally:
+        _generator.set_key(saved)

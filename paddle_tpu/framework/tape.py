@@ -1,0 +1,313 @@
+"""Dygraph autograd engine.
+
+Capability parity with the reference's eager autograd engine (``/root/reference/paddle/
+fluid/eager/``: ``GradNodeBase`` grad_node_info.h:168, ``RunBackward`` backward.cc:105, and
+the per-op codegen eager_gen.py). TPU-native redesign: instead of 40k LoC of generated C++
+grad nodes, every differentiable op dispatches through :func:`apply`, which records one
+``TapeNode`` holding the ``jax.vjp`` pullback. ``backward()`` is the reference's queue-based
+reverse-topo walk (backward.cc:124-175) in ~60 lines of Python.
+
+Crucially the tape is pure Python over jax values, so running a whole forward+backward under
+``jax.jit`` traces the tape away: the same user code is op-at-a-time eager on TPU when run
+directly, and a single fused XLA program when wrapped in ``paddle_tpu.jit.to_static``.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    global _grad_enabled
+    saved = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = saved
+
+
+class no_grad:
+    """paddle.no_grad parity: usable as context manager and decorator."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._saved = _grad_enabled
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._saved)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with no_grad_guard():
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+@contextlib.contextmanager
+def enable_grad():
+    global _grad_enabled
+    saved = _grad_enabled
+    _grad_enabled = True
+    try:
+        yield
+    finally:
+        _grad_enabled = saved
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded differentiable op: the vjp pullback plus links to the input
+    tensors whose gradients it produces (analog of GradNodeBase + TensorWrapper)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "freed")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor] — diff inputs, order matches vjp outputs
+        self.out_avals = out_avals  # list[(shape, jnp dtype)] per diff output
+        self.name = name
+        self.freed = False
+
+
+def _is_diff_dtype(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating)
+
+
+def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwargs):
+    """Dispatch one op through the tape.
+
+    `fn(*arrays, **kwargs)` must be a pure jax function. Positional `args` may mix
+    Tensors and non-tensors; only floating Tensors with stop_gradient=False are
+    differentiated. Returns Tensor / tuple of Tensors mirroring fn's output structure
+    (with has_aux, fn returns (diff_out, aux) and aux tensors are non-differentiable).
+    """
+    from .tensor import Tensor  # local: avoid import cycle
+
+    vals = []
+    diff_idx = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            vals.append(v)
+            if (
+                _grad_enabled
+                and not a.stop_gradient
+                and _is_diff_dtype(v.dtype)
+            ):
+                diff_idx.append(i)
+        else:
+            vals.append(a)
+
+    name = op_name or getattr(fn, "__name__", "op")
+
+    if not diff_idx:
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, None, has_aux)
+
+    diff_tensors = tuple(args[i] for i in diff_idx)
+    diff_vals = tuple(vals[i] for i in diff_idx)
+
+    def closed(*dvals):
+        full = list(vals)
+        for i, dv in zip(diff_idx, dvals):
+            full[i] = dv
+        return fn(*full, **kwargs)
+
+    if has_aux:
+        out_val, vjp_fn, aux = jax.vjp(closed, *diff_vals, has_aux=True)
+    else:
+        out_val, vjp_fn = jax.vjp(closed, *diff_vals)
+        aux = None
+
+    multi = isinstance(out_val, (tuple, list))
+    outs = tuple(out_val) if multi else (out_val,)
+    out_avals = [(o.shape, o.dtype) for o in outs]
+    node = TapeNode(vjp_fn, diff_tensors, out_avals, name)
+
+    wrapped = tuple(
+        Tensor(o, stop_gradient=False, _node=node, _out_index=i)
+        for i, o in enumerate(outs)
+    )
+    result = wrapped if multi else wrapped[0]
+    if has_aux:
+        aux_wrapped = _wrap_outputs(aux, None, False)
+        return result, aux_wrapped
+    return result
+
+
+def _wrap_outputs(out, node, has_aux):
+    from .tensor import Tensor
+
+    if has_aux:
+        main, aux = out
+        return _wrap_outputs(main, node, False), _wrap_outputs(aux, None, False)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=True) for o in out)
+    return Tensor(out, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _toposort(root_nodes: Sequence[TapeNode]) -> list[TapeNode]:
+    order: list[TapeNode] = []
+    seen: set[int] = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order  # children before parents; iterate reversed for backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run reverse accumulation from `tensors` (paddle.autograd.backward parity).
+
+    Leaf tensors (stop_gradient=False, not produced by a taped op) receive/accumulate
+    ``.grad``. Mirrors eager/backward.cc:105 RunBackward.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    node_grads: dict[int, list] = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None:
+            if not t.stop_gradient:
+                seed = g._value if g is not None else jnp.ones(t.shape, t._value.dtype)
+                t._accumulate_grad(seed)
+            continue
+        if t._node.freed:
+            raise RuntimeError(
+                f"backward through op '{t._node.name}' a second time, but the tape "
+                "was freed. Pass retain_graph=True to backward()."
+            )
+        if g is None:
+            # paddle semantics (eager/backward.cc): missing grad seeds all-ones,
+            # for non-scalars too (torch would error here)
+            g_val = jnp.ones(t.shape, t._value.dtype)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        slot = node_grads.setdefault(id(t._node), [None] * len(t._node.out_avals))
+        slot[t._out_index] = (
+            g_val if slot[t._out_index] is None else slot[t._out_index] + g_val
+        )
+        roots.append(t._node)
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        grads = node_grads.pop(id(node), None)
+        if grads is None:
+            continue  # unreachable from roots
+        cots = tuple(
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(grads, node.out_avals)
+        )
+        multi = len(cots) > 1
+        in_grads = node.vjp_fn(cots if multi else cots[0])
+        for t, g in zip(node.inputs, in_grads):
+            if t._node is not None:
+                slot = node_grads.setdefault(
+                    id(t._node), [None] * len(t._node.out_avals)
+                )
+                i = t._out_index
+                slot[i] = g if slot[i] is None else slot[i] + g
+            else:
+                t._accumulate_grad(g)
+        if not retain_graph:
+            node.freed = True
+            node.vjp_fn = None
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad parity (reference: eager/general_grad.h GeneralGrad).
+
+    Computes d(outputs)/d(inputs) without touching ``.grad`` of other leaves.
+    create_graph is currently handled by re-tracing (the vjp calls are jax-traceable);
+    double-backward through `grad` returns non-taped results for now.
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    single = isinstance(inputs, Tensor)
+    if single:
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    # Stash and clear leaf grads of the requested inputs, run backward, read them.
+    saved = [t._grad for t in inputs]
+    for t in inputs:
+        t._grad = None
+    try:
+        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; pass "
+                        "allow_unused=True to return None for it"
+                    )
+                results.append(None)
+            else:
+                g = t._grad
+                results.append(
+                    Tensor(g._value if isinstance(g, Tensor) else g, stop_gradient=not create_graph)
+                )
+    finally:
+        for t, s in zip(inputs, saved):
+            t._grad = s
+    return results[0] if single else results

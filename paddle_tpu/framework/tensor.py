@@ -1,0 +1,319 @@
+"""The Tensor type.
+
+Parity with the reference's eager Tensor (``/root/reference/paddle/fluid/pybind/eager.cc``
++ ``python/paddle/fluid/dygraph/varbase_patch_methods.py``): stop_gradient, .grad,
+.backward(), .numpy(), in-place ``*_`` methods, rich operator overloads.
+
+TPU-native design: a Tensor wraps a ``jax.Array`` (or a tracer, inside jit). All math
+dispatches through the tape (framework/tape.py) into jnp/lax, so the same object works
+eagerly on TPU and inside compiled step functions. Most math methods are attached by
+``paddle_tpu.ops`` at import time (the monkey-patch pattern the reference uses in
+monkey_patch_varbase) — this file holds only structural behavior.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import place as place_mod
+from . import tape as tape_mod
+
+
+def _as_value(data, dtype=None, place=None):
+    """Normalize user data to a jax value on the right device."""
+    jd = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+    if isinstance(data, Tensor):
+        v = data._value
+        return v.astype(jd) if jd is not None and v.dtype != jd else v
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        return data.astype(jd) if jd is not None and data.dtype != jd else data
+    arr = np.asarray(data)
+    if jd is None:
+        # paddle semantics: python floats -> default dtype; ints stay int64
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+            jd = dtype_mod.to_jax_dtype(dtype_mod.default_dtype())
+        else:
+            jd = arr.dtype
+    dev = place_mod.to_jax_device(place) if place is not None else None
+    if dev is not None:
+        return jax.device_put(arr.astype(jd) if arr.dtype != jd else arr, dev)
+    return jnp.asarray(arr, dtype=jd)
+
+
+class Tensor:
+    """paddle.Tensor parity object wrapping a jax.Array / tracer."""
+
+    __slots__ = ("_value", "stop_gradient", "_grad", "_node", "_out_index", "name",
+                 "persistable", "_is_param", "__weakref__")
+
+    # let Tensor win against numpy in reflected ops
+    __array_priority__ = 100
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True,
+                 _node=None, _out_index: int = 0, name: str = None):
+        self._value = _as_value(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = _node
+        self._out_index = _out_index
+        self.name = name
+        self.persistable = False
+        self._is_param = False
+
+    # -- structural properties ------------------------------------------------
+    @property
+    def shape(self) -> list:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self) -> dtype_mod.DType:
+        return dtype_mod.convert_dtype(np.dtype(self._value.dtype))
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        v = self._value
+        if hasattr(v, "devices"):
+            try:
+                dev = next(iter(v.devices()))
+                if dev.platform == "cpu":
+                    return place_mod.CPUPlace()
+                return place_mod.TPUPlace(dev.id)
+            except Exception:
+                pass
+        return place_mod._get_current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def _accumulate_grad(self, g_val):
+        if self._grad is None:
+            self._grad = Tensor(g_val, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + g_val, stop_gradient=True)
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        tape_mod.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value))
+        else:
+            self._grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    # -- host interop ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        v = self._value if not idx else self._value[idx]
+        return v.item() if hasattr(v, "item") else np.asarray(v).item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.asarray(self._value)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                    f"{grad_s},\n       {data})")
+        except Exception:  # tracer inside jit
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_s}, traced)"
+
+    def __hash__(self):
+        return id(self)
+
+    # -- dtype / device movement ---------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, place_mod.to_jax_device(place_mod.CPUPlace())),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **kw):  # alias for accelerator, reference-API compat
+        return Tensor(jax.device_put(self._value, place_mod.to_jax_device(place_mod.TPUPlace(0))),
+                      stop_gradient=self.stop_gradient)
+
+    tpu = cuda
+
+    def pin_memory(self):
+        return self.cpu()
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "gpu", "tpu", "cuda"):
+                device = a
+            else:
+                dtype = a
+        t = self
+        if dtype is not None:
+            t = t.astype(dtype)
+        if device is not None:
+            dev = place_mod.to_jax_device(place_mod.set_device(device)) \
+                if not isinstance(device, place_mod.Place) else place_mod.to_jax_device(device)
+            t = Tensor(jax.device_put(t._value, dev), stop_gradient=t.stop_gradient)
+        return t
+
+    # -- in-place machinery ---------------------------------------------------
+    def _inplace_assign(self, new: "Tensor"):
+        """Rebind this tensor's value/tape link to `new` (in-place op semantics)."""
+        self._value = new._value
+        self._node = new._node
+        self._out_index = new._out_index
+        self.stop_gradient = new.stop_gradient and self.stop_gradient
+        return self
+
+    def set_value(self, value):
+        v = _as_value(value)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {list(v.shape)} vs {self.shape}")
+        self._value = v.astype(self._value.dtype)
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    # -- indexing -------------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _normalize_index(idx)
+        return tape_mod.apply(lambda v: v[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _normalize_index(idx)
+        val = value._value if isinstance(value, Tensor) else value
+        out = tape_mod.apply(
+            lambda v, w: v.at[idx].set(jnp.asarray(w, v.dtype) if not hasattr(w, "dtype") or w.dtype != v.dtype else w),
+            self, value if isinstance(value, Tensor) else val,
+            op_name="setitem",
+        )
+        self._inplace_assign(out)
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # math dunders & named methods are attached by paddle_tpu.ops.monkey_patch()
+
+
+def _normalize_index(idx):
+    """Unwrap Tensor indices into jax values."""
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+# jax pytree registration: Tensors flatten to their value, so pytrees of Tensors
+# pass straight through jit/grad/shard_map boundaries.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), t.stop_gradient),
+    lambda sg, vals: Tensor(vals[0], stop_gradient=sg),
+)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self._is_param = True
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda meta, vals: _unflatten_param(meta, vals),
+)
+
+
+def _unflatten_param(meta, vals):
+    sg, name = meta
+    p = Parameter(vals[0], name=name, trainable=not sg)
+    return p
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,62 @@
+"""Op dispatch helpers shared by all functional op modules.
+
+Replaces the reference's generated dispatch stack (``/root/reference/paddle/phi/api/lib/
+kernel_dispatch.h:42-63`` + eager ad_func codegen): here an "op" is a pure jax function
+routed through the autograd tape (differentiable) or around it (integer/bool outputs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import tape as _tape
+from ..framework.dtype import to_jax_dtype, convert_dtype
+
+apply = _tape.apply
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(v, stop_gradient=True):
+    if isinstance(v, (tuple, list)):
+        return tuple(Tensor(x, stop_gradient=stop_gradient) for x in v)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def apply_nondiff(fn, *args, op_name=None, **kwargs):
+    """Run an op whose outputs are non-differentiable (bool/int) — no tape node."""
+    vals = [unwrap(a) for a in args]
+    return wrap(fn(*vals, **kwargs))
+
+
+def binop(fn, x, y, op_name=None):
+    """Elementwise binary op accepting Tensor|scalar on either side, with paddle's
+    scalar-promotion rule (python scalars adopt the tensor's dtype)."""
+    if not isinstance(x, Tensor):
+        x = _scalar_like(x, y)
+    if not isinstance(y, Tensor):
+        y = _scalar_like(y, x)
+    return apply(fn, x, y, op_name=op_name)
+
+
+def _scalar_like(scalar, ref: Tensor) -> Tensor:
+    dt = ref._value.dtype
+    if isinstance(scalar, bool):
+        return Tensor(jnp.asarray(scalar))
+    if isinstance(scalar, float) and jnp.issubdtype(dt, jnp.integer):
+        return Tensor(jnp.asarray(scalar, jnp.float32))
+    if isinstance(scalar, complex) and not jnp.issubdtype(dt, jnp.complexfloating):
+        return Tensor(jnp.asarray(scalar))
+    return Tensor(jnp.asarray(scalar, dt))
+
+
+def maybe_cast_pair(x: Tensor, y: Tensor):
+    """Promote a (Tensor, Tensor) pair to a common dtype like the reference's
+    data-transform layer (phi/api/lib/data_transform.cc)."""
+    if x._value.dtype == y._value.dtype:
+        return x, y
+    common = jnp.promote_types(x._value.dtype, y._value.dtype)
+    from . import cast
+    return cast(x, common), cast(y, common)

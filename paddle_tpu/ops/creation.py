@@ -1,0 +1,215 @@
+"""Tensor creation ops.
+
+Parity: ``/root/reference/python/paddle/tensor/creation.py`` and random.py. Random ops
+draw from the stateful global generator (framework/random.py) which threads jax PRNG keys —
+inside a compiled step use ``rng_guard`` for per-step randomness.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import unwrap, wrap
+from ..framework.tensor import Tensor, to_tensor
+from ..framework.dtype import to_jax_dtype, default_dtype
+from ..framework import random as random_mod
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform", "normal",
+    "standard_normal", "multinomial", "bernoulli", "poisson", "tril_indices",
+    "triu_indices", "one_hot", "clone", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return to_jax_dtype(default or default_dtype())
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, bool):
+        return wrap(jnp.full(_shape(shape), fv, jnp.bool_))
+    return wrap(jnp.full(_shape(shape), fv, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    v = unwrap(x)
+    return wrap(jnp.zeros_like(v, dtype=_dt(dtype, v.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    v = unwrap(x)
+    return wrap(jnp.ones_like(v, dtype=_dt(dtype, v.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    v = unwrap(x)
+    return wrap(jnp.full_like(v, unwrap(fill_value), dtype=_dt(dtype, v.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def clone(x, name=None):
+    from .manipulation import assign
+    return assign(x)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = unwrap(start)
+    end = unwrap(end) if end is not None else None
+    step = unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py_vals = [v for v in (start, end, step) if not hasattr(v, "dtype")]
+        is_float = any(isinstance(v, float) for v in py_vals) or any(
+            hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+            for v in (start, end, step))
+        dtype = "float32" if is_float else "int64"
+    return wrap(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             base=unwrap(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(int(num_rows),
+                        int(num_columns) if num_columns is not None else None,
+                        dtype=_dt(dtype)))
+
+
+def complex(real, imag, name=None):
+    from ..framework.tape import apply
+    return apply(jax.lax.complex, real, imag, op_name="complex")
+
+
+# ---- random ----------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = random_mod.next_key()
+    return wrap(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ()))
+        key = random_mod.next_key()
+        return wrap(m + s * jax.random.normal(key, out_shape,
+                                              getattr(m, "dtype", jnp.float32)))
+    key = random_mod.next_key()
+    return wrap(mean + std * jax.random.normal(key, _shape(shape or [1]),
+                                               _dt(None)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                   minval=unwrap(min), maxval=unwrap(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return wrap(jax.random.randint(key, _shape(shape), int(low), int(high),
+                                   dtype=_dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return wrap(jax.random.randint(key, v.shape, int(low), int(high),
+                                   dtype=_dt(dtype, v.dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_mod.next_key()
+    return wrap(jax.random.permutation(key, int(n)).astype(to_jax_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-38))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*v.shape[:-1], int(num_samples)))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, v.shape, jnp.float32)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return wrap(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    v = unwrap(x)
+    return wrap((jax.random.uniform(key, v.shape) < v).astype(v.dtype))
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    v = unwrap(x)
+    return wrap(jax.random.poisson(key, v).astype(v.dtype))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = jnp.tril_indices(int(row), k=offset, m=int(col))
+    return wrap(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(int(row), k=offset, m=int(col))
+    return wrap(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    v = unwrap(x)
+    return wrap(jax.nn.one_hot(v, int(num_classes), dtype=jnp.float32))

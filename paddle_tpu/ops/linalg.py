@@ -1,0 +1,257 @@
+"""Linear algebra ops.
+
+Parity: ``/root/reference/python/paddle/tensor/linalg.py``. matmul is THE op on TPU —
+it lowers to MXU systolic-array tiles; ``FLAGS_use_bf16_matmul`` keeps bf16 inputs in
+bf16 with f32 accumulation (XLA default), matching MXU-native precision.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import apply, apply_nondiff, unwrap, wrap, maybe_cast_pair
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "matmul", "dot", "mm", "bmm", "mv", "t", "norm", "dist", "cross", "einsum",
+    "cholesky", "inv", "pinv", "svd", "qr", "lu", "eig", "eigh", "eigvals",
+    "eigvalsh", "det", "slogdet", "solve", "triangular_solve", "cholesky_solve",
+    "lstsq", "matrix_power", "matrix_rank", "multi_dot", "cov", "corrcoef",
+    "histogram", "bincount",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if isinstance(x, Tensor) and isinstance(y, Tensor):
+        x, y = maybe_cast_pair(x, y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, x, y, op_name="matmul")
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply(f, x, y, op_name="dot")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, op_name="mv")
+
+
+def t(input, name=None):
+    return apply(lambda v: v.T if v.ndim >= 2 else v, input, op_name="t")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p in ("fro", 2):
+                out = jnp.sqrt(jnp.sum(jnp.square(flat)))
+            elif p == 1:
+                out = jnp.sum(jnp.abs(flat))
+            elif p in ("inf", np.inf, float("inf")):
+                out = jnp.max(jnp.abs(flat))
+            elif p in ("-inf", -np.inf, float("-inf")):
+                out = jnp.min(jnp.abs(flat))
+            else:
+                out = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+            if keepdim:
+                out = out.reshape([1] * v.ndim)
+            return out
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        if p == "fro" or (p == 2 and len(ax) == 2):
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p in ("inf", np.inf, float("inf")):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p in ("-inf", -np.inf, float("-inf")):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim),
+                         1.0 / p)
+    return apply(f, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p in (np.inf, float("inf")):
+            return jnp.max(jnp.abs(d))
+        if p in (-np.inf, float("-inf")):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return apply(f, x, y, op_name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(f, x, y, op_name="cross")
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands, op_name="einsum")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(f, x, op_name="cholesky")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x,
+                 op_name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x, op_name="qr")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    v = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(v)
+    outs = (wrap(lu_), wrap((piv + 1).astype(jnp.int32)))
+    if get_infos:
+        return (*outs, wrap(jnp.zeros((), jnp.int32)))
+    return outs
+
+
+def eig(x, name=None):
+    v = np.asarray(unwrap(x))
+    w, vec = np.linalg.eig(v)  # CPU fallback: general eig is host-only in XLA TPU
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), x,
+                 op_name="eigh")
+
+
+def eigvals(x, name=None):
+    v = np.asarray(unwrap(x))
+    return wrap(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v), x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply(f, x, op_name="slogdet")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(f, x, y, op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply(f, x, y, op_name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_, sv
+    v = unwrap(x)
+    sol, res, rank_, sv = jnp.linalg.lstsq(v, unwrap(y), rcond=rcond)
+    return wrap(sol), wrap(res), wrap(rank_), wrap(sv)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, int(n)), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_nondiff(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x)
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(list(vs)), *x, op_name="multi_dot")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = unwrap(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    if lo is None:
+        lo = float(jnp.min(v))
+        hi = float(jnp.max(v))
+        if lo == hi:
+            lo, hi = lo - 1, hi + 1
+    hist, _ = jnp.histogram(v.reshape(-1), bins=int(bins), range=(lo, hi))
+    return wrap(hist.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    n = int(np.asarray(jnp.max(v)).item()) + 1 if v.size else 0
+    length = builtins_max(n, int(minlength))
+    out = jnp.bincount(v.reshape(-1), weights=None if w is None else w.reshape(-1),
+                       length=length)
+    return wrap(out if w is not None else out.astype(jnp.int64))
+
+
+def builtins_max(a, b):
+    return a if a > b else b

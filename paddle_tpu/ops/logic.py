@@ -1,0 +1,68 @@
+"""Comparison / logical / bitwise ops (all non-differentiable outputs).
+
+Parity: ``/root/reference/python/paddle/tensor/logic.py``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import apply_nondiff, unwrap, wrap
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "equal_all", "allclose", "isclose", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "is_empty", "is_tensor",
+]
+
+
+def _bin(fn):
+    def op(x, y, name=None):
+        xv, yv = unwrap(x), unwrap(y)
+        return wrap(fn(xv, yv))
+    return op
+
+equal = _bin(jnp.equal)
+not_equal = _bin(jnp.not_equal)
+greater_than = _bin(jnp.greater)
+greater_equal = _bin(jnp.greater_equal)
+less_than = _bin(jnp.less)
+less_equal = _bin(jnp.less_equal)
+logical_and = _bin(jnp.logical_and)
+logical_or = _bin(jnp.logical_or)
+logical_xor = _bin(jnp.logical_xor)
+bitwise_and = _bin(jnp.bitwise_and)
+bitwise_or = _bin(jnp.bitwise_or)
+bitwise_xor = _bin(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return wrap(jnp.logical_not(unwrap(x)))
+
+
+def bitwise_not(x, name=None):
+    return wrap(jnp.bitwise_not(unwrap(x)))
+
+
+def equal_all(x, y, name=None):
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return wrap(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                             equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return wrap(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                            equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    return wrap(jnp.asarray(unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,549 @@
+"""Shape / layout / gather-scatter ops.
+
+Parity: ``/root/reference/python/paddle/tensor/manipulation.py``. Static shapes are kept
+wherever possible so XLA can tile onto the MXU; the few inherently dynamic ops
+(unique, nonzero-driven) document their host-sync behavior.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import apply, apply_nondiff, unwrap, wrap
+
+_py_slice = slice  # the builtin; shadowed below by the paddle `slice` op
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+
+__all__ = [
+    "cast", "reshape", "reshape_", "transpose", "flatten", "squeeze", "unsqueeze",
+    "concat", "stack", "split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_add", "index_put", "masked_select",
+    "masked_fill", "where", "roll", "flip", "rot90", "unique", "unique_consecutive",
+    "unbind", "unstack", "repeat_interleave", "take_along_axis", "put_along_axis",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "tensordot", "moveaxis",
+    "as_complex", "as_real", "view", "view_as", "slice", "strided_slice",
+    "crop", "pad", "shard_index", "numel", "rank", "assign", "fill_", "zero_",
+    "diag_embed", "flatten_", "squeeze_", "unsqueeze_", "tolist", "atleast_1d",
+    "atleast_2d", "atleast_3d",
+]
+
+
+def cast(x, dtype):
+    jd = to_jax_dtype(dtype)
+    v = unwrap(x)
+    if v.dtype == jd:
+        return x if isinstance(x, Tensor) else wrap(v)
+    if jnp.issubdtype(jd, jnp.floating) or jnp.issubdtype(jd, jnp.complexfloating):
+        return apply(lambda u: u.astype(jd), x, op_name="cast")
+    return apply_nondiff(lambda u: u.astype(jd), x)
+
+
+def assign(x, output=None):
+    out = apply(lambda v: v + 0 if False else jnp.asarray(v), x, op_name="assign") \
+        if isinstance(x, Tensor) else wrap(jnp.asarray(np.asarray(x)))
+    if output is not None:
+        output._inplace_assign(out if isinstance(out, Tensor) else Tensor(out))
+        return output
+    return out
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)) if unwrap(x).shape else 1,
+                            jnp.int64 if False else jnp.int32))
+
+
+def rank(x):
+    return wrap(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+def _resolve_shape(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.tolist()]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def reshape(x, shape, name=None):
+    shape = _resolve_shape(shape)
+    return apply(lambda v: jnp.reshape(v, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_assign(reshape(x, shape))
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        a = start_axis % nd if nd else 0
+        b = stop_axis % nd if nd else 0
+        new_shape = list(v.shape[:a]) + [-1] + list(v.shape[b + 1:])
+        return jnp.reshape(v, new_shape)
+    return apply(f, x, op_name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply(f, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_assign(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(unwrap(a)) if isinstance(a, Tensor) else int(a) for a in axes]
+    def f(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply(f, x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_assign(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    tensors = list(x)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *tensors, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *tensors, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    v = unwrap(x)
+    dim = v.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    def f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(o), int(o) + int(s), axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply(f, x, op_name="split"))
+
+
+def builtins_sum(it, start=0):
+    total = start
+    for v in it:
+        total = total + v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    v = unwrap(x)
+    n = v.shape[axis]
+    def f(v):
+        parts = jnp.split(v, n, axis=axis)
+        return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+    return list(apply(f, x, op_name="unbind"))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _resolve_shape(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _resolve_shape(shape)
+    def f(v):
+        tgt = list(shape)
+        # paddle: -1 means keep original dim
+        offset = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - offset]
+        return jnp.broadcast_to(v, tgt)
+    return apply(f, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(unwrap(y).shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    vs = [unwrap(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vs])
+    return [apply(lambda v: jnp.broadcast_to(v, shape), t) for t in inputs]
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    idx = unwrap(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply(lambda v: jnp.take(v, idx, axis=axis), x, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(index)
+    def f(v):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+    return apply(f, x, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(index).reshape(-1)
+    def f(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        # paddle overwrite=False: zero target rows then add
+        zeroed = v.at[idx].set(jnp.zeros_like(u))
+        return zeroed.at[idx].add(u)
+    return apply(f, x, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_assign(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = unwrap(index)
+    shape = _resolve_shape(shape)
+    def f(u):
+        z = jnp.zeros(shape, u.dtype)
+        k = idx.shape[-1]
+        return z.at[tuple(idx[..., i] for i in range(k))].add(u)
+    return apply(f, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(index)
+    def f(v, u):
+        k = idx.shape[-1]
+        return v.at[tuple(idx[..., i] for i in range(k))].add(u)
+    return apply(f, x, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = unwrap(index).reshape(-1)
+    return apply(lambda v: jnp.take(v, idx, axis=axis), x, op_name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = unwrap(index).reshape(-1)
+    def f(v, u):
+        sl = [slice(None)] * v.ndim
+        sl[axis] = idx
+        return v.at[tuple(sl)].add(u)
+    return apply(f, x, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(unwrap(i) for i in indices)
+    def f(v, u):
+        return v.at[idx].add(u) if accumulate else v.at[idx].set(u)
+    return apply(f, x, value, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-shape op: forces host sync for the count (documented divergence —
+    on TPU prefer where/masked_fill)."""
+    m = np.asarray(unwrap(mask)).astype(bool)
+    v = unwrap(x)
+    return wrap(jnp.asarray(np.asarray(v)[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(mask)
+    val = unwrap(value)
+    return apply(lambda v: jnp.where(m, jnp.asarray(val, v.dtype), v), x,
+                 op_name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return tuple(wrap(i) for i in jnp.nonzero(unwrap(condition)))
+    cond = unwrap(condition)
+    return apply(lambda a, b: jnp.where(cond, a, b), x, y, op_name="where")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis=axis), x, op_name="roll")
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda v: jnp.flip(v, axis=tuple(axes)), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Host-side (dynamic output shape)."""
+    v = np.asarray(unwrap(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    jd = to_jax_dtype(dtype)
+    outs = [wrap(jnp.asarray(res[0]))]
+    for r in res[1:]:
+        outs.append(wrap(jnp.asarray(r.astype(np.dtype(jd)))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+        axis = 0
+    keep = np.ones(v.shape[axis], bool)
+    sl = lambda i: tuple(slice(None) if d != axis else i for d in range(v.ndim))
+    for i in range(1, v.shape[axis]):
+        keep[i] = not np.array_equal(v[sl(i)], v[sl(i - 1)])
+    idx = np.nonzero(keep)[0]
+    out = [wrap(jnp.asarray(np.take(v, idx, axis=axis)))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(wrap(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        counts = np.diff(np.append(idx, v.shape[axis]))
+        out.append(wrap(jnp.asarray(counts.astype(np.int64))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats) if isinstance(repeats, Tensor) else repeats
+    if isinstance(r, (jax.Array,)) and r.ndim > 0:
+        total = int(np.asarray(r).sum())
+        return apply(lambda v: jnp.repeat(v, r, axis=axis, total_repeat_length=total), x)
+    return apply(lambda v: jnp.repeat(v, int(r), axis=axis), x)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    idx = unwrap(indices)
+    return apply(lambda v: jnp.take_along_axis(v, idx, axis=axis), arr,
+                 op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = unwrap(indices)
+    def f(v, u):
+        u = jnp.broadcast_to(jnp.asarray(u, v.dtype), idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idx, u, axis=axis, inplace=False)
+        sl = jnp.indices(idx.shape, sparse=True)
+        full_idx = list(sl)
+        full_idx[axis] = idx
+        if reduce == "add":
+            return v.at[tuple(full_idx)].add(u)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[tuple(full_idx)].multiply(u)
+        raise ValueError(f"unsupported reduce {reduce!r}")
+    if isinstance(values, Tensor):
+        return apply(f, arr, values, op_name="put_along_axis")
+    return apply(lambda v: f(v, values), arr, op_name="put_along_axis")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), x, op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diag(v, k=offset)
+    return apply(f, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        row = i + max(-offset, 0)
+        col = i + max(offset, 0)
+        out = out.at[..., row, col].set(v)
+        if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply(f, x, op_name="diag_embed")
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args,
+                      op_name="meshgrid"))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, op_name="tensordot")
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x, op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x,
+                 op_name="as_real")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, list(unwrap(other).shape))
+
+
+def slice(input, axes, starts, ends):
+    starts = _resolve_shape(starts)
+    ends = _resolve_shape(ends)
+    def f(v):
+        out = v
+        for ax, s, e in zip(axes, starts, ends):
+            dim = v.shape[ax]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+    return apply(f, input, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(v):
+        idx = [_py_slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, _resolve_shape(starts), _resolve_shape(ends),
+                                _resolve_shape(strides)):
+            idx[ax] = _py_slice(s, e, st)
+        return v[tuple(idx)]
+    return apply(f, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _resolve_shape(shape)
+    offsets = _resolve_shape(offsets) if offsets is not None else [0] * len(shape)
+    def f(v):
+        sizes = [sh if sh != -1 else v.shape[i] - offsets[i] for i, sh in enumerate(shape)]
+        return jax.lax.dynamic_slice(v, offsets, sizes)
+    return apply(f, x, op_name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad-compatible; here the generic tensor version."""
+    p = _resolve_shape(pad) if not isinstance(pad, int) else [pad]
+    def f(v):
+        nd = v.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(p)//2 dims (NCHW spatial),
+            # given low-to-high as [l, r, t, b ...] over trailing dims reversed
+            k = len(p) // 2
+            width = [(0, 0)] * (nd - k) + [
+                (p[2 * i], p[2 * i + 1]) for i in range(k)
+            ]
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                pass  # trailing dims are spatial already
+        if mode == "constant":
+            return jnp.pad(v, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(v, width, mode=jmode)
+    return apply(f, x, op_name="pad")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+    return apply_nondiff(f, input)
+
+
+def fill_(x, value):
+    out = apply(lambda v: jnp.full_like(v, value), x, op_name="fill_")
+    return x._inplace_assign(out)
+
+
+def zero_(x):
+    return fill_(x, 0.0)
+
+
+def tolist(x):
+    return np.asarray(unwrap(x)).tolist()
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs

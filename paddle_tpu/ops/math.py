@@ -1,0 +1,380 @@
+"""Elementwise & reduction math ops.
+
+Parity: ``/root/reference/python/paddle/tensor/math.py`` (which dispatches to _C_ops →
+phi kernels). Here every op is a pure jnp/lax function through the tape, so XLA fuses
+chains of these into single TPU kernels — the fusion the reference needed hand-written
+fused ops for.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import apply, apply_nondiff, binop, unwrap, wrap
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "abs", "sign", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "floor", "ceil", "round", "trunc", "frac",
+    "reciprocal", "square", "erf", "erfinv", "lgamma", "digamma",
+    "clip", "scale", "stanh", "multiplex",
+    "sum", "mean", "max", "min", "prod", "std", "var", "median", "nanmedian",
+    "nansum", "nanmean", "logsumexp", "amax", "amin",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "isnan", "isinf", "isfinite", "nan_to_num",
+    "add_n", "addmm", "inner", "outer", "kron", "lerp", "diff", "rad2deg", "deg2rad",
+    "angle", "conj", "real", "imag", "trace", "diagonal", "heaviside",
+    "logaddexp", "logit", "gcd", "lcm", "count_nonzero",
+    "increment", "any", "all",
+]
+
+
+# ---- elementwise binary ----------------------------------------------------
+
+def add(x, y, name=None):
+    return binop(jnp.add, x, y, op_name="add")
+
+def subtract(x, y, name=None):
+    return binop(jnp.subtract, x, y, op_name="subtract")
+
+def multiply(x, y, name=None):
+    return binop(jnp.multiply, x, y, op_name="multiply")
+
+def divide(x, y, name=None):
+    def f(a, b):
+        # int/int -> float32 (paddle semantics; avoids f64 under x64 mode)
+        if jnp.issubdtype(a.dtype, jnp.integer) and jnp.issubdtype(b.dtype, jnp.integer):
+            a = a.astype(jnp.float32)
+            b = b.astype(jnp.float32)
+        return jnp.true_divide(a, b)
+    return binop(f, x, y, op_name="divide")
+
+def floor_divide(x, y, name=None):
+    return binop(jnp.floor_divide, x, y, op_name="floor_divide")
+
+def remainder(x, y, name=None):
+    return binop(jnp.remainder, x, y, op_name="remainder")
+
+mod = remainder
+
+def pow(x, y, name=None):
+    # keep python-scalar exponents as scalars: integer powers lower to repeated
+    # squaring (exact) instead of exp(y*log(x))
+    if not isinstance(y, Tensor) and not isinstance(x, Tensor):
+        return wrap(jnp.power(x, y))
+    if not isinstance(y, Tensor):
+        return apply(lambda v: jnp.power(v, y), x, op_name="pow")
+    if not isinstance(x, Tensor):
+        return apply(lambda v: jnp.power(x, v), y, op_name="pow")
+    return binop(jnp.power, x, y, op_name="pow")
+
+def float_power(x, y, name=None):
+    return binop(lambda a, b: jnp.float_power(a, b).astype(jnp.float64), x, y)
+
+def maximum(x, y, name=None):
+    return binop(jnp.maximum, x, y, op_name="maximum")
+
+def minimum(x, y, name=None):
+    return binop(jnp.minimum, x, y, op_name="minimum")
+
+def fmax(x, y, name=None):
+    return binop(jnp.fmax, x, y, op_name="fmax")
+
+def fmin(x, y, name=None):
+    return binop(jnp.fmin, x, y, op_name="fmin")
+
+def logaddexp(x, y, name=None):
+    return binop(jnp.logaddexp, x, y, op_name="logaddexp")
+
+def atan2(x, y, name=None):
+    return binop(jnp.arctan2, x, y, op_name="atan2")
+
+def gcd(x, y, name=None):
+    return apply_nondiff(jnp.gcd, x, y)
+
+def lcm(x, y, name=None):
+    return apply_nondiff(jnp.lcm, x, y)
+
+def heaviside(x, y, name=None):
+    return binop(jnp.heaviside, x, y, op_name="heaviside")
+
+
+# ---- elementwise unary -----------------------------------------------------
+
+def _unary(jfn, name):
+    def op(x, name_=None, name=None):
+        return apply(jfn, x, op_name=name)
+    op.__name__ = name
+    return op
+
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+square = _unary(jnp.square, "square")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+angle = _unary(jnp.angle, "angle")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+
+def frac(x, name=None):
+    return apply(lambda v: v - jnp.trunc(v), x, op_name="frac")
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        u = v if eps is None else jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(u / (1.0 - u))
+    return apply(f, x, op_name="logit")
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, op_name="stanh")
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return apply(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    def f(v):
+        out = v * jnp.asarray(s, v.dtype) + jnp.asarray(b, v.dtype) if bias_after_scale \
+            else (v + jnp.asarray(b, v.dtype)) * jnp.asarray(s, v.dtype)
+        return out
+    return apply(f, x, op_name="scale")
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda v: v + jnp.asarray(value, v.dtype), x, op_name="increment")
+    if isinstance(x, Tensor):
+        x._inplace_assign(out)
+    return x
+
+
+# ---- reductions ------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.sum(v, axis=axis, dtype=jd, keepdims=keepdim), x, op_name="sum")
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.nansum(v, axis=axis, dtype=jd, keepdims=keepdim), x)
+
+def mean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.mean(v, axis=axis, keepdims=keepdim), x, op_name="mean")
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.nanmean(v, axis=axis, keepdims=keepdim), x)
+
+def max(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.max(v, axis=axis, keepdims=keepdim), x, op_name="max")
+
+def min(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.min(v, axis=axis, keepdims=keepdim), x, op_name="min")
+
+amax, amin = max, min
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    axis = _norm_axis(axis)
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    return apply(lambda v: jnp.prod(v, axis=axis, dtype=jd, keepdims=keepdim), x)
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.std(v, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, op_name="std")
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.var(v, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, op_name="var")
+
+def median(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.median(v, axis=axis, keepdims=keepdim), x)
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), x)
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply(lambda v: jax.scipy.special.logsumexp(v, axis=axis, keepdims=keepdim), x)
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_nondiff(lambda v: jnp.count_nonzero(v, axis=axis, keepdims=keepdim), x)
+
+def all(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_nondiff(lambda v: jnp.all(v, axis=axis, keepdims=keepdim), x)
+
+def any(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return apply_nondiff(lambda v: jnp.any(v, axis=axis, keepdims=keepdim), x)
+
+
+# ---- scans -----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=jd)
+        return jnp.cumsum(v, axis=int(axis), dtype=jd)
+    return apply(f, x, op_name="cumsum")
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=jd)
+        return jnp.cumprod(v, axis=int(dim), dtype=jd)
+    return apply(f, x, op_name="cumprod")
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return jax.lax.cumlogsumexp(v, axis=ax)
+    return apply(f, x, op_name="logcumsumexp")
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else int(axis)
+    v2 = unwrap(x).reshape(-1) if axis is None else unwrap(x)
+    values = apply(lambda u: jax.lax.cummax(u.reshape(-1) if axis is None else u, axis=ax), x)
+    idx = jnp.asarray(_cum_arg(v2, ax, jnp.greater_equal), dtype=to_jax_dtype(dtype))
+    return values, wrap(idx)
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    v = unwrap(x)
+    ax = 0 if axis is None else int(axis)
+    v2 = v.reshape(-1) if axis is None else v
+    values = apply(lambda u: jax.lax.cummin(u.reshape(-1) if axis is None else u, axis=ax), x)
+    idx = jnp.asarray(_cum_arg(v2, ax, jnp.less_equal), dtype=to_jax_dtype(dtype))
+    return values, wrap(idx)
+
+def _cum_arg(v, axis, cmp):
+    """Running argmax/argmin along axis via associative scan on (value, index)."""
+    n = v.shape[axis]
+    idx = jnp.broadcast_to(
+        jnp.arange(n).reshape([-1 if i == axis % v.ndim else 1 for i in range(v.ndim)]),
+        v.shape,
+    )
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = cmp(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    _, out_idx = jax.lax.associative_scan(combine, (v, idx), axis=axis)
+    return out_idx
+
+
+# ---- predicates ------------------------------------------------------------
+
+def isnan(x, name=None):
+    return apply_nondiff(jnp.isnan, x)
+
+def isinf(x, name=None):
+    return apply_nondiff(jnp.isinf, x)
+
+def isfinite(x, name=None):
+    return apply_nondiff(jnp.isfinite, x)
+
+
+# ---- composite -------------------------------------------------------------
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *vs: jnp.sum(jnp.stack(vs), axis=0) if len(vs) > 1 else vs[0],
+                 *inputs, op_name="add_n")
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, op_name="addmm")
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y, op_name="inner")
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y, op_name="kron")
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return apply(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x)
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+def multiplex(inputs, index, name=None):
+    idx = unwrap(index).reshape(-1)
+    return apply(
+        lambda *vs: jnp.stack(vs, axis=0)[idx, jnp.arange(vs[0].shape[0])],
+        *inputs, op_name="multiplex",
+    )

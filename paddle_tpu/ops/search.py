@@ -1,0 +1,136 @@
+"""Search / sort ops.
+
+Parity: ``/root/reference/python/paddle/tensor/search.py``. top_k/sort lower to XLA's
+TPU-optimized sort networks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import apply, apply_nondiff, unwrap, wrap
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "top_k", "nonzero", "index_sample",
+    "searchsorted", "kthvalue", "mode", "masked_select_idx", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = to_jax_dtype(dtype)
+    def f(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape([1] * v.ndim).astype(jd) if keepdim else out.astype(jd)
+        out = jnp.argmax(v, axis=int(axis), keepdims=keepdim)
+        return out.astype(jd)
+    return apply_nondiff(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jd = to_jax_dtype(dtype)
+    def f(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape([1] * v.ndim).astype(jd) if keepdim else out.astype(jd)
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(jd)
+    return apply_nondiff(f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply_nondiff(f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply(lambda v: jnp.sort(v, axis=axis, descending=descending), x,
+                 op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+    def f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        u = jnp.moveaxis(v, ax, -1) if ax != v.ndim - 1 else v
+        if largest:
+            vals, idx = jax.lax.top_k(u, k)
+        else:
+            vals, idx = jax.lax.top_k(-u, k)
+            vals = -vals
+        if ax != v.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+    vals, idx = apply(lambda v: f(v)[0], x, op_name="topk"), None
+    # compute indices without tape (non-diff)
+    idx = apply_nondiff(lambda v: f(v)[1], x)
+    return vals, idx
+
+
+top_k = topk
+
+
+def nonzero(x, as_tuple=False, name=None):
+    """Dynamic-shape: host sync (documented divergence from jit-compatible ops)."""
+    v = np.asarray(unwrap(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def index_sample(x, index, name=None):
+    idx = unwrap(index)
+    return apply(lambda v: jnp.take_along_axis(v, idx, axis=1), x,
+                 op_name="index_sample")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq, v = unwrap(sorted_sequence), unwrap(values)
+    side = "right" if right else "left"
+    def f(s, u):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, u, side=side)
+        else:
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                s.reshape(-1, s.shape[-1]), u.reshape(-1, u.shape[-1])
+            ).reshape(u.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_nondiff(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fv(v):
+        s = jnp.sort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+    def fi(v):
+        si = jnp.argsort(v, axis=axis)
+        out = jnp.take(si, k - 1, axis=axis)
+        return (jnp.expand_dims(out, axis) if keepdim else out).astype(jnp.int64)
+    return apply(fv, x, op_name="kthvalue"), apply_nondiff(fi, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(unwrap(x))
+    from scipy import stats  # scipy ships with the jax stack
+    m = stats.mode(v, axis=axis, keepdims=True)
+    vals = np.take_along_axis(v, np.zeros_like(m.mode, dtype=np.int64), axis) * 0 + m.mode
+    idx = np.argmax(v == m.mode, axis=axis)
+    vals_out = m.mode if keepdim else np.squeeze(m.mode, axis=axis)
+    idx_out = np.expand_dims(idx, axis) if keepdim else idx
+    return wrap(jnp.asarray(vals_out)), wrap(jnp.asarray(idx_out.astype(np.int64)))
+
+
+def masked_select_idx(x, mask):
+    v, m = np.asarray(unwrap(x)), np.asarray(unwrap(mask), bool)
+    return wrap(jnp.asarray(v[m]))

@@ -1,0 +1,21 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy of a fake device fixture
+(/root/reference/paddle/phi/backends/custom/fake_cpu_device.h) — here XLA CPU stands in
+for TPU, and --xla_force_host_platform_device_count=8 gives a virtual 8-chip mesh so
+every sharding/collective path is exercised without hardware.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# Numeric-oracle tests need exact f32 matmuls; production default stays MXU bf16.
+jax.config.update("jax_default_matmul_precision", "highest")
