@@ -45,3 +45,7 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+# save/load + seed surface
+from .framework.io import save, load  # noqa: F401,E402

@@ -1,0 +1,9 @@
+"""Automatic mixed precision.
+
+Parity: ``/root/reference/python/paddle/amp/`` (auto_cast O1/O2, decorate, GradScaler
+with dynamic loss scaling using check_finite_and_unscale semantics). TPU-native: the
+preferred low dtype is bfloat16 (MXU native, no loss scaling needed); float16 is
+supported for parity and engages the scaler.
+"""
+from .auto_cast import auto_cast, amp_guard, decorate, amp_decorate, white_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

@@ -1,0 +1,97 @@
+"""auto_cast / decorate.
+
+Parity: reference python/paddle/amp/auto_cast.py:20 (auto_cast), :82 (decorate); op
+lists from paddle/fluid/imperative/amp_auto_cast.cc. The cast hook lives in the op
+dispatch layer (framework/tape.py consults `current_amp_state`), mirroring how the
+reference injects eager_amp_auto_cast calls into every generated ad_func.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import dtype as dtype_mod
+
+# O1 lists (subset of imperative/amp_auto_cast.cc, TPU-relevant)
+WHITE_LIST = {
+    "matmul", "linear", "mm", "bmm", "mv", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "flash_attention", "scaled_dot_product_attention", "addmm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss", "l1_loss",
+    "bce_with_logits", "binary_cross_entropy", "mean", "sum", "norm", "layer_norm",
+    "batch_norm", "group_norm", "instance_norm", "rms_norm", "logsumexp",
+    "cumsum", "softmax_with_cross_entropy",
+}
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enable=False, dtype="float16", level="O1",
+                 custom_white=None, custom_black=None):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = set(custom_white or ())
+        self.custom_black = set(custom_black or ())
+
+
+_state = _AmpState()
+
+
+def current_amp_state() -> _AmpState:
+    return _state
+
+
+def white_list():
+    return WHITE_LIST | _state.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast parity; default dtype is bfloat16 (TPU-native)."""
+    global _state
+    saved = _state
+    _state = _AmpState(enable, dtype, level, custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        _state = saved
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low dtype (optimizers keep f32 master state —
+    Adam/Lamb here always compute in f32 for low dtypes)."""
+    if level not in ("O1", "O2"):
+        raise ValueError("level must be O1 or O2")
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        from ..nn.layer import norm as norm_layers
+        norm_types = (norm_layers._BatchNormBase, norm_layers.LayerNorm,
+                      norm_layers.GroupNorm, norm_layers.InstanceNorm1D,
+                      norm_layers.RMSNorm)
+        for m in model_list:
+            keep_f32 = set()
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, norm_types):
+                    keep_f32.update(id(p) for p in sub.parameters(
+                        include_sublayers=False))
+            for p in m.parameters():
+                # norm scale/bias stay f32 (paddle O2 keeps bn/ln master dtype)
+                if p.dtype == dtype_mod.float32 and id(p) not in keep_f32:
+                    p._value = p._value.astype(
+                        dtype_mod.to_jax_dtype(dtype))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+amp_decorate = decorate

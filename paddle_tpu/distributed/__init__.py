@@ -1,0 +1,2 @@
+"""paddle_tpu.distributed — populated fully by the collective/fleet modules."""
+from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401

@@ -1,0 +1,70 @@
+"""Process-level distributed environment.
+
+Parity: the PADDLE_* env contract set by the reference launcher
+(``/root/reference/python/paddle/distributed/launch/controllers/collective.py``):
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_CURRENT_ENDPOINT. On TPU pods the JAX runtime env (JAX_PROCESS_INDEX etc.)
+is honored as a fallback.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank()
+    for var in ("PADDLE_TRAINER_ID", "JAX_PROCESS_INDEX", "RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    for var in ("PADDLE_TRAINERS_NUM", "JAX_NUM_PROCESSES", "WORLD_SIZE"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return 1
+
+
+def get_endpoints() -> list[str]:
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def current_endpoint() -> str:
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+class ParallelEnv:
+    """Parity: reference python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def trainer_endpoints(self):
+        return get_endpoints()
+
+    @property
+    def current_endpoint(self):
+        return current_endpoint()

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load.
+
+Parity: ``/root/reference/python/paddle/framework/io.py:639 save / :881 load`` —
+pickled nested state structures. Tensors serialize as numpy arrays + dtype tag so
+checkpoints are host-portable; bfloat16 round-trips via ml_dtypes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor, Parameter
+from ..optimizer.lr import LRScheduler
+
+
+class _TensorPayload:
+    def __init__(self, array: np.ndarray, dtype_name: str, is_param: bool, name):
+        self.array = array
+        self.dtype_name = dtype_name
+        self.is_param = is_param
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.dtype.name,
+                              obj._is_param, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    if isinstance(obj, LRScheduler):
+        return {"__lr_scheduler__": type(obj).__name__,
+                "state": obj.state_dict()}
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        from .dtype import convert_dtype
+        t = (Parameter(obj.array, name=obj.name) if obj.is_param
+             else Tensor(obj.array))
+        if obj.dtype_name != t.dtype.name:
+            t = Tensor(t._value.astype(convert_dtype(obj.dtype_name).np_dtype))
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

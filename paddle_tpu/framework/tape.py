@@ -79,6 +79,37 @@ def enable_grad():
 
 
 # ---------------------------------------------------------------------------
+# AMP hook (reference: eager_gen.py:357 injects eager_amp_auto_cast per ad_func)
+# ---------------------------------------------------------------------------
+
+
+def _amp_cast_fn(op_name):
+    """Return a value-cast fn for this op under the active amp state, or None."""
+    try:
+        from ..amp.auto_cast import current_amp_state, WHITE_LIST, BLACK_LIST
+    except ImportError:
+        return None
+    st = current_amp_state()
+    if not st.enable:
+        return None
+    white = (op_name in WHITE_LIST or op_name in st.custom_white) \
+        and op_name not in st.custom_black
+    black = op_name in BLACK_LIST or op_name in st.custom_black
+    from .dtype import to_jax_dtype
+    low = to_jax_dtype(st.dtype)
+
+    if white:
+        def cast(v):
+            return v.astype(low) if v.dtype == jnp.float32 else v
+        return cast
+    if black:
+        def cast(v):
+            return v.astype(jnp.float32) if v.dtype == low else v
+        return cast
+    return None
+
+
+# ---------------------------------------------------------------------------
 # tape
 # ---------------------------------------------------------------------------
 
@@ -111,11 +142,23 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
     """
     from .tensor import Tensor  # local: avoid import cycle
 
+    # static-graph recording: any lazy input routes the op into the Program DAG
+    if any(isinstance(a, Tensor) and getattr(a, "_lazy", None) is not None
+           for a in args):
+        from ..static.program import make_lazy_output
+        return make_lazy_output(fn, args, kwargs,
+                                op_name or getattr(fn, "__name__", "op"))
+
+    name_for_amp = op_name or getattr(fn, "__name__", "op")
+    amp_cast = _amp_cast_fn(name_for_amp)
+
     vals = []
     diff_idx = []
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
             v = a._value
+            if amp_cast is not None:
+                v = amp_cast(v)
             vals.append(v)
             if (
                 _grad_enabled
@@ -198,7 +241,8 @@ def _toposort(root_nodes: Sequence[TapeNode]) -> list[TapeNode]:
     return order  # children before parents; iterate reversed for backward
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             _leaf_filter=None):
     """Run reverse accumulation from `tensors` (paddle.autograd.backward parity).
 
     Leaf tensors (stop_gradient=False, not produced by a taped op) receive/accumulate
@@ -217,7 +261,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
     roots = []
     for t, g in zip(tensors, grad_tensors):
         if t._node is None:
-            if not t.stop_gradient:
+            if not t.stop_gradient and (_leaf_filter is None
+                                        or id(t) in _leaf_filter):
                 seed = g._value if g is not None else jnp.ones(t.shape, t._value.dtype)
                 t._accumulate_grad(seed)
             continue
@@ -256,7 +301,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 )
                 i = t._out_index
                 slot[i] = g if slot[i] is None else slot[i] + g
-            else:
+            elif _leaf_filter is None or id(t) in _leaf_filter:
                 t._accumulate_grad(g)
         if not retain_graph:
             node.freed = True
@@ -287,12 +332,14 @@ def grad(
     if retain_graph is None:
         retain_graph = create_graph
 
-    # Stash and clear leaf grads of the requested inputs, run backward, read them.
+    # Stash and clear leaf grads of the requested inputs; the leaf filter keeps
+    # backward from touching .grad of any other leaf (only_inputs semantics).
     saved = [t._grad for t in inputs]
     for t in inputs:
         t._grad = None
     try:
-        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph)
+        backward(outputs, grad_tensors=grad_outputs, retain_graph=retain_graph,
+                 _leaf_filter={id(t) for t in inputs})
         results = []
         for t in inputs:
             if t._grad is None:

@@ -48,7 +48,7 @@ class Tensor:
     """paddle.Tensor parity object wrapping a jax.Array / tracer."""
 
     __slots__ = ("_value", "stop_gradient", "_grad", "_node", "_out_index", "name",
-                 "persistable", "_is_param", "__weakref__")
+                 "persistable", "_is_param", "_lazy", "__weakref__")
 
     # let Tensor win against numpy in reflected ops
     __array_priority__ = 100

@@ -1,0 +1,18 @@
+"""Dataset / DataLoader.
+
+Parity: ``/root/reference/python/paddle/io/`` → fluid/reader.py:311 DataLoader and
+fluid/dataloader/ (Dataset, IterableDataset, BatchSampler, DistributedBatchSampler).
+TPU-native design: the loader is a host-side numpy pipeline with a background
+prefetch thread that overlaps host batching with device steps — the role the
+reference's multiprocess workers + mmap shared memory play. Batches stay numpy so
+a jitted train step can donate its device buffers.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,16 @@
+"""paddle_tpu.jit — the compiled ("static graph") execution path.
+
+Parity: ``/root/reference/python/paddle/jit/`` (@to_static, jit.save/jit.load) and the
+run_program op (``paddle/fluid/operators/run_program_op.h``) that executes a traced
+program inside dygraph.
+
+TPU-native redesign: the reference compiles Python ASTs to ProgramDesc; here the dygraph
+facade is already jax-traceable, so `to_static` simply jits the whole forward (params as
+inputs) and registers the compiled program as ONE taped op — backward flows through it
+via `jax.vjp`, exactly the role run_program_grad plays. No AST rewriting is needed: the
+tape IS the trace. Python control flow is captured at trace time per input signature
+(shape/dtype-specialized recompile, like ProgramTranslator's program cache
+(dy2static/program_translator.py:1111)).
+"""
+from .api import to_static, not_to_static, ignore_module, functional_call, TracedProgram  # noqa: F401
+from .save_load import save, load, TranslatedLayer  # noqa: F401

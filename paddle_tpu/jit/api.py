@@ -1,0 +1,199 @@
+"""to_static implementation.
+
+See package docstring. The compiled program caches per input signature — the analog of
+ConcreteProgram caching in the reference's ProgramTranslator
+(``/root/reference/python/paddle/jit/dy2static/program_translator.py:272,893``).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, Parameter
+from ..framework import tape as tape_mod
+from ..framework import random as random_mod
+from ..nn.layer.layers import Layer
+
+
+def _tree_unwrap(obj):
+    if isinstance(obj, Tensor):
+        return obj._value
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_unwrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_unwrap(v) for k, v in obj.items()}
+    return obj
+
+
+@contextlib.contextmanager
+def _bind_values(tensors, values):
+    saved = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._value = s
+
+
+def functional_call(layer: Layer, params_and_buffers: dict, *args, **kwargs):
+    """Run `layer` with parameter/buffer values taken from a pytree — the bridge
+    from the stateful Layer API to jax's functional world (pjit, grad, shard_map)."""
+    sd = layer.state_dict()
+    tensors, values = [], []
+    for k, t in sd.items():
+        if k in params_and_buffers:
+            v = params_and_buffers[k]
+            tensors.append(t)
+            values.append(v._value if isinstance(v, Tensor) else v)
+    with _bind_values(tensors, values):
+        return layer(*args, **kwargs)
+
+
+class TracedProgram:
+    """One compiled (params, buffers, inputs) -> (outputs, new_buffers) program."""
+
+    def __init__(self, pyfunc, layer: Layer | None):
+        self._pyfunc = pyfunc
+        self._layer = layer
+        self._params: list[Tensor] = []
+        self._buffers: list[Tensor] = []
+        if layer is not None:
+            self._params = [p for p in layer.parameters() if p.trainable]
+            self._buffers = layer.buffers()
+            seen_p = {id(p) for p in self._params}
+            # non-trainable params ride with buffers (stop_gradient through vjp)
+            for p in layer.parameters():
+                if id(p) not in seen_p and not p.trainable:
+                    self._buffers.append(p)
+        self._compiled_core = None
+
+    def _build_core(self):
+        pyfunc = self._pyfunc
+        params, buffers = self._params, self._buffers
+
+        def core(param_vals, buffer_vals, rng_key, training, *arg_vals):
+            with _bind_values(params, param_vals), \
+                    _bind_values(buffers, buffer_vals), \
+                    random_mod.rng_guard(rng_key):
+                if self._layer is not None:
+                    self._layer.training = bool(training)
+                out = pyfunc(*[Tensor(v) if isinstance(v, jax.Array) or hasattr(v, "aval")
+                               else v for v in arg_vals])
+                out_vals = _tree_unwrap(out)
+                new_buf = [b._value for b in buffers]
+            return out_vals, new_buf
+
+        return core
+
+    def __call__(self, *args):
+        if self._compiled_core is None:
+            core = self._build_core()
+            # params are diff inputs; buffers/args ride through has_aux as needed
+            self._jitted = jax.jit(core, static_argnums=(3,))
+            self._compiled_core = core
+        arg_vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        buffer_vals = [b._value for b in self._buffers]
+        training = self._layer.training if self._layer is not None else False
+        key = random_mod.next_key()
+
+        if tape_mod.is_grad_enabled() and self._params:
+            # register the whole program as one taped op (run_program parity)
+            def taped(*pvals):
+                out_vals, new_buf = self._jitted(list(pvals), buffer_vals, key,
+                                                 training, *arg_vals)
+                return out_vals, new_buf
+
+            out, aux = tape_mod.apply(taped, *self._params,
+                                      op_name="run_program", has_aux=True)
+            new_buf = aux
+        else:
+            with tape_mod.no_grad_guard():
+                out_vals, new_buf_vals = self._jitted(
+                    [p._value for p in self._params], buffer_vals, key, training,
+                    *arg_vals)
+            out = jax.tree_util.tree_map(
+                lambda v: Tensor(v), out_vals,
+                is_leaf=lambda v: isinstance(v, jax.Array))
+            new_buf = [Tensor(v) for v in new_buf_vals]
+
+        for b, nv in zip(self._buffers, list(new_buf)):
+            b._value = nv._value if isinstance(nv, Tensor) else nv
+        return out
+
+
+class StaticFunction:
+    """@to_static wrapper with per-signature program cache."""
+
+    def __init__(self, fn, layer=None, input_spec=None, build_strategy=None):
+        self._fn = fn
+        self._layer = layer
+        self._cache: dict = {}
+        functools.update_wrapper(self, fn)
+
+    def _sig(self, args):
+        parts = []
+        for a in args:
+            if isinstance(a, Tensor):
+                parts.append(("T", tuple(a._value.shape), str(a._value.dtype)))
+            else:
+                parts.append(("S", repr(a)))
+        if self._layer is not None:
+            parts.append(("train", self._layer.training))
+        return tuple(parts)
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            fn = functools.partial(self._fn, **kwargs)
+        else:
+            fn = self._fn
+        key = (self._sig(args),
+               tuple((k, self._sig([v])) for k, v in sorted(kwargs.items())))
+        prog = self._cache.get(key)
+        if prog is None:
+            prog = TracedProgram(fn, self._layer)
+            self._cache[key] = prog
+        return prog(*args)
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """paddle.jit.to_static parity: decorator or call-form; accepts Layer or fn."""
+
+    def wrap(f):
+        if isinstance(f, Layer):
+            sf = StaticFunction(lambda *a, **kw: type(f).forward(f, *a, **kw),
+                                layer=f, input_spec=input_spec)
+            f.forward = sf
+            # calling the layer goes through __call__ → hooks → sf
+            return f
+        # plain function (may close over layers; their params won't be diff
+        # inputs unless passed — document as single-program fast path)
+        return StaticFunction(f, layer=_find_self_layer(f),
+                              input_spec=input_spec)
+
+    if function is None:
+        return wrap
+    return wrap(function)
+
+
+def _find_self_layer(fn):
+    self_obj = getattr(fn, "__self__", None)
+    return self_obj if isinstance(self_obj, Layer) else None
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass

@@ -1,0 +1,108 @@
+"""jit.save / jit.load.
+
+Parity: reference ``python/paddle/jit/api.py`` jit.save (inference program + params on
+disk) and ``jit/translated_layer.py`` (load saved model back as a Layer).
+
+TPU-native format: StableHLO via jax.export (portable, AOT-recompilable on any XLA
+backend) + a pickled params blob. Directory layout:
+    path + ".pdmodel"   — serialized StableHLO bytes
+    path + ".pdiparams" — params pytree (framework/io.py format)
+    path + ".pdmeta"    — input signature metadata
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import io as fio
+from ..nn.layer.layers import Layer
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer.forward as StableHLO specialized to `input_spec` shapes."""
+    from jax import export as jax_export
+
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(shape, dtype)] or example "
+            "Tensors to fix the traced signature")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s._value.shape),
+                                              s._value.dtype))
+        elif isinstance(s, InputSpec):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape),
+                                              jnp.dtype(s.dtype)))
+        else:
+            raise TypeError(f"bad input spec {s!r}")
+
+    layer.eval()
+    sd = layer.state_dict()
+    names = list(sd.keys())
+    param_vals = [sd[k]._value for k in names]
+
+    def pure(params, *inputs):
+        from .api import functional_call
+        out = functional_call(layer, dict(zip(names, params)),
+                              *[Tensor(i) for i in inputs])
+        return out._value if isinstance(out, Tensor) else \
+            tuple(o._value for o in out)
+
+    exported = jax_export.export(jax.jit(pure))(
+        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals], *specs)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    fio.save({k: sd[k] for k in names}, path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"param_names": names,
+                     "input_specs": [(tuple(s.shape), str(s.dtype))
+                                     for s in specs]}, f)
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(int(s) if s is not None and s >= 0 else 1
+                           for s in shape)
+        from ..framework.dtype import to_jax_dtype
+        self.dtype = to_jax_dtype(dtype)
+        self.name = name
+
+
+class TranslatedLayer(Layer):
+    """A loaded compiled program behaving like a Layer (inference only)."""
+
+    def __init__(self, exported, params, param_names):
+        super().__init__()
+        self._exported = exported
+        self._param_vals = [params[k]._value for k in param_names]
+
+    def forward(self, *inputs):
+        vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._param_vals, *vals)
+        if isinstance(out, (tuple, list)):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+
+def load(path, **configs):
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params = fio.load(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, params, meta["param_names"])

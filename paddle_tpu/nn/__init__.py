@@ -1,0 +1,48 @@
+"""paddle_tpu.nn — layers, functional, initializers, clipping.
+
+Parity: reference python/paddle/nn/__init__.py export surface.
+"""
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
+    Flatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, Pad1D, Pad2D,
+    Pad3D, ZeroPad2D, CosineSimilarity, Bilinear, PixelShuffle, PixelUnshuffle,
+    ChannelShuffle, Unfold, Fold,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, ELU, CELU, SELU, GELU, Sigmoid, LogSigmoid, Hardsigmoid,
+    Hardswish, Hardtanh, Hardshrink, Softshrink, Tanhshrink, LeakyReLU, PReLU,
+    RReLU, Silu, Swish, Mish, Softplus, Softsign, Tanh, Softmax, LogSoftmax,
+    Maxout, ThresholdedReLU,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, CTCLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401

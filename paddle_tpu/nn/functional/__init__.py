@@ -1,0 +1,12 @@
+"""nn.functional: the neural-net op surface.
+
+Parity: ``/root/reference/python/paddle/nn/functional/``. Convs/pools lower to
+lax.conv_general_dilated / lax.reduce_window (MXU/VPU native); everything is jit-traceable.
+"""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403

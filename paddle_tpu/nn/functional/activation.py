@@ -1,0 +1,186 @@
+"""Activation functions (parity: reference python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "sigmoid", "hardsigmoid",
+    "hardswish", "hardtanh", "hardshrink", "softshrink", "tanhshrink", "leaky_relu",
+    "prelu", "rrelu", "log_sigmoid", "maxout", "silu", "swish", "mish", "softplus",
+    "softsign", "tanh", "softmax", "log_softmax", "gumbel_softmax", "glu",
+    "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, op_name="relu")
+
+
+def relu_(x, name=None):
+    return x._inplace_assign(relu(x))
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x, op_name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x, op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.sign(v) * jnp.maximum(jnp.abs(v) - threshold, 0.0), x)
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x, op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return apply(f, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    from ...framework import random as random_mod
+    if training:
+        def f(v):
+            k = random_mod.next_key()
+            a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, a * v)
+        return apply(f, x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda v: jnp.where(v >= 0, v, mid * v), x, op_name="rrelu")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = list(v.shape[:ax]) + [c // groups, groups] + list(v.shape[ax + 1:])
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply(f, x, op_name="maxout")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, op_name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, op_name="mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda v: jnp.where(beta * v > threshold, v,
+                            (1.0 / beta) * jnp.log1p(jnp.exp(beta * v))), x)
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    def f(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.softmax(v, axis=axis)
+    return apply(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    def f(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply(f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as random_mod
+    def f(v):
+        k = random_mod.next_key()
+        g = jax.random.gumbel(k, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                        jnp.ones((), y.dtype), axis=axis,
+                                        inplace=False)
+            # straight-through: y_hard in fwd, softmax grad in bwd
+            y = y + jax.lax.stop_gradient(y_hard - y)
+        return y
+    return apply(f, x, op_name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    def f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply(f, x, op_name="glu")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, 0.0), x)

@@ -1,0 +1,73 @@
+"""Attention functionals.
+
+Parity: the reference's fused attention ops (``/root/reference/paddle/fluid/operators/
+fused/fused_attention_op.cu``, ``fmha_ref.h``) — here one jit-traceable function that XLA
+fuses, with a Pallas flash-attention fast path (kernels/flash_attention.py) selected
+automatically for TPU-friendly shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale, training, key=None):
+    # q,k,v: [B, S, H, D] (paddle layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.einsum("bshd->bhsd", q)
+    kt = jnp.einsum("bshd->bhsd", k)
+    vt = jnp.einsum("bshd->bhsd", v)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None, training=True,
+                                 name=None):
+    """Inputs [batch, seq, num_heads, head_dim]; returns same layout.
+
+    Uses the Pallas flash-attention kernel when available (TPU, no mask or causal
+    mask, seq multiple of block); falls back to the XLA-fused reference path.
+    """
+    from ...framework import random as random_mod
+    mask = unwrap(attn_mask) if attn_mask is not None else None
+    drop_key = random_mod.next_key() if (dropout_p > 0.0 and training) else None
+
+    use_flash = mask is None and dropout_p == 0.0
+    if use_flash:
+        try:
+            from ...kernels.flash_attention import flash_attention_bshd, supported
+            q = unwrap(query)
+            if supported(q.shape):
+                def ff(qv, kv, vv):
+                    return flash_attention_bshd(qv, kv, vv, causal=is_causal,
+                                                scale=scale)
+                return apply(ff, query, key, value, op_name="flash_attention")
+        except ImportError:
+            pass
+
+    def f(q, k, v):
+        return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale, training,
+                         drop_key)
+
+    return apply(f, query, key, value, op_name="scaled_dot_product_attention")

@@ -1,0 +1,300 @@
+"""Common NN functionals: linear, dropout, embedding, interpolate, etc.
+
+Parity: reference python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._dispatch import apply, apply_nondiff, unwrap
+from ...ops.manipulation import pad  # re-export paddle.nn.functional.pad
+from ...framework.tensor import Tensor
+from ...framework import random as random_mod
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "label_smooth", "interpolate", "upsample",
+           "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+           "cosine_similarity", "pad", "bilinear", "class_center_sample"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] (paddle convention) — a single MXU
+    matmul; keep inputs bf16 for peak throughput."""
+    if bias is not None:
+        return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                     op_name="linear")
+    return apply(jnp.matmul, x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else apply(lambda v: v, x)
+    key = random_mod.next_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return apply(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b
+
+    return apply(f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(v, *pd):
+        k = v.shape[-1]
+        if pd:
+            return (1.0 - epsilon) * v + epsilon * pd[0]
+        return (1.0 - epsilon) * v + epsilon / k
+    if prior_dist is not None:
+        return apply(f, label, prior_dist, op_name="label_smooth")
+    return apply(f, label, op_name="label_smooth")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def f(v):
+        nd = v.ndim - 2
+        if channel_last:
+            spatial = v.shape[1:-1]
+        else:
+            spatial = v.shape[2:]
+        if size is not None:
+            out_spatial = [int(s) for s in (size if isinstance(size, (list, tuple))
+                                            else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * nd
+            out_spatial = [int(s * f_) for s, f_ in zip(spatial, sf)]
+        if channel_last:
+            out_shape = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
+        else:
+            out_shape = v.shape[:2] + tuple(out_spatial)
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via manual coords
+            return _resize_align_corners(v, out_shape, method, channel_last)
+        return jax.image.resize(v, out_shape, method=method)
+
+    return apply(f, x, op_name="interpolate")
+
+
+def _resize_align_corners(v, out_shape, method, channel_last):
+    nd = v.ndim
+    spatial_axes = range(1, nd - 1) if channel_last else range(2, nd)
+    out = v
+    for ax in spatial_axes:
+        n_in, n_out = v.shape[ax], out_shape[ax]
+        if n_in == n_out:
+            continue
+        pos = jnp.linspace(0.0, n_in - 1.0, n_out)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (pos - lo).astype(v.dtype)
+        a = jnp.take(out, lo, axis=ax)
+        b = jnp.take(out, hi, axis=ax)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        out = a + (b - a) * w.reshape(shape)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c // (r * r), r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, r, r, c // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, c, h // r, r, w // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h // r, r, w // r, r, c)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            out = v.reshape(n, groups, c // groups, h, w)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        out = v.reshape(n, h, w, groups, c // groups)
+        out = jnp.swapaxes(out, 3, 4)
+        return out.reshape(n, h, w, c)
+    return apply(f, x, op_name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _norm_tuple
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    if isinstance(paddings, int):
+        p = [(paddings, paddings)] * 2
+    elif len(paddings) == 2:
+        p = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:
+        p = [(paddings[0], paddings[2]), (paddings[1], paddings[3])]
+
+    def f(v):
+        n, c = v.shape[:2]
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [n, c*kh*kw, oh, ow] -> [n, c*kh*kw, L]
+        return patches.reshape(n, c * k[0] * k[1], -1)
+
+    return apply(f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _norm_tuple
+    out_hw = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    if isinstance(paddings, int):
+        p = (paddings,) * 4
+    elif len(paddings) == 2:
+        p = (paddings[0], paddings[1], paddings[0], paddings[1])
+    else:
+        p = tuple(paddings)
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + p[0] + p[2] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + p[1] + p[3] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        v5 = v.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_hw[0] + p[0] + p[2], out_hw[1] + p[1] + p[3]),
+                        v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0],
+                             wj:wj + ow * s[1]:s[1]].add(v5[:, :, i, j])
+        return out[:, :, p[0]:out.shape[2] - p[2], p[1]:out.shape[3] - p[3]]
+
+    return apply(f, x, op_name="fold")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis))
+        nb = jnp.sqrt(jnp.sum(jnp.square(b), axis=axis))
+        return num / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2, op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+    if bias is not None:
+        return apply(f, x1, x2, weight, bias, op_name="bilinear")
+    return apply(f, x1, x2, weight, op_name="bilinear")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Host-side sampling (parity shim for PLSC-style training)."""
+    lab = np.asarray(unwrap(label))
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = random_mod.np_rng().choice(
+            neg, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    sampled.sort()
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    from ...ops._dispatch import wrap
+    return wrap(jnp.asarray(remap[lab])), wrap(jnp.asarray(sampled))

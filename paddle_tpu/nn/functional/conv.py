@@ -1,0 +1,168 @@
+"""Convolutions over lax.conv_general_dilated (MXU-native on TPU).
+
+Parity: reference python/paddle/nn/functional/conv.py (conv1d/2d/3d + transpose
+variants, NCHW/NHWC, groups, dilation). The reference's 389 GPU conv kernel files
+collapse into XLA's one convolution HLO here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """Return ((lo, hi), ...) per spatial dim or the string 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return tuple((p, p) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((padding[2 * i], padding[2 * i + 1]) for i in range(n))
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # paddle NCHW form [[0,0],[0,0],[t,b],[l,r]]
+        spatial = [p for p in padding if len(p) == 2]
+        return tuple(tuple(p) for p in spatial[-n:])
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    dn_spec = _dim_numbers(n, channel_last)
+
+    def f(v, w, *b):
+        # paddle weight layout is always OIHW-style [out, in/groups, *k]
+        if channel_last:
+            w_spec = dn_spec[1]
+            # transpose OIHW -> HWIO etc.
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        dn = jax.lax.conv_dimension_numbers(v.shape, w.shape, dn_spec)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return apply(f, x, weight, bias, op_name=f"conv{n}d")
+    return apply(f, x, weight, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    out_pad = _norm_tuple(output_padding, n)
+    pad = _norm_padding(padding, n)
+    dn_spec = _dim_numbers(n, channel_last)
+
+    def f(v, w, *b):
+        # paddle transpose-conv weight layout: [in, out/groups, *k] (IOHW)
+        # grad-of-conv formulation: lhs-dilate input by stride
+        if pad == "SAME" or pad == "VALID":
+            pads = [(0, 0)] * n if pad == "VALID" else None
+        else:
+            pads = list(pad)
+        k_eff = [dilation[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        if pads is None:  # SAME
+            pads = [((k_eff[i] - stride[i] + 1) // 2,) * 2 for i in range(n)]
+        trans_pads = tuple(
+            (k_eff[i] - 1 - pads[i][0],
+             k_eff[i] - 1 - pads[i][1] + out_pad[i])
+            for i in range(n))
+        # weight IOHW -> flip spatial, swap io -> use as normal conv OIHW
+        w2 = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # [in, out/g, *k] -> per-group swap: reshape to [g, in/g, out/g, *k]
+            io = w2.shape
+            w2 = w2.reshape((groups, io[0] // groups) + io[1:])
+            w2 = jnp.swapaxes(w2, 1, 2)  # [g, out/g, in/g, *k]
+            w2 = w2.reshape((io[1] * groups, io[0] // groups) + io[2:])
+        else:
+            w2 = jnp.swapaxes(w2, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w2 = jnp.transpose(w2, perm)
+        dn = jax.lax.conv_dimension_numbers(v.shape, w2.shape, dn_spec)
+        out = jax.lax.conv_general_dilated(
+            v, w2, window_strides=(1,) * n, padding=trans_pads,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return apply(f, x, weight, bias, op_name=f"conv{n}d_transpose")
+    return apply(f, x, weight, op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,269 @@
+"""Loss functionals (parity: reference python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
+    "log_loss", "sigmoid_focal_loss", "dice_loss", "npair_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logits, lab):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = lab
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                tgt = (1.0 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            li = lab
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
+            loss = jnp.where(valid, loss, jnp.zeros((), loss.dtype))
+            if w is not None:
+                loss = loss * jnp.where(valid, jnp.take(w, safe), 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(
+                    valid, jnp.take(w, safe) if w is not None
+                    else jnp.ones((), loss.dtype), 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    return apply(f, input, label, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns loss with the class axis kept as size-1
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *w):
+        loss = -(t * jnp.log(jnp.maximum(p, 1e-12))
+                 + (1 - t) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = unwrap(pos_weight) if pos_weight is not None else None
+
+    def f(z, t, *w):
+        # numerically stable: max(z,0) - z*t + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            loss = loss * (t * (pw - 1) + 1)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([weight] if weight is not None else [])
+    return apply(f, *args, op_name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logp, lab):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        wt = jnp.take(w, safe) if w is not None else jnp.ones((), loss.dtype)
+        loss = jnp.where(valid, loss * wt, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        return _reduce(loss, reduction)
+
+    return apply(f, input, label, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda p, t: _reduce(jnp.square(p - t), reduction), input, label,
+                 op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda p, t: _reduce(jnp.abs(p - t), reduction), input, label,
+                 op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(p, t):
+        d = jnp.abs(p - t)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, t):
+        return _reduce(jnp.maximum(-t * (a - b) + margin, 0.0), reduction)
+    return apply(f, input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(x, t):
+        loss = jnp.where(t == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(loss, reduction)
+    return apply(f, input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, t):
+        sim = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - sim, jnp.maximum(sim - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply(f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dn2 = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1),
+                            1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax (log_probs: [T, B, C] paddle layout)."""
+    import optax
+    lp = unwrap(log_probs)
+    lab = unwrap(labels)
+    il = unwrap(input_lengths)
+    ll = unwrap(label_lengths)
+
+    def f(lp_):
+        logits = jnp.transpose(lp_, (1, 0, 2))  # [B, T, C]
+        B, T, _ = logits.shape
+        t_idx = jnp.arange(T)[None, :]
+        logitpaddings = (t_idx >= il[:, None]).astype(jnp.float32)
+        L = lab.shape[1]
+        l_idx = jnp.arange(L)[None, :]
+        labelpaddings = (l_idx >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logitpaddings, lab, labelpaddings,
+                                 blank_id=blank)
+        return _reduce(per_seq if not norm_by_times else per_seq / il, reduction)
+
+    return apply(f, log_probs, op_name="ctc_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda p, t: jnp.square(p - t), input, label,
+                 op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, t):
+        return -t * jnp.log(p + epsilon) - (1 - t) * jnp.log(1 - p + epsilon)
+    return apply(f, input, label, op_name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    norm = unwrap(normalizer) if normalizer is not None else None
+
+    def f(z, t):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+
+    return apply(f, logit, label, op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, t):
+        t_oh = jax.nn.one_hot(jnp.squeeze(t, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * t_oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(t_oh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(f, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    lab = unwrap(labels).reshape(-1)
+
+    def f(a, p):
+        sim = jnp.matmul(a, p.T)
+        tgt = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
+                        + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+        return ce + reg
+
+    return apply(f, anchor, positive, op_name="npair_loss")

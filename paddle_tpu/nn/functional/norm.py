@@ -1,0 +1,182 @@
+"""Normalization functionals (parity: reference nn/functional/norm.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "normalize",
+           "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Functional batch norm. When training, running stats tensors are updated
+    IN PLACE (host-level rebind) like the reference's kernels do on device."""
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    rm, rv = unwrap(running_mean), unwrap(running_var)
+
+    def stats_shape(v):
+        s = [1] * v.ndim
+        s[channel_axis] = v.shape[channel_axis]
+        return s
+
+    if use_stats:
+        def f(v, *wb):
+            s = stats_shape(v)
+            out = (v - rm.reshape(s)) / jnp.sqrt(rv.reshape(s) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(s)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(s)
+            return out
+        args = [a for a in (weight, bias) if a is not None]
+        return apply(f, x, *args, op_name="batch_norm")
+
+    # training: compute batch stats, update running stats; stats come out through
+    # the tape's has_aux channel (a closure would leak vjp tracers)
+    def f(v, *wb):
+        axes = tuple(a for a in range(v.ndim) if a != channel_axis % v.ndim)
+        mean = jnp.mean(v, axis=axes)
+        var = jnp.var(v, axis=axes)
+        s = stats_shape(v)
+        out = (v - mean.reshape(s)) / jnp.sqrt(var.reshape(s) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(s)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(s)
+        return out, (jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var))
+
+    args = [a for a in (weight, bias) if a is not None]
+    out, (bm, bv) = apply(f, x, *args, op_name="batch_norm", has_aux=True)
+    # update running stats (momentum convention: new = m*old + (1-m)*batch)
+    if isinstance(running_mean, Tensor):
+        running_mean._value = momentum * rm + (1.0 - momentum) * bm._value
+        running_var._value = momentum * rv + (1.0 - momentum) * bv._value
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n = len(tuple(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply(f, x, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native addition (no reference equivalent op; used by modern LLMs)."""
+    def f(v, *w):
+        ms = jnp.mean(jnp.square(v), axis=-1, keepdims=True)
+        out = v * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0]
+        return out
+    args = [weight] if weight is not None else []
+    return apply(f, x, *args, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    channel_axis = 1 if data_format.startswith("NC") else -1
+
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim)) if channel_axis == 1 else \
+            tuple(range(1, v.ndim - 1))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        s = [1] * v.ndim
+        s[channel_axis] = v.shape[channel_axis]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(s)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(s)
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply(f, x, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def f(v, *wb):
+        if channel_last:
+            v2 = jnp.moveaxis(v, -1, 1)
+        else:
+            v2 = v
+        n, c = v2.shape[0], v2.shape[1]
+        g = num_groups
+        rest = v2.shape[2:]
+        r = v2.reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, r.ndim))
+        mean = jnp.mean(r, axis=axes, keepdims=True)
+        var = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - mean) / jnp.sqrt(var + epsilon)).reshape(v2.shape)
+        s = [1] * v2.ndim
+        s[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(s)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(s)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply(f, x, *args, op_name="group_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True),
+                        1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return apply(f, x, op_name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(v):
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        window = [1] * v.ndim
+        window[ch_axis] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * v.ndim, [(0, 0)] * v.ndim)
+        return v / jnp.power(k + alpha * s, beta)
+    return apply(f, x, op_name="local_response_norm")

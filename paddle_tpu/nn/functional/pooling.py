@@ -1,0 +1,151 @@
+"""Pooling via lax.reduce_window (parity: reference nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._dispatch import apply, apply_nondiff, unwrap
+from .conv import _norm_tuple, _norm_padding
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _pool(x, kernel, stride, padding, n, mode, ceil_mode, exclusive, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        pads = pad  # SAME / VALID
+    else:
+        pads = list(pad)
+
+    def f(v):
+        nd = v.ndim
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            full_pads = pads if isinstance(pads, str) else \
+                [(0, 0)] + pads + [(0, 0)]
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            full_pads = pads if isinstance(pads, str) else \
+                [(0, 0), (0, 0)] + pads
+        if isinstance(full_pads, str):
+            full_pads = jax.lax.padtype_to_pads(v.shape, window, strides, full_pads)
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
+                                         full_pads)
+        # avg
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                       full_pads)
+        if exclusive and any(p != (0, 0) for p in full_pads):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, full_pads)
+            return summed / counts
+        return summed / float(np.prod(kernel))
+
+    return apply(f, x, op_name=f"{mode}_pool{n}d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, fmt)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive,
+                 data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive,
+                 data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode, True, fmt)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode, True,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode, True,
+                 data_format)
+
+
+def _adaptive(x, output_size, n, mode, data_format):
+    out_sizes = _norm_tuple(output_size, n)
+
+    def f(v):
+        # spatial dims are the last n dims for NCHW layout
+        spatial_start = v.ndim - n
+        out = v
+        for i, os in enumerate(out_sizes):
+            ax = spatial_start + i
+            in_size = out.shape[ax]
+            if os is None or os == in_size:
+                continue
+            if in_size % os == 0:
+                k = in_size // os
+                new_shape = (out.shape[:ax] + (os, k) + out.shape[ax + 1:])
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else jnp.mean(
+                    r, axis=ax + 1)
+            else:
+                # non-divisible: per-output-bin gather (paddle adaptive formula)
+                starts = (np.arange(os) * in_size) // os
+                ends = ((np.arange(os) + 1) * in_size + os - 1) // os
+                slices = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if mode == "max" \
+                        else jnp.mean(seg, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return apply(f, x, op_name=f"adaptive_{mode}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,346 @@
+"""nn.Layer base class.
+
+Parity: ``/root/reference/python/paddle/fluid/dygraph/layers.py`` (class Layer): sublayer
+and parameter registries, hooks, state_dict/set_state_dict, train/eval, create_parameter.
+TPU addition: ``raw_state()``/``load_raw_state()`` expose the parameter pytree for the
+compiled (pjit) path, and sharding annotations attach per-parameter via ``param.name``.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ...framework.tensor import Tensor, Parameter
+from ...framework.dtype import convert_dtype, default_dtype
+from .. import initializer as init_mod
+
+
+class ParamAttr:
+    """paddle.ParamAttr parity (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, init_mod.Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"invalid ParamAttr {attr!r}")
+
+
+_layer_name_counters: dict[str, int] = {}
+
+
+def _unique_name(prefix: str) -> str:
+    n = _layer_name_counters.get(prefix, 0)
+    _layer_name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype is not None else default_dtype()
+        self._parameters: OrderedDict[str, Parameter] = OrderedDict()
+        self._sub_layers: OrderedDict[str, Layer] = OrderedDict()
+        self._buffers: OrderedDict[str, Tensor] = OrderedDict()
+        self._non_persistable_buffer_names: set[str] = set()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._full_name = _unique_name(
+            name_scope or re.sub(r"(?<!^)(?=[A-Z])", "_", type(self).__name__).lower())
+
+    # ---- attribute routing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning params")
+            params[name] = value
+            layers.pop(name, None)
+            buffers.pop(name, None) if buffers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            params.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name] = Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d:
+                extra.extend(d.keys())
+        return sorted(set(list(super().__dir__()) + extra))
+
+    # ---- forward ------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def register_forward_pre_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.hook_id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.hook_id] = hook
+        return handle
+
+    # ---- parameter creation -------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) if dtype is not None else self._dtype
+        initializer = (attr.initializer or default_initializer
+                       or (init_mod.Constant(0.0) if is_bias
+                           else init_mod.XavierNormal()))
+        value = initializer(shape, dtype)
+        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        if p.name is None:
+            p.name = _unique_name(f"{self._full_name}.w" if not is_bias
+                                  else f"{self._full_name}.b")
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        import jax.numpy as jnp
+        t = Tensor(jnp.zeros([], (convert_dtype(dtype) or self._dtype).np_dtype))
+        t.name = name
+        return t
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ---- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> list:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self=False) -> list:
+        out = []
+        for _, l in self._traverse("", True):
+            out.append(l)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, l in self._traverse(prefix, True):
+            if include_self or l is not self:
+                yield name, l
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ---- modes --------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---- state --------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix,
+                                             include_sublayers):
+            dest[name] = p
+        for name, layer in self._traverse(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in layer._non_persistable_buffer_names:
+                    dest[f"{name}.{bname}" if name else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            target = own[k]
+            val = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            if tuple(val.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {list(val.shape)} vs {target.shape}")
+            target.set_value(val)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- dtype / device movement -------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(dtype)
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(dtype)
+        return self
+
+    def _cast_all(self, dtype, floating_only=True):
+        import jax.numpy as jnp
+        jd = convert_dtype(dtype)
+        for p in self.parameters():
+            if not floating_only or p.dtype.is_floating_point:
+                p._value = p._value.astype(jd.np_dtype)
+        for b in self.buffers():
+            if b is not None and (not floating_only or b.dtype.is_floating_point):
+                b._value = b._value.astype(jd.np_dtype)
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + ("\n  ".join(sub_repr)))
+        main = f"{type(self).__name__}({extra}" + ("" if not lines else "\n" + "\n".join(lines) + "\n")
+        return main + ")"
+
+    def extra_repr(self):
+        return ""
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self.hook_id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self.hook_id, None)

@@ -1,0 +1,249 @@
+"""Recurrent layers via lax.scan.
+
+Parity: reference python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU + cells).
+lax.scan compiles the time loop into one XLA while-op — the TPU-idiomatic
+replacement for the reference's cudnn RNN kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+from .. import initializer as I
+from ...ops._dispatch import apply, unwrap
+from ...framework.tensor import Tensor
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "SimpleRNN", "LSTM", "GRU", "RNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        b = unwrap(batch_ref).shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                  self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = fg * cv + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return new_h, (new_h, new_c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1.0 - z) * n + z * h
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                  self.bias_hh, op_name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wrap a cell into a time-looped layer (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        # python loop keeps the tape simple; under jit it unrolls & XLA fuses.
+        t_axis = 0 if self.time_major else 1
+        steps = unwrap(inputs).shape[t_axis]
+        states = initial_states
+        outs = []
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in idx:
+            x_t = M.squeeze(M.slice(inputs, [t_axis], [t], [t + 1]), [t_axis])
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = M.stack(outs, axis=t_axis)
+        return outputs, states
+
+
+class _RNNBase(Layer):
+    _cell_cls = None
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        from .container import LayerList
+        fw, bw = [], []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * (
+                2 if self.bidirect else 1)
+            fw.append(self._cell_cls(in_size, hidden_size, **cell_kwargs))
+            if self.bidirect:
+                bw.append(self._cell_cls(in_size, hidden_size, **cell_kwargs))
+        self.fw_cells = LayerList(fw)
+        self.bw_cells = LayerList(bw) if self.bidirect else None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        from .. import functional as F
+        x = inputs
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            fw_rnn = RNN(self.fw_cells[layer], time_major=self.time_major)
+            out_f, st_f = fw_rnn(x)
+            if self.bidirect:
+                bw_rnn = RNN(self.bw_cells[layer], is_reverse=True,
+                             time_major=self.time_major)
+                out_b, st_b = bw_rnn(x)
+                x = M.concat([out_f, out_b], axis=-1)
+                sts = [st_f, st_b]
+            else:
+                x = out_f
+                sts = [st_f]
+            for st in sts:
+                if self._n_states == 2:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        h = M.stack(final_h, axis=0)
+        if self._n_states == 2:
+            c = M.stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation=activation)
+
+
+class LSTM(_RNNBase):
+    _cell_cls = LSTMCell
+    _n_states = 2
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell
+    _n_states = 1

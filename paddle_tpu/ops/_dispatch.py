@@ -27,6 +27,11 @@ def wrap(v, stop_gradient=True):
 
 def apply_nondiff(fn, *args, op_name=None, **kwargs):
     """Run an op whose outputs are non-differentiable (bool/int) — no tape node."""
+    if any(isinstance(a, Tensor) and getattr(a, "_lazy", None) is not None
+           for a in args):
+        from ..static.program import make_lazy_output
+        return make_lazy_output(fn, args, kwargs,
+                                op_name or getattr(fn, "__name__", "op"))
     vals = [unwrap(a) for a in args]
     return wrap(fn(*vals, **kwargs))
 

@@ -247,11 +247,7 @@ def bincount(x, weights=None, minlength=0, name=None):
     v = unwrap(x)
     w = unwrap(weights) if weights is not None else None
     n = int(np.asarray(jnp.max(v)).item()) + 1 if v.size else 0
-    length = builtins_max(n, int(minlength))
+    length = n if n > int(minlength) else int(minlength)
     out = jnp.bincount(v.reshape(-1), weights=None if w is None else w.reshape(-1),
                        length=length)
     return wrap(out if w is not None else out.astype(jnp.int64))
-
-
-def builtins_max(a, b):
-    return a if a > b else b

@@ -20,8 +20,7 @@ __all__ = [
 
 def _bin(fn):
     def op(x, y, name=None):
-        xv, yv = unwrap(x), unwrap(y)
-        return wrap(fn(xv, yv))
+        return apply_nondiff(fn, x, y, op_name=fn.__name__)
     return op
 
 equal = _bin(jnp.equal)
@@ -39,11 +38,11 @@ bitwise_xor = _bin(jnp.bitwise_xor)
 
 
 def logical_not(x, name=None):
-    return wrap(jnp.logical_not(unwrap(x)))
+    return apply_nondiff(jnp.logical_not, x)
 
 
 def bitwise_not(x, name=None):
-    return wrap(jnp.bitwise_not(unwrap(x)))
+    return apply_nondiff(jnp.bitwise_not, x)
 
 
 def equal_all(x, y, name=None):

@@ -42,8 +42,8 @@ def cast(x, dtype):
 
 
 def assign(x, output=None):
-    out = apply(lambda v: v + 0 if False else jnp.asarray(v), x, op_name="assign") \
-        if isinstance(x, Tensor) else wrap(jnp.asarray(np.asarray(x)))
+    out = apply(jnp.asarray, x, op_name="assign") if isinstance(x, Tensor) \
+        else wrap(jnp.asarray(np.asarray(x)))
     if output is not None:
         output._inplace_assign(out if isinstance(out, Tensor) else Tensor(out))
         return output
@@ -51,8 +51,8 @@ def assign(x, output=None):
 
 
 def numel(x, name=None):
-    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)) if unwrap(x).shape else 1,
-                            jnp.int64 if False else jnp.int32))
+    shape = unwrap(x).shape
+    return wrap(jnp.asarray(int(np.prod(shape)) if shape else 1, jnp.int64))
 
 
 def rank(x):
@@ -142,12 +142,15 @@ def split(x, num_or_sections, axis=0, name=None):
     v = unwrap(x)
     dim = v.shape[axis]
     if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split expects dim {dim} divisible by {num_or_sections}; "
+                "use chunk() for uneven splits")
         sizes = [dim // num_or_sections] * num_or_sections
     else:
         sizes = [int(s) for s in num_or_sections]
-        n_unknown = builtins_sum(1 for s in sizes if s < 0)
-        if n_unknown:
-            known = builtins_sum(s for s in sizes if s >= 0)
+        if any(s < 0 for s in sizes):
+            known = sum(s for s in sizes if s >= 0)
             sizes = [s if s >= 0 else dim - known for s in sizes]
     offsets = np.cumsum([0] + sizes[:-1])
     def f(v):
@@ -156,15 +159,15 @@ def split(x, num_or_sections, axis=0, name=None):
     return list(apply(f, x, op_name="split"))
 
 
-def builtins_sum(it, start=0):
-    total = start
-    for v in it:
-        total = total + v
-    return total
-
-
 def chunk(x, chunks, axis=0, name=None):
-    return split(x, chunks, axis)
+    dim = unwrap(x).shape[axis]
+    per = (dim + chunks - 1) // chunks
+    sizes = []
+    left = dim
+    while left > 0:
+        sizes.append(min(per, left))
+        left -= per
+    return split(x, sizes, axis)
 
 
 def unbind(x, axis=0, name=None):
@@ -213,30 +216,30 @@ def broadcast_tensors(inputs, name=None):
 
 def gather(x, index, axis=0, name=None):
     axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
-    idx = unwrap(index)
-    if idx.ndim > 1:
-        idx = idx.reshape(-1)
-    return apply(lambda v: jnp.take(v, idx, axis=axis), x, op_name="gather")
+    def f(v, idx):
+        if idx.ndim > 1:
+            idx = idx.reshape(-1)
+        return jnp.take(v, idx, axis=axis)
+    return apply(f, x, index, op_name="gather")
 
 
 def gather_nd(x, index, name=None):
-    idx = unwrap(index)
-    def f(v):
+    def f(v, idx):
         k = idx.shape[-1]
         flat_idx = tuple(idx[..., i] for i in range(k))
         return v[flat_idx]
-    return apply(f, x, op_name="gather_nd")
+    return apply(f, x, index, op_name="gather_nd")
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
-    idx = unwrap(index).reshape(-1)
-    def f(v, u):
+    def f(v, idx, u):
+        idx = idx.reshape(-1)
         if overwrite:
             return v.at[idx].set(u)
         # paddle overwrite=False: zero target rows then add
         zeroed = v.at[idx].set(jnp.zeros_like(u))
         return zeroed.at[idx].add(u)
-    return apply(f, x, updates, op_name="scatter")
+    return apply(f, x, index, updates, op_name="scatter")
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
@@ -262,8 +265,8 @@ def scatter_nd_add(x, index, updates, name=None):
 
 
 def index_select(x, index, axis=0, name=None):
-    idx = unwrap(index).reshape(-1)
-    return apply(lambda v: jnp.take(v, idx, axis=axis), x, op_name="index_select")
+    return apply(lambda v, idx: jnp.take(v, idx.reshape(-1), axis=axis), x, index,
+                 op_name="index_select")
 
 
 def index_add(x, index, axis, value, name=None):
@@ -291,17 +294,16 @@ def masked_select(x, mask, name=None):
 
 
 def masked_fill(x, mask, value, name=None):
-    m = unwrap(mask)
     val = unwrap(value)
-    return apply(lambda v: jnp.where(m, jnp.asarray(val, v.dtype), v), x,
+    return apply(lambda v, m: jnp.where(m, jnp.asarray(val, v.dtype), v), x, mask,
                  op_name="masked_fill")
 
 
 def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return tuple(wrap(i) for i in jnp.nonzero(unwrap(condition)))
-    cond = unwrap(condition)
-    return apply(lambda a, b: jnp.where(cond, a, b), x, y, op_name="where")
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                 op_name="where")
 
 
 def roll(x, shifts, axis=None, name=None):
@@ -362,9 +364,8 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 
 def take_along_axis(arr, indices, axis, name=None):
-    idx = unwrap(indices)
-    return apply(lambda v: jnp.take_along_axis(v, idx, axis=axis), arr,
-                 op_name="take_along_axis")
+    return apply(lambda v, idx: jnp.take_along_axis(v, idx, axis=axis), arr,
+                 indices, op_name="take_along_axis")
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
@@ -498,14 +499,11 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         if len(p) == 2 * nd:
             width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
         else:
-            # paddle convention: pad applies to last len(p)//2 dims (NCHW spatial),
-            # given low-to-high as [l, r, t, b ...] over trailing dims reversed
+            # paddle convention (reference nn/functional/common.py pad): the FIRST
+            # pair applies to the LAST dim, next pair to the dim before it, etc.
             k = len(p) // 2
-            width = [(0, 0)] * (nd - k) + [
-                (p[2 * i], p[2 * i + 1]) for i in range(k)
-            ]
-            if data_format in ("NCHW", "NCL", "NCDHW"):
-                pass  # trailing dims are spatial already
+            pairs = [(p[2 * i], p[2 * i + 1]) for i in range(k)]
+            width = [(0, 0)] * (nd - k) + pairs[::-1]
         if mode == "constant":
             return jnp.pad(v, width, constant_values=value)
         jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

@@ -66,9 +66,8 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
             vals = jnp.moveaxis(vals, -1, ax)
             idx = jnp.moveaxis(idx, -1, ax)
         return vals, idx.astype(jnp.int64)
-    vals, idx = apply(lambda v: f(v)[0], x, op_name="topk"), None
-    # compute indices without tape (non-diff)
-    idx = apply_nondiff(lambda v: f(v)[1], x)
+    # one pass: values taped (differentiable), indices via the aux channel
+    vals, idx = apply(f, x, op_name="topk", has_aux=True)
     return vals, idx
 
 
@@ -85,8 +84,7 @@ def nonzero(x, as_tuple=False, name=None):
 
 
 def index_sample(x, index, name=None):
-    idx = unwrap(index)
-    return apply(lambda v: jnp.take_along_axis(v, idx, axis=1), x,
+    return apply(lambda v, idx: jnp.take_along_axis(v, idx, axis=1), x, index,
                  op_name="index_sample")
 
 

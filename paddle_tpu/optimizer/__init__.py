@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (parity: reference python/paddle/optimizer/)."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Lamb, Adagrad, RMSProp, Adadelta,
+    Adamax, L1Decay, L2Decay,
+)
+from . import lr  # noqa: F401

@@ -1,0 +1,379 @@
+"""Optimizer base + concrete optimizers.
+
+Parity: ``/root/reference/python/paddle/optimizer/optimizer.py`` (base `_apply_optimize`,
+regularization, grad-clip hooks) and adam.py/adamw.py/momentum.py/lamb.py etc.
+Updates are pure jnp expressions over param/grad/state pytrees — eager they run
+op-at-a-time; under a jitted train step XLA fuses the whole update into one kernel,
+which is what the reference needed fused_adam/multi_tensor kernels for.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.tape import no_grad_guard
+from ..ops._dispatch import unwrap
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._regularization = weight_decay
+        self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
+        self._global_step = 0
+        self.helper = None
+
+    # -- lr -------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def _lr_step(self):
+        pass  # schedulers step explicitly via scheduler.step() (paddle semantics)
+
+    # -- state ----------------------------------------------------------------
+    def _state_key(self, name, p):
+        if self._parameter_list is not None and p.name is None:
+            try:
+                idx = next(i for i, q in enumerate(self._parameter_list)
+                           if q is p)
+            except StopIteration:
+                idx = id(p)
+            return f"{idx}_{name}"
+        return f"{p.name}_{name}"
+
+    def _acc(self, name, p, init=None):
+        d = self._accumulators[name]
+        key = id(p)
+        if key not in d:
+            pending = getattr(self, "_pending_state", None)
+            restored = None
+            if pending is not None:
+                sk = self._state_key(name, p)
+                if sk in pending:
+                    v = pending[sk]
+                    restored = Tensor(v._value if isinstance(v, Tensor)
+                                      else jnp.asarray(v))
+            d[key] = restored if restored is not None else Tensor(
+                jnp.zeros(p.shape, unwrap(p).dtype) if init is None else init)
+        return d[key]
+
+    def state_dict(self):
+        state = {}
+        for name, d in self._accumulators.items():
+            for p in self._parameter_list or []:
+                if id(p) in d:
+                    state[self._state_key(name, p)] = d[id(p)]
+        state["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        if "global_step" in state:
+            gs = state["global_step"]
+            self._global_step = int(gs.item() if hasattr(gs, "item") else gs)
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        # fill already-created accumulators now; lazily-created ones get
+        # restored on first _acc() call via _pending_state
+        for name, d in list(self._accumulators.items()):
+            for p in self._parameter_list or []:
+                key = self._state_key(name, p)
+                if key in state and id(p) in d:
+                    v = state[key]
+                    d[id(p)] = v if isinstance(v, Tensor) else Tensor(v)
+        self._pending_state = state
+
+    # -- step -----------------------------------------------------------------
+    def _collect_params_grads(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters")
+        pg = []
+        for p in self._parameter_list:
+            if getattr(p, "trainable", True) and p.grad is not None:
+                pg.append((p, p.grad))
+        return pg
+
+    def step(self):
+        with no_grad_guard():
+            params_grads = self._collect_params_grads()
+            if not params_grads:
+                return
+            # per-param regularizer overrides global (reference optimizer.py)
+            reg = []
+            for p, g in params_grads:
+                r = p.regularizer if p.regularizer is not None \
+                    else self._regularization
+                if isinstance(r, L2Decay) and r.coeff:
+                    g = Tensor(unwrap(g) + r.coeff * unwrap(p))
+                elif isinstance(r, L1Decay) and r.coeff:
+                    g = Tensor(unwrap(g) + r.coeff * jnp.sign(unwrap(p)))
+                reg.append((p, g))
+            params_grads = reg
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            self._global_step += 1
+            for p, g in params_grads:
+                lr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0)
+                self._update_param(p, unwrap(g), lr)
+
+    def _update_param(self, p, g, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import in_static_mode, default_main_program, is_lazy
+        if in_static_mode() and is_lazy(loss):
+            # static mode: record intent; Executor compiles fwd+bwd+update
+            default_main_program()._record_minimize(self, loss)
+            return [], []
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- functional (jit) application — used by static Executor & pjit steps --
+    def _jit_apply(self, params, param_vals, grads, lr=None):
+        """Run one optimizer step functionally: bind tracer values, mutate, and
+        return (new_param_vals, accumulator_state_vals). Pure w.r.t. jax."""
+        saved_vals = [p._value for p in params]
+        saved_grads = [p._grad for p in params]
+        saved_plist = self._parameter_list
+        saved_lr = self._learning_rate
+        self._parameter_list = list(params)
+        if lr is not None:
+            self._learning_rate = lr
+        for p, v, g in zip(params, param_vals, grads):
+            p._value = v
+            p._grad = Tensor(g) if g is not None else None
+        try:
+            self.step()
+            new_vals = [p._value for p in params]
+            keys = [(n, k) for n, d in self._accumulators.items()
+                    for k in d.keys()]
+            self._jit_state_keys = keys
+            state_vals = [self._accumulators[n][k]._value for n, k in keys]
+            return new_vals, state_vals
+        finally:
+            for p, v, g in zip(params, saved_vals, saved_grads):
+                p._value = v
+                p._grad = g
+            self._parameter_list = saved_plist
+            self._learning_rate = saved_lr
+
+    def _restore_jit_state(self, state_vals):
+        for (n, k), v in zip(getattr(self, "_jit_state_keys", []), state_vals):
+            self._accumulators[n][k]._value = v
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr):
+        p._value = unwrap(p) - lr * g.astype(unwrap(p).dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr):
+        v = self._acc("velocity", p)
+        new_v = self._momentum * unwrap(v) + g
+        v._value = new_v
+        if self._use_nesterov:
+            p._value = unwrap(p) - lr * (g + self._momentum * new_v)
+        else:
+            p._value = unwrap(p) - lr * new_v
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        pv = unwrap(p)
+        compute_dtype = jnp.float32 if pv.dtype in (jnp.float16, jnp.bfloat16) \
+            else pv.dtype
+        g = g.astype(compute_dtype)
+        m = self._acc("moment1", p, jnp.zeros(pv.shape, compute_dtype))
+        v = self._acc("moment2", p, jnp.zeros(pv.shape, compute_dtype))
+        b1p = self._acc("beta1_pow", p, jnp.ones((), compute_dtype))
+        b2p = self._acc("beta2_pow", p, jnp.ones((), compute_dtype))
+        b1p._value = unwrap(b1p) * self._beta1
+        b2p._value = unwrap(b2p) * self._beta2
+        m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
+        v._value = self._beta2 * unwrap(v) + (1 - self._beta2) * jnp.square(g)
+        mhat = unwrap(m) / (1 - unwrap(b1p))
+        vhat = unwrap(v) / (1 - unwrap(b2p))
+        update = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        p._value = pv - update.astype(pv.dtype)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else \
+            getattr(weight_decay, "coeff", 0.01)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not \
+                self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if decay:
+            p._value = unwrap(p) * (1.0 - lr * decay)
+        super()._update_param(p, g, lr)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr):
+        pv = unwrap(p).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        m = self._acc("moment1", p, jnp.zeros(pv.shape, jnp.float32))
+        v = self._acc("moment2", p, jnp.zeros(pv.shape, jnp.float32))
+        b1p = self._acc("beta1_pow", p, jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.ones((), jnp.float32))
+        b1p._value = unwrap(b1p) * self._beta1
+        b2p._value = unwrap(b2p) * self._beta2
+        m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
+        v._value = self._beta2 * unwrap(v) + (1 - self._beta2) * jnp.square(g)
+        mhat = unwrap(m) / (1 - unwrap(b1p))
+        vhat = unwrap(v) / (1 - unwrap(b2p))
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._lamb_wd
+        r = r + wd * pv
+        w_norm = jnp.linalg.norm(pv)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p._value = (pv - lr * trust * r).astype(unwrap(p).dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr):
+        acc = self._acc("moment", p,
+                        jnp.full(p.shape, self._init_acc, unwrap(p).dtype))
+        acc._value = unwrap(acc) + jnp.square(g)
+        p._value = unwrap(p) - lr * g / (jnp.sqrt(unwrap(acc)) + self._epsilon)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g, lr):
+        ms = self._acc("mean_square", p)
+        ms._value = self._rho * unwrap(ms) + (1 - self._rho) * jnp.square(g)
+        denom = unwrap(ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg._value = self._rho * unwrap(mg) + (1 - self._rho) * g
+            denom = denom - jnp.square(unwrap(mg))
+        upd = g / jnp.sqrt(denom + self._epsilon)
+        if self._momentum > 0:
+            mom = self._acc("momentum", p)
+            mom._value = self._momentum * unwrap(mom) + upd
+            upd = unwrap(mom)
+        p._value = unwrap(p) - lr * upd
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _update_param(self, p, g, lr):
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_up = self._acc("avg_squared_update", p)
+        avg_sq._value = self._rho * unwrap(avg_sq) + (1 - self._rho) * jnp.square(g)
+        upd = (jnp.sqrt(unwrap(avg_up) + self._epsilon) /
+               jnp.sqrt(unwrap(avg_sq) + self._epsilon)) * g
+        avg_up._value = self._rho * unwrap(avg_up) + (1 - self._rho) * jnp.square(upd)
+        p._value = unwrap(p) - lr * upd
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, jnp.ones((), jnp.float32))
+        b1p._value = unwrap(b1p) * self._beta1
+        m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
+        u._value = jnp.maximum(self._beta2 * unwrap(u), jnp.abs(g))
+        p._value = unwrap(p) - (lr / (1 - unwrap(b1p))) * unwrap(m) / (
+            unwrap(u) + self._epsilon)

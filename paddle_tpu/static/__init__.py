@@ -1,0 +1,24 @@
+"""paddle_tpu.static — the static-graph user API.
+
+Parity: ``/root/reference/python/paddle/static/`` (Program, program_guard, data,
+Executor; reference executor stack: python/paddle/fluid/executor.py:911 →
+core.StandaloneExecutor → InterpreterCore).
+
+TPU-native redesign: a Program is a recorded lazy op-DAG (built by the same op
+dispatch layer the dygraph mode uses — framework/tape.py consults `static_build`).
+Executor.run closes the DAG into a pure jax function of (feeds, params) and jits it
+once per feed signature: InterpreterCore's kernel scheduling, stream management and
+GC collapse into XLA's compiled program. `minimize` runs jax.grad over the same
+closed function, so one compiled step fuses forward+backward+update like the
+reference's whole-program pass pipeline aims to.
+"""
+from .program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    enable_static, disable_static, in_static_mode, data, static_build,
+    name_scope,
+)
+from .executor import Executor, global_scope  # noqa: F401
+from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
+from ..jit.save_load import InputSpec  # noqa: F401
+from ..nn.functional import *  # noqa: F401,F403  (paddle.static.nn shims live in nn)
+from .. import amp  # noqa: F401  (paddle.static.amp parity alias)

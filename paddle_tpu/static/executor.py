@@ -1,0 +1,170 @@
+"""Static-graph Executor.
+
+Parity: reference ``python/paddle/fluid/executor.py:911 Executor`` / ``:1377 run``.
+The lazy Program DAG is closed into a pure jax function of (feeds, params) and
+jitted once per feed signature (the _ExecutorCache role, executor.py:739). When the
+program recorded a `minimize`, the same compiled step computes grads with jax.grad
+and applies the optimizer update functionally — forward+backward+update fuse into
+one XLA executable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, Parameter
+from ..framework import tape as tape_mod
+from ..framework import random as random_mod
+from .program import Program, default_main_program, is_lazy
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def _collect_graph(fetch_vars):
+    """Walk lazy graph from fetches; return (ordered nodes, param leaves)."""
+    nodes, params, seen_n, seen_p = [], [], set(), set()
+
+    def visit_tensor(t):
+        lz = getattr(t, "_lazy", None)
+        if lz is None:
+            if isinstance(t, Parameter) and id(t) not in seen_p:
+                seen_p.add(id(t))
+                params.append(t)
+            return
+        if lz[0] == "feed":
+            return
+        node = lz[0]
+        visit_node(node)
+
+    def visit_node(node):
+        if id(node) in seen_n:
+            return
+        seen_n.add(id(node))
+        for a in node.args:
+            if isinstance(a, Tensor):
+                visit_tensor(a)
+        nodes.append(node)
+
+    for t in fetch_vars:
+        if isinstance(t, Tensor):
+            visit_tensor(t)
+    return nodes, params
+
+
+def _eval_graph(fetch_vars, feed_vals, param_map):
+    """Evaluate the lazy DAG. feed_vals: name->array. param_map: id->array."""
+    memo = {}
+
+    def eval_tensor(t):
+        if not isinstance(t, Tensor):
+            return t
+        lz = getattr(t, "_lazy", None)
+        if lz is None:
+            if id(t) in param_map:
+                return param_map[id(t)]
+            return t._value
+        if lz[0] == "feed":
+            return feed_vals[lz[1]]
+        node, idx = lz
+        if id(node) not in memo:
+            vals = [eval_tensor(a) if isinstance(a, Tensor) else a
+                    for a in node.args]
+            out = node.fn(*vals, **node.kwargs)
+            memo[id(node)] = out if isinstance(out, (tuple, list)) else (out,)
+        return memo[id(node)][idx]
+
+    return [eval_tensor(t) for t in fetch_vars]
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_prune=False):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if program is default_startup_sentinel() or not program._nodes and \
+                not fetch_list:
+            return []  # startup program: params already initialized eagerly
+
+        feed_vals = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                     for k, v in feed.items()}
+
+        sig_items = tuple(sorted(
+            (k, tuple(np.shape(v)), str(np.asarray(v).dtype) if not hasattr(
+                v, "dtype") else str(v.dtype)) for k, v in feed_vals.items()))
+        key = (id(program), sig_items, tuple(id(t) for t in fetch_list),
+               len(program._optimize_ops), len(program._nodes))
+
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, fetch_list)
+            self._cache[key] = entry
+        jitted, params, opt = entry
+
+        param_vals = [p._value for p in params]
+        rng = random_mod.next_key()
+        if opt is None:
+            outs = jitted(feed_vals, param_vals, rng)
+        else:
+            outs, new_param_vals, new_state = jitted(feed_vals, param_vals, rng)
+            for p, nv in zip(params, new_param_vals):
+                p._value = nv
+            opt_obj = opt[0]
+            opt_obj._restore_jit_state(new_state)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _build(self, program: Program, fetch_list):
+        nodes, params = _collect_graph(
+            fetch_list + [loss for _, loss in program._optimize_ops])
+        opt = program._optimize_ops[-1] if program._optimize_ops else None
+
+        if opt is None:
+            def run_fn(feed_vals, param_vals, rng):
+                pm = {id(p): v for p, v in zip(params, param_vals)}
+                with random_mod.rng_guard(rng):
+                    return _eval_graph(fetch_list, feed_vals, pm)
+            return jax.jit(run_fn), params, None
+
+        optimizer, loss_var = opt
+
+        def loss_fn(param_vals, feed_vals, rng):
+            pm = {id(p): v for p, v in zip(params, param_vals)}
+            with random_mod.rng_guard(rng):
+                outs = _eval_graph(fetch_list + [loss_var], feed_vals, pm)
+            return outs[-1].sum(), outs[:-1]
+
+        def run_fn(feed_vals, param_vals, rng):
+            (loss_val, outs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(param_vals, feed_vals, rng)
+            new_vals, new_state = optimizer._jit_apply(params, param_vals, grads)
+            return outs, new_vals, new_state
+
+        return jax.jit(run_fn), params, (optimizer,)
+
+
+def default_startup_sentinel():
+    from .program import default_startup_program
+    return default_startup_program()

@@ -1,0 +1,87 @@
+"""Static-graph save/load.
+
+Parity: reference ``python/paddle/static/io.py`` (save_inference_model /
+load_inference_model; save/load of program parameters). Inference models serialize
+as StableHLO (same format as jit.save): `path.pdmodel` + `path.pdiparams`.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import io as fio
+from .program import default_main_program
+from .executor import _collect_graph, _eval_graph
+
+
+def save(program, path_prefix, protocol=4):
+    """Persist all parameters reachable from the program."""
+    _, params = _collect_graph(list(program._feeds.values()) +
+                               [t for n in program._nodes
+                                for t in n.args if isinstance(t, Tensor)])
+    state = {p.name or f"param_{i}": p for i, p in enumerate(params)}
+    fio.save(state, path_prefix + ".pdparams")
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    state = fio.load(path_prefix + ".pdparams")
+    _, params = _collect_graph(list(program._feeds.values()) +
+                               [t for n in program._nodes
+                                for t in n.args if isinstance(t, Tensor)])
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in state:
+            p.set_value(state[key].numpy())
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    from jax import export as jax_export
+    program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    _, params = _collect_graph(list(fetch_vars))
+    param_vals = [p._value for p in params]
+
+    def pure(pvals, *feeds):
+        pm = {id(p): v for p, v in zip(params, pvals)}
+        feed_map = {fv._lazy[1]: v for fv, v in zip(feed_vars, feeds)}
+        outs = _eval_graph(list(fetch_vars), feed_map, pm)
+        return tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(fv._value.shape), fv._value.dtype)
+             for fv in feed_vars]
+    exported = jax_export.export(jax.jit(pure))(
+        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals], *specs)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    fio.save({f"p{i}": Tensor(v) for i, v in enumerate(param_vals)},
+             path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"n_params": len(param_vals),
+                     "feed_names": [fv._lazy[1] for fv in feed_vars]}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (predict_fn, feed_names, fetch_count): predict_fn(*feeds)->outputs."""
+    from jax import export as jax_export
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params = fio.load(path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    pvals = [params[f"p{i}"]._value for i in range(meta["n_params"])]
+
+    def predict(*feeds):
+        vals = [f._value if isinstance(f, Tensor) else jnp.asarray(f)
+                for f in feeds]
+        outs = exported.call(pvals, *vals)
+        return [np.asarray(o) for o in outs]
+
+    return predict, meta["feed_names"], None

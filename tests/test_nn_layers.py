@@ -1,0 +1,179 @@
+"""nn.Layer corpus tests (parity model: reference unittests for nn layers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_matches_numpy():
+    l = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.rand(5, 4).astype("float32"))
+    out = l(x)
+    ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_torch_free_reference():
+    # oracle: explicit im2col conv
+    np.random.seed(0)
+    x = np.random.rand(1, 2, 5, 5).astype("float32")
+    w = np.random.rand(3, 2, 3, 3).astype("float32")
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    ref = np.zeros((1, 3, 5, 5), "float32")
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    for oc in range(3):
+        for i in range(5):
+            for j in range(5):
+                ref[0, oc, i, j] = np.sum(xp[0, :, i:i + 3, j:j + 3] * w[oc])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_grad_flows():
+    conv = nn.Conv2D(3, 4, 3, padding=1)
+    x = paddle.to_tensor(np.random.rand(2, 3, 8, 8).astype("float32"))
+    loss = conv(x).sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert conv.weight.grad.shape == [4, 3, 3, 3]
+
+
+def test_conv2d_transpose_shape():
+    x = paddle.to_tensor(np.random.rand(1, 4, 8, 8).astype("float32"))
+    ct = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    assert ct(x).shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor((np.random.rand(4, 3, 8, 8) * 5 + 2).astype("float32"))
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 8, 8]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.to_tensor(np.random.rand(4, 16).astype("float32"))
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), "float32"))
+    d.train()
+    y = d(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[1, 2, 0]]))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(4))
+
+
+def test_mha_self_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(np.random.rand(2, 5, 16).astype("float32"))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder_grad():
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 2)
+    x = paddle.to_tensor(np.random.rand(2, 6, 16).astype("float32"),
+                         stop_gradient=False)
+    out = enc(x)
+    out.sum().backward()
+    assert x.grad is not None
+    n_with_grad = sum(1 for p in enc.parameters() if p.grad is not None)
+    assert n_with_grad == len(enc.parameters())
+
+
+def test_lstm_shapes():
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.rand(3, 7, 8).astype("float32"))
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 7, 32]
+    assert h.shape == [4, 3, 16]
+
+
+def test_sequential_and_containers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(m) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.random.rand(4, 5).astype("float32"))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    l = nn.CrossEntropyLoss()(logits, labels)
+    # numpy oracle
+    z = logits.numpy()
+    lse = np.log(np.exp(z).sum(-1))
+    ref = (lse - z[np.arange(4), [0, 1, 2, 3]]).mean()
+    np.testing.assert_allclose(float(l), ref, rtol=1e-5)
+
+    pred = paddle.to_tensor(np.random.rand(4).astype("float32"))
+    tgt = paddle.to_tensor(np.random.rand(4).astype("float32"))
+    np.testing.assert_allclose(
+        float(nn.MSELoss()(pred, tgt)),
+        ((pred.numpy() - tgt.numpy()) ** 2).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.to_tensor(np.random.rand(4, 5).astype("float32"))
+    labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+    l = float(nn.CrossEntropyLoss(ignore_index=-100)(logits, labels))
+    z = logits.numpy()
+    lse = np.log(np.exp(z).sum(-1))
+    per = lse - z[np.arange(4), [0, 0, 2, 0]]
+    ref = per[[0, 2]].mean()
+    np.testing.assert_allclose(l, ref, rtol=1e-5)
+
+
+def test_activations_match_numpy():
+    x = paddle.to_tensor(np.linspace(-3, 3, 13).astype("float32"))
+    np.testing.assert_allclose(F.relu(x).numpy(), np.maximum(x.numpy(), 0))
+    np.testing.assert_allclose(
+        F.sigmoid(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    sm = F.softmax(x).numpy()
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+
+
+def test_clip_grad_by_global_norm():
+    p1 = paddle.Parameter(np.ones((2, 2), "float32"))
+    p2 = paddle.Parameter(np.ones((3,), "float32"))
+    g1 = paddle.to_tensor(np.full((2, 2), 3.0, "float32"))
+    g2 = paddle.to_tensor(np.full((3,), 4.0, "float32"))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)

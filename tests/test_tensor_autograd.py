@@ -214,3 +214,34 @@ def test_where_gather_scatter():
     cond = paddle.to_tensor([True, False, True, False])
     np.testing.assert_allclose(
         paddle.where(cond, x, -x).numpy(), [1, -2, 3, -4])
+
+
+def test_pad_paddle_convention():
+    # first pair pads the LAST dim (paddle convention)
+    x = paddle.to_tensor(np.zeros((1, 1, 2, 3), "float32"))
+    import paddle_tpu.nn.functional as F
+    assert F.pad(x, [1, 0, 0, 0]).shape == [1, 1, 2, 4]
+    assert F.pad(x, [0, 0, 1, 1]).shape == [1, 1, 4, 3]
+
+
+def test_chunk_uneven_and_split_errors():
+    c = paddle.chunk(paddle.to_tensor(np.arange(5.0)), 2)
+    assert [t.shape[0] for t in c] == [3, 2]
+    with pytest.raises(ValueError):
+        paddle.split(paddle.to_tensor(np.arange(5.0)), 2)
+
+
+def test_grad_does_not_touch_other_leaves():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (gx,) = paddle.framework.grad((w * x).sum(), [x])
+    assert w.grad is None
+    np.testing.assert_allclose(gx.numpy(), [1.0])
+
+
+def test_topk_grad_single_pass():
+    t = paddle.to_tensor(np.array([3.0, 1.0, 2.0]), stop_gradient=False)
+    vals, idx = paddle.topk(t, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [1, 0, 1])
+    np.testing.assert_array_equal(idx.numpy(), [0, 2])
