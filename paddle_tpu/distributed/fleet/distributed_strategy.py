@@ -1,0 +1,120 @@
+"""DistributedStrategy.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/base/distributed_strategy.py``
+wrapping ``framework/distributed_strategy.proto:26-66`` (RecomputeConfig,
+ShardingConfig, HybridConfig, AMPConfig...). Plain python dataclasses replace the
+protobuf — the strategy feeds mesh construction and the compiled-step builder
+instead of a meta-optimizer pass chain.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+
+
+@dataclass
+class RecomputeConfig:
+    checkpoints: list = field(default_factory=list)
+    enable_offload: bool = False
+
+
+@dataclass
+class AMPConfig:
+    init_loss_scaling: float = 32768.0
+    use_dynamic_loss_scaling: bool = True
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_pure_fp16: bool = False
+    use_bf16: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+
+
+@dataclass
+class ShardingConfig:
+    sharding_degree: int = 1
+    stage: int = 1
+    offload: bool = False
+    accumulate_steps: int = 1
+
+
+@dataclass
+class PipelineConfig:
+    accumulate_steps: int = 1
+    micro_batch_size: int = 1
+    schedule_mode: str = "1F1B"
+
+
+@dataclass
+class TensorParallelConfig:
+    tensor_parallel_degree: int = 1
+    tensor_init_seed: int = -1
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = AMPConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = TensorParallelConfig()
+        self.hybrid_configs = HybridConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # XLA fuses; advisory
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1  # parity no-op
+
+    def _set_hybrid(self, cfg: dict):
+        hc = self.hybrid_configs
+        for k, v in cfg.items():
+            if hasattr(hc, k):
+                setattr(hc, k, v)
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict):
+            if "hybrid_configs" not in self.__dict__:
+                object.__setattr__(self, "hybrid_configs", HybridConfig())
+            self._set_hybrid(v)
+            return
+        if k == "sharding_configs" and isinstance(v, dict):
+            sc = self.__dict__.get("sharding_configs", ShardingConfig())
+            for kk, vv in v.items():
+                if hasattr(sc, kk):
+                    setattr(sc, kk, vv)
+            object.__setattr__(self, "sharding_configs", sc)
+            return
+        if k == "amp_configs" and isinstance(v, dict):
+            ac = self.__dict__.get("amp_configs", AMPConfig())
+            for kk, vv in v.items():
+                if hasattr(ac, kk):
+                    setattr(ac, kk, vv)
+            object.__setattr__(self, "amp_configs", ac)
+            return
+        if k == "pipeline_configs" and isinstance(v, dict):
+            pc = self.__dict__.get("pipeline_configs", PipelineConfig())
+            for kk, vv in v.items():
+                if hasattr(pc, kk):
+                    setattr(pc, kk, vv)
+            object.__setattr__(self, "pipeline_configs", pc)
+            return
+        object.__setattr__(self, k, v)

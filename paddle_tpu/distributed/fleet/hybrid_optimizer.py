@@ -1,0 +1,51 @@
+"""HybridParallelOptimizer.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_optimizer.py:187`` — wraps the inner optimizer,
+makes global-norm grad clipping topology-aware, and fuses mp/pp grad sync.
+
+TPU-native: inside the compiled step a global norm over sharded grads IS the
+correct cross-group norm — jnp.sum over a GSPMD-sharded grad lowers to a psum
+over every mesh axis the grad is partitioned on (dp/sharding via batch, mp via
+weight sharding). So the reference's per-group partial-norm + allreduce dance
+(_dygraph_clip in hybrid_parallel_optimizer.py) reduces to the plain
+ClipGradByGlobalNorm math executed under pjit.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Optimizer
+from ...nn.clip import ClipGradByGlobalNorm
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, **kw):
+        self._inner_opt.clear_grad(**kw)
+
+    def minimize(self, loss, **kw):
+        return self._inner_opt.minimize(loss, **kw)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+
+class HybridParallelGradScaler:
+    """Parity: hybrid_parallel_gradscaler.py:24 — the found-inf flag must agree
+    across ranks; with a single compiled step the isfinite-reduction is already
+    global, so this is the plain GradScaler."""
+
+    def __new__(cls, scaler, hcg=None):
+        return scaler

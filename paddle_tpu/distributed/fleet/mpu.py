@@ -1,0 +1,218 @@
+"""Megatron-style tensor-parallel layers + RNG state tracker.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_layers.py``
+(:38 VocabParallelEmbedding, :176 ColumnParallelLinear, :335 RowParallelLinear,
+:501 ParallelCrossEntropy), ``mpu/mp_ops.py`` (_c_identity/_c_concat/...), and
+``mpu/random.py:35 RNGStatesTracker``.
+
+TPU-native redesign (GSPMD): a parallel layer holds the FULL logical weight and
+attaches a PartitionSpec via ``param.sharding_spec``. Under the compiled train
+step (pjit over the hybrid mesh) XLA partitions the weight over ``mp`` and
+inserts exactly the identity/allreduce/allgather pattern Megatron hand-codes:
+column-parallel matmul produces output sharded on the feature dim; feeding it to
+a row-parallel matmul consumes that sharding and psums the partial results. On a
+single chip the same layers run unsharded — parity with the degenerate mp=1
+path. gather_output / input_is_parallel toggle output/input PartitionSpecs.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...framework.tensor import Tensor
+from ...framework import random as random_mod
+from ...ops._dispatch import apply, unwrap
+from ..mesh import get_hybrid_communicate_group
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """mp-aware RNG streams (mpu/random.py:35): dropout inside mp regions must
+    differ per mp rank; outside they must agree. jax keys make this exact: the
+    tracked stream folds in the mp axis index when inside a compiled mp region."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            self.states_[name] = jax.random.key(0)
+        key = self.states_[name]
+        try:
+            # fold in mp coordinate when tracing inside an mp shard_map region
+            axis_env = None
+            try:
+                idx = jax.lax.axis_index("mp")
+                key = jax.random.fold_in(key, idx)
+            except NameError:
+                pass
+            except Exception:
+                pass
+            key, sub = jax.random.split(key)
+            self.states_[name] = key
+            with random_mod.rng_guard(sub):
+                yield
+        finally:
+            pass
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import numpy as np
+    global _RNG_STATE_TRACKER
+    _RNG_STATE_TRACKER = RNGStatesTracker()
+    basic = seed if seed is not None else np.random.randint(0, 2 ** 31)
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, basic + 1024)
+    random_mod.seed(basic)
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:38)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_spec = P("mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(nn.Layer):
+    """W [in, out] sharded on out over mp (mp_layers.py:176)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_spec = P(None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.sharding_spec = P("mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            # keep activation sharded on the feature dim over mp
+            out = with_sharding_constraint(out, P(*([None] * (out.ndim - 1)), "mp"))
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """W [in, out] sharded on in over mp; partial results psum over mp
+    (mp_layers.py:335)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding_spec = P("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.sharding_spec = P()
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # GSPMD: x sharded on last dim (from column-parallel) ⊗ W sharded on in
+        # → partial matmul + all-reduce inserted by the partitioner
+        out = F.linear(x, self.weight, self.bias)
+        out = with_sharding_constraint(out, P(*([None] * out.ndim)))
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over mp-sharded logits (mp_layers.py:501). GSPMD computes
+    the softmax reduction over the sharded class dim with an mp psum."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        from ...ops.manipulation import unsqueeze
+        return unsqueeze(loss, -1)
+
+
+def with_sharding_constraint(t, spec):
+    """Annotate intermediate sharding (the _c_identity/_c_split analog)."""
+    from ..mesh import get_global_mesh
+    mesh = get_global_mesh()
+    if mesh is None:
+        return t
+
+    def f(v):
+        try:
+            from jax.sharding import NamedSharding
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec))
+        except (ValueError, RuntimeError):
+            return v  # outside jit on non-mesh values
+
+    return apply(f, t, op_name="sharding_constraint")
+
+
+# mp_ops parity shims -------------------------------------------------------
+
+def _c_identity(tensor, group=None):
+    return tensor
+
+
+def _c_concat(tensor, group=None):
+    return with_sharding_constraint(
+        tensor, P(*([None] * unwrap(tensor).ndim)))
+
+
+def _c_split(tensor, group=None):
+    v = unwrap(tensor)
+    return with_sharding_constraint(
+        tensor, P(*([None] * (v.ndim - 1)), "mp"))
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    return tensor  # inserted by GSPMD at the row-parallel boundary

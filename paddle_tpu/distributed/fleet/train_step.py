@@ -1,0 +1,195 @@
+"""ParallelTrainStep — the compiled hybrid-parallel training engine.
+
+This is the TPU-native replacement for the whole tower the reference builds out
+of: HybridParallelOptimizer (dygraph_optimizer/hybrid_parallel_optimizer.py:187),
+the EagerReducer fused allreduce (collective/reducer.h), sharding stages 1-3
+(group_sharded_optimizer_stage2.py:53, group_sharded_stage3.py:61), and the
+meta-optimizer program rewrites.
+
+One pjit-compiled pure function computes loss, grads, and the optimizer update:
+- batch sharded over (dp, sharding)  → XLA emits the fused gradient
+  reduce-scatter/all-reduce at the optimal schedule point
+- params carry PartitionSpecs (mp from mpu layers; optional ZeRO dim-0 sharding
+  over `sharding`)                    → GSPMD partitions matmuls over ICI
+- optimizer accumulators sharded over `sharding` (ZeRO stage-1 semantics by
+  default; stage 3 also shards params)
+- params + opt state donated          → in-place update, zero copy
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from ...framework.tensor import Tensor, Parameter
+from ...framework import random as random_mod
+from ...framework.tape import no_grad_guard
+from ...jit.api import _bind_values
+from ..mesh import get_hybrid_communicate_group
+
+DATA_AXES = ("dp", "sharding")  # batch dim sharding (paddle hybrid semantics)
+
+
+def _param_spec(p, zero_stage, mesh):
+    spec = getattr(p, "sharding_spec", None) or P()
+    if zero_stage >= 3 and mesh.shape["sharding"] > 1:
+        # ZeRO-3: additionally shard the largest free dim over `sharding`
+        parts = list(spec) + [None] * (len(p.shape) - len(spec))
+        for i, (s, dim) in enumerate(zip(parts, p.shape)):
+            if s is None and dim % mesh.shape["sharding"] == 0 and dim > 1:
+                parts[i] = "sharding"
+                break
+        spec = P(*parts)
+    return spec
+
+
+def _state_spec(p_spec, shape, mesh, zero_stage):
+    """Optimizer accumulator sharding: follow the param, plus ZeRO>=1 shards a
+    free dim over `sharding`."""
+    if len(shape) == 0:
+        return P()
+    parts = list(p_spec) + [None] * (len(shape) - len(p_spec))
+    parts = parts[: len(shape)]
+    if zero_stage >= 1 and mesh.shape["sharding"] > 1:
+        for i, (s, dim) in enumerate(zip(parts, shape)):
+            if s is None and dim % mesh.shape["sharding"] == 0 and dim > 1:
+                parts[i] = "sharding"
+                break
+    return P(*parts)
+
+
+class ParallelTrainStep:
+    """Build once per (model, optimizer, loss_fn); call with batches."""
+
+    def __init__(self, model, optimizer, loss_fn, hcg=None, zero_stage=1,
+                 batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn  # loss_fn(model, *batch_tensors) -> scalar Tensor
+        self.hcg = hcg or get_hybrid_communicate_group()
+        self.mesh = self.hcg.mesh
+        self.zero_stage = zero_stage
+        self.accumulate_steps = accumulate_steps
+        self.data_axes = tuple(a for a in data_axes if self.mesh.shape[a] >= 1)
+        self.batch_spec = batch_spec
+        self._params = [p for p in model.parameters() if p.trainable]
+        self._buffers = [b for b in model.buffers()]
+        self._compiled = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _pure_step(self, param_vals, state_vals, buffer_vals, key, lr,
+                   *batch_vals):
+        params, buffers = self._params, self._buffers
+
+        def compute_loss(pvals):
+            # no_grad: grads come from jax.value_and_grad tracing, not the tape —
+            # skipping per-op vjp recording halves trace work
+            with _bind_values(params, pvals), \
+                    _bind_values(buffers, buffer_vals), \
+                    random_mod.rng_guard(key), no_grad_guard():
+                batch = [Tensor(v) for v in batch_vals]
+                loss = self.loss_fn(self.model, *batch)
+                new_buf = [b._value for b in buffers]
+            return loss._value, new_buf
+
+        (loss_val, new_buf), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(list(param_vals))
+
+        # restore optimizer accumulators from carried state, then step
+        with no_grad_guard():
+            if state_vals is not None:
+                self.optimizer._restore_jit_state(state_vals)
+            new_vals, new_state = self.optimizer._jit_apply(
+                params, param_vals, grads, lr=lr)
+        return loss_val, new_vals, new_state, new_buf
+
+    # ------------------------------------------------------------------
+    def _build(self, batch_vals):
+        mesh = self.mesh
+        param_vals = [p._value for p in self._params]
+        buffer_vals = [b._value for b in self._buffers]
+        key = random_mod.next_key()
+        lr0 = self.optimizer.get_lr()
+
+        # discover optimizer state structure abstractly
+        state_shapes = jax.eval_shape(
+            lambda pv, bv, k, lr, *b: self._pure_step(pv, None, bv, k, lr, *b),
+            param_vals, buffer_vals, key, lr0, *batch_vals)[2]
+
+        p_specs = [_param_spec(p, self.zero_stage, mesh) for p in self._params]
+        s_specs = []
+        for (name, pid), shp in zip(self.optimizer._jit_state_keys,
+                                    state_shapes):
+            p_idx = next(i for i, p in enumerate(self._params)
+                         if id(p) == pid)
+            s_specs.append(_state_spec(p_specs[p_idx], shp.shape, mesh,
+                                       self.zero_stage))
+
+        if self.batch_spec is not None:
+            b_specs = list(self.batch_spec)
+        else:
+            b_specs = [P(self.data_axes, *([None] * (np.ndim(v) - 1)))
+                       for v in batch_vals]
+
+        ns = lambda spec: NamedSharding(mesh, spec)
+        in_shardings = (
+            [ns(s) for s in p_specs],
+            [ns(s) for s in s_specs],
+            [ns(P()) for _ in buffer_vals],
+            ns(P()),  # rng key
+            ns(P()),  # lr
+            *[ns(s) for s in b_specs],
+        )
+        out_shardings = (
+            ns(P()),
+            [ns(s) for s in p_specs],
+            [ns(s) for s in s_specs],
+            [ns(P()) for _ in buffer_vals],
+        )
+        self._compiled = jax.jit(
+            self._pure_step,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0, 1),
+        )
+        # place params/state on the mesh with their shardings
+        for p, spec in zip(self._params, p_specs):
+            p._value = jax.device_put(p._value, ns(spec))
+        self._state_specs = s_specs
+        self._param_specs = p_specs
+
+        # materialize initial state (zeros) with correct shardings
+        init_state = []
+        for (name, pid), shp, spec in zip(self.optimizer._jit_state_keys,
+                                          state_shapes, s_specs):
+            acc = self.optimizer._accumulators[name][pid]
+            v = acc._value
+            if isinstance(v, jax.core.Tracer) or not isinstance(v, jax.Array):
+                v = jnp.zeros(shp.shape, shp.dtype)  # leaked abstract value
+            acc._value = v
+            init_state.append(jax.device_put(v, ns(spec)))
+        self._state_vals = init_state
+
+    # ------------------------------------------------------------------
+    def __call__(self, *batch):
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        if self._compiled is None:
+            self._build(batch_vals)
+        key = random_mod.next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        param_vals = [p._value for p in self._params]
+        buffer_vals = [b._value for b in self._buffers]
+        loss, new_params, new_state, new_buf = self._compiled(
+            param_vals, self._state_vals, buffer_vals, key, lr, *batch_vals)
+        for p, v in zip(self._params, new_params):
+            p._value = v
+        for b, v in zip(self._buffers, new_buf):
+            b._value = v
+        self._state_vals = new_state
+        self._step_count += 1
+        return Tensor(loss)
+
+    train_batch = __call__

@@ -1,0 +1,225 @@
+"""Device mesh + communication topology.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/base/topology.py``
+(:53 CommunicateTopology, :139 HybridCommunicateGroup) — the 4-D (dp × pp ×
+sharding × mp) process topology whose per-axis communicators drive every hybrid
+strategy.
+
+TPU-native redesign: the topology IS a ``jax.sharding.Mesh`` with named axes.
+A "communication group" is not an NCCL communicator but a mesh axis name — XLA
+emits the collectives over ICI when a pjit/shard_map program references the axis.
+Axis order puts ``pp`` outermost (slowest/DCN-friendly) and ``mp`` innermost
+(fastest ICI), following the scaling-book placement rule; ``sp``/``ep`` alias the
+mp/sharding axes by default, as Ulysses/expert layouts do.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+# canonical axis order, outermost → innermost
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+_global_mesh: Mesh | None = None
+_hcg: "HybridCommunicateGroup | None" = None
+
+
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None) -> Mesh:
+    """Create the global mesh over all (or given) devices."""
+    devices = devices if devices is not None else jax.devices()
+    need = dp * mp * pp * sharding * sep
+    if need > len(devices):
+        raise ValueError(
+            f"topology dp={dp} mp={mp} pp={pp} sharding={sharding} sep={sep} "
+            f"needs {need} devices, have {len(devices)}")
+    devices = np.asarray(devices[:need]).reshape(pp, dp, sharding, sep, mp)
+    return Mesh(devices, AXIS_ORDER)
+
+
+def set_global_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Mesh | None:
+    return _global_mesh
+
+
+def get_hybrid_communicate_group() -> "HybridCommunicateGroup | None":
+    return _hcg
+
+
+def _set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+class Group:
+    """A communication group = one (or more) mesh axis.
+
+    Parity: the per-axis groups HybridCommunicateGroup builds with new_group
+    (topology.py:139). `axis_name` is what compiled code passes to lax
+    collectives; `nranks`/`rank` mirror the reference's group interface.
+    """
+
+    _next_gid = 0
+
+    def __init__(self, axis_name, mesh=None, ranks=None, backend="xla"):
+        self.axis_name = axis_name  # str or tuple[str]
+        self.mesh = mesh if mesh is not None else _global_mesh
+        self.backend = backend
+        self.id = Group._next_gid
+        Group._next_gid += 1
+        self._ranks = ranks
+
+    @property
+    def nranks(self):
+        if self.mesh is None:
+            return 1
+        axes = (self.axis_name,) if isinstance(self.axis_name, str) \
+            else tuple(self.axis_name)
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        from . import env
+        return env.get_rank()
+
+    def get_group_rank(self, rank=None):
+        return 0 if self.nranks <= 1 else (rank or 0)
+
+    @property
+    def process_ids(self):
+        return list(range(self.nranks))
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, nranks={self.nranks})"
+
+
+@dataclass
+class CommunicateTopology:
+    """Parity shell for topology.py:53 — maps axis names to degrees/coords."""
+
+    hybrid_group_names: tuple = ("data", "pipe", "sharding", "model")
+    dims: tuple = (1, 1, 1, 1)
+
+    def get_dim(self, name):
+        return self.dims[self.hybrid_group_names.index(name)]
+
+    def world_size(self):
+        return int(np.prod(self.dims))
+
+
+class HybridCommunicateGroup:
+    """The hybrid topology object every fleet component consults.
+
+    Parity: topology.py:139. Mirrors get_model_parallel_group() etc.; here each
+    returns an axis-named Group over the global Mesh.
+    """
+
+    def __init__(self, topology: CommunicateTopology = None, *, dp_degree=None,
+                 mp_degree=None, pp_degree=None, sharding_degree=None,
+                 sep_degree=1, mesh=None):
+        if topology is not None and dp_degree is None:
+            names = topology.hybrid_group_names
+            get = lambda n: (topology.dims[names.index(n)]
+                             if n in names else 1)
+            dp_degree = get("data")
+            pp_degree = get("pipe")
+            sharding_degree = get("sharding")
+            mp_degree = get("model")
+        self._dp_degree = dp_degree or 1
+        self._mp_degree = mp_degree or 1
+        self._pp_degree = pp_degree or 1
+        self._sharding_degree = sharding_degree or 1
+        self._sep_degree = sep_degree or 1
+        self.mesh = mesh if mesh is not None else build_mesh(
+            dp=self._dp_degree, mp=self._mp_degree, pp=self._pp_degree,
+            sharding=self._sharding_degree, sep=self._sep_degree)
+        set_global_mesh(self.mesh)
+        _set_hcg(self)
+        self._topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (self._dp_degree, self._pp_degree, self._sharding_degree,
+             self._mp_degree))
+
+    # --- degrees (parity: topology.py:145-148) ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # --- groups ---
+    def get_data_parallel_group(self):
+        return Group("dp", self.mesh)
+
+    def get_model_parallel_group(self):
+        return Group("mp", self.mesh)
+
+    def get_pipe_parallel_group(self):
+        return Group("pp", self.mesh)
+
+    def get_sharding_parallel_group(self):
+        return Group("sharding", self.mesh)
+
+    def get_sep_parallel_group(self):
+        return Group("sep", self.mesh)
+
+    def get_check_parallel_group(self):
+        return Group(("pp", "dp", "sharding", "sep", "mp"), self.mesh)
+
+    def topology(self):
+        return self._topo
+
+    def get_global_group(self):
+        return Group(tuple(AXIS_ORDER), self.mesh)
+
+    # --- ranks: single-controller SPMD has no per-process rank for mesh axes;
+    # these exist for API parity and multi-process launches ---
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def __repr__(self):
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, "
+                f"mp={self._mp_degree}, pp={self._pp_degree}, "
+                f"sharding={self._sharding_degree}, sep={self._sep_degree})")
+
+
+def named_sharding(*spec) -> NamedSharding:
+    mesh = get_global_mesh()
+    if mesh is None:
+        raise RuntimeError("no global mesh; call fleet.init or build_mesh first")
+    return NamedSharding(mesh, PartitionSpec(*spec))

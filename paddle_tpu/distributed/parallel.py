@@ -1,0 +1,132 @@
+"""Process bootstrap + DataParallel.
+
+Parity: ``/root/reference/python/paddle/distributed/parallel.py:108
+init_parallel_env`` (TCPStore rendezvous + default ProcessGroup) and
+``python/paddle/fluid/dygraph/parallel.py`` DataParallel (+ C++ EagerReducer,
+collective/reducer.h:42).
+
+TPU-native: rendezvous is ``jax.distributed.initialize`` (its coordination
+service is the TCPStore analog); the default "process group" is the dp axis of
+the global mesh. DataParallel needs no bucketing reducer — in the compiled train
+step the batch is sharded over dp, so XLA emits one fused reduce-scatter/all-
+reduce for the gradient tree at the optimum point in the schedule, which is
+exactly what EagerReducer's group-by-size fusion approximates by hand.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import env as env_mod
+from .mesh import build_mesh, set_global_mesh, get_global_mesh, Group
+from .collective import _set_default_group
+
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstrap multi-process (multi-host) or single-process multi-device."""
+    global _initialized
+    if _initialized:
+        return env_mod.ParallelEnv()
+    world = env_mod.get_world_size()
+    if world > 1 and "PADDLE_TRAINER_ENDPOINTS" in os.environ:
+        eps = env_mod.get_endpoints()
+        coordinator = eps[0]
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world,
+            process_id=env_mod.get_rank())
+    mesh = build_mesh(dp=len(jax.devices()))
+    set_global_mesh(mesh)
+    _set_default_group(Group("dp", mesh))
+    _initialized = True
+    return env_mod.ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity wrapper.
+
+    Eager single-controller: forward passes through; gradients are correct by
+    construction once the step runs under the compiled dp-sharded path
+    (fleet.distributed_model + to_static / ParallelTrainStep). The
+    comm_buffer_size/last_comm_buffer_size knobs are accepted for parity; XLA's
+    scheduler owns fusion so they are advisory no-ops.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, state_dict, **kw):
+        return self._layers.set_state_dict(state_dict, **kw)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # grads reduced inside the compiled step (see class docstring)
+
+
+ParallelEnv = env_mod.ParallelEnv
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity: fork `nprocs` python processes with the
+    PADDLE_* env contract on localhost."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 0:
+        nprocs = max(1, len(jax.devices()))
+
+    def find_free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [find_free_port() for _ in range(nprocs)]
+    eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        child_env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": eps,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{ports[rank]}",
+        }
+        p = ctx.Process(target=_spawn_entry, args=(func, args, child_env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process failed: {p.exitcode}")
+    return procs
+
+
+def _spawn_entry(func, args, child_env):
+    os.environ.update(child_env)
+    func(*args)

@@ -1,0 +1,46 @@
+"""group_sharded (ZeRO) API.
+
+Parity: ``/root/reference/python/paddle/distributed/sharding/group_sharded.py:37
+group_sharded_parallel`` routing to stage1/2/3
+(fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53,
+group_sharded_stage2.py:46, group_sharded_stage3.py:61).
+
+TPU-native: ZeRO is a sharding-spec choice, not a runtime. The stages map to how
+the compiled step (fleet/train_step.py) shards state over the `sharding` axis:
+  stage 1 (os)      → optimizer accumulators sharded
+  stage 2 (os_g)    → + gradients reduce-scattered (XLA does this automatically
+                       when the consumer-side state is sharded)
+  stage 3 (p_g_os)  → + parameters sharded, all-gathered on use
+This function records the stage on the model; fleet.distributed_model /
+ParallelTrainStep pick it up.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+_STAGE_MAP = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    if level not in _STAGE_MAP:
+        raise ValueError(f"level must be one of {list(_STAGE_MAP)}")
+    stage = _STAGE_MAP[level]
+    model._zero_stage = stage
+    optimizer._zero_stage = stage
+    if offload:
+        model._zero_offload = True  # host offload: orbax/jax.device_put(host) later
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Parity: each rank saves its slice in the reference; single-controller
+    saves the global state once."""
+    from ..framework import io as fio
+    fio.save(model.state_dict(), output + ".pdmodel.pdparams")
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(), output + ".pdopt")

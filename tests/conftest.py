@@ -17,5 +17,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+# The axon sitecustomize force-selects the TPU platform via jax.config — the env
+# var alone is ignored. Re-force CPU here so tests get the 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # Numeric-oracle tests need exact f32 matmuls; production default stays MXU bf16.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
