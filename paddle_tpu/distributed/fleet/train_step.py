@@ -51,7 +51,8 @@ def _state_spec(p_spec, shape, mesh, zero_stage):
         return P()
     parts = list(p_spec) + [None] * (len(shape) - len(p_spec))
     parts = parts[: len(shape)]
-    if zero_stage >= 1 and mesh.shape["sharding"] > 1:
+    if zero_stage >= 1 and mesh.shape["sharding"] > 1 and \
+            "sharding" not in parts:
         for i, (s, dim) in enumerate(zip(parts, shape)):
             if s is None and dim % mesh.shape["sharding"] == 0 and dim > 1:
                 parts[i] = "sharding"
@@ -165,9 +166,10 @@ class ParallelTrainStep:
         for (name, pid), shp, spec in zip(self.optimizer._jit_state_keys,
                                           state_shapes, s_specs):
             acc = self.optimizer._accumulators[name][pid]
-            v = acc._value
-            if isinstance(v, jax.core.Tracer) or not isinstance(v, jax.Array):
-                v = jnp.zeros(shp.shape, shp.dtype)  # leaked abstract value
+            v = self.optimizer._init_acc_value(name, pid)
+            if v is None:
+                v = jnp.zeros(shp.shape, shp.dtype)
+            v = v.astype(shp.dtype) if v.dtype != shp.dtype else v
             acc._value = v
             init_state.append(jax.device_put(v, ns(spec)))
         self._state_vals = init_state

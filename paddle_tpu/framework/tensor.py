@@ -289,7 +289,8 @@ jax.tree_util.register_pytree_node(
 class Parameter(Tensor):
     """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "sharding_spec", "is_distributed")
 
     def __init__(self, data, dtype=None, name=None, trainable: bool = True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -299,18 +300,25 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        # PartitionSpec over the hybrid mesh (mpu layers set this; consumed by
+        # ParallelTrainStep when laying params onto the mesh)
+        self.sharding_spec = None
+        self.is_distributed = False
 
 
 jax.tree_util.register_pytree_node(
     Parameter,
-    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda t: ((t._value,), (t.stop_gradient, t.name, t.sharding_spec,
+                             t.is_distributed)),
     lambda meta, vals: _unflatten_param(meta, vals),
 )
 
 
 def _unflatten_param(meta, vals):
-    sg, name = meta
+    sg, name, spec, is_dist = meta
     p = Parameter(vals[0], name=name, trainable=not sg)
+    p.sharding_spec = spec
+    p.is_distributed = is_dist
     return p
 
 

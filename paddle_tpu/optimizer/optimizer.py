@@ -38,6 +38,7 @@ class Optimizer:
             weight_decay = L2Decay(weight_decay)
         self._regularization = weight_decay
         self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
+        self._acc_factories: dict[str, dict[int, object]] = {}
         self._global_step = 0
         self.helper = None
 
@@ -76,9 +77,30 @@ class Optimizer:
                     v = pending[sk]
                     restored = Tensor(v._value if isinstance(v, Tensor)
                                       else jnp.asarray(v))
-            d[key] = restored if restored is not None else Tensor(
-                jnp.zeros(p.shape, unwrap(p).dtype) if init is None else init)
+            # `init` may be a zero-arg factory: compiled steps (ParallelTrainStep,
+            # static Executor) discover state under an abstract trace, then call
+            # the factory again to materialize the true concrete initial value
+            # (e.g. Adam's beta_pow starts at 1, not 0).
+            if restored is not None:
+                # checkpoint-restored value IS the initial value for any
+                # compiled step built afterwards
+                factory = lambda r=restored._value: r
+            elif callable(init):
+                factory = init
+            elif init is None:
+                shape, dt = tuple(p.shape), unwrap(p).dtype
+                factory = lambda shape=shape, dt=dt: jnp.zeros(shape, dt)
+            else:
+                factory = lambda iv=init: iv
+            self._acc_factories.setdefault(name, {})[key] = factory
+            d[key] = restored if restored is not None else Tensor(factory())
         return d[key]
+
+    def _init_acc_value(self, name, pid):
+        """Concrete initial value of accumulator `name` for param id `pid`
+        (None if unknown). Safe to call outside any trace."""
+        f = self._acc_factories.get(name, {}).get(pid)
+        return f() if f is not None else None
 
     def state_dict(self):
         state = {}
@@ -236,10 +258,10 @@ class Adam(Optimizer):
         compute_dtype = jnp.float32 if pv.dtype in (jnp.float16, jnp.bfloat16) \
             else pv.dtype
         g = g.astype(compute_dtype)
-        m = self._acc("moment1", p, jnp.zeros(pv.shape, compute_dtype))
-        v = self._acc("moment2", p, jnp.zeros(pv.shape, compute_dtype))
-        b1p = self._acc("beta1_pow", p, jnp.ones((), compute_dtype))
-        b2p = self._acc("beta2_pow", p, jnp.ones((), compute_dtype))
+        m = self._acc("moment1", p, lambda s=pv.shape, d=compute_dtype: jnp.zeros(s, d))
+        v = self._acc("moment2", p, lambda s=pv.shape, d=compute_dtype: jnp.zeros(s, d))
+        b1p = self._acc("beta1_pow", p, lambda d=compute_dtype: jnp.ones((), d))
+        b2p = self._acc("beta2_pow", p, lambda d=compute_dtype: jnp.ones((), d))
         b1p._value = unwrap(b1p) * self._beta1
         b2p._value = unwrap(b2p) * self._beta2
         m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
@@ -287,10 +309,10 @@ class Lamb(Optimizer):
     def _update_param(self, p, g, lr):
         pv = unwrap(p).astype(jnp.float32)
         g = g.astype(jnp.float32)
-        m = self._acc("moment1", p, jnp.zeros(pv.shape, jnp.float32))
-        v = self._acc("moment2", p, jnp.zeros(pv.shape, jnp.float32))
-        b1p = self._acc("beta1_pow", p, jnp.ones((), jnp.float32))
-        b2p = self._acc("beta2_pow", p, jnp.ones((), jnp.float32))
+        m = self._acc("moment1", p, lambda s=pv.shape: jnp.zeros(s, jnp.float32))
+        v = self._acc("moment2", p, lambda s=pv.shape: jnp.zeros(s, jnp.float32))
+        b1p = self._acc("beta1_pow", p, lambda: jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, lambda: jnp.ones((), jnp.float32))
         b1p._value = unwrap(b1p) * self._beta1
         b2p._value = unwrap(b2p) * self._beta2
         m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
@@ -316,8 +338,10 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _update_param(self, p, g, lr):
-        acc = self._acc("moment", p,
-                        jnp.full(p.shape, self._init_acc, unwrap(p).dtype))
+        acc = self._acc(
+            "moment", p,
+            lambda s=tuple(p.shape), d=unwrap(p).dtype:
+                jnp.full(s, self._init_acc, d))
         acc._value = unwrap(acc) + jnp.square(g)
         p._value = unwrap(p) - lr * g / (jnp.sqrt(unwrap(acc)) + self._epsilon)
 
@@ -371,7 +395,7 @@ class Adamax(Optimizer):
     def _update_param(self, p, g, lr):
         m = self._acc("moment", p)
         u = self._acc("inf_norm", p)
-        b1p = self._acc("beta1_pow", p, jnp.ones((), jnp.float32))
+        b1p = self._acc("beta1_pow", p, lambda: jnp.ones((), jnp.float32))
         b1p._value = unwrap(b1p) * self._beta1
         m._value = self._beta1 * unwrap(m) + (1 - self._beta1) * g
         u._value = jnp.maximum(self._beta2 * unwrap(u), jnp.abs(g))
