@@ -27,6 +27,10 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer INIT/UNSCALED/STEPPED tracking (reference OptimizerState
+        # in python/paddle/amp/grad_scaler.py) — guards the standard
+        # unscale_-then-clip-then-step pattern against double unscaling
+        self._opt_states = {}
 
     def scale(self, loss):
         if not self._enable:
@@ -36,6 +40,13 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        state, _ = self._opt_states.get(id(optimizer), ("INIT", False))
+        if state == "UNSCALED":
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update().")
+        if state == "STEPPED":
+            raise RuntimeError("unscale_() is being called after step().")
         inv = 1.0 / self._scale
         found = False
         with no_grad_guard():
@@ -46,18 +57,30 @@ class GradScaler:
                 if not bool(jnp.all(jnp.isfinite(g))):
                     found = True
                 p.grad._value = g
-        self._found_inf = found
+        # found_inf is tracked per optimizer (reference OptimizerState); the
+        # scaler-level flag is the OR across optimizers for update()
+        self._found_inf = self._found_inf or found
+        self._opt_states[id(optimizer)] = ("UNSCALED", found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        state, found = self._opt_states.get(id(optimizer), ("INIT", False))
+        if state == "STEPPED":
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if state != "UNSCALED":
+            self.unscale_(optimizer)
+            state, found = self._opt_states[id(optimizer)]
+        if not found:
             optimizer.step()
+        self._opt_states[id(optimizer)] = ("STEPPED", found)
 
     def update(self):
+        self._opt_states.clear()
         if not self._enable or not self._dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -114,6 +114,8 @@ class ParallelTrainStep:
         key = random_mod.next_key()
         lr0 = self.optimizer.get_lr()
 
+        # live/restored accumulator state must survive the discovery trace
+        snapshot = self.optimizer._concrete_state_snapshot()
         # discover optimizer state structure abstractly
         state_shapes = jax.eval_shape(
             lambda pv, bv, k, lr, *b: self._pure_step(pv, None, bv, k, lr, *b),
@@ -161,16 +163,16 @@ class ParallelTrainStep:
         self._state_specs = s_specs
         self._param_specs = p_specs
 
-        # materialize initial state (zeros) with correct shardings
+        # materialize initial state (snapshot > init factory > zeros) with
+        # correct shardings
+        vals = self.optimizer._materialize_jit_state(snapshot)
         init_state = []
-        for (name, pid), shp, spec in zip(self.optimizer._jit_state_keys,
-                                          state_shapes, s_specs):
-            acc = self.optimizer._accumulators[name][pid]
-            v = self.optimizer._init_acc_value(name, pid)
+        for (name, pid), v, shp, spec in zip(self.optimizer._jit_state_keys,
+                                             vals, state_shapes, s_specs):
             if v is None:
                 v = jnp.zeros(shp.shape, shp.dtype)
             v = v.astype(shp.dtype) if v.dtype != shp.dtype else v
-            acc._value = v
+            self.optimizer._accumulators[name][pid]._value = v
             init_state.append(jax.device_put(v, ns(spec)))
         self._state_vals = init_state
 

@@ -101,15 +101,26 @@ class TracedProgram:
         training = self._layer.training if self._layer is not None else False
         key = random_mod.next_key()
 
-        if tape_mod.is_grad_enabled() and self._params:
+        # grads must also flow to non-param inputs (reference run_program
+        # propagates to any stop_gradient=False input — ADVICE r1 fix)
+        diff_arg_idx = [i for i, a in enumerate(args)
+                        if isinstance(a, Tensor) and not a.stop_gradient]
+        if tape_mod.is_grad_enabled() and (self._params or diff_arg_idx):
+            n_p = len(self._params)
+
             # register the whole program as one taped op (run_program parity)
-            def taped(*pvals):
-                out_vals, new_buf = self._jitted(list(pvals), buffer_vals, key,
-                                                 training, *arg_vals)
+            def taped(*vals):
+                pvals = list(vals[:n_p])
+                full_args = list(arg_vals)
+                for i, v in zip(diff_arg_idx, vals[n_p:]):
+                    full_args[i] = v
+                out_vals, new_buf = self._jitted(pvals, buffer_vals, key,
+                                                 training, *full_args)
                 return out_vals, new_buf
 
-            out, aux = tape_mod.apply(taped, *self._params,
-                                      op_name="run_program", has_aux=True)
+            out, aux = tape_mod.apply(
+                taped, *self._params, *[args[i] for i in diff_arg_idx],
+                op_name="run_program", has_aux=True)
             new_buf = aux
         else:
             with tape_mod.no_grad_guard():

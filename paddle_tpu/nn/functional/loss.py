@@ -40,6 +40,15 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 k = logits.shape[axis]
                 tgt = (1.0 - label_smoothing) * tgt + label_smoothing / k
             loss = -jnp.sum(tgt * logp, axis=axis)
+            if w is not None:
+                # reference weights the soft-label path by sum(weight * target);
+                # align the 1-D class weight with the class axis first
+                wshape = [1] * logits.ndim
+                wshape[axis % logits.ndim] = -1
+                sw = jnp.sum(w.reshape(wshape) * tgt, axis=axis)
+                loss = loss * sw
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(sw), 1e-12)
         else:
             li = lab
             if li.ndim == logp.ndim:

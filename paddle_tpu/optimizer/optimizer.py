@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from collections import defaultdict
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -101,6 +102,33 @@ class Optimizer:
         (None if unknown). Safe to call outside any trace."""
         f = self._acc_factories.get(name, {}).get(pid)
         return f() if f is not None else None
+
+    def _concrete_state_snapshot(self):
+        """(name, pid) -> concrete accumulator value for every accumulator that
+        holds a real array (tracers skipped). Take this BEFORE an abstract
+        discovery trace so live training state survives a rebuild."""
+        snap = {}
+        for name, d in self._accumulators.items():
+            for pid, t in d.items():
+                v = t._value
+                if isinstance(v, jax.Array) and not isinstance(v, jax.core.Tracer):
+                    snap[(name, pid)] = v
+        return snap
+
+    def _materialize_jit_state(self, snapshot):
+        """After a discovery trace filled _jit_state_keys, replace any abstract
+        accumulator values with concrete ones — the pre-trace snapshot first
+        (live/restored state), else the registered init factory. Returns values
+        ordered like _jit_state_keys (None where neither source knows)."""
+        out = []
+        for name, pid in self._jit_state_keys:
+            v = snapshot.get((name, pid))
+            if v is None:
+                v = self._init_acc_value(name, pid)
+            if v is not None:
+                self._accumulators[name][pid]._value = v
+            out.append(v)
+        return out
 
     def state_dict(self):
         state = {}

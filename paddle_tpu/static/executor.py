@@ -118,7 +118,7 @@ class Executor:
 
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._build(program, fetch_list)
+            entry = self._build(program, fetch_list, feed_vals)
             self._cache[key] = entry
         jitted, params, opt = entry
 
@@ -127,16 +127,23 @@ class Executor:
         if opt is None:
             outs = jitted(feed_vals, param_vals, rng)
         else:
-            outs, new_param_vals, new_state = jitted(feed_vals, param_vals, rng)
+            # optimizer accumulators/LR are jit INPUTS carried across runs (the
+            # ADVICE r1 fix: without this, Momentum velocity / Adam moments /
+            # scheduler LR were baked in as trace-time constants)
+            opt_obj = opt[0]
+            state_vals = [opt_obj._accumulators[n][k]._value
+                          for n, k in opt_obj._jit_state_keys]
+            lr = jnp.asarray(opt_obj.get_lr(), jnp.float32)
+            outs, new_param_vals, new_state = jitted(
+                feed_vals, param_vals, state_vals, rng, lr)
             for p, nv in zip(params, new_param_vals):
                 p._value = nv
-            opt_obj = opt[0]
             opt_obj._restore_jit_state(new_state)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
 
-    def _build(self, program: Program, fetch_list):
+    def _build(self, program: Program, fetch_list, feed_vals):
         nodes, params = _collect_graph(
             fetch_list + [loss for _, loss in program._optimize_ops])
         opt = program._optimize_ops[-1] if program._optimize_ops else None
@@ -156,13 +163,29 @@ class Executor:
                 outs = _eval_graph(fetch_list + [loss_var], feed_vals, pm)
             return outs[-1].sum(), outs[:-1]
 
-        def run_fn(feed_vals, param_vals, rng):
+        def step_fn(feed_vals, param_vals, state_vals, rng, lr):
             (loss_val, outs), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(param_vals, feed_vals, rng)
-            new_vals, new_state = optimizer._jit_apply(params, param_vals, grads)
+            if state_vals is not None:
+                optimizer._restore_jit_state(state_vals)
+            new_vals, new_state = optimizer._jit_apply(
+                params, param_vals, grads, lr=lr)
             return outs, new_vals, new_state
 
-        return jax.jit(run_fn), params, (optimizer,)
+        # abstract trace with state=None discovers the accumulator structure
+        # (fills optimizer._jit_state_keys); live/restored state is snapshotted
+        # first so a rebuild (new feed signature mid-training) keeps it, and
+        # never-stepped accumulators materialize from their init factories
+        snapshot = optimizer._concrete_state_snapshot()
+        param_vals = [p._value for p in params]
+        rng0 = random_mod.next_key()
+        lr0 = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        jax.eval_shape(
+            lambda fv, pv, rng, lr: step_fn(fv, pv, None, rng, lr),
+            feed_vals, param_vals, rng0, lr0)
+        optimizer._materialize_jit_state(snapshot)
+
+        return jax.jit(step_fn), params, (optimizer,)
 
 
 def default_startup_sentinel():

@@ -1,0 +1,239 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+1. static Executor threads optimizer accumulator state + LR through the jit step
+2. GradScaler guards against double unscaling in unscale_-then-step
+3. to_static propagates grads to stop_gradient=False non-param inputs
+4. cross_entropy applies class weights on the soft-label path
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.jit import to_static
+
+
+def test_static_momentum_carries_velocity():
+    """3 static-mode Momentum steps must match eager Momentum, not plain SGD."""
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((8, 2)).astype(np.float32)
+    y_np = (x_np @ np.array([[1.0], [2.0]], np.float32))
+
+    # eager oracle
+    l_e = nn.Linear(2, 1)
+    w0, b0 = l_e.weight.numpy().copy(), l_e.bias.numpy().copy()
+    o_e = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=l_e.parameters())
+    for _ in range(3):
+        loss = ((l_e(paddle.to_tensor(x_np)) - paddle.to_tensor(y_np)) ** 2).mean()
+        loss.backward()
+        o_e.step()
+        o_e.clear_grad()
+    w_ref = l_e.weight.numpy()
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 2], "float32")
+            yt = static.data("y", [8, 1], "float32")
+            l = nn.Linear(2, 1)
+            l.weight.set_value(w0)
+            l.bias.set_value(b0)
+            loss = ((l(x) - yt) ** 2).mean()
+            mom = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                               parameters=l.parameters())
+            mom.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": x_np, "y": y_np}, fetch_list=[loss])
+        np.testing.assert_allclose(l.weight.numpy(), w_ref, rtol=1e-5, atol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_static_adam_matches_eager():
+    rng = np.random.default_rng(1)
+    x_np = rng.standard_normal((8, 3)).astype(np.float32)
+    y_np = rng.standard_normal((8, 1)).astype(np.float32)
+
+    l_e = nn.Linear(3, 1)
+    w0, b0 = l_e.weight.numpy().copy(), l_e.bias.numpy().copy()
+    o_e = opt.Adam(learning_rate=0.05, parameters=l_e.parameters())
+    ref_losses = []
+    for _ in range(4):
+        loss = ((l_e(paddle.to_tensor(x_np)) - paddle.to_tensor(y_np)) ** 2).mean()
+        loss.backward()
+        o_e.step()
+        o_e.clear_grad()
+        ref_losses.append(float(loss.numpy()))
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 3], "float32")
+            yt = static.data("y", [8, 1], "float32")
+            l = nn.Linear(3, 1)
+            l.weight.set_value(w0)
+            l.bias.set_value(b0)
+            loss = ((l(x) - yt) ** 2).mean()
+            opt.Adam(learning_rate=0.05, parameters=l.parameters()).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": x_np, "y": y_np},
+                                fetch_list=[loss])[0]) for _ in range(4)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_grad_scaler_no_double_unscale():
+    """unscale_-then-step must divide grads by the scale exactly once."""
+    l = nn.Linear(2, 2)
+    o = opt.SGD(learning_rate=0.0, parameters=l.parameters())  # lr=0: params fixed
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    loss = l(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.unscale_(optimizer=o)
+    g_after_unscale = l.weight.grad.numpy().copy()
+    scaler.step(o)  # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(l.weight.grad.numpy(), g_after_unscale)
+    # the unscaled grad equals the plain (unscaled-loss) grad
+    np.testing.assert_allclose(g_after_unscale,
+                               np.tile(x.numpy().sum(0)[:, None], (1, 2)))
+
+
+def test_grad_scaler_double_unscale_raises():
+    l = nn.Linear(2, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=l.parameters())
+    scaler = GradScaler()
+    loss = l(paddle.to_tensor(np.ones((2, 2), np.float32))).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(o)
+    with pytest.raises(RuntimeError):
+        scaler.unscale_(o)
+    scaler.step(o)
+    scaler.update()
+    # after update() the guard resets — next iteration works
+    loss = l(paddle.to_tensor(np.ones((2, 2), np.float32))).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(o)
+    scaler.step(o)
+    scaler.update()
+
+
+def test_to_static_input_grads_flow():
+    m = nn.Linear(4, 4)
+    ms = to_static(m)
+    x_np = np.random.rand(2, 4).astype("float32")
+
+    x_e = paddle.to_tensor(x_np, stop_gradient=False)
+    m(x_e).sum().backward()
+    ref = x_e.grad.numpy()
+    m.clear_gradients()
+
+    x_s = paddle.to_tensor(x_np, stop_gradient=False)
+    ms(x_s).sum().backward()
+    assert x_s.grad is not None, "to_static input grad is None"
+    np.testing.assert_allclose(x_s.grad.numpy(), ref, rtol=1e-5)
+
+
+def test_static_rebuild_preserves_optimizer_state():
+    """A new feed signature mid-training (partial last batch) must not reset
+    Adam moments."""
+    rng = np.random.default_rng(5)
+    x_np = rng.standard_normal((8, 3)).astype(np.float32)
+    y_np = rng.standard_normal((8, 1)).astype(np.float32)
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 3], "float32")
+            yt = static.data("y", [-1, 1], "float32")
+            l = nn.Linear(3, 1)
+            loss = ((l(x) - yt) ** 2).mean()
+            adam = opt.Adam(learning_rate=0.05, parameters=l.parameters())
+            adam.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": x_np, "y": y_np}, fetch_list=[loss])
+        m_before = {k: np.asarray(adam._accumulators["moment1"][pid]._value)
+                    for k, pid in enumerate(adam._accumulators["moment1"])}
+        assert any(np.abs(v).max() > 0 for v in m_before.values())
+        # different batch size -> new cache key -> _build runs again
+        exe.run(main, feed={"x": x_np[:5], "y": y_np[:5]}, fetch_list=[loss])
+        for k, pid in enumerate(adam._accumulators["moment1"]):
+            after = np.asarray(adam._accumulators["moment1"][pid]._value)
+            assert np.abs(after).max() > 0, "rebuild reset Adam moment to zero"
+    finally:
+        static.disable_static()
+
+
+def test_grad_scaler_per_optimizer_found_inf():
+    """inf found in opt1's grads must not be masked by a clean opt2 unscale."""
+    l1, l2 = nn.Linear(2, 2), nn.Linear(2, 2)
+    w1_before = l1.weight.numpy().copy()
+    o1 = opt.SGD(learning_rate=0.1, parameters=l1.parameters())
+    o2 = opt.SGD(learning_rate=0.1, parameters=l2.parameters())
+    scaler = GradScaler(init_loss_scaling=4.0)
+    (l1(paddle.to_tensor(np.ones((2, 2), np.float32))).sum()
+     + l2(paddle.to_tensor(np.ones((2, 2), np.float32))).sum()).backward()
+    l1.weight.grad._value = l1.weight.grad._value * np.inf  # poison opt1
+    scaler.unscale_(o1)
+    scaler.unscale_(o2)  # clean — must not clear opt1's found_inf
+    scaler.step(o1)
+    scaler.step(o2)
+    scaler.update()
+    np.testing.assert_array_equal(l1.weight.numpy(), w1_before)
+    assert not np.array_equal(l2.weight.numpy(), np.zeros_like(w1_before))
+
+
+def test_cross_entropy_soft_label_weight_axis1():
+    """weight must align with the class axis even when it is not last."""
+    rng = np.random.default_rng(6)
+    logits_np = rng.standard_normal((4, 3, 5)).astype(np.float32)  # (N, C, L)
+    soft_np = rng.uniform(size=(4, 3, 5)).astype(np.float32)
+    soft_np /= soft_np.sum(1, keepdims=True)
+    w_np = np.array([0.5, 1.0, 2.0], np.float32)
+
+    out = F.cross_entropy(paddle.to_tensor(logits_np),
+                          paddle.to_tensor(soft_np),
+                          weight=paddle.to_tensor(w_np),
+                          soft_label=True, reduction="mean", axis=1)
+    logp = logits_np - np.log(np.exp(logits_np).sum(1, keepdims=True))
+    per = -(soft_np * logp).sum(1)
+    sw = (w_np[None, :, None] * soft_np).sum(1)
+    ref = (per * sw).sum() / sw.sum()
+    np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-5)
+
+
+def test_cross_entropy_soft_label_weight():
+    rng = np.random.default_rng(2)
+    logits_np = rng.standard_normal((5, 3)).astype(np.float32)
+    soft_np = rng.uniform(size=(5, 3)).astype(np.float32)
+    soft_np /= soft_np.sum(-1, keepdims=True)
+    w_np = np.array([0.2, 1.0, 3.0], np.float32)
+
+    out = F.cross_entropy(paddle.to_tensor(logits_np),
+                          paddle.to_tensor(soft_np),
+                          weight=paddle.to_tensor(w_np),
+                          soft_label=True, reduction="mean")
+    logp = logits_np - np.log(
+        np.exp(logits_np).sum(-1, keepdims=True))
+    per = -(soft_np * logp).sum(-1)
+    sw = (w_np * soft_np).sum(-1)
+    ref = (per * sw).sum() / sw.sum()
+    np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-5)
