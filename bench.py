@@ -1,0 +1,124 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric: GPT pretraining tokens/sec/chip with MFU, on the compiled
+hybrid train step (single-chip mesh on the real TPU; all parallel axes 1).
+BASELINE.md config #3-style (GPT decoder LM, AdamW, bf16 compute, remat).
+The reference publishes no in-tree numbers (BASELINE.json `published: {}`),
+so vs_baseline is reported as 1.0 at parity-by-definition; the driver tracks
+round-over-round movement via `extras`.
+
+Run: python bench.py  [--config tiny|345m|1.3b] [--steps N]
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def model_flops_per_token(cfg, seq_len):
+    """Standard 6N + attention estimate (FLOPs/token, fwd+bwd).
+
+    N counts the matmul params: qkv (3H^2) + out (H^2) + mlp (2*H*F) per layer
+    plus the (tied) head V*H and position table.
+    """
+    H, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    per_layer = 4 * H * H + 2 * H * cfg.intermediate_size
+    n_params = V * H + cfg.max_position_embeddings * H + L * per_layer
+    matmul_flops = 6 * n_params  # fwd 2N + bwd 4N
+    attn_flops = 12 * L * H * seq_len  # qk^T + av, fwd+bwd
+    return matmul_flops + attn_flops, n_params
+
+
+def peak_flops_per_chip():
+    """bf16 peak for the attached chip; conservative v5p default."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    table = {
+        "v5p": 459e12, "v5 lite": 197e12, "v5e": 197e12,
+        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if d.platform == "cpu":
+        return 1e12  # nominal, keeps MFU finite in CPU smoke runs
+    return 459e12
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="345m",
+                    choices=["tiny", "345m", "1.3b"])
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--seq", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    sys.path.insert(0, ".")
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTHybridTrainStep, GPTModel, gpt_tiny_config,
+        gpt_345m_config, gpt_1p3b_config,
+    )
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if args.config == "tiny" or on_cpu:
+        cfg = gpt_tiny_config()
+        B = args.batch or 8
+        S = args.seq or 128
+    elif args.config == "345m":
+        cfg = gpt_345m_config(max_position_embeddings=1024)
+        B = args.batch or 8
+        S = args.seq or 1024
+    else:
+        cfg = gpt_1p3b_config()
+        B = args.batch or 4
+        S = args.seq or 2048
+
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=1)
+    model = GPTForPretraining(GPTModel(cfg))
+    step = GPTHybridTrainStep(model, cfg, hcg, n_micro=1, lr=1e-4,
+                              remat=True, compute_dtype="bfloat16")
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    for _ in range(args.warmup):
+        loss = step(ids, labels)
+    loss.numpy()  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = step(ids, labels)
+    final_loss = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens = B * S * args.steps
+    tps = tokens / dt
+    fpt, n_params = model_flops_per_token(cfg, S)
+    mfu = tps * fpt / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": f"gpt_{args.config}_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "extras": {
+            "mfu": round(mfu, 4),
+            "n_params": n_params,
+            "batch": B, "seq": S, "steps": args.steps,
+            "step_time_ms": round(1000 * dt / args.steps, 2),
+            "final_loss": round(final_loss, 4),
+            "device": str(jax.devices()[0].device_kind),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -158,17 +158,35 @@ class StaticFunction:
         return tuple(parts)
 
     def __call__(self, *args, **kwargs):
+        # Tensor kwargs become trailing positional inputs of the traced
+        # program — real traced inputs (fresh values each call, grads flow
+        # when stop_gradient=False) instead of baked trace constants.
+        # Non-tensor kwargs (flags) stay baked per cache entry.
+        t_keys = tuple(sorted(k for k, v in kwargs.items()
+                              if isinstance(v, Tensor)))
+        s_kw = {k: v for k, v in kwargs.items() if k not in t_keys}
         if kwargs:
-            fn = functools.partial(self._fn, **kwargs)
+            npos = len(args)
+            base = functools.partial(self._fn, **s_kw) if s_kw else self._fn
+
+            if t_keys:
+                def fn(*all_args):
+                    return base(*all_args[:npos],
+                                **dict(zip(t_keys, all_args[npos:])))
+            else:
+                fn = base
+            call_args = args + tuple(kwargs[k] for k in t_keys)
+            key = (self._sig(call_args), t_keys, npos,
+                   tuple(sorted((k, repr(v)) for k, v in s_kw.items())))
         else:
             fn = self._fn
-        key = (self._sig(args),
-               tuple((k, self._sig([v])) for k, v in sorted(kwargs.items())))
+            call_args = args
+            key = (self._sig(call_args),)
         prog = self._cache.get(key)
         if prog is None:
             prog = TracedProgram(fn, self._layer)
             self._cache[key] = prog
-        return prog(*args)
+        return prog(*call_args)
 
     @property
     def concrete_programs(self):

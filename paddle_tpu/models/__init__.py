@@ -1,0 +1,10 @@
+"""Model zoo: flagship language models built on paddle_tpu.nn.
+
+Reference anchor: the fleet GPT benchmark models driven by
+meta_parallel/pipeline_parallel.py + mpu layers in the reference repo.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTDecoderLayer, GPTEmbeddings, GPTModel, GPTForPretraining,
+    GPTPretrainingCriterion, GPTHybridTrainStep, gpt_tiny_config,
+    gpt_345m_config, gpt_1p3b_config, gpt_13b_config,
+)

@@ -1,0 +1,555 @@
+"""GPT decoder language-model family — the flagship model.
+
+Parity: the reference's fleet GPT benchmark stack — decoder layers built from
+mpu layers (/root/reference/python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py:38,176,335,501), driven by PipelineParallel 1F1B
+(meta_parallel/pipeline_parallel.py:119) with vocab-parallel cross entropy.
+
+TPU-native design: ONE functional decoder block (`gpt_block`) is the math for
+both execution paths:
+
+- **Eager / GSPMD path**: `GPTDecoderLayer` (an nn.Layer) dispatches the block
+  through the tape as a single fused op; its Parameters carry PartitionSpecs
+  (head-dim over ``mp``) so ParallelTrainStep/pjit partitions it à la Megatron
+  with XLA-inserted collectives.
+- **Compiled hybrid path**: `GPTHybridTrainStep` stacks the per-layer params
+  into [n_layers, ...] arrays (leading dim sharded over ``pp``), runs the GPipe
+  micro-batch schedule inside one `shard_map` over the full mesh with *manual*
+  mp collectives (`psum` after row-parallel matmuls, vocab-parallel softmax
+  cross-entropy with pmax/psum over ``mp``), rotates activations between stages
+  with `ppermute`, and applies a fused functional AdamW under GSPMD with
+  optimizer moments sharded over ``sharding`` (ZeRO-1).
+
+Weights are tied: the vocab-parallel embedding matrix is reused as the LM head
+inside the pipeline's last stage.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..framework.tensor import Tensor, Parameter
+from ..framework import random as random_mod
+from ..ops._dispatch import apply, unwrap
+
+__all__ = [
+    "GPTConfig", "GPTDecoderLayer", "GPTEmbeddings", "GPTModel",
+    "GPTForPretraining", "GPTPretrainingCriterion", "GPTHybridTrainStep",
+    "gpt_tiny_config", "gpt_345m_config", "gpt_1p3b_config", "gpt_13b_config",
+]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: str = "float32"  # param dtype; compute in bf16 on TPU via amp
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+def gpt_tiny_config(**kw):
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                     max_position_embeddings=128, **kw)
+
+
+def gpt_345m_config(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b_config(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_13b_config(**kw):
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_position_embeddings=2048, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the functional decoder block — single source of truth for both paths
+# ---------------------------------------------------------------------------
+
+def _ln(x, w, b, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def gpt_block(p, x, n_heads_local, eps, mp_axis=None):
+    """One pre-LN decoder block. Pure jax.
+
+    p: dict of (possibly mp-sliced) tensors:
+      ln1_w/ln1_b [H], wqkv [H,3,nh,d], bqkv [3,nh,d], wo [nh,d,H], bo [H],
+      ln2_w/ln2_b [H], w1 [H,F], b1 [F], w2 [F,H], b2 [H]
+    x: [B, S, H]. When `mp_axis` is set (inside shard_map) the head dim of
+    wqkv/bqkv/wo and the F dim of w1/b1/w2 are local slices and the row-parallel
+    outputs are psum'ed over the axis — the hand-rolled Megatron pattern the
+    GSPMD path gets from sharding propagation instead.
+    """
+    h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
+    qkv = jnp.einsum("bsh,hknd->bsknd", h, p["wqkv"]) + p["bqkv"]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,nh,d]
+    d = q.shape[-1]
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k) / math.sqrt(d)
+    s = x.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+    attn = jnp.einsum("bnst,btnd->bsnd", probs, v)
+    o = jnp.einsum("bsnd,ndh->bsh", attn, p["wo"])
+    if mp_axis is not None:
+        o = jax.lax.psum(o, mp_axis)
+    x = x + o + p["bo"]
+    h = _ln(x, p["ln2_w"], p["ln2_b"], eps)
+    u = jax.nn.gelu(h @ p["w1"] + p["b1"], approximate=True)
+    m = u @ p["w2"]
+    if mp_axis is not None:
+        m = jax.lax.psum(m, mp_axis)
+    return x + m + p["b2"]
+
+
+def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
+                                 loss_mask=None):
+    """LM head + softmax CE over an mp-sharded vocab (mp_layers.py:501 parity).
+
+    h [B,S,H], wte_local [V_local,H], labels [B,S] global ids. Stable global
+    logsumexp via pmax/psum over the mp axis; the target logit is picked on the
+    rank owning the label id and psum'ed. Returns mean loss over (masked) tokens.
+    """
+    logits = jnp.einsum("bsh,vh->bsv", h, wte_local).astype(jnp.float32)
+    v_local = logits.shape[-1]
+    if mp_axis is not None:
+        start = jax.lax.axis_index(mp_axis) * v_local
+    else:
+        start = 0
+    # the max shift is for numerical stability only — constant w.r.t. AD
+    # (pmax has no VJP rule, and none is needed)
+    m_loc = jax.lax.stop_gradient(jnp.max(logits, -1))
+    m = jax.lax.pmax(m_loc, mp_axis) if mp_axis is not None else m_loc
+    sumexp = jnp.sum(jnp.exp(logits - m[..., None]), -1)
+    if mp_axis is not None:
+        sumexp = jax.lax.psum(sumexp, mp_axis)
+    lse = jnp.log(sumexp) + m
+    local_idx = labels - start
+    in_range = (local_idx >= 0) & (local_idx < v_local)
+    picked = jnp.take_along_axis(
+        logits, jnp.clip(local_idx, 0, v_local - 1)[..., None], -1)[..., 0]
+    tgt = jnp.where(in_range, picked, 0.0)
+    if mp_axis is not None:
+        tgt = jax.lax.psum(tgt, mp_axis)
+    loss = lse - tgt
+    if loss_mask is not None:
+        return jnp.sum(loss * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# nn.Layer (eager / GSPMD) path
+# ---------------------------------------------------------------------------
+
+_BLOCK_KEYS = ("ln1_w", "ln1_b", "wqkv", "bqkv", "wo", "bo",
+               "ln2_w", "ln2_b", "w1", "b1", "w2", "b2")
+
+
+class GPTDecoderLayer(nn.Layer):
+    """One decoder block; params shaped for head-sharded tensor parallelism."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        H, nh, d, Fm = (config.hidden_size, config.num_heads, config.head_dim,
+                        config.intermediate_size)
+        std = config.initializer_range
+        # residual-out projections use the scaled init (GPT-2 scheme)
+        res_std = std / math.sqrt(2.0 * config.num_layers)
+        mk = self.create_parameter
+        self.ln1_w = mk([H], default_initializer=I.Constant(1.0))
+        self.ln1_b = mk([H], is_bias=True)
+        self.wqkv = mk([H, 3, nh, d], default_initializer=I.Normal(0.0, std))
+        self.bqkv = mk([3, nh, d], is_bias=True)
+        self.wo = mk([nh, d, H], default_initializer=I.Normal(0.0, res_std))
+        self.bo = mk([H], is_bias=True)
+        self.ln2_w = mk([H], default_initializer=I.Constant(1.0))
+        self.ln2_b = mk([H], is_bias=True)
+        self.w1 = mk([H, Fm], default_initializer=I.Normal(0.0, std))
+        self.b1 = mk([Fm], is_bias=True)
+        self.w2 = mk([Fm, H], default_initializer=I.Normal(0.0, res_std))
+        self.b2 = mk([H], is_bias=True)
+        # GSPMD tensor-parallel layout: heads / ffn dim over mp
+        self.wqkv.sharding_spec = P(None, None, "mp", None)
+        self.bqkv.sharding_spec = P(None, "mp", None)
+        self.wo.sharding_spec = P("mp", None, None)
+        self.w1.sharding_spec = P(None, "mp")
+        self.b1.sharding_spec = P("mp")
+        self.w2.sharding_spec = P("mp", None)
+
+    def _param_dict_values(self):
+        return {k: unwrap(getattr(self, k)) for k in _BLOCK_KEYS}
+
+    def forward(self, x):
+        cfg = self.config
+        tensors = [getattr(self, k) for k in _BLOCK_KEYS]
+
+        def f(xv, *pv):
+            return gpt_block(dict(zip(_BLOCK_KEYS, pv)), xv, cfg.num_heads,
+                             cfg.layer_norm_epsilon)
+
+        return apply(f, x, *tensors, op_name="gpt_block")
+
+
+class GPTEmbeddings(nn.Layer):
+    """Tied vocab-parallel word embedding + learned positions."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        std = config.initializer_range
+        self.word_embeddings = self.create_parameter(
+            [config.vocab_size, config.hidden_size],
+            default_initializer=I.Normal(0.0, std))
+        self.word_embeddings.sharding_spec = P("mp", None)
+        self.position_embeddings = self.create_parameter(
+            [config.max_position_embeddings, config.hidden_size],
+            default_initializer=I.Normal(0.0, std))
+
+    def forward(self, input_ids, position_ids=None):
+        h = F.embedding(input_ids, self.word_embeddings)
+        if position_ids is None:
+            pos = jnp.arange(unwrap(input_ids).shape[-1])
+            pe = apply(lambda w: w[pos], self.position_embeddings,
+                       op_name="pos_embedding")
+        else:
+            pe = F.embedding(position_ids, self.position_embeddings)
+        return h + pe
+
+
+class GPTModel(nn.Layer):
+    """Decoder stack -> final LayerNorm; returns hidden states [B,S,H]."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.lnf_w = self.create_parameter(
+            [config.hidden_size], default_initializer=I.Constant(1.0))
+        self.lnf_b = self.create_parameter([config.hidden_size], is_bias=True)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            x = layer(x)
+        eps = self.config.layer_norm_epsilon
+        return apply(lambda xv, w, b: _ln(xv, w, b, eps), x, self.lnf_w,
+                     self.lnf_b, op_name="final_layer_norm")
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to the word embedding (reference GPTForPretraining)."""
+
+    def __init__(self, gpt: GPTModel):
+        super().__init__()
+        self.gpt = gpt
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        wte = self.gpt.embeddings.word_embeddings
+        return apply(lambda hv, w: jnp.einsum("bsh,vh->bsv", hv, w), h, wte,
+                     op_name="lm_head")
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Masked token-mean cross entropy over logits."""
+
+    def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
+        logits = prediction_scores
+
+        def ce(lg, lab, mask=None):
+            lg = lg.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg, -1)
+            tgt = jnp.take_along_axis(lg, lab[..., None].astype(jnp.int32),
+                                      -1)[..., 0]
+            loss = lse - tgt
+            if mask is not None:
+                return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.mean(loss)
+
+        if loss_mask is not None:
+            return apply(ce, logits, masked_lm_labels, loss_mask,
+                         op_name="gpt_criterion")
+        return apply(ce, logits, masked_lm_labels, op_name="gpt_criterion")
+
+
+# ---------------------------------------------------------------------------
+# compiled hybrid-parallel train step (pp × dp × sharding × mp)
+# ---------------------------------------------------------------------------
+
+_STACK_SPECS = {
+    "ln1_w": P("pp", None), "ln1_b": P("pp", None),
+    "wqkv": P("pp", None, None, "mp", None), "bqkv": P("pp", None, "mp", None),
+    "wo": P("pp", "mp", None, None), "bo": P("pp", None),
+    "ln2_w": P("pp", None), "ln2_b": P("pp", None),
+    "w1": P("pp", None, "mp"), "b1": P("pp", "mp"),
+    "w2": P("pp", "mp", None), "b2": P("pp", None),
+}
+
+
+class GPTHybridTrainStep:
+    """One pjit-compiled GPT pretraining step over the hybrid mesh.
+
+    The TPU-native replacement for the reference's
+    PipelineParallel.forward_backward_pipeline (pipeline_parallel.py:119) +
+    HybridParallelOptimizer: GPipe micro-batch schedule inside shard_map
+    (ppermute stage rotation, manual Megatron mp collectives, vocab-parallel
+    CE), AdamW update under GSPMD with ZeRO-1 moment sharding.
+
+    model: GPTForPretraining (or GPTModel) built eagerly — its per-layer
+    Parameters are stacked into [L, ...] arrays laid out on the mesh.
+    """
+
+    def __init__(self, model, config: GPTConfig, hcg, n_micro=None, lr=1e-4,
+                 beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.01,
+                 grad_clip_norm=1.0, remat=True, compute_dtype=None):
+        gpt = model.gpt if isinstance(model, GPTForPretraining) else model
+        self.model = model
+        self.gpt = gpt
+        self.config = config
+        self.hcg = hcg
+        self.mesh = hcg.mesh
+        pp = self.mesh.shape["pp"]
+        mp = self.mesh.shape["mp"]
+        assert config.num_layers % pp == 0, "layers must divide pp"
+        assert config.num_heads % mp == 0, "heads must divide mp"
+        assert config.vocab_size % mp == 0, "vocab must divide mp"
+        self.n_micro = n_micro or max(pp, 1)
+        self.hyper = (lr, beta1, beta2, eps, weight_decay, grad_clip_norm)
+        self.remat = remat
+        # AMP-O2 style: master params stay f32, forward runs in compute_dtype
+        # (bf16 on TPU keeps the matmuls on the MXU at full rate)
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
+        self._compiled = None
+        self._t = 0
+
+        # stack per-layer params; keep references to write trained values back
+        self._layer_refs = {k: [getattr(l, k) for l in gpt.layers]
+                            for k in _BLOCK_KEYS}
+        blocks = {k: jnp.stack([unwrap(p) for p in refs])
+                  for k, refs in self._layer_refs.items()}
+        self.params = {
+            "blocks": blocks,
+            "wte": unwrap(gpt.embeddings.word_embeddings),
+            "wpe": unwrap(gpt.embeddings.position_embeddings),
+            "lnf_w": unwrap(gpt.lnf_w),
+            "lnf_b": unwrap(gpt.lnf_b),
+        }
+        self.param_specs = {
+            "blocks": dict(_STACK_SPECS),
+            "wte": P("mp", None),
+            "wpe": P(),
+            "lnf_w": P(),
+            "lnf_b": P(),
+        }
+        ns = lambda s: NamedSharding(self.mesh, s)
+        # jnp.copy: the compiled step donates its inputs; never alias the eager
+        # model's (or another step's) buffers
+        self.params = jax.tree.map(
+            lambda v, s: jax.device_put(jnp.copy(v), ns(s)), self.params,
+            self.param_specs, is_leaf=lambda x: isinstance(x, jax.Array))
+        # AdamW moments: param layout + ZeRO-1 sharding of a free dim
+        self.state_specs = jax.tree.map(self._moment_spec, self.param_specs,
+                                        jax.tree.map(jnp.shape, self.params))
+        zeros = lambda v, s: jax.device_put(
+            jnp.zeros(v.shape, jnp.float32), ns(s))
+        self.opt_state = {
+            "m": jax.tree.map(zeros, self.params, self.state_specs),
+            "v": jax.tree.map(zeros, self.params, self.state_specs),
+        }
+
+    def _moment_spec(self, p_spec, shape):
+        shard = self.mesh.shape["sharding"]
+        parts = list(p_spec) + [None] * (len(shape) - len(p_spec))
+        if shard > 1 and "sharding" not in parts:
+            for i, (s, dim) in enumerate(zip(parts, shape)):
+                if s is None and dim % shard == 0 and dim > 1:
+                    parts[i] = "sharding"
+                    break
+        return P(*parts)
+
+    # ------------------------------------------------------------------
+    def _loss_fn(self, params, ids, labels):
+        """Full forward: embed (GSPMD) -> GPipe decoder shard_map -> loss."""
+        cfg = self.config
+        mesh = self.mesh
+        pp = mesh.shape["pp"]
+        mp = mesh.shape["mp"]
+        n_micro = self.n_micro
+        B, S = ids.shape
+        assert B % n_micro == 0, "batch must divide micro-batches"
+        mb = B // n_micro
+
+        if self.compute_dtype is not None:
+            cast = lambda v: v.astype(self.compute_dtype)
+            params = dict(params, blocks=jax.tree.map(cast, params["blocks"]),
+                          wte=cast(params["wte"]), wpe=cast(params["wpe"]))
+
+        pos = jnp.arange(S)
+        h = params["wte"][ids] + params["wpe"][pos]
+        xs = h.reshape(n_micro, mb, S, cfg.hidden_size)
+        labs = labels.reshape(n_micro, mb, S)
+
+        nh_local = cfg.num_heads // mp
+        layers_per_stage = cfg.num_layers // pp
+        eps = cfg.layer_norm_epsilon
+        remat = self.remat
+
+        def stage_prog(blocks_local, wte_local, lnf_w, lnf_b, xs, labs):
+            stage = jax.lax.axis_index("pp")
+
+            blk = lambda p, xx: gpt_block(p, xx, nh_local, eps, mp_axis="mp")
+            if remat:
+                blk = jax.checkpoint(blk)
+
+            def apply_blocks(x):
+                out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x,
+                                      blocks_local)
+                return out
+
+            def head(x, lab):
+                x = _ln(x, lnf_w, lnf_b, eps).astype(wte_local.dtype)
+                return vocab_parallel_cross_entropy(x, wte_local, lab,
+                                                    mp_axis="mp")
+
+            n_ticks = n_micro + pp - 1
+
+            def tick(carry, t):
+                state, total = carry
+                inject = jnp.take(xs, jnp.clip(t, 0, n_micro - 1), axis=0)
+                use_inject = (stage == 0) & (t < n_micro)
+                state = jnp.where(use_inject, inject, state)
+                state = apply_blocks(state)
+                mi = t - (pp - 1)
+                valid = (stage == pp - 1) & (mi >= 0) & (mi < n_micro)
+                lab = jnp.take(labs, jnp.clip(mi, 0, n_micro - 1), axis=0)
+                loss_t = head(state, lab)
+                total = total + jnp.where(valid, loss_t, 0.0)
+                state = jax.lax.ppermute(
+                    state, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+                return (state, total), None
+
+            state0 = jnp.zeros_like(xs[0])
+            (state, total), _ = jax.lax.scan(
+                tick, (state0, jnp.zeros((), jnp.float32)),
+                jnp.arange(n_ticks))
+            # mean over micro-batches and over dp/sharding batch shards
+            total = jax.lax.psum(total, "pp") / n_micro
+            return jax.lax.pmean(total, ("dp", "sharding"))
+
+        data_spec = P(None, ("dp", "sharding"), None)
+        loss = shard_map(
+            stage_prog, mesh=mesh,
+            in_specs=(dict(_STACK_SPECS), P("mp", None), P(), P(),
+                      P(None, ("dp", "sharding"), None, None), data_spec),
+            out_specs=P(),
+            check_vma=False,
+        )(params["blocks"], params["wte"], params["lnf_w"], params["lnf_b"],
+          xs, labs)
+        return loss
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        ns = lambda s: NamedSharding(self.mesh, s)
+        p_sh = jax.tree.map(ns, self.param_specs)
+        s_sh = jax.tree.map(ns, self.state_specs)
+        data_sh = ns(P(("dp", "sharding"), None))
+
+        def step(params, opt_state, ids, labels, lr, t):
+            _, b1, b2, eps_o, wd, clip = self.hyper
+            loss, grads = jax.value_and_grad(self._loss_fn)(params, ids,
+                                                            labels)
+            if clip is not None and clip > 0:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
+                scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-6))
+            else:
+                scale = 1.0
+
+            def upd(p, g, m, v):
+                g = g.astype(jnp.float32) * scale
+                m2 = b1 * m + (1 - b1) * g
+                v2 = b2 * v + (1 - b2) * jnp.square(g)
+                mhat = m2 / (1 - jnp.power(b1, t))
+                vhat = v2 / (1 - jnp.power(b2, t))
+                p32 = p.astype(jnp.float32)
+                p2 = p32 * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps_o)
+                return p2.astype(p.dtype), m2, v2
+
+            out = jax.tree.map(upd, params, grads, opt_state["m"],
+                               opt_state["v"])
+            is_upd = lambda o: isinstance(o, tuple)
+            new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_upd)
+            new_m = jax.tree.map(lambda o: o[1], out, is_leaf=is_upd)
+            new_v = jax.tree.map(lambda o: o[2], out, is_leaf=is_upd)
+            return loss, new_params, {"m": new_m, "v": new_v}
+
+        self._compiled = jax.jit(
+            step,
+            in_shardings=(p_sh, {"m": s_sh, "v": s_sh}, data_sh, data_sh,
+                          ns(P()), ns(P())),
+            out_shardings=(ns(P()), p_sh, {"m": s_sh, "v": s_sh}),
+            donate_argnums=(0, 1),
+        )
+
+    # ------------------------------------------------------------------
+    def __call__(self, input_ids, labels):
+        ids = unwrap(input_ids) if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        labs = unwrap(labels) if isinstance(labels, Tensor) \
+            else jnp.asarray(labels)
+        if self._compiled is None:
+            self._build()
+        self._t += 1
+        lr = jnp.asarray(self.hyper[0], jnp.float32)
+        t = jnp.asarray(self._t, jnp.float32)
+        loss, self.params, self.opt_state = self._compiled(
+            self.params, self.opt_state, ids, labs, lr, t)
+        return Tensor(loss)
+
+    train_batch = __call__
+
+    def sync_params_to_model(self):
+        """Write trained stacked params back into the eager Layer tree."""
+        for k, refs in self._layer_refs.items():
+            stacked = self.params["blocks"][k]
+            for i, p in enumerate(refs):
+                p._value = stacked[i]
+        g = self.gpt
+        g.embeddings.word_embeddings._value = self.params["wte"]
+        g.embeddings.position_embeddings._value = self.params["wpe"]
+        g.lnf_w._value = self.params["lnf_w"]
+        g.lnf_b._value = self.params["lnf_b"]

@@ -225,7 +225,12 @@ class Optimizer:
             p._grad = Tensor(g) if g is not None else None
         try:
             self.step()
-            new_vals = [p._value for p in params]
+            # lr arrives as a float32 jax array under jit; keep each param's
+            # storage dtype (eager semantics: weak python-float lr never
+            # promotes f16/bf16 params)
+            new_vals = [p._value if p._value.dtype == sv.dtype
+                        else p._value.astype(sv.dtype)
+                        for p, sv in zip(params, saved_vals)]
             keys = [(n, k) for n, d in self._accumulators.items()
                     for k in d.keys()]
             self._jit_state_keys = keys

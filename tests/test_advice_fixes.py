@@ -237,3 +237,36 @@ def test_cross_entropy_soft_label_weight():
     sw = (w_np * soft_np).sum(-1)
     ref = (per * sw).sum() / sw.sum()
     np.testing.assert_allclose(float(out.numpy()), ref, rtol=1e-5)
+
+
+def test_to_static_tensor_kwarg_is_traced_input():
+    """Tensor kwargs must be fresh traced inputs with grad flow, not baked."""
+    from paddle_tpu.jit import to_static as _to_static
+
+    @_to_static
+    def f(x, scale=None):
+        return (x * scale).sum()
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    s1 = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+    out1 = f(x, scale=s1)
+    out1.backward()
+    assert s1.grad is not None
+    np.testing.assert_allclose(float(s1.grad.numpy()), 4.0, rtol=1e-6)
+    # same shape, different value -> must NOT reuse the baked constant
+    s2 = paddle.to_tensor(np.float32(3.0))
+    out2 = f(x, scale=s2)
+    np.testing.assert_allclose(float(out2.numpy()), 12.0, rtol=1e-6)
+
+
+def test_jit_apply_preserves_param_dtype():
+    """float16 params must stay float16 through the functional jit step."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework.tensor import Parameter
+
+    p = Parameter(np.ones((4,), np.float16))
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    g = jnp.ones((4,), jnp.float16)
+    new_vals, _ = o._jit_apply([p], [p._value], [g],
+                               lr=jnp.asarray(0.1, jnp.float32))
+    assert new_vals[0].dtype == jnp.float16
