@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — the hot-op corpus.
+
+Parity: the reference's fused CUDA ops (/root/reference/paddle/fluid/operators/
+fused/: fused_attention_op.cu, fmha_ref.h, fused_feedforward) re-designed as
+Pallas TPU kernels instead of hand-written CUDA.
+"""

@@ -1,0 +1,336 @@
+"""FlashAttention-2 forward + backward Pallas TPU kernels.
+
+Parity target: the reference's fused attention CUDA path
+(/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu,
+fmha_ref.h) — here as an online-softmax tiled kernel that never materializes
+the [S, S] probability matrix, with a custom VJP whose dq/dkv passes are also
+Pallas kernels (recompute-from-LSE, FlashAttention-2 scheme).
+
+Pipelining: each pallas_call uses a 3-D grid whose innermost ("arbitrary")
+dimension walks K/V (resp. Q) blocks while the online-softmax state lives in
+VMEM scratch — Pallas double-buffers the HBM→VMEM block streams so DMA
+overlaps the MXU matmuls. Causal programs early-out on fully-masked blocks.
+
+Layout contract: paddle sdpa layout [batch, seq, num_heads, head_dim]
+(`flash_attention_bshd`); internally [batch*heads, seq, head_dim] with
+head_dim zero-padded to the 128-lane width (exact: padded q·k adds zeros,
+padded v columns are sliced off).
+
+The package enables jax x64 globally (paddle int64 dtype semantics) but Mosaic
+cannot lower 64-bit scalars, so every pallas_call traces under
+jax.enable_x64(False). On CPU the kernels run in interpreter mode so the same
+code path is testable on the virtual mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MIN_BLOCK = 128
+MAX_BLOCK = 512
+_LANE = 128
+_NEG_INF = -1e30
+
+
+def _pick_block(s_len):
+    """Largest MXU-friendly block dividing the sequence (bigger blocks raise
+    arithmetic intensity per grid step; 512 wins on v5e at GPT shapes)."""
+    for b in (MAX_BLOCK, 256, MIN_BLOCK):
+        if s_len % b == 0:
+            return b
+    raise ValueError(f"seq {s_len} not a multiple of {MIN_BLOCK}")
+
+
+def supported(shape) -> bool:
+    """Gate used by nn.functional.attention: [B, S, N, D] TPU-friendly?"""
+    if len(shape) != 4:
+        return False
+    b, s, n, d = shape
+    return s >= MIN_BLOCK and s % MIN_BLOCK == 0 and 0 < d <= _LANE
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _no_x64(fn):
+    @functools.wraps(fn)
+    def inner(*a, **kw):
+        with jax.enable_x64(False):
+            return fn(*a, **kw)
+    return inner
+
+
+def _causal_mask(s, qi, ki, bq, bk):
+    row = qi * np.int32(bq) + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ki * np.int32(bk) + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(row >= col, s, jnp.float32(_NEG_INF))
+
+
+_ARB = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (bn, nq, nk) — innermost streams K/V blocks
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal, scale):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks strictly above the diagonal
+    run = (j * np.int32(bk) <= qi * np.int32(bq) + np.int32(bq - 1)) \
+        if causal else (j >= 0)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        # matmuls run in the input dtype (bf16 on TPU -> full MXU rate) with
+        # f32 accumulation; softmax state is always f32
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, j, bq, bk)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = corr * acc_scr[:] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
+
+
+@_no_x64
+def _fwd(q, k, v, causal, scale):
+    bn, s_len, d = q.shape
+    bq = bk = _pick_block(s_len)
+    nq, nk = s_len // bq, s_len // bk
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale),
+        grid=(bn, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, s_len, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=_ARB,
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward dq: grid (bn, nq, nk) — innermost streams K/V blocks
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, causal, scale):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (j * np.int32(bk) <= qi * np.int32(bq) + np.int32(bq - 1)) \
+        if causal else (j >= 0)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, j, bq, bk)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_scr[:] = dq_scr[:] + jnp.dot(ds, k,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward dk/dv: grid (bn, nk, nq) — innermost streams Q/dO blocks
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale):
+    ki = pl.program_id(1)
+    j = pl.program_id(2)
+    nq = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # causal: q block j contributes only if its last row >= k block first row
+    run = (j * np.int32(bq) + np.int32(bq - 1) >= ki * np.int32(bk)) \
+        if causal else (j >= 0)
+
+    @pl.when(run)
+    def _():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, j, ki, bq, bk)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        dv_scr[:] = dv_scr[:] + jnp.dot(p.astype(do.dtype).T, do,
+                                        preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jnp.dot(ds.T, q,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@_no_x64
+def _bwd(causal, scale, residuals, do):
+    q, k, v, o, lse = residuals
+    bn, s_len, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    bq = bk = _pick_block(s_len)
+    nq, nk = s_len // bq, s_len // bk
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(bn, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_ARB,
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
+        grid=(bn, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=_ARB,
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    o, _ = _fwd(q, k, v, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    o, lse = _fwd(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """q/k/v: [BN, S, D] (head-major). Returns [BN, S, D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if d < _LANE:
+        pad = [(0, 0), (0, 0), (0, _LANE - d)]
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    out = _flash(q, k, v, causal, scale)
+    return out[..., :d] if d < _LANE else out
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """paddle sdpa layout [B, S, N, D] -> [B, S, N, D]."""
+    b, s, n, d = q.shape
+    to3 = lambda t: t.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+    out = flash_attention(to3(q), to3(k), to3(v), causal=causal, scale=scale)
+    return out.reshape(b, n, s, d).transpose(0, 2, 1, 3)

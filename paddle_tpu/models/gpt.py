@@ -99,7 +99,7 @@ def _ln(x, w, b, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
 
 
-def gpt_block(p, x, n_heads_local, eps, mp_axis=None):
+def gpt_block(p, x, eps, mp_axis=None, use_flash=False):
     """One pre-LN decoder block. Pure jax.
 
     p: dict of (possibly mp-sliced) tensors:
@@ -108,18 +108,23 @@ def gpt_block(p, x, n_heads_local, eps, mp_axis=None):
     x: [B, S, H]. When `mp_axis` is set (inside shard_map) the head dim of
     wqkv/bqkv/wo and the F dim of w1/b1/w2 are local slices and the row-parallel
     outputs are psum'ed over the axis — the hand-rolled Megatron pattern the
-    GSPMD path gets from sharding propagation instead.
+    GSPMD path gets from sharding propagation instead. With `use_flash` the
+    attention core runs the Pallas FlashAttention kernel (TPU only).
     """
     h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
     qkv = jnp.einsum("bsh,hknd->bsknd", h, p["wqkv"]) + p["bqkv"]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,nh,d]
     d = q.shape[-1]
-    logits = jnp.einsum("bsnd,btnd->bnst", q, k) / math.sqrt(d)
-    s = x.shape[1]
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
-    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
-    attn = jnp.einsum("bnst,btnd->bsnd", probs, v)
+    if use_flash:
+        from ..kernels.flash_attention import flash_attention_bshd
+        attn = flash_attention_bshd(q, k, v, causal=True)
+    else:
+        logits = jnp.einsum("bsnd,btnd->bnst", q, k) / math.sqrt(d)
+        s = x.shape[1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(causal, logits, jnp.asarray(-1e30, logits.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+        attn = jnp.einsum("bnst,btnd->bsnd", probs, v)
     o = jnp.einsum("bsnd,ndh->bsh", attn, p["wo"])
     if mp_axis is not None:
         o = jax.lax.psum(o, mp_axis)
@@ -215,7 +220,7 @@ class GPTDecoderLayer(nn.Layer):
         tensors = [getattr(self, k) for k in _BLOCK_KEYS]
 
         def f(xv, *pv):
-            return gpt_block(dict(zip(_BLOCK_KEYS, pv)), xv, cfg.num_heads,
+            return gpt_block(dict(zip(_BLOCK_KEYS, pv)), xv,
                              cfg.layer_norm_epsilon)
 
         return apply(f, x, *tensors, op_name="gpt_block")
@@ -333,7 +338,8 @@ class GPTHybridTrainStep:
 
     def __init__(self, model, config: GPTConfig, hcg, n_micro=None, lr=1e-4,
                  beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.01,
-                 grad_clip_norm=1.0, remat=True, compute_dtype=None):
+                 grad_clip_norm=1.0, remat=True, compute_dtype=None,
+                 use_flash=None):
         gpt = model.gpt if isinstance(model, GPTForPretraining) else model
         self.model = model
         self.gpt = gpt
@@ -352,6 +358,9 @@ class GPTHybridTrainStep:
         # (bf16 on TPU keeps the matmuls on the MXU at full rate)
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
+        # Pallas flash attention: None = auto (decided per sequence length at
+        # trace time), True/False = forced
+        self.use_flash = use_flash
         self._compiled = None
         self._t = 0
 
@@ -417,20 +426,33 @@ class GPTHybridTrainStep:
             params = dict(params, blocks=jax.tree.map(cast, params["blocks"]),
                           wte=cast(params["wte"]), wpe=cast(params["wpe"]))
 
+        if S > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {S} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
         pos = jnp.arange(S)
         h = params["wte"][ids] + params["wpe"][pos]
         xs = h.reshape(n_micro, mb, S, cfg.hidden_size)
         labs = labels.reshape(n_micro, mb, S)
 
-        nh_local = cfg.num_heads // mp
-        layers_per_stage = cfg.num_layers // pp
         eps = cfg.layer_norm_epsilon
         remat = self.remat
+        # auto: flash beats XLA's fused attention for full-lane heads (d=128,
+        # no pad waste) or long sequences; off on the CPU mesh (interpret mode
+        # inside shard_map is slow and adds nothing)
+        if self.use_flash is None:
+            use_flash = (jax.default_backend() == "tpu"
+                         and (cfg.head_dim == 128 or S >= 2048))
+        else:
+            use_flash = self.use_flash
+        use_flash = use_flash and S % 128 == 0 and S >= 128 \
+            and cfg.head_dim <= 128
 
         def stage_prog(blocks_local, wte_local, lnf_w, lnf_b, xs, labs):
             stage = jax.lax.axis_index("pp")
 
-            blk = lambda p, xx: gpt_block(p, xx, nh_local, eps, mp_axis="mp")
+            blk = lambda p, xx: gpt_block(p, xx, eps, mp_axis="mp",
+                                          use_flash=use_flash)
             if remat:
                 blk = jax.checkpoint(blk)
 
@@ -455,8 +477,13 @@ class GPTHybridTrainStep:
                 mi = t - (pp - 1)
                 valid = (stage == pp - 1) & (mi >= 0) & (mi < n_micro)
                 lab = jnp.take(labs, jnp.clip(mi, 0, n_micro - 1), axis=0)
-                loss_t = head(state, lab)
-                total = total + jnp.where(valid, loss_t, 0.0)
+                # cond skips the big vocab einsum on non-final stages / fill
+                # ticks; `valid` is uniform within each mp group, so the
+                # psum/pmax inside head stay collective-safe
+                loss_t = jax.lax.cond(
+                    valid, lambda: head(state, lab),
+                    lambda: jnp.zeros((), jnp.float32))
+                total = total + loss_t
                 state = jax.lax.ppermute(
                     state, "pp", [(i, (i + 1) % pp) for i in range(pp)])
                 return (state, total), None
@@ -480,6 +507,14 @@ class GPTHybridTrainStep:
           xs, labs)
         return loss
 
+    def _decay_mask(self):
+        """Reference GPT recipe: weight decay on matmul weights + embeddings,
+        never on LayerNorm scales or biases."""
+        blocks = {k: k in ("wqkv", "wo", "w1", "w2")
+                  for k in self.params["blocks"]}
+        return {"blocks": blocks, "wte": True, "wpe": True,
+                "lnf_w": False, "lnf_b": False}
+
     # ------------------------------------------------------------------
     def _build(self):
         ns = lambda s: NamedSharding(self.mesh, s)
@@ -499,18 +534,19 @@ class GPTHybridTrainStep:
             else:
                 scale = 1.0
 
-            def upd(p, g, m, v):
+            def upd(p, g, m, v, decays):
                 g = g.astype(jnp.float32) * scale
                 m2 = b1 * m + (1 - b1) * g
                 v2 = b2 * v + (1 - b2) * jnp.square(g)
                 mhat = m2 / (1 - jnp.power(b1, t))
                 vhat = v2 / (1 - jnp.power(b2, t))
                 p32 = p.astype(jnp.float32)
-                p2 = p32 * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps_o)
+                p2 = p32 * (1 - lr * (wd if decays else 0.0)) \
+                    - lr * mhat / (jnp.sqrt(vhat) + eps_o)
                 return p2.astype(p.dtype), m2, v2
 
             out = jax.tree.map(upd, params, grads, opt_state["m"],
-                               opt_state["v"])
+                               opt_state["v"], self._decay_mask())
             is_upd = lambda o: isinstance(o, tuple)
             new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_upd)
             new_m = jax.tree.map(lambda o: o[1], out, is_leaf=is_upd)

@@ -1,0 +1,70 @@
+"""Flash-attention Pallas kernel vs the XLA reference sdpa (interpret mode on
+the CPU mesh; the same kernels compile on TPU)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention, flash_attention_bshd, supported,
+)
+from paddle_tpu.nn.functional.attention import _sdpa_ref
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape).astype(dtype))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("d", [64, 128])
+def test_flash_forward_matches_ref(causal, d):
+    b, s, n = 2, 256, 2
+    q, k, v = (_rand((b, s, n, d), seed=i) for i in range(3))
+    ref = _sdpa_ref(q, k, v, None, 0.0, causal, None, False)
+    out = flash_attention_bshd(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_ref(causal):
+    bn, s, d = 2, 256, 64
+    q, k, v = (_rand((bn, s, d), seed=10 + i) for i in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, causal=causal)))
+
+    def loss_ref(q, k, v):
+        e = lambda t: t[:, :, None, :]
+        out = _sdpa_ref(e(q), e(k), e(v), None, 0.0, causal, None, False)
+        return jnp.sum(jnp.square(out[:, :, 0, :]))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_supported_gate():
+    assert supported((2, 256, 4, 64))
+    assert supported((1, 128, 1, 128))
+    assert not supported((2, 100, 4, 64))   # seq not multiple of block
+    assert not supported((2, 64, 4, 64))    # seq too short
+    assert not supported((2, 256, 4, 256))  # head_dim too wide
+    assert not supported((2, 256, 64))      # wrong rank
+
+
+def test_sdpa_routes_to_flash():
+    """nn.functional sdpa picks the kernel for supported shapes and matches."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    q, k, v = (_rand((1, 128, 2, 64), seed=20 + i) for i in range(3))
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+        paddle.to_tensor(np.asarray(v)), is_causal=True, dropout_p=0.0)
+    ref = _sdpa_ref(q, k, v, None, 0.0, True, None, False)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
+                               atol=2e-4)
