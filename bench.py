@@ -90,9 +90,10 @@ def main():
     ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1).astype(np.int32)
 
-    for _ in range(max(args.warmup, 1)):
+    for _ in range(args.warmup):
         loss = step(ids, labels)
-    loss.numpy()  # sync
+    if args.warmup:
+        loss.numpy()  # sync; with --warmup 0 the first timed step compiles
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

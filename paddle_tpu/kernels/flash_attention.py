@@ -47,12 +47,20 @@ def _pick_block(s_len):
     raise ValueError(f"seq {s_len} not a multiple of {MIN_BLOCK}")
 
 
-def supported(shape) -> bool:
-    """Gate used by nn.functional.attention: [B, S, N, D] TPU-friendly?"""
-    if len(shape) != 4:
+def supported(q_shape, k_shape=None, v_shape=None) -> bool:
+    """Gate used by nn.functional.attention: [B, S, N, D] TPU-friendly?
+
+    The kernel is self-attention-shaped: k/v must match q exactly. Cross
+    attention (sk != sq) and MQA/GQA head broadcasting route to the XLA
+    reference path.
+    """
+    if len(q_shape) != 4:
         return False
-    b, s, n, d = shape
-    return s >= MIN_BLOCK and s % MIN_BLOCK == 0 and 0 < d <= _LANE
+    b, s, n, d = q_shape
+    if not (s >= MIN_BLOCK and s % MIN_BLOCK == 0 and 0 < d <= _LANE):
+        return False
+    return all(other is None or tuple(other) == tuple(q_shape)
+               for other in (k_shape, v_shape))
 
 
 def _interpret() -> bool:

@@ -58,7 +58,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         try:
             from ...kernels.flash_attention import flash_attention_bshd, supported
             q = unwrap(query)
-            if supported(q.shape):
+            if supported(q.shape, unwrap(key).shape, unwrap(value).shape):
                 def ff(qv, kv, vv):
                     return flash_attention_bshd(qv, kv, vv, causal=is_causal,
                                                 scale=scale)
