@@ -1,0 +1,56 @@
+"""global_scatter / global_gather parity.
+
+Parity: ``/root/reference/python/paddle/distributed/utils/moe_utils.py`` backed
+by ``operators/collective/global_scatter_op.cc`` / ``global_gather_op.cc``
+(NCCL grouped send/recv moving expert-count-many rows between ranks).
+
+TPU-native stance: dynamic-count point-to-point exchange does not map to XLA's
+static-shape model; the compiled MoE path (incubate.distributed.models.moe.
+MoELayer) instead uses static-capacity einsum dispatch whose all_to_all GSPMD
+inserts. These functions exist for API parity and for the degenerate
+single-process layout, where the exchange is an in-place regroup: rows are
+already ordered by (rank, expert) and every destination is the local process.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unwrap, wrap
+
+
+def _counts(x):
+    v = unwrap(x)
+    return np.asarray(v).astype(np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Send ``local_count[i]`` rows of ``x`` to expert ``i % n_expert`` on rank
+    ``i // n_expert``; receive ``global_count``-many rows back-to-back.
+
+    Single-process (world_size==1): local_count == global_count and all
+    destinations are local, so the result is exactly the input rows.
+    """
+    nranks = getattr(group, "nranks", 1) if group is not None else 1
+    if nranks > 1:
+        raise NotImplementedError(
+            "eager multi-process global_scatter is not part of the "
+            "single-controller TPU runtime; use MoELayer's compiled dispatch")
+    lc, gc = _counts(local_count), _counts(global_count)
+    assert int(lc.sum()) == int(gc.sum()) == unwrap(x).shape[0], \
+        "counts must cover all rows"
+    # identity exchange: return the input tensor itself so the tape stays intact
+    return x if isinstance(x, Tensor) else wrap(unwrap(x))
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of :func:`global_scatter` (global_gather_op.cc semantics)."""
+    nranks = getattr(group, "nranks", 1) if group is not None else 1
+    if nranks > 1:
+        raise NotImplementedError(
+            "eager multi-process global_gather is not part of the "
+            "single-controller TPU runtime; use MoELayer's compiled dispatch")
+    lc, gc = _counts(local_count), _counts(global_count)
+    assert int(lc.sum()) == int(gc.sum()) == unwrap(x).shape[0], \
+        "counts must cover all rows"
+    return x if isinstance(x, Tensor) else wrap(unwrap(x))

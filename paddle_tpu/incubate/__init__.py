@@ -1,0 +1,2 @@
+"""Experimental features (reference: ``python/paddle/incubate/``)."""
+from . import distributed  # noqa: F401

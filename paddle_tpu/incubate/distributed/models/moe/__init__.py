@@ -1,0 +1,12 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity: ``/root/reference/python/paddle/incubate/distributed/models/moe/``
+(moe_layer.py:260 MoELayer, gate/, grad_clip.py). TPU-native redesign: the
+reference dispatches tokens with dynamic-shape ``global_scatter``/
+``global_gather`` NCCL grouped send/recv; here dispatch is the static-capacity
+GShard einsum formulation, so the whole layer jits to one XLA program and the
+expert dim shards over the ``ep`` mesh axis (XLA inserts the all_to_all).
+"""
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer, ExpertLayer  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401

@@ -1,0 +1,137 @@
+"""MoE gate networks.
+
+Parity: ``/root/reference/python/paddle/incubate/distributed/models/moe/gate/``
+(base_gate.py, naive_gate.py, gshard_gate.py, switch_gate.py). Contract kept
+from the reference: ``gate(x) -> (top_k_val, top_k_idx)`` over tokens
+``x [S, d_model]``; load-balancing auxiliary loss is stashed via
+``set_loss``/``get_loss``.
+
+Single-controller note: ``num_expert`` here is the number of experts held by
+this controller; with expert parallelism the expert dim is *sharded* over the
+``ep`` mesh axis rather than split across processes, so ``world_size`` is 1 in
+typical use and ``tot_expert == num_expert``.
+"""
+from __future__ import annotations
+
+from ..... import nn
+from .....nn import functional as F
+from ..... import ops
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, num_expert, world_size):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError("Base gate cannot be directly used for fwd")
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    @property
+    def has_loss(self):
+        return self.loss is not None
+
+
+class NaiveGate(BaseGate):
+    """Linear top-k gate, no capacity logic, no aux loss (naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        gate = self.gate(inp)
+        gate_top_k_val, gate_top_k_idx = ops.topk(
+            gate, k=self.top_k, axis=-1, largest=True, sorted=True)
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx
+
+
+def _load_balance_loss(probs, top1_idx, num_expert):
+    """GShard/Switch auxiliary loss: E * sum_e mean_s(probs_e) * frac_s(e).
+
+    probs [S, E] softmax over experts, top1_idx [S] hard assignment.
+    """
+    me = ops.mean(probs, axis=0)                       # [E] mean gate prob
+    mask1 = F.one_hot(top1_idx, num_expert)            # [S, E] (non-diff)
+    ce = ops.mean(mask1.astype(probs.dtype), axis=0)   # [E] load fraction
+    return ops.sum(me * ce) * float(num_expert)
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with normalized weights + aux loss (gshard_gate.py).
+
+    Capacity enforcement happens in MoELayer's static dispatch; the gate's
+    ``capacity`` pair (train, eval) mirrors the reference's defaults and is
+    consulted by the layer.
+    """
+
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True,
+                 group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        logits = self.gate(x)
+        probs = F.softmax(logits, axis=-1)
+        topk_val, topk_idx = ops.topk(
+            probs, k=self.top_k, axis=-1, largest=True, sorted=True)
+        # normalize the two winning probabilities to sum to one
+        denom = ops.sum(topk_val, axis=-1, keepdim=True) + 1e-9
+        topk_val = topk_val / denom
+        if self.random_routing and self.training:
+            # gshard random routing: the 2nd expert is kept only with
+            # probability min(1, 2*p2) — otherwise its combine weight is
+            # zeroed (the reference drops the token from dispatch; here the
+            # capacity slot is still held but contributes nothing)
+            u = ops.rand([topk_val.shape[0]], dtype=topk_val.dtype)
+            keep = (2.0 * topk_val[:, 1] > u).astype(topk_val.dtype)
+            topk_val = ops.stack([topk_val[:, 0], topk_val[:, 1] * keep],
+                                 axis=-1)
+        self.set_loss(_load_balance_loss(
+            probs, topk_idx[:, 0], self.tot_expert))
+        return topk_val, topk_idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 gate with aux loss (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+        if self.training and self.switch_eps:
+            # multiplicative jitter (switch transformer exploration noise)
+            noise = ops.rand(logits.shape, dtype=logits.dtype)
+            logits = logits * (1.0 + (noise - 0.5) * 2.0 * self.switch_eps)
+        probs = F.softmax(logits, axis=-1)
+        topk_val, topk_idx = ops.topk(
+            probs, k=1, axis=-1, largest=True, sorted=True)
+        self.set_loss(_load_balance_loss(
+            probs, topk_idx[:, 0], self.tot_expert))
+        return topk_val, topk_idx
