@@ -38,12 +38,30 @@ def __getattr__(name):
     _lazy = {
         "nn", "optimizer", "amp", "autograd", "io", "vision", "static", "jit",
         "distributed", "incubate", "models", "kernels", "profiler", "utils",
-        "metric", "device",
+        "metric", "device", "hapi", "distribution", "sparse", "fft", "signal",
+        "text", "audio",
     }
     if name in _lazy:
-        mod = importlib.import_module(f".{name}", __name__)
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # keep hasattr()/getattr(default) semantics for unbuilt subpackages
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
+    # top-level classes/fns that live in lazily-imported packages
+    _lazy_attrs = {
+        "Model": ("hapi", "Model"),
+        "summary": ("hapi", "summary"),
+        "callbacks": ("hapi", "callbacks"),
+    }
+    if name in _lazy_attrs:
+        mod_name, attr = _lazy_attrs[name]
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        val = getattr(mod, attr)
+        globals()[name] = val
+        return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

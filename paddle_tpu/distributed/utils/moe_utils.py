@@ -24,6 +24,15 @@ def _counts(x):
     return np.asarray(v).astype(np.int64)
 
 
+def _check_counts(x, local_count, global_count):
+    lc, gc = _counts(local_count), _counts(global_count)
+    n = unwrap(x).shape[0]
+    if not (int(lc.sum()) == int(gc.sum()) == n):
+        raise ValueError(
+            f"counts must cover all rows: local={int(lc.sum())} "
+            f"global={int(gc.sum())} rows={n}")
+
+
 def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
     """Send ``local_count[i]`` rows of ``x`` to expert ``i % n_expert`` on rank
     ``i // n_expert``; receive ``global_count``-many rows back-to-back.
@@ -36,9 +45,7 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
         raise NotImplementedError(
             "eager multi-process global_scatter is not part of the "
             "single-controller TPU runtime; use MoELayer's compiled dispatch")
-    lc, gc = _counts(local_count), _counts(global_count)
-    assert int(lc.sum()) == int(gc.sum()) == unwrap(x).shape[0], \
-        "counts must cover all rows"
+    _check_counts(x, local_count, global_count)
     # identity exchange: return the input tensor itself so the tape stays intact
     return x if isinstance(x, Tensor) else wrap(unwrap(x))
 
@@ -50,7 +57,5 @@ def global_gather(x, local_count, global_count, group=None, use_calc_stream=True
         raise NotImplementedError(
             "eager multi-process global_gather is not part of the "
             "single-controller TPU runtime; use MoELayer's compiled dispatch")
-    lc, gc = _counts(local_count), _counts(global_count)
-    assert int(lc.sum()) == int(gc.sum()) == unwrap(x).shape[0], \
-        "counts must cover all rows"
+    _check_counts(x, local_count, global_count)
     return x if isinstance(x, Tensor) else wrap(unwrap(x))

@@ -300,6 +300,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                     id(t._node), [None] * len(t._node.out_avals)
                 )
                 i = t._out_index
+                # AMP boundary: a black-listed op runs in fp32 on a cast copy
+                # of a low-precision producer output; its vjp then emits fp32
+                # cotangents that must be cast back to the producer's dtype
+                want = t._node.out_avals[i][1]
+                if g.dtype != want:
+                    g = g.astype(want)
                 slot[i] = g if slot[i] is None else slot[i] + g
             elif _leaf_filter is None or id(t) in _leaf_filter:
                 t._accumulate_grad(g)

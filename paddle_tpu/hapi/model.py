@@ -1,0 +1,315 @@
+"""hapi Model: the Keras-shaped train/eval/predict driver.
+
+Parity: ``/root/reference/python/paddle/hapi/model.py`` (:1115 Model, :1696
+fit, :1947 evaluate, :2059 predict; the dygraph adapter's train_batch at
+:771). The reference keeps two adapters (static graph vs dygraph); here the
+eager path *is* the traced path — the network runs through the autograd tape,
+so users wanting a fully fused step wrap the network with
+``paddle.jit.to_static`` before constructing the Model, with no API change.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import io as io_mod
+from ..framework import tape as tape_mod
+from ..metric.metrics import Metric
+from ..io import DataLoader
+from .callbacks import CallbackList, config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    """Network wrapper with fit/evaluate/predict (model.py:1115).
+
+    Args:
+        network: an ``nn.Layer``.
+        inputs/labels: optional InputSpec lists (accepted for parity; shapes
+            are discovered from the data on this stack — XLA specializes per
+            concrete shape anyway).
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._scaler = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------ setup
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be a callable (Layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), \
+                f"metrics must be paddle.metric.Metric, got {type(m)}"
+        if amp_configs:
+            from ..amp import GradScaler
+            cfg = amp_configs if isinstance(amp_configs, dict) else {}
+            self._amp_level = cfg.get("level", "O1")
+            self._amp_dtype = cfg.get("dtype", "float16")
+            # loss scaling matters for fp16; bf16 runs unscaled
+            self._scaler = GradScaler(
+                enable=self._amp_dtype == "float16",
+                init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    # ------------------------------------------------------------ batch steps
+    def _run_loss(self, outputs, labels):
+        if self._loss is None:
+            raise RuntimeError("loss not set; call prepare(loss=...) first")
+        return self._loss(*(outputs + labels))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._optimizer is not None, \
+            "call prepare(optimizer=..., loss=...) before train_batch"
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        if self._scaler is not None:
+            # AMP path (reference dygraph adapter model.py:798-809)
+            from ..amp import auto_cast
+            with auto_cast(enable=True, level=self._amp_level,
+                           dtype=self._amp_dtype):
+                outputs = _to_list(self.network(*inputs))
+                loss = self._run_loss(outputs, labels)
+            self._scaler.scale(loss).backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            outputs = _to_list(self.network(*inputs))
+            loss = self._run_loss(outputs, labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            with tape_mod.no_grad_guard():
+                res = m.compute(*(outputs + labels))
+            metrics.append(m.update(*_to_list(res)))
+        lv = [float(np.asarray(loss._value))]
+        return (lv, metrics) if metrics else lv
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        with tape_mod.no_grad_guard():
+            outputs = _to_list(self.network(*inputs))
+            loss = self._run_loss(outputs, labels) \
+                if self._loss is not None else None
+            metrics = []
+            for m in self._metrics:
+                res = m.compute(*(outputs + labels))
+                metrics.append(m.update(*_to_list(res)))
+        lv = [float(np.asarray(loss._value))] if loss is not None else []
+        return (lv, metrics) if metrics else lv
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        with tape_mod.no_grad_guard():
+            outputs = _to_list(self.network(*inputs))
+        return [np.asarray(o._value) for o in outputs]
+
+    # ------------------------------------------------------------------ loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) == 1:
+            return list(batch), []
+        return list(batch[:-1]), [batch[-1]]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given"
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=self._metrics_name(), mode="train")
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            n_steps = len(loader) if hasattr(loader, "__len__") else None
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                # flush on the last batch too so tail grads are never dropped
+                update = (step + 1) % accumulate_grad_batches == 0 or \
+                    (n_steps is not None and step + 1 == n_steps)
+                out = self.train_batch(inputs, labels, update=update)
+                logs = self._merge_logs(out)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              log_freq=log_freq, verbose=verbose,
+                              num_workers=num_workers, callbacks=cbks)
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if isinstance(callbacks, CallbackList) else \
+            config_callbacks(callbacks, model=self, log_freq=log_freq,
+                             verbose=verbose, metrics=self._metrics_name(),
+                             mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            out = self.eval_batch(inputs, labels)
+            lv = out[0] if isinstance(out, tuple) else out
+            if lv:
+                losses.append(lv[0])
+            cbks.on_eval_batch_end(step, self._merge_logs(out))
+        result = {}
+        if losses:
+            result["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            for n, v in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                result[n] = v
+        cbks.on_eval_end(result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                mode="predict")
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            inputs, _ = self._split_batch(batch)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step)
+        # regroup: list over batches → list over outputs
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        cbks.on_predict_end()
+        return grouped
+
+    # ------------------------------------------------------------------- io
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        io_mod.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            io_mod.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = io_mod.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(io_mod.load(opt_path))
+
+    # -------------------------------------------------------------- helpers
+    def _metrics_name(self):
+        out = ["loss"]
+        for m in self._metrics:
+            out.extend(_to_list(m.name()))
+        return out
+
+    def _merge_logs(self, out):
+        logs = {}
+        if isinstance(out, tuple):
+            lv, mv = out
+        else:
+            lv, mv = out, []
+        if lv:
+            logs["loss"] = lv
+        for m, v in zip(self._metrics, mv):
+            for n, x in zip(_to_list(m.name()), _to_list(v)):
+                logs[n] = x
+        return logs
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count summary (reference hapi/model_summary.py, condensed:
+    no shape inference pass — XLA owns shapes; reports the layer tree and
+    parameter totals, which is what the summary is read for)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for p in layer.parameters(include_sublayers=False):
+            n_params += int(np.prod(p.shape)) if p.shape else 1
+            if getattr(p, "trainable", True):
+                trainable += int(np.prod(p.shape)) if p.shape else 1
+        total += n_params
+        rows.append((name or type(net).__name__, type(layer).__name__,
+                     n_params))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Params':>12}",
+             "-" * (width + 36)]
+    for name, tname, n in rows:
+        lines.append(f"{name:<{width}}{tname:<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -143,8 +143,12 @@ class MoELayer(nn.Layer):
         return max(1, min(n_tokens, c))
 
     def _homogeneous_ffn(self):
-        return all(isinstance(e, ExpertLayer) for e in self.experts) and \
-            len({e.act for e in self.experts}) == 1
+        if not all(isinstance(e, ExpertLayer) for e in self.experts):
+            return False
+        e0 = self.experts[0]
+        return all(e.act == e0.act and
+                   tuple(e.htoh4.weight.shape) == tuple(e0.htoh4.weight.shape)
+                   for e in self.experts)
 
     def forward(self, inp):
         orig_shape = inp.shape

@@ -1,0 +1,4 @@
+"""paddle.metric parity (reference: ``python/paddle/metric/metrics.py``)."""
+from .metrics import (  # noqa: F401
+    Metric, Accuracy, Precision, Recall, Auc, accuracy,
+)

@@ -1,0 +1,199 @@
+"""Metrics with the reference's streaming protocol.
+
+Parity: ``/root/reference/python/paddle/metric/metrics.py`` (:33 Metric,
+:187 Accuracy, :338 Precision, :468 Recall, :601 Auc). The contract is
+unchanged — ``compute`` (optional, runs on device outputs), ``update`` (host
+accumulation), ``accumulate``/``reset``/``name`` — because hapi's fit loop and
+user code drive metrics through exactly these five methods. Accumulation is
+plain numpy on host: metric state is tiny and keeping it out of jit avoids
+retraces.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap, wrap
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Device-side preprocessing of (pred, label) → update() inputs."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (metrics.py:187)."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        # top-maxk indices per row
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        correct = (idx == label_np[..., None]).astype(np.float32)
+        return wrap(correct)
+
+    def update(self, correct, *args):
+        c = _np(correct).reshape(-1, self.maxk)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = float(c[:, :k].sum())
+            self.total[i] += num
+            self.count[i] += c.shape[0]
+            accs.append(num / c.shape[0] if c.shape[0] else 0.0)
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over thresholded scores (metrics.py:338)."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall over thresholded scores (metrics.py:468)."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Streaming ROC-AUC via score histogram buckets (metrics.py:601)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self.curve = curve
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]  # probability of the positive class
+        p = p.reshape(-1)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        idx = np.clip((p * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._stat_pos, idx, (l == 1).astype(np.int64))
+        np.add.at(self._stat_neg, idx, (l == 0).astype(np.int64))
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        # trapezoid rule over the bucketed ROC curve, high threshold → low
+        tot_pos = tot_neg = 0.0
+        area = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference metric/metrics.py:791)."""
+    pred = _np(input)
+    lab = _np(label)
+    idx = np.argsort(-pred, axis=-1)[..., :k]
+    if lab.ndim == pred.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    corr = (idx == lab[..., None]).any(axis=-1)
+    return wrap(np.asarray(corr.mean(), np.float32))

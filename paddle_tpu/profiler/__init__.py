@@ -1,0 +1,7 @@
+"""paddle.profiler parity (reference: ``python/paddle/profiler/``)."""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, make_scheduler,
+    export_chrome_tracing, SummaryView,
+)
+from .utils import RecordEvent, load_profiler_result  # noqa: F401
+from .timer import benchmark  # noqa: F401

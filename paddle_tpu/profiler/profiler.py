@@ -1,0 +1,239 @@
+"""Profiler with scheduler states + chrome-trace export.
+
+Parity: ``/root/reference/python/paddle/profiler/profiler.py`` (:79
+ProfilerState, :117 make_scheduler, :215 export_chrome_tracing, :344
+Profiler, :838 summary). TPU-native redesign: the CUPTI device tracer is
+replaced by ``jax.profiler`` (XPlane/TensorBoard trace of XLA ops); the host
+tracer is the RecordEvent buffer in ``utils.py``. ``export_chrome_tracing``
+emits chrome://tracing JSON from host events (same output contract as the
+reference's chrometracing_logger.cc); device-side analysis is read in
+TensorBoard from the jax trace directory.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+
+from . import utils as _utils
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # record and emit the trace at this step's end
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """State machine over step numbers (profiler.py:117 parity):
+    skip_first CLOSED steps, then cycles of [closed × CLOSED, ready × READY,
+    record × RECORD(last=RECORD_AND_RETURN)], repeated ``repeat`` times
+    (0 = forever)."""
+    assert record > 0, "record span must be positive"
+    span = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        cycle = step // span
+        if repeat and cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % span
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == span - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_fn(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # profile everything between start and stop
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """Returns an on_trace_ready callback writing chrome trace json files."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handle(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time() * 1000)}.paddle_trace.json")
+        prof.export(path, format="json")
+
+    return handle
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class Profiler:
+    """Scheduler-driven profiler (profiler.py:344 parity).
+
+    Usage::
+
+        with profiler.Profiler(scheduler=(2, 5)) as p:
+            for batch in loader:
+                train_step(batch)
+                p.step()
+        p.summary()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if scheduler is None:
+            self._state_fn = _default_state_fn
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._state_fn = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        else:
+            self._state_fn = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._events = []            # drained host events across record spans
+        self._jax_trace_dir = None
+        self._jax_tracing = False
+        self._step_t0 = None
+        self._step_times = []
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        self.current_state = self._state_fn(self.step_num)
+        self._apply_state()
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._end_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+        _utils._set_collecting(False)
+
+    def step(self, num_samples=None):
+        if self._step_t0 is not None:
+            self._step_times.append(time.perf_counter() - self._step_t0)
+        prev = self.current_state
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._end_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.step_num += 1
+        self.current_state = self._state_fn(self.step_num)
+        if prev != self.current_state or \
+                prev == ProfilerState.RECORD_AND_RETURN:
+            self._apply_state()
+        self._step_t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _apply_state(self):
+        recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        _utils._set_collecting(recording and not self.timer_only)
+        want_jax = recording and not self.timer_only and \
+            ProfilerTarget.TPU in self.targets
+        if want_jax and not self._jax_tracing:
+            try:
+                import jax
+                self._jax_trace_dir = os.environ.get(
+                    "PADDLE_PROFILER_JAX_DIR", "/tmp/paddle_tpu_jax_trace")
+                jax.profiler.start_trace(self._jax_trace_dir)
+                self._jax_tracing = True
+            except Exception:
+                self._jax_tracing = False
+
+    def _end_record(self):
+        self._events.extend(_utils._drain_events())
+        _utils._set_collecting(False)
+        if self._jax_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    # ------------------------------------------------------------- analysis
+    def export(self, path: str, format: str = "json"):
+        """Write collected host events as chrome://tracing JSON."""
+        assert format in ("json", "pb"), format
+        events = []
+        for name, tid, t0, t1, etype in self._events:
+            events.append({
+                "name": name, "ph": "X", "cat": etype,
+                "pid": os.getpid(), "tid": tid,
+                "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,  # µs
+            })
+        payload = {"traceEvents": events,
+                   "displayTimeUnit": "ms",
+                   "metadata": {"tool": "paddle_tpu.profiler",
+                                "jax_trace_dir": self._jax_trace_dir}}
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        """Print aggregated host-event table + step-time stats; returns the
+        aggregate dict (profiler_statistic.py condensed)."""
+        agg = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_ms]
+        for name, _tid, t0, t1, _etype in self._events:
+            a = agg[name]
+            a[0] += 1
+            a[1] += (t1 - t0) / 1e6
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        width = max([len(k) for k in agg] + [10]) + 2
+        lines = [f"{'Name':<{width}}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}",
+                 "-" * (width + 32)]
+        for name, (calls, total) in rows:
+            lines.append(f"{name:<{width}}{calls:>8}{total:>12.3f}"
+                         f"{total / calls:>12.3f}")
+        if self._step_times:
+            st = self._step_times
+            lines.append("-" * (width + 32))
+            lines.append(
+                f"steps: {len(st)}  avg: {1e3 * sum(st) / len(st):.3f}ms  "
+                f"min: {1e3 * min(st):.3f}ms  max: {1e3 * max(st):.3f}ms")
+        print("\n".join(lines))
+        return {k: {"calls": v[0], "total_ms": v[1]} for k, v in agg.items()}

@@ -1,0 +1,57 @@
+"""Throughput benchmark helper.
+
+Parity: ``/root/reference/python/paddle/profiler/timer.py`` (``benchmark()``
+singleton with ips/step-time tracking driven by hapi/DataLoader hooks).
+"""
+from __future__ import annotations
+
+import time
+
+
+class _Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._steps = 0
+        self._samples = 0
+        self._elapsed = 0.0
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples=None):
+        if self._t0 is None:
+            self.begin()
+            return
+        now = time.perf_counter()
+        self._elapsed += now - self._t0
+        self._t0 = now
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+
+    def end(self):
+        if self._t0 is not None:
+            self.step()
+            self._t0 = None
+
+    @property
+    def ips(self):
+        """Samples/sec if step() was fed num_samples, else steps/sec."""
+        if self._elapsed == 0:
+            return 0.0
+        n = self._samples if self._samples else self._steps
+        return n / self._elapsed
+
+    def report(self):
+        return {"steps": self._steps, "elapsed_s": self._elapsed,
+                "ips": self.ips}
+
+
+_benchmark = _Benchmark()
+
+
+def benchmark():
+    return _benchmark

@@ -1,0 +1,76 @@
+"""Profiler instrumentation utilities.
+
+Parity: ``/root/reference/python/paddle/profiler/utils.py:37 RecordEvent``.
+Host events are recorded into a per-process buffer (the analog of the
+reference's lock-free ``host_event_recorder.h``); when a jax device trace is
+active, the same scope is also emitted as a ``jax.profiler.TraceAnnotation``
+so events line up with XLA ops in the TensorBoard/XPlane view.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import ContextDecorator
+
+_lock = threading.Lock()
+_host_events: list = []          # (name, tid, start_ns, end_ns, event_type)
+_collecting = False
+
+
+def _set_collecting(flag: bool):
+    global _collecting
+    _collecting = flag
+
+
+def _drain_events():
+    global _host_events
+    with _lock:
+        ev, _host_events = _host_events, []
+    return ev
+
+
+class RecordEvent(ContextDecorator):
+    """User-scoped event: ``with RecordEvent('data_load'): ...`` or decorator."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self.event_type = event_type or "UserDefined"
+        self._jax_ann = None
+        self._begin_ns = None
+
+    def begin(self):
+        self._begin_ns = time.perf_counter_ns()
+        try:
+            import jax.profiler
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+
+    def end(self):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        if self._begin_ns is None:
+            return
+        if _collecting:
+            with _lock:
+                _host_events.append(
+                    (self.name, threading.get_ident(), self._begin_ns,
+                     time.perf_counter_ns(), self.event_type))
+        self._begin_ns = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(filename: str):
+    """Load an exported chrome-trace json (profiler.py export counterpart)."""
+    import json
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,162 @@
+"""hapi Model/fit + paddle.metric tests.
+
+Parity model: reference hapi tests (python/paddle/tests/test_model.py) fit a
+small net on synthetic data and assert accuracy improves and checkpoints
+round-trip; metric tests check streaming values against sklearn-style oracles
+computed with numpy.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc, accuracy
+from paddle_tpu.hapi import EarlyStopping
+
+
+class SynthCls(Dataset):
+    """Linearly separable 2-class blobs."""
+
+    def __init__(self, n=256, d=8, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, d)).astype(np.float32)
+        w = rng.standard_normal((d,))
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp(d=8, classes=2):
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, classes))
+
+
+def _model(net=None):
+    net = net or _mlp()
+    m = paddle.Model(net)
+    m.prepare(optimizer=opt.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss(),
+              metrics=Accuracy())
+    return m
+
+
+def test_fit_improves_and_evaluate(tmp_path):
+    paddle.seed(0)
+    m = _model()
+    before = m.evaluate(SynthCls(), batch_size=64, verbose=0)
+    m.fit(SynthCls(), batch_size=64, epochs=6, verbose=0)
+    after = m.evaluate(SynthCls(), batch_size=64, verbose=0)
+    assert after["acc"] > max(0.9, before["acc"])
+    assert after["loss"][0] < before["loss"][0]
+
+
+def test_predict_and_batch_apis():
+    paddle.seed(1)
+    m = _model()
+    ds = SynthCls(n=32)
+    outs = m.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert len(outs) == 1 and outs[0].shape == (32, 2)
+    lv = m.train_batch([ds.x[:8]], [ds.y[:8]])
+    loss_list = lv[0] if isinstance(lv, tuple) else lv
+    assert np.isfinite(loss_list[0])
+    ev = m.eval_batch([ds.x[:8]], [ds.y[:8]])
+    ev_list = ev[0] if isinstance(ev, tuple) else ev
+    assert np.isfinite(ev_list[0])
+
+
+def test_save_load_roundtrip(tmp_path):
+    paddle.seed(2)
+    m = _model()
+    m.fit(SynthCls(n=64), batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    m.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    net2 = _mlp()
+    m2 = _model(net2)
+    m2.load(path)
+    x = SynthCls(n=4).x
+    np.testing.assert_allclose(
+        m.predict_batch([x])[0], m2.predict_batch([x])[0], rtol=1e-6)
+
+
+def test_fit_with_checkpoint_callback(tmp_path):
+    paddle.seed(3)
+    m = _model()
+    save_dir = str(tmp_path / "ckpts")
+    m.fit(SynthCls(n=64), batch_size=32, epochs=2, verbose=0,
+          save_dir=save_dir)
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+
+
+def test_early_stopping():
+    paddle.seed(4)
+    m = _model()
+    # acc saturates at 1.0 on the separable set, triggering the stop
+    es = EarlyStopping(monitor="acc", patience=1, verbose=0, mode="max")
+    m.fit(SynthCls(n=32), eval_data=SynthCls(n=32), batch_size=32,
+          epochs=50, verbose=0, callbacks=[es])
+    assert m.stop_training  # stopped before the 50th epoch
+
+
+def test_summary_counts_params():
+    net = _mlp(8, 2)
+    info = paddle.summary(net)
+    # 8*32+32 + 32*2+2 = 354
+    assert info["total_params"] == 354
+
+
+def test_metric_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.5, 0.3, 0.2]], np.float32)
+    label = np.array([[1], [2]])
+    correct = m.compute(paddle.to_tensor(pred), paddle.to_tensor(label))
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert top1 == 0.5  # row0 right, row1 wrong
+    assert top2 == 0.5  # row1's label 2 is 3rd even in top-2
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_metric_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # thresh 0.5: predicted pos = [1,1,0,1]; tp=2 fp=1 fn=1
+    assert abs(p.accumulate() - 2 / 3) < 1e-9
+    assert abs(r.accumulate() - 2 / 3) < 1e-9
+
+
+def test_metric_auc_matches_exact():
+    rng = np.random.default_rng(0)
+    scores = rng.random(500)
+    labels = (rng.random(500) < scores).astype(np.int64)  # correlated
+    auc = Auc()
+    auc.update(scores, labels)
+    got = auc.accumulate()
+    # exact AUC via rank statistic
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    n_pos, n_neg = labels.sum(), (1 - labels).sum()
+    exact = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / \
+        (n_pos * n_neg)
+    assert abs(got - exact) < 5e-3
+
+
+def test_functional_accuracy():
+    pred = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    label = np.array([[1], [1]])
+    acc = accuracy(paddle.to_tensor(pred), paddle.to_tensor(label), k=1)
+    assert float(np.asarray(acc._value)) == 0.5

@@ -1,0 +1,89 @@
+"""Profiler tests.
+
+Parity model: reference unittests/test_profiler.py — scheduler state
+transitions, RecordEvent capture, chrome-trace export round-trip, summary
+aggregation.
+"""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, RecordEvent, make_scheduler,
+    export_chrome_tracing, load_profiler_result, benchmark,
+)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [
+        ProfilerState.CLOSED,             # skip_first
+        ProfilerState.CLOSED,             # closed
+        ProfilerState.READY,              # ready
+        ProfilerState.RECORD,             # record
+        ProfilerState.RECORD_AND_RETURN,  # last record step
+        ProfilerState.CLOSED,             # repeat exhausted
+    ]
+
+
+def test_record_and_export(tmp_path):
+    traces = []
+    p = Profiler(scheduler=(0, 3),
+                 on_trace_ready=lambda prof: traces.append(prof),
+                 targets=[profiler.ProfilerTarget.CPU])
+    p.start()
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    for _ in range(3):
+        with RecordEvent("matmul_step"):
+            y = paddle.matmul(x, x)
+        p.step()
+    p.stop()
+    assert traces, "on_trace_ready never fired"
+    path = str(tmp_path / "trace.json")
+    p.export(path)
+    data = load_profiler_result(path)
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "matmul_step" in names
+    stats = p.summary()
+    assert stats["matmul_step"]["calls"] == 3
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    d = str(tmp_path / "traces")
+    p = Profiler(scheduler=(0, 2), on_trace_ready=export_chrome_tracing(d),
+                 targets=[profiler.ProfilerTarget.CPU])
+    p.start()
+    for _ in range(2):
+        with RecordEvent("step"):
+            pass
+        p.step()
+    p.stop()
+    files = os.listdir(d)
+    assert any(f.endswith(".paddle_trace.json") for f in files)
+    with open(os.path.join(d, files[0])) as f:
+        assert "traceEvents" in json.load(f)
+
+
+def test_events_not_collected_when_closed():
+    p = Profiler(scheduler=(5, 6), targets=[profiler.ProfilerTarget.CPU])
+    p.start()
+    with RecordEvent("should_not_appear"):
+        pass
+    p.stop()
+    assert all(e[0] != "should_not_appear" for e in p._events)
+
+
+def test_benchmark_timer():
+    b = benchmark()
+    b.reset()
+    b.begin()
+    for _ in range(3):
+        b.step(num_samples=32)
+    b.end()
+    r = b.report()
+    assert r["ips"] > 0 and r["steps"] >= 3
