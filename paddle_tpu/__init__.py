@@ -17,6 +17,7 @@ _jax.config.update("jax_enable_x64", True)
 
 from .framework import (  # noqa: F401
     Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+    grad,
     seed, get_rng_state, set_rng_state, set_flags, get_flags,
     set_default_dtype, get_default_dtype,
     CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
@@ -45,9 +46,13 @@ def __getattr__(name):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
-            # keep hasattr()/getattr(default) semantics for unbuilt subpackages
-            raise AttributeError(
-                f"module 'paddle_tpu' has no attribute {name!r}") from e
+            # keep hasattr()/getattr(default) semantics for unbuilt
+            # subpackages — but only when it's this subpackage that's absent,
+            # not a genuine missing dependency inside an existing one
+            if e.name == f"{__name__}.{name}":
+                raise AttributeError(
+                    f"module 'paddle_tpu' has no attribute {name!r}") from e
+            raise
         globals()[name] = mod
         return mod
     # top-level classes/fns that live in lazily-imported packages

@@ -1,0 +1,108 @@
+"""Custom differentiable ops via PyLayer.
+
+Parity: ``/root/reference/python/paddle/autograd/py_layer.py`` — user defines
+``forward(ctx, *args)`` / ``backward(ctx, *grads)`` staticmethods with
+``ctx.save_for_backward``. TPU-native: apply() registers one TapeNode whose
+pullback calls the user's ``backward``, so PyLayers interleave freely with
+jax-vjp-taped ops in the same graph (the analog of the reference's
+PyLayerBackward grad node).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import tape as tape_mod
+from ..framework.tape import TapeNode
+
+
+class PyLayerContext:
+    """Carries state from forward to backward (py_layer.py:30)."""
+
+    def __init__(self):
+        self.container = None
+        self._non_differentiable = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    def saved_tensor(self):
+        return self.container
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+        if bases and "apply" in attrs:
+            raise TypeError("apply() must not be overridden in a PyLayer")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with forward/backward staticmethods; call ``apply``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape_mod.no_grad_guard():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = tuple(outputs) if multi else (outputs,)
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError(
+                    f"{cls.__name__}.forward must return Tensor(s), "
+                    f"got {type(o)}")
+
+        # differentiable inputs, in positional order (kwargs are non-diff,
+        # matching the reference's tensor-positional contract)
+        diff_tensors = tuple(
+            a for a in args
+            if isinstance(a, Tensor) and not a.stop_gradient
+            and jnp.issubdtype(a._value.dtype, jnp.floating))
+        if not tape_mod.is_grad_enabled() or not diff_tensors:
+            return outputs
+
+        non_diff_ids = {id(t) for t in ctx._non_differentiable}
+        out_avals = [(o._value.shape, o._value.dtype) for o in outs]
+
+        def vjp_fn(cots):
+            cot_vals = cots if isinstance(cots, tuple) else (cots,)
+            grad_ins = [Tensor(c) for c in cot_vals]
+            with tape_mod.no_grad_guard():
+                gout = cls.backward(ctx, *grad_ins)
+            gouts = tuple(gout) if isinstance(gout, (tuple, list)) else (gout,)
+            if len(gouts) != len(diff_tensors):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(gouts)} grads "
+                    f"for {len(diff_tensors)} differentiable inputs")
+            vals = []
+            for g, t in zip(gouts, diff_tensors):
+                if g is None:
+                    vals.append(jnp.zeros(t.shape, t._value.dtype))
+                else:
+                    vals.append(g._value if isinstance(g, Tensor)
+                                else jnp.asarray(g))
+            return tuple(vals)
+
+        node = TapeNode(vjp_fn, diff_tensors, out_avals, cls.__name__)
+        wrapped = tuple(
+            Tensor(o._value, stop_gradient=id(o) in non_diff_ids,
+                   _node=None if id(o) in non_diff_ids else node,
+                   _out_index=i)
+            for i, o in enumerate(outs))
+        return wrapped if multi else wrapped[0]

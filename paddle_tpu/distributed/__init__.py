@@ -20,3 +20,4 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from . import fleet  # noqa: F401
 
 # paddle.distributed.launch lives in .launch (python -m paddle_tpu.distributed.launch)
+from . import utils  # noqa: F401,E402

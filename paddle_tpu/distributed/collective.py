@@ -177,16 +177,41 @@ def _current_spec(v, mesh, axis):
     return P()
 
 
+def _axis_only_spec(spec, axis):
+    """Project a PartitionSpec onto the group axis (drop foreign axes)."""
+    axes = set((axis,) if isinstance(axis, str) else tuple(axis))
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry if entry in axes else None)
+        else:
+            kept = tuple(a for a in entry if a in axes)
+            out.append(kept if kept else None)
+    return P(*out)
+
+
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather per-rank shards into a list on every rank. Real resharding: when
+    `tensor` is sharded over the group axis the result materializes each
+    rank's (distinct) shard; a replicated input degenerates to n copies,
+    matching the reference where every rank holds the same value."""
     group = _get_group(group)
     v = unwrap(tensor)
     if group.nranks <= 1:
         out = [Tensor(v)]
     else:
         mesh, axis = group.mesh, group.axis_name
+        # keep only the group axis of the input's sharding: foreign-axis
+        # shards must be resharded to replicated first or each local shard
+        # would gather a partial tensor
+        spec = _axis_only_spec(_current_spec(v, mesh, axis), axis)
+        # all_gather output is invariant over the axis; the vma checker can't
+        # infer that, so disable it for this call
         gathered = shard_map(
             lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False),
-            mesh=mesh, in_specs=P(), out_specs=P())(v)
+            mesh=mesh, in_specs=spec, out_specs=P(), check_vma=False)(v)
         out = [Tensor(gathered[i]) for i in range(group.nranks)]
     if tensor_list is not None:
         tensor_list.clear()
@@ -201,34 +226,80 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # single-controller: a global array is already consistent; parity no-op
-    return tensor
+    """Every rank's shard becomes src's shard.
+
+    A replicated global array is already consistent (identity, the common
+    case). When `tensor` IS sharded over the group axis — the only state in
+    which single-controller ranks disagree — a shard_map all_gather picks
+    rank src's shard and writes it into every shard, which is exactly the
+    reference ProcessGroup broadcast."""
+    group = _get_group(group)
+    v = unwrap(tensor)
+    if group.nranks <= 1:
+        return tensor
+    mesh, axis = group.mesh, group.axis_name
+    spec = _current_spec(v, mesh, axis)
+    axes = set((axis,) if isinstance(axis, str) else tuple(axis))
+    spec_axes = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        spec_axes.update((entry,) if isinstance(entry, str) else tuple(entry))
+    if not (axes & spec_axes):
+        return tensor  # replicated w.r.t. the group ⇒ already broadcast
+    out = shard_map(
+        lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)[src],
+        mesh=mesh, in_specs=spec, out_specs=spec)(v)
+    res = Tensor(out)
+    if isinstance(tensor, Tensor):
+        tensor._inplace_assign(res)
+        return tensor
+    return res
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # single-controller: the reduced value is a global array visible to all
+    # ranks, so reduce ≡ all_reduce (dst selects who *keeps* it in the
+    # reference; there is no per-rank storage to differ here)
     return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """This process's rank receives its chunk of src's tensor_list.
+
+    Under multi-process launch each process writes tensor_list[its group
+    rank]; under pure single-controller SPMD (one process, rank 0) the result
+    is chunk 0 — matching the reference where rank r's buffer gets chunk r."""
     group = _get_group(group)
     if tensor_list:
-        tensor._inplace_assign(tensor_list[0].clone()
-                               if isinstance(tensor_list[0], Tensor)
-                               else Tensor(tensor_list[0]))
+        from . import env as env_mod
+        r = group.get_group_rank(env_mod.get_rank())
+        if r < 0:
+            return tensor  # this process is not a member of the group
+        chunk = tensor_list[r]
+        tensor._inplace_assign(chunk.clone() if isinstance(chunk, Tensor)
+                               else Tensor(chunk))
     return tensor
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Chunk exchange over the group's devices.
+
+    Single-controller semantics: all ranks share this controller's
+    in_tensor_list, so rank j's received row is in_tensor_list[j]; the data
+    movement that remains real is *distribution* — each chunk is device_put
+    replicated over the group's devices (so every rank can read its row),
+    keeping outputs composable with each other and with mesh-sharded arrays.
+    Compiled code should use prims.all_to_all / the MoE dispatch instead."""
     group = _get_group(group)
-    if group.nranks <= 1:
+    if group.nranks <= 1 or group.mesh is None:
         outs = [t.clone() if isinstance(t, Tensor) else Tensor(t)
                 for t in in_tensor_list]
     else:
-        stacked = jnp.stack([unwrap(t) for t in in_tensor_list])
-        mesh, axis = group.mesh, group.axis_name
-        # each "rank" i receives chunk i from all: transpose of chunks — in the
-        # single-controller view this is an identity regroup
-        outs = [Tensor(stacked[i]) for i in range(len(in_tensor_list))]
+        mesh = group.mesh
+        repl = NamedSharding(mesh, P())
+        outs = [Tensor(jax.device_put(unwrap(t), repl))
+                for t in in_tensor_list]
     out_tensor_list.clear()
     out_tensor_list.extend(outs)
     return out_tensor_list

@@ -1,0 +1,2 @@
+"""paddle.distributed.launch parity (reference: ``distributed/launch/``)."""
+from .main import launch, main  # noqa: F401

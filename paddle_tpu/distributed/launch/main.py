@@ -1,0 +1,147 @@
+"""Multi-process launcher.
+
+Parity: ``/root/reference/python/paddle/distributed/launch/main.py:18 launch``
++ ``controllers/collective.py`` — spawn one worker process per device with the
+PADDLE_TRAINER_* env contract, tee per-rank logs, kill the pod on first
+failure.
+
+TPU-native notes: on a TPU pod slice the runtime already runs one process per
+host and ``jax.distributed.initialize()`` discovers peers from the TPU
+metadata — so ``--devices`` here means *processes on this host* (the CPU/
+multi-host-sim path, and the test fixture the reference gets from
+``test_dist_base.py``). Rendezvous uses the first endpoint as the jax
+coordinator (the TCPStore analog).
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py --lr 3
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_ports(n, host="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (launch/main.py parity)")
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices",
+                   default=None,
+                   help="comma-separated device ids; count = procs per node")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--nnodes", type=str, default="1")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="host:port of rank-0 coordinator (multi-node)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective"])
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    """Spawn the worker pod; returns the list of exit codes."""
+    args = _parse_args(argv)
+
+    if args.nproc_per_node is not None:
+        nproc = args.nproc_per_node
+    elif args.devices:
+        nproc = len([d for d in str(args.devices).split(",") if d != ""])
+    else:
+        nproc = 1
+    nnodes = int(str(args.nnodes).split(":")[0])
+    world = nproc * nnodes
+
+    host = "127.0.0.1"
+    if args.master:
+        master_ep = args.master
+        ports = _free_ports(nproc)
+        endpoints = None  # filled by master in a real multi-node deployment
+    else:
+        ports = _free_ports(nproc)
+        endpoints = [f"{host}:{p}" for p in ports]
+        master_ep = endpoints[0]
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_CURRENT_ENDPOINT":
+                endpoints[local_rank] if endpoints else
+                f"{host}:{ports[local_rank]}",
+            "PADDLE_MASTER": master_ep,
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        if endpoints:
+            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        cmd = [sys.executable, args.training_script] + \
+            list(args.training_script_args)
+        if args.log_dir:
+            log = open(os.path.join(args.log_dir,
+                                    f"workerlog.{local_rank}"), "w")
+            procs.append((subprocess.Popen(cmd, env=env, stdout=log,
+                                           stderr=subprocess.STDOUT), log))
+        else:
+            procs.append((subprocess.Popen(cmd, env=env), None))
+
+    # supervise: first failure kills the pod (controllers/collective.py watch)
+    codes = [None] * nproc
+    try:
+        while any(c is None for c in codes):
+            for i, (proc, _log) in enumerate(procs):
+                if codes[i] is None:
+                    rc = proc.poll()
+                    if rc is not None:
+                        codes[i] = rc
+                        if rc != 0:
+                            for j, (p2, _l2) in enumerate(procs):
+                                if codes[j] is None:
+                                    p2.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+    finally:
+        for proc, log in procs:
+            if proc.poll() is None:
+                proc.kill()
+            if log:
+                log.close()
+    return codes
+
+
+def main():
+    codes = launch()
+    bad = [c for c in codes if c]
+    if bad:
+        sys.exit(bad[0])
+
+
+if __name__ == "__main__":
+    main()

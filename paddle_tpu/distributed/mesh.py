@@ -97,7 +97,13 @@ class Group:
         return env.get_rank()
 
     def get_group_rank(self, rank=None):
-        return 0 if self.nranks <= 1 else (rank or 0)
+        """Group-local rank of `rank` (global), or -1 if not a member."""
+        if rank is None:
+            from . import env
+            rank = env.get_rank()
+        if self._ranks is not None:
+            return self._ranks.index(rank) if rank in self._ranks else -1
+        return rank % self.nranks if self.nranks > 0 else 0
 
     @property
     def process_ids(self):

@@ -114,6 +114,31 @@ def _amp_cast_fn(op_name):
 # ---------------------------------------------------------------------------
 
 
+def _maybe_check_nan_inf(name, out):
+    """FLAGS_check_nan_inf: scan op outputs like the reference's
+    nan_inf_utils_detail.cc (eager variant eager/nan_inf_utils.cc). Debug-only:
+    forces a host sync per op, and is skipped under tracing (abstract values)."""
+    from . import flags as flags_mod
+    if not flags_mod._FLAGS.get("FLAGS_check_nan_inf", False):
+        return
+    import jax
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    for i, v in enumerate(vals):
+        if not hasattr(v, "dtype") or isinstance(v, jax.core.Tracer):
+            continue
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            continue
+        bad = int(jnp.size(v)) - int(jnp.sum(jnp.isfinite(v)))
+        if bad:
+            level = flags_mod._FLAGS.get("FLAGS_check_nan_inf_level", 0)
+            msg = (f"op '{name}' output {i} contains {bad} NaN/Inf values "
+                   f"(shape {v.shape}, dtype {v.dtype})")
+            if level == 0:
+                raise FloatingPointError(msg)
+            import warnings
+            warnings.warn(msg)
+
+
 class TapeNode:
     """One recorded differentiable op: the vjp pullback plus links to the input
     tensors whose gradients it produces (analog of GradNodeBase + TensorWrapper)."""
@@ -173,6 +198,7 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
 
     if not diff_idx:
         out = fn(*vals, **kwargs)
+        _maybe_check_nan_inf(name, out)
         return _wrap_outputs(out, None, has_aux)
 
     diff_tensors = tuple(args[i] for i in diff_idx)
@@ -192,6 +218,7 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
 
     multi = isinstance(out_val, (tuple, list))
     outs = tuple(out_val) if multi else (out_val,)
+    _maybe_check_nan_inf(name, outs)
     out_avals = [(o.shape, o.dtype) for o in outs]
     node = TapeNode(vjp_fn, diff_tensors, out_avals, name)
 

@@ -1,0 +1,177 @@
+"""Fork-based DataLoader workers.
+
+Parity: the reference's multiprocess DataLoader
+(``fluid/reader.py:311`` + ``fluid/dataloader/dataloader_iter.py`` — forked
+workers, an index queue feeding them, an out-of-order data queue drained with
+a reordering buffer). Differences, deliberate:
+
+- Workers collate to **numpy** (no jax import in children): a forked child
+  must never touch the parent's TPU/XLA runtime; the parent wraps arrays into
+  Tensors on arrival. This replaces the reference's mmap shared-memory
+  LoDTensor transport (``mmap_allocator.cc``) — batches cross via the
+  multiprocessing queue's pickled numpy buffers, and host→device transfer
+  happens once, in the parent, where the device lives.
+- ETL (``__getitem__`` + transforms + collate) runs fully in the workers, so
+  Python-heavy vision pipelines scale past the GIL — the reason VERDICT r1
+  flagged the thread-only loader for config #1 (ResNet imgs/sec).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def np_collate(batch):
+    """default_collate_fn, numpy-only (safe inside forked workers)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(np_collate(list(s)) for s in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: np_collate([b[k] for b in batch]) for k in sample}
+    if hasattr(sample, "numpy"):  # Tensor that leaked into a worker
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    return np.asarray(batch)
+
+
+def _to_np_tree(item):
+    """Worker-side: force everything to numpy so nothing device-backed is
+    pickled across the queue (a custom collate_fn may have built Tensors)."""
+    if isinstance(item, np.ndarray):
+        return item
+    if isinstance(item, tuple):
+        return tuple(_to_np_tree(x) for x in item)
+    if isinstance(item, list):
+        return [_to_np_tree(x) for x in item]
+    if isinstance(item, dict):
+        return {k: _to_np_tree(v) for k, v in item.items()}
+    if hasattr(item, "numpy"):
+        return np.asarray(item.numpy())
+    return item
+
+
+def wrap_np_tree(item):
+    """Parent-side: numpy tree → Tensor tree (single host→device hop)."""
+    if isinstance(item, np.ndarray):
+        return Tensor(item)
+    if isinstance(item, tuple):
+        return tuple(wrap_np_tree(x) for x in item)
+    if isinstance(item, list):
+        return [wrap_np_tree(x) for x in item]
+    if isinstance(item, dict):
+        return {k: wrap_np_tree(v) for k, v in item.items()}
+    return item
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_init_fn, worker_id):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        batch_idx, indices = job
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data_queue.put((batch_idx, _to_np_tree(batch), None))
+        except BaseException as e:  # ship the error to the parent
+            data_queue.put((batch_idx, None, e))
+
+
+class MultiprocessIterator:
+    """Reordering fan-out over forked workers (dataloader_iter.py analog)."""
+
+    def __init__(self, dataset, batches, num_workers, collate_fn,
+                 worker_init_fn=None, prefetch_factor=2, timeout=0):
+        self._batches = list(batches)
+        self._timeout = timeout or None
+        ctx = mp.get_context("fork")
+        self._data_queue = ctx.Queue()
+        self._index_queues = [ctx.Queue() for _ in range(num_workers)]
+        self._workers = []
+        for wid in range(num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self._index_queues[wid], self._data_queue,
+                      collate_fn, worker_init_fn, wid),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._buffer = {}
+        # prime: keep prefetch_factor batches in flight per worker
+        for _ in range(min(len(self._batches),
+                           num_workers * prefetch_factor)):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._send_idx < len(self._batches):
+            wid = self._send_idx % len(self._index_queues)
+            self._index_queues[wid].put(
+                (self._send_idx, self._batches[self._send_idx]))
+            self._send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._rcvd_idx >= len(self._batches):
+            self._shutdown()
+            raise StopIteration
+        import time as _time
+        deadline = (_time.monotonic() + self._timeout) if self._timeout \
+            else None
+        while self._rcvd_idx not in self._buffer:
+            # poll so a worker killed without raising (OOM/segfault) is
+            # detected instead of blocking forever
+            try:
+                idx, batch, err = self._data_queue.get(timeout=5.0)
+            except queue_mod.Empty:
+                dead = [w for w in self._workers
+                        if not w.is_alive() and w.exitcode]
+                if dead:
+                    codes = [w.exitcode for w in dead]
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) died with exit code(s) "
+                        f"{codes} (killed? OOM?)")
+                if deadline is not None and _time.monotonic() > deadline:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {self._timeout}s")
+                continue
+            if err is not None:
+                self._shutdown()
+                raise err
+            self._buffer[idx] = batch
+        batch = self._buffer.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        self._dispatch()
+        return wrap_np_tree(batch)
+
+    def _shutdown(self):
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=1.0)
+            if w.is_alive():
+                w.terminate()
+        self._workers = []
+
+    def __del__(self):
+        if getattr(self, "_workers", None):
+            self._shutdown()

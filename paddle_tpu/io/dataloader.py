@@ -46,8 +46,16 @@ class DataLoader:
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
+        self._custom_collate = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        # fork workers move ETL past the GIL (reference fluid/reader.py:311);
+        # use_shared_memory=False falls back to the prefetch thread
+        import multiprocessing as _mp
+        self._use_mp = (num_workers > 0 and use_shared_memory
+                        and "fork" in _mp.get_all_start_methods())
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -78,6 +86,14 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._produce()
+            return
+        if self._use_mp and not self._iterable:
+            from ._mp_loader import MultiprocessIterator, np_collate
+            collate = self.collate_fn if self._custom_collate else np_collate
+            yield from MultiprocessIterator(
+                self.dataset, list(self.batch_sampler), self.num_workers,
+                collate, worker_init_fn=self.worker_init_fn,
+                prefetch_factor=self.prefetch_factor, timeout=self.timeout)
             return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
