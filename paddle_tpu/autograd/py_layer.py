@@ -80,16 +80,25 @@ class PyLayer(metaclass=PyLayerMeta):
         non_diff_ids = {id(t) for t in ctx._non_differentiable}
         out_avals = [(o._value.shape, o._value.dtype) for o in outs]
 
+        # reference contract: backward returns one grad per forward *tensor*
+        # input; grads for non-differentiable positions are dropped
+        tensor_args = tuple(a for a in args if isinstance(a, Tensor))
+        diff_ids = {id(t) for t in diff_tensors}
+
         def vjp_fn(cots):
             cot_vals = cots if isinstance(cots, tuple) else (cots,)
             grad_ins = [Tensor(c) for c in cot_vals]
             with tape_mod.no_grad_guard():
                 gout = cls.backward(ctx, *grad_ins)
             gouts = tuple(gout) if isinstance(gout, (tuple, list)) else (gout,)
+            if len(gouts) == len(tensor_args):
+                gouts = tuple(g for g, t in zip(gouts, tensor_args)
+                              if id(t) in diff_ids)
             if len(gouts) != len(diff_tensors):
                 raise ValueError(
-                    f"{cls.__name__}.backward returned {len(gouts)} grads "
-                    f"for {len(diff_tensors)} differentiable inputs")
+                    f"{cls.__name__}.backward returned {len(gouts)} grads; "
+                    f"expected {len(tensor_args)} (one per tensor input) or "
+                    f"{len(diff_tensors)} (one per differentiable input)")
             vals = []
             for g, t in zip(gouts, diff_tensors):
                 if g is None:

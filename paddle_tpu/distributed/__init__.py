@@ -21,3 +21,5 @@ from . import fleet  # noqa: F401
 
 # paddle.distributed.launch lives in .launch (python -m paddle_tpu.distributed.launch)
 from . import utils  # noqa: F401,E402
+from . import auto_parallel  # noqa: F401,E402
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401,E402

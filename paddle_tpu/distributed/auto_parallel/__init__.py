@@ -1,0 +1,14 @@
+"""Semi-automatic SPMD (auto-parallel) facade.
+
+Parity: ``/root/reference/python/paddle/distributed/auto_parallel/``
+(process_mesh.py:45 ProcessMesh, interface.py:28 shard_tensor,
+engine.py:122 Engine with fit :807 / evaluate :977 / predict :1087).
+
+TPU-native redesign: the reference's 35k-LoC Completer/Partitioner/Resharder
+pipeline (dist-attr propagation + per-rank program rewrite + comm insertion)
+IS the GSPMD partitioner inside XLA. A ``shard_tensor`` annotation becomes a
+``NamedSharding``; propagation, partitioning, and resharding collectives all
+happen in the compiler. What remains here is the thin user surface.
+"""
+from .interface import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,118 @@
+"""ProcessMesh + shard annotations.
+
+Parity: ``auto_parallel/process_mesh.py:45``, ``interface.py:28``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor, Parameter
+from ...ops._dispatch import unwrap
+
+
+class ProcessMesh:
+    """An N-D arrangement of processes/devices with named dims.
+
+    ``ProcessMesh([[0,1],[2,3]], dim_names=["x","y"])`` — entries are device
+    indices into ``jax.devices()`` (the reference's process ids; one device
+    per process under SPMD).
+    """
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            assert shape is not None and process_ids is not None
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = list(arr.shape)
+        self._process_ids = [int(i) for i in arr.flatten()]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        assert len(dim_names) == arr.ndim, \
+            f"{len(dim_names)} dim_names for {arr.ndim}-d mesh"
+        self._dim_names = list(dim_names)
+        devices = jax.devices()
+        dev_arr = np.asarray([devices[i] for i in self._process_ids],
+                             dtype=object).reshape(arr.shape)
+        self.jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    # reference alias
+    processes = process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def __getitem__(self, idx):
+        sub = np.asarray(self._process_ids).reshape(self._shape)[idx]
+        names = self._dim_names[1:] if np.ndim(sub) < self.ndim \
+            else self._dim_names
+        return ProcessMesh(sub.tolist() if np.ndim(sub) else [int(sub)],
+                           dim_names=names[:max(np.ndim(sub), 1)])
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+def _spec_from_shard_spec(shard_spec):
+    if shard_spec is None:
+        return P()
+    return P(*[s if s is not None else None for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None):
+    """Annotate + place a tensor on the mesh (interface.py:28).
+
+    For Parameters the PartitionSpec is also recorded on
+    ``param.sharding_spec`` so compiled train steps (ParallelTrainStep /
+    GSPMD) pick it up; the value itself is device_put immediately — that is
+    the "reshard" the reference defers to its Resharder.
+    """
+    assert process_mesh is not None, "process_mesh is required"
+    spec = _spec_from_shard_spec(shard_spec)
+    sharding = NamedSharding(process_mesh.jax_mesh, spec)
+    v = unwrap(x)
+    placed = jax.device_put(v, sharding)
+    if isinstance(x, Tensor):
+        x._value = placed
+        try:
+            x.sharding_spec = spec  # Parameters carry it into compiled steps
+        except AttributeError:
+            pass  # plain Tensor __slots__ has no sharding_spec; the value
+            # itself is already placed, which is what matters eagerly
+        return x
+    return Tensor(placed)
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    """Annotate an op's outputs (interface.py shard_op). Under GSPMD the
+    in-specs are inferred; we constrain the outputs."""
+    from ..fleet.mpu import with_sharding_constraint
+
+    def wrapper(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if out_shard_specs is None:
+            return out
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        res = []
+        for o, ss in zip(outs, out_shard_specs):
+            res.append(with_sharding_constraint(
+                o, _spec_from_shard_spec(ss)) if ss is not None else o)
+        return tuple(res) if isinstance(out, (tuple, list)) else res[0]
+
+    return wrapper

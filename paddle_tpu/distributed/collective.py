@@ -247,8 +247,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         spec_axes.update((entry,) if isinstance(entry, str) else tuple(entry))
     if not (axes & spec_axes):
         return tensor  # replicated w.r.t. the group ⇒ already broadcast
+    g_src = group.get_group_rank(src)  # src is a global rank (paddle API)
+    if g_src < 0:
+        raise ValueError(f"src rank {src} is not a member of {group}")
     out = shard_map(
-        lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)[src],
+        lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)[g_src],
         mesh=mesh, in_specs=spec, out_specs=spec)(v)
     res = Tensor(out)
     if isinstance(tensor, Tensor):

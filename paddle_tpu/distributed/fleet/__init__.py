@@ -16,6 +16,9 @@ from .mpu import (  # noqa: F401
 )
 from .train_step import ParallelTrainStep  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention, split_sequence, gather_sequence,
+)
 from ..mesh import (
     HybridCommunicateGroup, CommunicateTopology, get_hybrid_communicate_group,
 )

@@ -1,0 +1,74 @@
+"""Sequence (context) parallelism over the ``sep`` mesh axis.
+
+Additive capability: the reference has no sequence parallelism (SURVEY §2.4);
+this is the TPU-native long-context stack. The sequence dim of activations is
+sharded over ``sep``; attention runs as an exact ring (kernels/
+ring_attention.py) with K/V blocks hopping neighbor-to-neighbor over ICI,
+while every other layer (LN/MLP/embedding) is token-local and needs no
+communication at all — the sp layout is free outside attention.
+
+API:
+- ``ring_attention(q, k, v, is_causal=..., scale=..., group=...)`` — drop-in
+  for scaled_dot_product_attention on [B, S, H, D] tensors.
+- ``split_sequence(x)`` / ``gather_sequence(x)`` — annotate an activation as
+  sep-sharded / replicated on the seq dim (GSPMD moves the data).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ...framework.tape import apply
+from ...ops._dispatch import unwrap
+from ...kernels.ring_attention import ring_attention_sharded
+from ..mesh import get_global_mesh, get_hybrid_communicate_group
+from .mpu import with_sharding_constraint
+
+
+def _sep_axis(group=None):
+    if group is not None and getattr(group, "axis_name", None) is not None \
+            and group.nranks > 1:
+        return group.axis_name, group.mesh
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+        return "sep", hcg.mesh
+    return None, None
+
+
+def ring_attention(query, key, value, is_causal=False, scale=None,
+                   group=None, name=None):
+    """Exact attention with the sequence sharded over sep.
+
+    Falls back to the fused single-device sdpa when no sep axis is active
+    (degree 1), so models can call it unconditionally.
+    """
+    axis, mesh = _sep_axis(group)
+    if axis is None:
+        from ...nn.functional.attention import scaled_dot_product_attention
+        return scaled_dot_product_attention(
+            query, key, value, is_causal=is_causal, scale=scale)
+
+    def f(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, axis,
+                                      causal=is_causal, scale=scale)
+
+    return apply(f, query, key, value, op_name="ring_attention")
+
+
+def split_sequence(x, group=None):
+    """Constrain x [B, S, ...] to be sharded over sep on dim 1."""
+    axis, _ = _sep_axis(group)
+    if axis is None:
+        return x
+    v = unwrap(x)
+    return with_sharding_constraint(
+        x, P(*([None, axis] + [None] * (v.ndim - 2))))
+
+
+def gather_sequence(x, group=None):
+    """Constrain x back to replicated on the seq dim."""
+    axis, _ = _sep_axis(group)
+    if axis is None:
+        return x
+    v = unwrap(x)
+    return with_sharding_constraint(x, P(*([None] * v.ndim)))

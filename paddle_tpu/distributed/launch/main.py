@@ -140,7 +140,10 @@ def main():
     codes = launch()
     bad = [c for c in codes if c]
     if bad:
-        sys.exit(bad[0])
+        # prefer the failing worker's code over the SIGTERM (-15) codes of
+        # healthy workers the supervisor killed
+        positive = [c for c in bad if c > 0]
+        sys.exit(positive[0] if positive else bad[0])
 
 
 if __name__ == "__main__":

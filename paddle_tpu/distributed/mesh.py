@@ -103,7 +103,23 @@ class Group:
             rank = env.get_rank()
         if self._ranks is not None:
             return self._ranks.index(rank) if rank in self._ranks else -1
-        return rank % self.nranks if self.nranks > 0 else 0
+        if self.nranks <= 1:
+            return 0
+        # mesh-axis group: the global rank is a linear index into the mesh
+        # (AXIS_ORDER layout); the group rank is this axis's coordinate —
+        # a plain modulo is wrong for any non-innermost axis
+        if self.mesh is not None:
+            names = list(self.mesh.axis_names)
+            dims = [self.mesh.shape[n] for n in names]
+            total = int(np.prod(dims))
+            coords = np.unravel_index(rank % total, dims)
+            axes = (self.axis_name,) if isinstance(self.axis_name, str) \
+                else tuple(self.axis_name)
+            r = 0
+            for a in axes:
+                r = r * self.mesh.shape[a] + int(coords[names.index(a)])
+            return r
+        return rank % self.nranks
 
     @property
     def process_ids(self):

@@ -132,6 +132,9 @@ class MultiprocessIterator:
         import time as _time
         deadline = (_time.monotonic() + self._timeout) if self._timeout \
             else None
+        # watchdog: even with timeout=0, a forked child wedged on an
+        # inherited lock (alive but deadlocked) must not hang training forever
+        watchdog = _time.monotonic() + max(self._timeout or 0, 600.0)
         while self._rcvd_idx not in self._buffer:
             # poll so a worker killed without raising (OOM/segfault) is
             # detected instead of blocking forever
@@ -146,10 +149,17 @@ class MultiprocessIterator:
                     raise RuntimeError(
                         f"DataLoader worker(s) died with exit code(s) "
                         f"{codes} (killed? OOM?)")
-                if deadline is not None and _time.monotonic() > deadline:
+                now = _time.monotonic()
+                if deadline is not None and now > deadline:
                     self._shutdown()
                     raise RuntimeError(
                         f"DataLoader worker timed out after {self._timeout}s")
+                if now > watchdog:
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader made no progress for 600s — worker "
+                        "presumed deadlocked (fork-inherited lock?); "
+                        "set use_shared_memory=False for threaded loading")
                 continue
             if err is not None:
                 self._shutdown()

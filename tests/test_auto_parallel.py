@@ -1,0 +1,94 @@
+"""Auto-parallel facade tests.
+
+Parity model: reference unittests/auto_parallel/ compile-time checks — a toy
+MLP with shard_tensor annotations must produce correctly sharded params and a
+converging Engine.fit, without devices beyond the virtual mesh.
+"""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh, shard_tensor, shard_op, Engine,
+)
+from paddle_tpu.io import Dataset
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    yield
+    mesh_mod._global_mesh, mesh_mod._hcg = saved
+
+
+def test_process_mesh_construction():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert pm.shape == [2, 4]
+    assert pm.dim_names == ["x", "y"]
+    assert pm.process_ids == list(range(8))
+    assert pm.jax_mesh.shape == {"x": 2, "y": 4}
+
+
+def test_shard_tensor_places_value():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    t = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    shard_tensor(t, pm, ["x", "y"])
+    sh = t._value.sharding
+    assert isinstance(sh, NamedSharding)
+    assert sh.spec == P("x", "y")
+    # a parameter additionally records the spec for compiled steps
+    lin = nn.Linear(4, 8)
+    shard_tensor(lin.weight, pm, [None, "y"])
+    assert lin.weight.sharding_spec == P(None, "y")
+
+
+def test_shard_op_constrains_output():
+    pm = ProcessMesh(list(range(8)), dim_names=["x"])
+    from paddle_tpu.distributed.mesh import set_global_mesh
+    set_global_mesh(pm.jax_mesh)
+    matmul = shard_op(paddle.matmul, pm, out_shard_specs=[[None, None]])
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    out = matmul(a, a)
+    np.testing.assert_allclose(np.asarray(out._value), 4 * np.ones((4, 4)))
+
+
+class _Reg(Dataset):
+    def __init__(self, n=128, d=8):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, d)).astype(np.float32)
+        self.w = rng.standard_normal((d, 1)).astype(np.float32)
+        self.y = self.x @ self.w
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_engine_fit_with_annotations():
+    paddle.seed(0)
+    pm = ProcessMesh(list(range(8)), dim_names=["dp"])
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    # annotate: replicate weights over the mesh (dp pattern)
+    for p in net.parameters():
+        shard_tensor(p, pm, [None] * len(p.shape))
+
+    def mse(pred, label):
+        from paddle_tpu import ops
+        return ops.mean((pred - label) ** 2)
+
+    eng = Engine(net, loss=mse,
+                 optimizer=opt.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters()))
+    eng.prepare(mesh=pm)
+    logs = eng.fit(_Reg(), batch_size=32, epochs=5, verbose=0)
+    assert logs["loss"][-1] < logs["loss"][0] * 0.5
+    ev = eng.evaluate(_Reg(), batch_size=32, verbose=0)
+    assert ev["loss"] is not None and np.isfinite(ev["loss"])
+    preds = eng.predict(_Reg(), batch_size=32)
+    assert len(preds) == 4 and preds[0].shape == (32, 1)

@@ -1,0 +1,118 @@
+"""Ring attention / sequence parallel tests.
+
+Oracle: the single-device fused sdpa (_sdpa_ref) over the full sequence —
+the ring result must be EXACT attention, forward and backward, causal and
+not, on the 8-way sep mesh.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+from paddle_tpu.distributed.fleet import ring_attention, split_sequence
+from paddle_tpu.nn.functional.attention import (
+    scaled_dot_product_attention, _sdpa_ref,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    yield
+    mesh_mod._global_mesh, mesh_mod._hcg = saved
+
+
+def _qkv(B=2, S=32, H=2, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((B, S, H, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    q, k, v = _qkv()
+    want = np.asarray(_sdpa_ref(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), None, 0.0, causal, None,
+                                False))
+    HybridCommunicateGroup(dp_degree=1, sep_degree=8)
+    got = ring_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                         paddle.to_tensor(v), is_causal=causal)
+    np.testing.assert_allclose(np.asarray(got._value), want,
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match(causal):
+    q, k, v = _qkv(S=16)
+
+    def run(path):
+        tq, tk, tv = (paddle.to_tensor(q), paddle.to_tensor(k),
+                      paddle.to_tensor(v))
+        for t in (tq, tk, tv):
+            t.stop_gradient = False
+        if path == "ring":
+            out = ring_attention(tq, tk, tv, is_causal=causal)
+        else:
+            out = scaled_dot_product_attention(tq, tk, tv, is_causal=causal)
+        w = paddle.to_tensor(
+            np.cos(np.arange(out._value.size, dtype=np.float32))
+            .reshape(out.shape))
+        ops.sum(out * w).backward()
+        return (np.asarray(tq.grad._value), np.asarray(tk.grad._value),
+                np.asarray(tv.grad._value))
+
+    ref = run("full")  # no mesh: sdpa oracle
+    HybridCommunicateGroup(dp_degree=1, sep_degree=8)
+    got = run("ring")
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=5e-5, atol=5e-5)
+
+
+def test_ring_degenerate_fallback():
+    """No sep axis active -> plain sdpa (identical values)."""
+    q, k, v = _qkv(S=16)
+    out1 = ring_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                          paddle.to_tensor(v), is_causal=True)
+    out2 = scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True)
+    np.testing.assert_allclose(np.asarray(out1._value),
+                               np.asarray(out2._value), rtol=1e-6)
+
+
+def test_ring_compiles_with_collective_permute():
+    """The compiled module must move K/V via collective-permute (ICI hops)."""
+    from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+    q, k, v = _qkv()
+    hcg = HybridCommunicateGroup(dp_degree=1, sep_degree=8)
+    txt = jax.jit(
+        lambda a, b, c: ring_attention_sharded(
+            a, b, c, hcg.mesh, "sep", causal=True)
+    ).lower(q, k, v).compile().as_text()
+    assert "collective-permute" in txt
+
+
+def test_split_sequence_shards_activation():
+    hcg = HybridCommunicateGroup(dp_degree=1, sep_degree=8)
+    x = paddle.to_tensor(np.zeros((2, 32, 8), np.float32))
+
+    @paddle.jit.to_static
+    def f(t):
+        return split_sequence(t) * 2.0
+
+    out = f(x)
+    assert tuple(out.shape) == (2, 32, 8)
+
+
+def test_long_sequence_runs():
+    """S=1024 over sep=8: per-device logits are 128x1024... ring keeps it
+    at [B,H,128,128] per step; just assert it runs and is finite."""
+    q, k, v = _qkv(B=1, S=1024, H=2, D=16, seed=3)
+    HybridCommunicateGroup(dp_degree=1, sep_degree=8)
+    out = ring_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                         paddle.to_tensor(v), is_causal=True)
+    assert np.isfinite(np.asarray(out._value)).all()
