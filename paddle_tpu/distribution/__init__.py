@@ -1,0 +1,14 @@
+"""paddle.distribution parity (reference: ``python/paddle/distribution/``)."""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .distributions import (  # noqa: F401
+    Normal, Uniform, Categorical, Beta, Dirichlet, Gumbel, Laplace,
+    LogNormal, Multinomial, Bernoulli,
+)
+from .independent import Independent  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401

@@ -1,0 +1,107 @@
+"""Distribution base classes.
+
+Parity: ``/root/reference/python/paddle/distribution/distribution.py`` (base
+contract: sample/rsample/log_prob/prob/entropy/cdf + batch_shape/event_shape)
+and ``exponential_family.py`` (Bregman-divergence entropy hook).
+All math is pure jax routed through the autograd tape, so log_prob/rsample
+are differentiable w.r.t. parameters (and values) like the reference's.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import tape as tape_mod
+from ..ops._dispatch import unwrap
+
+
+def _t(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-differentiable draw."""
+        with tape_mod.no_grad_guard():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        """Reparameterized (differentiable) draw."""
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        if isinstance(sample_shape, int):
+            sample_shape = (sample_shape,)
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+
+class ExponentialFamily(Distribution):
+    """Entropy via the Bregman identity over natural parameters
+    (exponential_family.py): -H = <natural, E[T]> - A(natural) computed with
+    autodiff of the log normalizer. Subclasses may override entropy directly;
+    this default uses jax.grad on ``_log_normalizer``."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        import jax
+        nat = [unwrap(n).astype(jnp.float32)
+               for n in self._natural_parameters]
+
+        def logA(*n):
+            return jnp.sum(self._log_normalizer(*n))
+
+        grads = jax.grad(logA, argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - self._mean_carrier_measure
+        for n, g in zip(nat, grads):
+            ent = ent - n * g
+        return Tensor(ent)

@@ -1,0 +1,47 @@
+"""Independent: reinterpret batch dims as event dims.
+
+Parity: ``/root/reference/python/paddle/distribution/independent.py``.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution
+from ..ops._dispatch import unwrap
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        assert 0 < reinterpreted_batch_rank <= len(base.batch_shape)
+        self.base = base
+        self._reinterpreted = reinterpreted_batch_rank
+        shape = base.batch_shape + base.event_shape
+        n = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:n],
+                         event_shape=shape[n:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        from .. import ops
+        lp = self.base.log_prob(value)
+        axes = list(range(unwrap(lp).ndim - self._reinterpreted,
+                          unwrap(lp).ndim))
+        return ops.sum(lp, axis=axes)
+
+    def entropy(self):
+        from .. import ops
+        ent = self.base.entropy()
+        axes = list(range(unwrap(ent).ndim - self._reinterpreted,
+                          unwrap(ent).ndim))
+        return ops.sum(ent, axis=axes)

@@ -1,0 +1,91 @@
+"""KL divergence registry.
+
+Parity: ``/root/reference/python/paddle/distribution/kl.py`` —
+``kl_divergence(p, q)`` dispatching on a ``register_kl`` table with
+most-specific-match resolution.
+"""
+from __future__ import annotations
+
+_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def _lookup(tp, tq):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(tp, p) and issubclass(tq, q)]
+    if not matches:
+        return None
+    # most specific: minimal by MRO distance
+    def score(pair):
+        p, q = pair
+        return (tp.__mro__.index(p), tq.__mro__.index(q))
+    return _REGISTRY[min(matches, key=score)]
+
+
+def kl_divergence(p, q):
+    fn = _lookup(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    # same-type closed forms implemented on the class (guard against the
+    # base Distribution.kl_divergence, which dispatches back here)
+    from .distribution import Distribution
+    if type(p) is type(q) and \
+            type(p).kl_divergence is not Distribution.kl_divergence:
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+# built-in registrations (kl.py registers these same pairs)
+from .distributions import Normal, Categorical, Uniform, Beta, Dirichlet  # noqa: E402
+from ..framework.tape import apply  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+from jax.scipy.special import gammaln, digamma  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return apply(
+        lambda a1, b1, a2, b2: jnp.where(
+            (a2 <= a1) & (b1 <= b2),
+            jnp.log((b2 - a2) / (b1 - a1)), jnp.inf),
+        p.low, p.high, q.low, q.high, op_name="uniform_kl")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def kl(a1, b1, a2, b2):
+        return ((gammaln(a1 + b1) - gammaln(a1) - gammaln(b1))
+                - (gammaln(a2 + b2) - gammaln(a2) - gammaln(b2))
+                + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    return apply(kl, p.alpha, p.beta, q.alpha, q.beta, op_name="beta_kl")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def kl(c1, c2):
+        s1 = c1.sum(-1)
+        return (gammaln(s1) - jnp.sum(gammaln(c1), -1)
+                - gammaln(c2.sum(-1)) + jnp.sum(gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (digamma(c1)
+                                       - digamma(s1[..., None])), -1))
+    return apply(kl, p.concentration, q.concentration,
+                 op_name="dirichlet_kl")

@@ -1,0 +1,303 @@
+"""Bijective transforms.
+
+Parity: ``/root/reference/python/paddle/distribution/transform.py`` (Transform
+base with forward/inverse/forward_log_det_jacobian + the concrete set).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..framework.tape import apply
+from ..ops._dispatch import unwrap
+from .distribution import _t
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.OTHER
+    # event dims consumed by one application (0 = elementwise)
+    event_rank = 0
+
+    def forward(self, x):
+        return apply(self._forward, _t(x), op_name=self._name("fwd"))
+
+    def inverse(self, y):
+        return apply(self._inverse, _t(y), op_name=self._name("inv"))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(self._fldj, _t(x), op_name=self._name("fldj"))
+
+    def inverse_log_det_jacobian(self, y):
+        from .. import ops
+        return ops.scale(self.forward_log_det_jacobian(self.inverse(y)), -1.0)
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def _name(self, tag):
+        return f"{type(self).__name__}_{tag}"
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks (pure jax)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return unwrap(self.loc) + unwrap(self.scale) * x
+
+    def _inverse(self, y):
+        return (y - unwrap(self.loc)) / unwrap(self.scale)
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(unwrap(self.scale))),
+                                x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, unwrap(self.power))
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / unwrap(self.power))
+
+    def _fldj(self, x):
+        p = unwrap(self.power)
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    event_rank = 1
+
+    def _forward(self, x):
+        e = jnp.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = 1 / (1 + jnp.exp(-(x - jnp.log(offset.astype(x.dtype)))))
+        zc = jnp.cumprod(1 - z, -1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * \
+            jnp.concatenate([pad, zc], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        z = y[..., :-1] / (1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1))
+        offset = y.shape[-1] - jnp.arange(1, y.shape[-1])
+        return jnp.log(z / (1 - z)) + jnp.log(offset.astype(y.dtype))
+
+    def _fldj(self, x):
+        # det J = prod_i z_i(1-z_i)·stick_i identity, in log form (matches
+        # the torch/tfp stick-breaking jacobian)
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        x2 = x - jnp.log(offset.astype(x.dtype))
+        y = self._forward(x)
+        import jax
+        return jnp.sum(-x2 + jax.nn.log_sigmoid(x2)
+                       + jnp.log(y[..., :-1]), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self.event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.event_rank = max([t.event_rank for t in self.transforms] + [0])
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from .. import ops
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            # align ranks: a transform with lower event_rank than the chain
+            # leaves per-element jacobians that must be reduced to the
+            # chain's batch rank before they can be added (otherwise a
+            # scalar term broadcasts over event dims and gets multi-counted)
+            extra = self.event_rank - t.event_rank
+            if extra > 0:
+                jv = unwrap(j)
+                axes = list(range(jv.ndim - extra, jv.ndim))
+                if axes:
+                    j = ops.sum(j, axis=axes)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = reinterpreted_batch_rank
+        self.event_rank = base.event_rank + reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        from .. import ops
+        j = self.base.forward_log_det_jacobian(x)
+        v = unwrap(j)
+        axes = list(range(v.ndim - self.reinterpreted_batch_rank, v.ndim))
+        return ops.sum(j, axis=axes)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        from .. import ops
+        return ops.unstack(x, axis=self.axis)
+
+    def forward(self, x):
+        from .. import ops
+        parts = self._split(x)
+        return ops.stack([t.forward(p) for t, p in
+                          zip(self.transforms, parts)], axis=self.axis)
+
+    def inverse(self, y):
+        from .. import ops
+        parts = self._split(y)
+        return ops.stack([t.inverse(p) for t, p in
+                          zip(self.transforms, parts)], axis=self.axis)
+
+    def forward_log_det_jacobian(self, x):
+        from .. import ops
+        parts = self._split(x)
+        return ops.stack([t.forward_log_det_jacobian(p) for t, p in
+                          zip(self.transforms, parts)], axis=self.axis)

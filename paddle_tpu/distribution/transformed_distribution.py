@@ -1,0 +1,47 @@
+"""TransformedDistribution.
+
+Parity: ``/root/reference/python/paddle/distribution/
+transformed_distribution.py`` — base distribution pushed through a chain of
+transforms; log_prob applies the change-of-variables correction.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution
+from .transform import ChainTransform
+from ..ops._dispatch import unwrap
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = self._chain.forward_shape(shape)
+        k = self._chain.event_rank
+        super().__init__(batch_shape=tuple(out_shape[:len(out_shape) - k]),
+                         event_shape=tuple(out_shape[len(out_shape) - k:]))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        from .. import ops
+        x = self._chain.inverse(value)
+        lp = self.base.log_prob(x)
+        j = self._chain.forward_log_det_jacobian(x)
+        jv = unwrap(j)
+        lv = unwrap(lp)
+        if jv.ndim > lv.ndim:
+            axes = list(range(lv.ndim, jv.ndim))
+            j = ops.sum(j, axis=axes)
+        elif jv.ndim < lv.ndim:
+            # event-consuming transform already reduced; align by summing lp
+            axes = list(range(jv.ndim, lv.ndim))
+            lp = ops.sum(lp, axis=axes)
+        return lp - j

@@ -1,0 +1,80 @@
+"""paddle.fft parity (reference: ``python/paddle/fft.py`` → phi fft kernels).
+
+Thin dispatch onto jnp.fft — XLA lowers FFTs natively on TPU. Norm semantics
+("backward"/"ortho"/"forward") match numpy's, which is what the reference
+implements.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .framework.tape import apply
+from .ops._dispatch import unwrap, wrap
+
+
+def _fft1(fn_name):
+    fn = getattr(jnp.fft, fn_name)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda v: fn(v, n=n, axis=axis, norm=norm), x,
+                     op_name=fn_name)
+    op.__name__ = fn_name
+    return op
+
+
+def _fft2d(fn_name):
+    fn = getattr(jnp.fft, fn_name)
+
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=norm), x,
+                     op_name=fn_name)
+    op.__name__ = fn_name
+    return op
+
+
+fft = _fft1("fft")
+ifft = _fft1("ifft")
+rfft = _fft1("rfft")
+irfft = _fft1("irfft")
+hfft = _fft1("hfft")
+ihfft = _fft1("ihfft")
+
+def _fftn(fn_name):
+    fn = getattr(jnp.fft, fn_name)
+
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        # axes=None means ALL axes (numpy/paddle fftn contract)
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=norm), x,
+                     op_name=fn_name)
+    op.__name__ = fn_name
+    return op
+
+
+fft2 = _fft2d("fft2")
+ifft2 = _fft2d("ifft2")
+rfft2 = _fft2d("rfft2")
+irfft2 = _fft2d("irfft2")
+
+fftn = _fftn("fftn")
+ifftn = _fftn("ifftn")
+rfftn = _fftn("rfftn")
+irfftn = _fftn("irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x,
+                 op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
+                 op_name="ifftshift")

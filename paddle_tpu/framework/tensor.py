@@ -135,6 +135,12 @@ class Tensor:
         self.stop_gradient = True
         return self
 
+    def to_sparse_coo(self, sparse_dim=None):
+        """Dense → SparseCooTensor (reference Tensor.to_sparse_coo);
+        sparse_dim keeps trailing dims dense (hybrid COO)."""
+        from ..sparse.unary import to_coo
+        return to_coo(self, sparse_dim=sparse_dim)
+
     def clone(self) -> "Tensor":
         from .. import ops
         return ops.assign(self)

@@ -175,8 +175,11 @@ class Optimizer:
             # per-param regularizer overrides global (reference optimizer.py)
             reg = []
             for p, g in params_grads:
-                r = p.regularizer if p.regularizer is not None \
-                    else self._regularization
+                # plain trainable Tensors (no Parameter attrs) are accepted,
+                # matching the reference optimizer contract
+                r = getattr(p, "regularizer", None)
+                if r is None:
+                    r = self._regularization
                 if isinstance(r, L2Decay) and r.coeff:
                     g = Tensor(unwrap(g) + r.coeff * unwrap(p))
                 elif isinstance(r, L1Decay) and r.coeff:
@@ -187,7 +190,8 @@ class Optimizer:
                 params_grads = self._grad_clip(params_grads)
             self._global_step += 1
             for p, g in params_grads:
-                lr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0)
+                lr = self.get_lr() * getattr(
+                    p, "optimize_attr", {}).get("learning_rate", 1.0)
                 self._update_param(p, unwrap(g), lr)
 
     def _update_param(self, p, g, lr):

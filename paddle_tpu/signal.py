@@ -1,0 +1,103 @@
+"""paddle.signal parity (reference: ``python/paddle/signal.py`` — stft/istft
+and frame/overlap_add on top of the fft kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tape import apply
+from .ops._dispatch import unwrap
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def f(v):
+        assert axis in (-1, v.ndim - 1), "frame supports the last axis"
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        out = v[..., idx]                      # [..., num, frame_length]
+        return jnp.moveaxis(out, -2, -1)       # paddle: [..., frame_len, num]
+    return apply(f, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def f(v):
+        assert axis in (-1, v.ndim - 1)
+        frame_length, num = v.shape[-2], v.shape[-1]
+        n = frame_length + hop_length * (num - 1)
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        for i in range(num):  # static unroll; num is trace-time constant
+            out = out.at[..., i * hop_length:i * hop_length + frame_length]\
+                .add(v[..., i])
+        return out
+    return apply(f, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Returns [..., n_fft//2+1 (or n_fft), num_frames] complex, matching the
+    reference signal.py stft contract."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def f(v, w):
+        w_full = jnp.zeros(n_fft, v.dtype)
+        start = (n_fft - win_length) // 2
+        w_full = w_full.at[start:start + win_length].set(w.astype(v.dtype))
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = v[..., idx] * w_full                  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -2, -1)              # [..., freq, num]
+    return apply(f, x, win, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def f(v, w):
+        spec = jnp.moveaxis(v, -1, -2)                 # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        w_full = jnp.zeros(n_fft, frames.dtype)
+        start = (n_fft - win_length) // 2
+        w_full = w_full.at[start:start + win_length].set(
+            w.astype(frames.dtype))
+        frames = frames * w_full
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        norm = jnp.zeros((n,), jnp.abs(w_full).dtype)  # real even if complex
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(w_full ** 2)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out.shape[-1] - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply(f, x, win, op_name="istft")

@@ -1,0 +1,17 @@
+"""paddle.sparse parity (reference: ``python/paddle/sparse/`` → phi sparse
+kernels: ``paddle/phi/kernels/sparse/``).
+
+TPU-native redesign: COO storage is ``jax.experimental.sparse.BCOO`` — the
+XLA-native batched-COO format whose matmuls lower to gather/scatter + MXU
+dense blocks. CSR is kept as an index-converted view over the same data
+(XLA has no native CSR compute; to_dense/matmul route through BCOO).
+"""
+from .creation import (  # noqa: F401
+    sparse_coo_tensor, sparse_csr_tensor,
+)
+from .tensor import SparseCooTensor, SparseCsrTensor  # noqa: F401
+from .unary import (  # noqa: F401
+    relu, sin, tanh, sqrt, abs, neg, cast, to_dense, to_coo,
+)
+from .binary import add, subtract, multiply, matmul, masked_matmul  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,60 @@
+"""Sparse binary ops (reference: python/paddle/sparse/binary.py →
+phi/kernels/sparse/elementwise_kernel.h, matmul_kernel.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()._bcoo
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    raise TypeError(type(x))
+
+
+def add(x, y, name=None):
+    s = (_coo(x) + _coo(y)).sum_duplicates()
+    return SparseCooTensor(s)
+
+
+def subtract(x, y, name=None):
+    yb = _coo(y)
+    neg = jsparse.BCOO((-yb.data, yb.indices), shape=yb.shape)
+    return SparseCooTensor((_coo(x) + neg).sum_duplicates())
+
+
+def multiply(x, y, name=None):
+    # elementwise; densify the smaller operand's pattern (phi kernels do the
+    # pattern intersection; BCOO lacks it, dense mul then re-sparsify)
+    dense = _coo(x).todense() * _coo(y).todense()
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (the phi sparse matmul contract)."""
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        yv = _coo(y).todense()
+    else:
+        yv = unwrap(y)
+    xb = _coo(x) if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else None
+    if xb is not None:
+        return Tensor(xb @ yv)
+    return Tensor(unwrap(x) @ _coo(y).todense())
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's sparsity pattern (sddmm)."""
+    xv, yv = unwrap(x), unwrap(y)
+    mb = _coo(mask)
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nd,nd->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, mb.indices), shape=(xv.shape[0], yv.shape[1])))

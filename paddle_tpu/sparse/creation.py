@@ -1,0 +1,39 @@
+"""Sparse tensor creation (reference: python/paddle/sparse/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+from ..ops._dispatch import unwrap
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """indices [sparse_dim, nnz] + values [nnz, ...] → SparseCooTensor."""
+    idx = np.asarray(unwrap(indices) if isinstance(indices, Tensor)
+                     else indices, np.int64)
+    vals = jnp.asarray(unwrap(values) if isinstance(values, Tensor)
+                       else values)
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(idx[d].max()) + 1 for d in range(idx.shape[0])) + \
+            tuple(vals.shape[1:])
+    b = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(b)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = jnp.asarray(unwrap(values) if isinstance(values, Tensor)
+                       else values)
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    return SparseCsrTensor(
+        unwrap(crows) if isinstance(crows, Tensor) else crows,
+        unwrap(cols) if isinstance(cols, Tensor) else cols,
+        vals, shape)

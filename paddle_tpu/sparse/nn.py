@@ -1,0 +1,34 @@
+"""paddle.sparse.nn parity (reference: python/paddle/sparse/nn/) — the
+activation layer wrappers used with sparse tensors."""
+from __future__ import annotations
+
+from . import unary
+
+
+class ReLU:
+    def __call__(self, x):
+        return unary.relu(x)
+
+
+class Softmax:
+    """Row-wise softmax over CSR values (phi sparse softmax contract)."""
+
+    def __init__(self, axis=-1):
+        assert axis == -1, "sparse softmax supports the last axis"
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+        from .tensor import SparseCsrTensor
+        assert isinstance(x, SparseCsrTensor), "softmax expects CSR"
+        # on-device segmented softmax: row id per value from the crows diffs
+        n_rows = len(x._crows) - 1
+        counts = jnp.diff(x._crows)
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=x.nnz)
+        v = x._values.astype(jnp.float32)
+        m = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        ex = jnp.exp(v - m[rows])
+        denom = jax.ops.segment_sum(ex, rows, num_segments=n_rows)
+        out = (ex / denom[rows]).astype(x._values.dtype)
+        return SparseCsrTensor(x._crows, x._cols, out, x._shape)

@@ -1,0 +1,120 @@
+"""Sparse tensor wrappers over jax BCOO.
+
+Parity: ``DenseTensor``-sibling types ``SparseCooTensor``/``SparseCsrTensor``
+(``/root/reference/paddle/phi/core/sparse_coo_tensor.h``,
+``sparse_csr_tensor.h``) surfaced in Python via Tensor.to_sparse_coo etc.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+
+
+class SparseCooTensor:
+    """COO sparse tensor; ``indices`` [ndim, nnz], ``values`` [nnz]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface -----------------------------------------------------
+    def indices(self):
+        return Tensor(jnp.asarray(self._bcoo.indices.T, jnp.int64))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor.from_coo(self)
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view: crows [rows+1], cols [nnz], values [nnz] (2-D only)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int64)
+        self._cols = jnp.asarray(cols, jnp.int64)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor):
+        assert len(coo.shape) == 2, "CSR requires 2-D"
+        b = coo._bcoo.sum_duplicates()
+        rows = np.asarray(b.indices[:, 0])
+        cols = np.asarray(b.indices[:, 1])
+        vals = np.asarray(b.data)
+        order = np.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        crows = np.zeros(coo.shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return cls(crows, cols, vals, coo.shape)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz)
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return Tensor(dense.at[rows, self._cols].add(self._values))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._cols], axis=1)
+        b = jsparse.BCOO((self._values, idx), shape=self._shape)
+        return SparseCooTensor(b)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")

@@ -1,0 +1,78 @@
+"""Sparse elementwise/unary ops (reference: python/paddle/sparse/unary.py →
+phi/kernels/sparse/unary_kernel.h). Zero-preserving ops apply to values only."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_jax_dtype
+from ..ops._dispatch import unwrap
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def _map_values(x, fn):
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, fn(x._values), x._shape)
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def relu(x, name=None):
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def sin(x, name=None):
+    return _map_values(x, jnp.sin)
+
+
+def tanh(x, name=None):
+    return _map_values(x, jnp.tanh)
+
+
+def sqrt(x, name=None):
+    return _map_values(x, jnp.sqrt)
+
+
+def abs(x, name=None):
+    return _map_values(x, jnp.abs)
+
+
+def neg(x, name=None):
+    return _map_values(x, jnp.negative)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    out = x
+    if value_dtype is not None:
+        out = _map_values(out, lambda v: v.astype(to_jax_dtype(value_dtype)))
+    if index_dtype is not None:
+        idt = to_jax_dtype(index_dtype)
+        if isinstance(out, SparseCooTensor):
+            b = out._bcoo
+            out = SparseCooTensor(jsparse.BCOO(
+                (b.data, b.indices.astype(idt)), shape=b.shape))
+        elif isinstance(out, SparseCsrTensor):
+            out = SparseCsrTensor(out._crows.astype(idt),
+                                  out._cols.astype(idt),
+                                  out._values, out._shape)
+    return out
+
+
+def to_dense(x, name=None):
+    return x.to_dense()
+
+
+def to_coo(x, sparse_dim=None, name=None):
+    if isinstance(x, SparseCooTensor):
+        return x
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    # dense Tensor → COO; sparse_dim < ndim produces a hybrid COO whose
+    # trailing dims stay dense (reference Tensor.to_sparse_coo contract)
+    v = unwrap(x)
+    n_dense = 0 if sparse_dim is None else v.ndim - int(sparse_dim)
+    return SparseCooTensor(jsparse.BCOO.fromdense(v, n_dense=n_dense))

@@ -1,0 +1,198 @@
+"""Distribution tests against the torch.distributions oracle.
+
+Parity model: reference unittests/distribution/ compare log_prob/entropy/kl
+against scipy; here torch (cpu, baked in) is the oracle.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Normal, Uniform, Categorical, Beta, Dirichlet, Gumbel, Laplace,
+    LogNormal, Multinomial, Bernoulli, Independent, TransformedDistribution,
+    AffineTransform, ExpTransform, TanhTransform, SigmoidTransform,
+    ChainTransform, kl_divergence, register_kl,
+)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+VALS = np.array([0.3, 1.2, -0.7], np.float32)
+
+
+def test_normal_oracle():
+    p = Normal(loc=0.5, scale=2.0)
+    tp = torch.distributions.Normal(0.5, 2.0)
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(VALS))),
+                               tp.log_prob(torch.tensor(VALS)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_np(p.entropy())),
+                               float(tp.entropy()), rtol=1e-5)
+    np.testing.assert_allclose(_np(p.cdf(paddle.to_tensor(VALS))),
+                               tp.cdf(torch.tensor(VALS)).numpy(), rtol=1e-5)
+    q = Normal(loc=-1.0, scale=0.5)
+    tq = torch.distributions.Normal(-1.0, 0.5)
+    np.testing.assert_allclose(
+        float(_np(kl_divergence(p, q))),
+        float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-5)
+
+
+def test_lognormal_laplace_gumbel_oracle():
+    pairs = [
+        (LogNormal(0.3, 0.8), torch.distributions.LogNormal(0.3, 0.8),
+         np.array([0.5, 1.5, 3.0], np.float32)),
+        (Laplace(0.2, 1.5), torch.distributions.Laplace(0.2, 1.5), VALS),
+        (Gumbel(0.1, 2.0), torch.distributions.Gumbel(0.1, 2.0), VALS),
+    ]
+    for p, tp, vals in pairs:
+        np.testing.assert_allclose(
+            _np(p.log_prob(paddle.to_tensor(vals))),
+            tp.log_prob(torch.tensor(vals)).numpy(), rtol=1e-4,
+            err_msg=type(p).__name__)
+        np.testing.assert_allclose(
+            np.asarray(_np(p.entropy())).reshape(-1)[0],
+            float(tp.entropy().reshape(-1)[0]), rtol=1e-4,
+            err_msg=type(p).__name__)
+
+
+def test_uniform_beta_dirichlet_oracle():
+    u = Uniform(-1.0, 3.0)
+    tu = torch.distributions.Uniform(-1.0, 3.0)
+    np.testing.assert_allclose(_np(u.log_prob(paddle.to_tensor(VALS))),
+                               tu.log_prob(torch.tensor(VALS)).numpy(),
+                               rtol=1e-5)
+    b = Beta(2.0, 3.0)
+    tb = torch.distributions.Beta(2.0, 3.0)
+    v = np.array([0.2, 0.5, 0.9], np.float32)
+    np.testing.assert_allclose(_np(b.log_prob(paddle.to_tensor(v))),
+                               tb.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(_np(b.entropy())),
+                               float(tb.entropy()), rtol=1e-4)
+    c = np.array([1.5, 2.0, 3.0], np.float32)
+    d = Dirichlet(paddle.to_tensor(c))
+    td = torch.distributions.Dirichlet(torch.tensor(c))
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(float(_np(d.log_prob(paddle.to_tensor(x)))),
+                               float(td.log_prob(torch.tensor(x))),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               float(td.entropy()), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(_np(kl_divergence(d, Dirichlet(paddle.to_tensor(c * 2))))),
+        float(torch.distributions.kl_divergence(
+            td, torch.distributions.Dirichlet(torch.tensor(c * 2)))),
+        rtol=1e-4)
+
+
+def test_categorical_and_multinomial():
+    w = np.array([1.0, 2.0, 3.0], np.float32)  # relative weights
+    c = Categorical(paddle.to_tensor(w))
+    tc = torch.distributions.Categorical(probs=torch.tensor(w))
+    v = np.array([0, 1, 2])
+    np.testing.assert_allclose(_np(c.log_prob(paddle.to_tensor(v))),
+                               tc.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_np(c.entropy())),
+                               float(tc.entropy()), rtol=1e-5)
+
+    m = Multinomial(10, paddle.to_tensor(w / w.sum()))
+    tm = torch.distributions.Multinomial(10, probs=torch.tensor(w))
+    counts = np.array([2.0, 3.0, 5.0], np.float32)
+    np.testing.assert_allclose(
+        float(_np(m.log_prob(paddle.to_tensor(counts)))),
+        float(tm.log_prob(torch.tensor(counts))), rtol=1e-5)
+    s = m.sample((4,))
+    assert _np(s).shape == (4, 3) and np.allclose(_np(s).sum(-1), 10)
+
+
+def test_bernoulli_and_sampling_statistics():
+    paddle.seed(0)
+    p = Bernoulli(paddle.to_tensor(np.float32(0.7)))
+    s = _np(p.sample((5000,)))
+    assert abs(s.mean() - 0.7) < 0.03
+    n = Normal(1.0, 2.0)
+    s = _np(n.sample((8000,)))
+    assert abs(s.mean() - 1.0) < 0.1 and abs(s.std() - 2.0) < 0.1
+
+
+def test_rsample_differentiable():
+    paddle.seed(1)
+    loc = paddle.to_tensor(np.float32(0.0))
+    scale = paddle.to_tensor(np.float32(1.0))
+    loc.stop_gradient = scale.stop_gradient = False
+    n = Normal(loc, scale)
+    from paddle_tpu import ops
+    x = n.rsample((64,))
+    ops.mean(x * x).backward()
+    assert loc.grad is not None and scale.grad is not None
+
+
+def test_independent_sums_event_dims():
+    locs = np.zeros((4, 3), np.float32)
+    n = Normal(paddle.to_tensor(locs), paddle.to_tensor(np.ones_like(locs)))
+    ind = Independent(n, 1)
+    assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+    v = np.ones((4, 3), np.float32)
+    lp = _np(ind.log_prob(paddle.to_tensor(v)))
+    assert lp.shape == (4,)
+    tn = torch.distributions.Independent(
+        torch.distributions.Normal(torch.zeros(4, 3), torch.ones(4, 3)), 1)
+    np.testing.assert_allclose(lp, tn.log_prob(torch.ones(4, 3)).numpy(),
+                               rtol=1e-5)
+
+
+def test_transformed_distribution_oracle():
+    base = Normal(0.0, 1.0)
+    tbase = torch.distributions.Normal(0.0, 1.0)
+    td = TransformedDistribution(base, [AffineTransform(1.0, 2.0)])
+    tt = torch.distributions.TransformedDistribution(
+        tbase, [torch.distributions.AffineTransform(1.0, 2.0)])
+    v = np.array([0.5, 2.0], np.float32)
+    np.testing.assert_allclose(_np(td.log_prob(paddle.to_tensor(v))),
+                               tt.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-5)
+    # exp transform == lognormal
+    te = TransformedDistribution(Normal(0.3, 0.8), [ExpTransform()])
+    ln = LogNormal(0.3, 0.8)
+    v = np.array([0.5, 1.5], np.float32)
+    np.testing.assert_allclose(_np(te.log_prob(paddle.to_tensor(v))),
+                               _np(ln.log_prob(paddle.to_tensor(v))),
+                               rtol=1e-5)
+
+
+def test_transforms_roundtrip_and_jacobian():
+    v = np.array([-0.9, 0.1, 0.8], np.float32)
+    for T, tt in [
+        (TanhTransform(), torch.distributions.TanhTransform()),
+        (SigmoidTransform(), torch.distributions.SigmoidTransform()),
+    ]:
+        x = paddle.to_tensor(v)
+        y = T.forward(x)
+        back = T.inverse(y)
+        np.testing.assert_allclose(_np(back), v, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(T.forward_log_det_jacobian(x)),
+            tt.log_abs_det_jacobian(torch.tensor(v),
+                                    tt(torch.tensor(v))).numpy(),
+            rtol=1e-4, atol=1e-5)
+    chain = ChainTransform([AffineTransform(0.0, 2.0), TanhTransform()])
+    y = chain.forward(paddle.to_tensor(v))
+    np.testing.assert_allclose(_np(y), np.tanh(2 * v), rtol=1e-5)
+
+
+def test_register_kl_custom():
+    class A(Normal):
+        pass
+
+    class B(Normal):
+        pass
+
+    @register_kl(A, B)
+    def _kl_ab(p, q):
+        return paddle.to_tensor(np.float32(42.0))
+
+    assert float(_np(kl_divergence(A(0., 1.), B(0., 1.)))) == 42.0

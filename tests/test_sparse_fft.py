@@ -1,0 +1,162 @@
+"""Sparse + fft/signal tests (numpy/scipy-free oracles: dense numpy + torch).
+
+Parity model: reference unittests/test_sparse_*.py compare against dense
+equivalents; fft tests against numpy.fft; stft/istft round-trip.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse, fft, signal
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _coo_from_dense(d):
+    idx = np.nonzero(d)
+    vals = d[idx]
+    return sparse.sparse_coo_tensor(np.stack(idx), vals, d.shape)
+
+
+def test_coo_create_to_dense_roundtrip():
+    d = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    s = _coo_from_dense(d)
+    assert s.shape == [2, 3] and s.nnz == 3
+    np.testing.assert_allclose(_np(s.to_dense()), d)
+    np.testing.assert_allclose(np.asarray(s.indices()._value),
+                               np.stack(np.nonzero(d)))
+    np.testing.assert_allclose(np.asarray(s.values()._value), [1, 2, 3])
+
+
+def test_csr_roundtrip():
+    d = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    coo = _coo_from_dense(d)
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(np.asarray(csr.crows()._value), [0, 1, 3, 3])
+    np.testing.assert_allclose(np.asarray(csr.cols()._value), [1, 0, 2])
+    np.testing.assert_allclose(_np(csr.to_dense()), d)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(_np(back.to_dense()), d)
+
+
+def test_sparse_csr_tensor_creation():
+    csr = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2],
+                                   [1.0, 2.0, 3.0], [2, 3])
+    d = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    np.testing.assert_allclose(_np(csr.to_dense()), d)
+
+
+def test_sparse_unary_binary():
+    d1 = np.array([[0, -1.0], [2.0, 0]], np.float32)
+    d2 = np.array([[1.0, 0], [-3.0, 0]], np.float32)
+    s1, s2 = _coo_from_dense(d1), _coo_from_dense(d2)
+    np.testing.assert_allclose(_np(sparse.relu(s1).to_dense()),
+                               np.maximum(d1, 0))
+    np.testing.assert_allclose(_np(sparse.add(s1, s2).to_dense()), d1 + d2)
+    np.testing.assert_allclose(_np(sparse.subtract(s1, s2).to_dense()),
+                               d1 - d2)
+    np.testing.assert_allclose(_np(sparse.multiply(s1, s2).to_dense()),
+                               d1 * d2)
+
+
+def test_sparse_matmul():
+    rng = np.random.default_rng(0)
+    d = rng.standard_normal((4, 6)).astype(np.float32)
+    d[d < 0.3] = 0
+    dense = rng.standard_normal((6, 5)).astype(np.float32)
+    s = _coo_from_dense(d)
+    out = sparse.matmul(s, paddle.to_tensor(dense))
+    np.testing.assert_allclose(_np(out), d @ dense, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+    mask_d = (rng.random((4, 4)) > 0.5).astype(np.float32)
+    m = _coo_from_dense(mask_d)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), m)
+    np.testing.assert_allclose(_np(out.to_dense()), (x @ y) * mask_d,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_nn_softmax():
+    d = np.array([[0, 1.0, 2.0], [3.0, 0, 0]], np.float32)
+    csr = _coo_from_dense(d).to_sparse_csr()
+    out = sparse.nn.Softmax()(csr).to_dense()
+    want = np.zeros_like(d)
+    want[0, 1:] = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    want[1, 0] = 1.0
+    np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(32).astype(np.float32)
+    np.testing.assert_allclose(_np(fft.fft(paddle.to_tensor(x))),
+                               np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(fft.rfft(paddle.to_tensor(x))),
+                               np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    x2 = rng.standard_normal((8, 8)).astype(np.float32)
+    np.testing.assert_allclose(_np(fft.fft2(paddle.to_tensor(x2))),
+                               np.fft.fft2(x2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        _np(fft.ifft(fft.fft(paddle.to_tensor(x)))).real, x,
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(fft.fftshift(paddle.to_tensor(x))),
+                               np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(_np(fft.fftfreq(16, 0.5)),
+                               np.fft.fftfreq(16, 0.5), rtol=1e-6)
+
+
+def test_fft_norm_and_grad():
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal(16).astype(np.float32))
+    x.stop_gradient = False
+    from paddle_tpu import ops
+    y = fft.rfft(x, norm="ortho")
+    loss = ops.sum(ops.abs(y) ** 2)
+    loss.backward()
+    assert x.grad is not None
+    # Parseval under ortho norm... rfft halves, so just check finiteness
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(2)
+    sig = rng.standard_normal(512).astype(np.float32)
+    n_fft, hop = 64, 16
+    window = np.hanning(n_fft).astype(np.float32)
+    spec = signal.stft(paddle.to_tensor(sig[None]), n_fft, hop_length=hop,
+                       window=paddle.to_tensor(window))
+    assert _np(spec).shape[1] == n_fft // 2 + 1
+    back = signal.istft(spec, n_fft, hop_length=hop,
+                        window=paddle.to_tensor(window), length=512)
+    np.testing.assert_allclose(_np(back)[0], sig, rtol=1e-3, atol=1e-3)
+
+
+def test_stft_matches_torch():
+    import torch
+    rng = np.random.default_rng(3)
+    sig = rng.standard_normal(256).astype(np.float32)
+    n_fft, hop = 32, 8
+    win = np.hanning(n_fft).astype(np.float32)
+    ours = _np(signal.stft(paddle.to_tensor(sig[None]), n_fft,
+                           hop_length=hop, window=paddle.to_tensor(win)))[0]
+    theirs = torch.stft(torch.tensor(sig), n_fft, hop_length=hop,
+                        window=torch.tensor(win), center=True,
+                        pad_mode="reflect", return_complex=True).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-3)
+
+
+def test_frame_overlap_add():
+    x = np.arange(16, dtype=np.float32)
+    f = signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+    assert _np(f).shape == (4, 7)
+    np.testing.assert_allclose(_np(f)[:, 0], [0, 1, 2, 3])
+    back = signal.overlap_add(f, hop_length=2)
+    # each sample appears twice except the edges
+    assert _np(back).shape == (16,)
