@@ -1,0 +1,98 @@
+"""Checkpoint resharding converter.
+
+Parity: ``/root/reference/python/paddle/distributed/auto_parallel/
+converter.py`` — re-shard saved parameter slices between parallel
+strategies: merge each param's per-rank slices under the previous dist_attr
+into the full tensor, then slice it for the current dist_attr.
+
+dist_attr per param: ``{"process_shape": [...], "process_group": [...],
+"dims_mapping": [...]}`` where dims_mapping[i] is the process-mesh dim that
+shards tensor dim i (-1 = replicated) — the reference's representation,
+which is also exactly a PartitionSpec in mesh-coordinates form.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Converter:
+    def __init__(self, params_dict, pre_strategy, cur_strategy):
+        """params_dict: name → list of per-rank numpy slices (rank order =
+        pre dist_attr process_group order); pre/cur_strategy: name →
+        dist_attr."""
+        self._params_dict = params_dict
+        self._pre = pre_strategy
+        self._cur = cur_strategy
+
+    def convert(self, strict=True):
+        out = {}
+        missing = []
+        for name, slices in self._params_dict.items():
+            if name not in self._pre:
+                missing.append(name)
+                continue
+            full = self.merge_with_dist_attr(slices, self._pre[name])
+            if name in self._cur:
+                out[name] = self.slice_with_dist_attr(full, self._cur[name])
+            else:
+                out[name] = [full]
+        if missing and strict:
+            raise ValueError(f"params missing pre dist_attr: {missing}")
+        return out
+
+    # ------------------------------------------------------------- merge
+    @staticmethod
+    def _rank_coords(rank_idx, process_shape):
+        return np.unravel_index(rank_idx, process_shape)
+
+    @classmethod
+    def merge_with_dist_attr(cls, slices, dist_attr):
+        """Per-rank slices → full tensor (converter.py merge)."""
+        process_shape = dist_attr["process_shape"]
+        group = dist_attr["process_group"]
+        dims_mapping = dist_attr["dims_mapping"]
+        assert len(slices) == len(group), \
+            f"{len(slices)} slices for {len(group)} ranks"
+        s0 = np.asarray(slices[0])
+        full_shape = []
+        for d, m in enumerate(dims_mapping):
+            mult = process_shape[m] if m >= 0 else 1
+            full_shape.append(s0.shape[d] * mult)
+        full = np.zeros(full_shape, s0.dtype)
+        for idx, sl in enumerate(slices):
+            sl = np.asarray(sl)
+            coords = cls._rank_coords(idx, process_shape)
+            sel = []
+            for d, m in enumerate(dims_mapping):
+                if m < 0:
+                    sel.append(slice(None))
+                else:
+                    c = int(coords[m])
+                    sel.append(slice(c * sl.shape[d], (c + 1) * sl.shape[d]))
+            full[tuple(sel)] = sl
+        return full
+
+    # ------------------------------------------------------------- slice
+    @classmethod
+    def slice_with_dist_attr(cls, full, dist_attr):
+        """Full tensor → per-rank slices for the new topology."""
+        full = np.asarray(full)
+        process_shape = dist_attr["process_shape"]
+        group = dist_attr["process_group"]
+        dims_mapping = dist_attr["dims_mapping"]
+        out = []
+        for idx in range(len(group)):
+            coords = cls._rank_coords(idx, process_shape)
+            sel = []
+            for d, m in enumerate(dims_mapping):
+                if m < 0:
+                    sel.append(slice(None))
+                else:
+                    n = process_shape[m]
+                    assert full.shape[d] % n == 0, \
+                        f"dim {d} ({full.shape[d]}) not divisible by {n}"
+                    blk = full.shape[d] // n
+                    c = int(coords[m])
+                    sel.append(slice(c * blk, (c + 1) * blk))
+            out.append(full[tuple(sel)].copy())
+        return out

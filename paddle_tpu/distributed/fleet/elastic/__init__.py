@@ -1,0 +1,5 @@
+"""Elastic training (reference: ``distributed/fleet/elastic/``)."""
+from .manager import (  # noqa: F401
+    ElasticManager, ElasticStatus, LauncherInterface, ELASTIC_TTL,
+    ELASTIC_TIMEOUT,
+)

@@ -1,0 +1,183 @@
+"""Elastic node manager.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/elastic/
+manager.py:126 ElasticManager`` — node registry with TTL lease (:257),
+watch callbacks (:254), PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL (:179) deciding
+whether pod loss aborts or rescales, launcher relaunch on membership change.
+
+TPU-native substitution: the etcd dependency becomes any Store-shaped KV
+(the native TCPStore, or the in-memory fake in tests). Leases are
+``(host, expire_ts)`` entries the keepalive thread refreshes; watch() is a
+poll thread diffing membership, exactly the failure-detection semantics of
+the reference's etcd lease+watch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+ELASTIC_TTL = 60
+ELASTIC_TIMEOUT = 30
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LauncherInterface:
+    """What the manager drives on membership change (manager.py launcher)."""
+
+    def launch(self):
+        raise NotImplementedError
+
+    def stop(self):
+        raise NotImplementedError
+
+    def watch(self):
+        """Return process status: None=running, 0=done, >0 failed."""
+        raise NotImplementedError
+
+
+class _MemStore:
+    """In-memory Store fallback (single-node dev / tests)."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def set(self, k, v):
+        with self._lock:
+            self._d[k] = v if isinstance(v, bytes) else str(v).encode()
+
+    def get_nowait(self, k):
+        with self._lock:
+            return self._d.get(k)
+
+    def delete_key(self, k):
+        with self._lock:
+            self._d.pop(k, None)
+
+    def keys_with_prefix(self, prefix):
+        with self._lock:
+            return [k for k in self._d if k.startswith(prefix)]
+
+
+class ElasticManager:
+    def __init__(self, job_id=None, np=None, host=None, store=None,
+                 elastic_ttl=None, fault_tolerance_level=None):
+        self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
+        np_spec = np if np is not None else os.getenv("PADDLE_ELASTIC_NP", "1")
+        self.min_np, self.max_np = self._parse_np(np_spec)
+        self.host = host or os.getenv("POD_IP", "127.0.0.1")
+        self.ttl = elastic_ttl or int(os.getenv("PADDLE_ELASTIC_TTL",
+                                                ELASTIC_TTL))
+        # level 0: any pod loss is fatal; >=1: tolerate & rescale within
+        # [min_np, max_np] (manager.py:179)
+        self.fault_tolerance_level = fault_tolerance_level \
+            if fault_tolerance_level is not None else \
+            int(os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0))
+        self.store = store or _MemStore()
+        self.enable = self.max_np > 1 or self.fault_tolerance_level > 0
+        self.stopped = False
+        self.need_sync = False
+        self._watchers = []
+        self._keepalive_thread = None
+        self._watch_thread = None
+        self.prefix = f"/paddle/{self.job_id}/nodes/"
+
+    @staticmethod
+    def _parse_np(np_spec):
+        """'2:4' → (2,4); '4' → (4,4) (manager.py _parse_np)."""
+        s = str(np_spec)
+        if ":" in s:
+            lo, hi = s.split(":")
+            return int(lo), int(hi)
+        n = int(s)
+        return n, n
+
+    # ------------------------------------------------------------ registry
+    def _node_key(self, host=None):
+        return f"{self.prefix}{host or self.host}"
+
+    def register(self):
+        """Write this node's lease and start the keepalive refresher."""
+        self._refresh_lease()
+        self._keepalive_thread = threading.Thread(
+            target=self._keepalive_loop, daemon=True)
+        self._keepalive_thread.start()
+
+    def _refresh_lease(self):
+        lease = json.dumps({"host": self.host,
+                            "expire": time.time() + self.ttl})
+        self.store.set(self._node_key(), lease.encode())
+
+    def _keepalive_loop(self):
+        while not self.stopped:
+            self._refresh_lease()
+            time.sleep(max(self.ttl / 3.0, 0.05))
+
+    def hosts(self):
+        """Live (unexpired-lease) nodes."""
+        now = time.time()
+        out = []
+        for k in self.store.keys_with_prefix(self.prefix):
+            raw = self.store.get_nowait(k)
+            if raw is None:
+                continue
+            try:
+                lease = json.loads(raw.decode())
+            except (ValueError, AttributeError):
+                continue
+            if lease.get("expire", 0) > now:
+                out.append(lease["host"])
+        return sorted(out)
+
+    # -------------------------------------------------------------- watch
+    def watch(self, callback=None, interval=1.0):
+        """Poll membership; on change invoke callback(old, new) and record
+        need_sync (manager.py:254 watch semantics)."""
+        if callback:
+            self._watchers.append(callback)
+
+        def loop():
+            prev = self.hosts()
+            while not self.stopped:
+                time.sleep(interval)
+                cur = self.hosts()
+                if cur != prev:
+                    self.need_sync = True
+                    for cb in self._watchers:
+                        cb(prev, cur)
+                    prev = cur
+
+        self._watch_thread = threading.Thread(target=loop, daemon=True)
+        self._watch_thread.start()
+
+    # ---------------------------------------------------------- decisions
+    def pod_leave_status(self, n_alive):
+        """What to do when membership drops to n_alive."""
+        if n_alive >= self.min_np:
+            return ElasticStatus.RESTART  # rescale within bounds
+        if self.fault_tolerance_level > 0:
+            return ElasticStatus.HOLD     # wait for nodes to come back
+        return ElasticStatus.ERROR        # level 0: abort the job
+
+    def wait_ready(self, timeout=ELASTIC_TIMEOUT):
+        """Block until at least min_np nodes are registered."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.hosts()) >= self.min_np:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def exit(self, completed=True):
+        self.stopped = True
+        self.store.delete_key(self._node_key())
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT

@@ -1,0 +1,2 @@
+"""KV rendezvous store (reference: ``distributed/store/``)."""
+from .tcp_store import TCPStore, Store  # noqa: F401

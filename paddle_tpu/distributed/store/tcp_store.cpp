@@ -1,0 +1,303 @@
+// TCPStore: native KV rendezvous server + client.
+//
+// Parity: /root/reference/paddle/fluid/distributed/store/tcp_store.h:117
+// (TCPStore over store/socket.cpp) — the bootstrap KV every launcher/process
+// group uses for rendezvous (ncclUniqueId exchange in the reference; jax
+// coordinator bootstrap + elastic node registry here).
+//
+// Design: one acceptor thread + one thread per connection; a mutex+condvar
+// protected map serves SET/GET/ADD/DEL/LIST; GET blocks (with timeout) until
+// the key exists — that is the synchronization primitive barrier()/wait()
+// build on. Wire format, little-endian:
+//   request : u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i32 status(0 ok, <0 err) | u32 vlen | value bytes
+// cmds: 1=SET 2=GET(block) 3=ADD(i64 delta in value) 4=DEL 5=PING 6=GET_NOWAIT
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;
+  std::mutex conn_mu;  // guards workers + conn_fds (acceptor vs stop)
+  Store store;
+  int port = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, int32_t status, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_full(fd, &status, 4)) return false;
+  if (!write_full(fd, &vlen, 4)) return false;
+  if (vlen && !write_full(fd, val.data(), vlen)) return false;
+  return true;
+}
+
+void serve_conn(Server* srv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, &val[0], vlen)) break;
+
+    Store& st = srv->store;
+    bool ok = true;
+    switch (cmd) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          st.data[key] = val;
+        }
+        st.cv.notify_all();
+        ok = send_reply(fd, 0, "");
+        break;
+      }
+      case 2: {  // GET, blocks until present; val = 8-byte timeout_ms or ""
+        int64_t timeout_ms = -1;
+        if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> lk(st.mu);
+        auto pred = [&] { return st.data.count(key) > 0 || srv->stop; };
+        if (timeout_ms < 0) {
+          st.cv.wait(lk, pred);
+        } else if (!st.cv.wait_for(
+                       lk, std::chrono::milliseconds(timeout_ms), pred)) {
+          ok = send_reply(fd, -2, "");  // timeout
+          break;
+        }
+        if (srv->stop && !st.data.count(key)) {
+          ok = send_reply(fd, -3, "");
+          break;
+        }
+        ok = send_reply(fd, 0, st.data[key]);
+        break;
+      }
+      case 3: {  // ADD: value is i64 delta; key treated as ascii int64
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          int64_t cur = 0;
+          auto it = st.data.find(key);
+          if (it != st.data.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string enc(8, '\0');
+          std::memcpy(&enc[0], &now, 8);
+          st.data[key] = enc;
+        }
+        st.cv.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(&out[0], &now, 8);
+        ok = send_reply(fd, 0, out);
+        break;
+      }
+      case 4: {  // DEL
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          st.data.erase(key);
+        }
+        st.cv.notify_all();
+        ok = send_reply(fd, 0, "");
+        break;
+      }
+      case 5: {  // PING
+        ok = send_reply(fd, 0, "pong");
+        break;
+      }
+      case 6: {  // GET_NOWAIT
+        std::lock_guard<std::mutex> lk(st.mu);
+        auto it = st.data.find(key);
+        ok = it == st.data.end() ? send_reply(fd, -1, "")
+                                 : send_reply(fd, 0, it->second);
+        break;
+      }
+      case 7: {  // LIST keys with prefix=key, newline-joined
+        std::string joined;
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          for (auto& kv : st.data) {
+            if (kv.first.rfind(key, 0) == 0) {
+              if (!joined.empty()) joined += '\n';
+              joined += kv.first;
+            }
+          }
+        }
+        ok = send_reply(fd, 0, joined);
+        break;
+      }
+      default:
+        ok = send_reply(fd, -9, "");
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns server handle (>0) or 0 on failure; *out_port gets the bound port
+void* tcp_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = srv->port;
+  srv->acceptor = std::thread([srv] {
+    while (!srv->stop) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (srv->stop) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(srv->conn_mu);
+      if (srv->stop) {
+        ::close(cfd);
+        break;
+      }
+      srv->conn_fds.push_back(cfd);
+      srv->workers.emplace_back(serve_conn, srv, cfd);
+    }
+  });
+  return srv;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (!srv) return;
+  srv->stop = true;
+  srv->store.cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->acceptor.joinable()) srv->acceptor.join();
+  {
+    // force worker recv() loops to return so the joins below terminate
+    std::lock_guard<std::mutex> lk(srv->conn_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& w : srv->workers)
+    if (w.joinable()) w.join();
+  delete srv;
+}
+
+// client: returns fd (>0) or -1
+int tcp_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+         0) {
+    if (timeout_ms <= 0 || std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcp_store_close(int fd) { ::close(fd); }
+
+// request/response round trip; returns status (0 ok) and fills out buffer.
+// out_cap is the caller's buffer size; *out_len gets the value length
+// (truncated to out_cap).
+int tcp_store_request(int fd, int cmd, const char* key, int klen,
+                      const char* val, int vlen, char* out, int out_cap,
+                      int* out_len) {
+  uint8_t c = static_cast<uint8_t>(cmd);
+  uint32_t kl = static_cast<uint32_t>(klen), vl = static_cast<uint32_t>(vlen);
+  if (!write_full(fd, &c, 1) || !write_full(fd, &kl, 4) ||
+      (kl && !write_full(fd, key, kl)) || !write_full(fd, &vl, 4) ||
+      (vl && !write_full(fd, val, vl)))
+    return -100;
+  int32_t status;
+  uint32_t rlen;
+  if (!read_full(fd, &status, 4) || !read_full(fd, &rlen, 4)) return -101;
+  std::string resp(rlen, '\0');
+  if (rlen && !read_full(fd, &resp[0], rlen)) return -102;
+  int n = static_cast<int>(rlen) < out_cap ? static_cast<int>(rlen) : out_cap;
+  if (n > 0 && out) std::memcpy(out, resp.data(), n);
+  if (out_len) *out_len = static_cast<int>(rlen);
+  return status;
+}
+
+}  // extern "C"

@@ -1,0 +1,190 @@
+"""TCPStore Python surface over the native server.
+
+Parity: ``/root/reference/paddle/fluid/distributed/store/tcp_store.h:117``
+(+ abstract ``Store`` store.h:26). The C++ server (tcp_store.cpp, built on
+first use with g++ into the package dir) owns the map off the GIL; this
+module is the ctypes binding plus the Store API (set/get/add/wait/barrier).
+A pure-Python fallback server keeps the API available if no compiler exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_tcp_store.so")
+_SRC = os.path.join(_HERE, "tcp_store.cpp")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    """Compile (once) + load the native store; None if no toolchain."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", _SRC, "-o", _SO + ".tmp"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(_SO + ".tmp", _SO)
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_int
+        lib.tcp_store_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tcp_store_close.argtypes = [ctypes.c_int]
+        lib.tcp_store_request.restype = ctypes.c_int
+        lib.tcp_store_request.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        _lib = lib
+        return _lib
+
+
+class Store:
+    """Abstract store contract (store.h:26)."""
+
+    def set(self, key: str, value: bytes):
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys):
+        for k in keys:
+            self.get(k)
+
+
+_CMD_SET, _CMD_GET, _CMD_ADD, _CMD_DEL, _CMD_PING, _CMD_GET_NOWAIT, \
+    _CMD_LIST = 1, 2, 3, 4, 5, 6, 7
+
+
+class TCPStore(Store):
+    """TCPStore(host, port, is_master, world_size, timeout).
+
+    The master process hosts the native server; every process (master
+    included) connects a client. ``barrier()`` is ADD + blocking-GET, the
+    same pattern the reference builds on its blocking Get.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=120.0):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        self._lock = threading.Lock()
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native TCPStore unavailable (g++ missing?); "
+                "use paddle_tpu.distributed.launch which needs no store")
+        self._lib = lib
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = lib.tcp_store_server_start(
+                port, ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            self.port = out_port.value
+        else:
+            self.port = port
+        self._fd = lib.tcp_store_connect(
+            host.encode(), self.port, int(self.timeout * 1000))
+        if self._fd < 0:
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host}:{self.port}")
+
+    def _request(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int(0)
+        with self._lock:  # one in-flight request per connection
+            status = self._lib.tcp_store_request(
+                self._fd, cmd, key.encode(), len(key.encode()),
+                val, len(val), out, cap, ctypes.byref(out_len))
+        return status, out.raw[:min(out_len.value, cap)]
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        status, _ = self._request(_CMD_SET, key, bytes(value))
+        if status != 0:
+            raise RuntimeError(f"TCPStore set failed: {status}")
+
+    def get(self, key) -> bytes:
+        timeout_ms = struct.pack("<q", int(self.timeout * 1000))
+        status, val = self._request(_CMD_GET, key, timeout_ms)
+        if status == -2:
+            raise TimeoutError(f"TCPStore get({key!r}) timed out")
+        if status != 0:
+            raise RuntimeError(f"TCPStore get failed: {status}")
+        return val
+
+    def get_nowait(self, key):
+        status, val = self._request(_CMD_GET_NOWAIT, key)
+        return val if status == 0 else None
+
+    def add(self, key, amount: int) -> int:
+        status, val = self._request(_CMD_ADD, key, struct.pack("<q", amount))
+        if status != 0:
+            raise RuntimeError(f"TCPStore add failed: {status}")
+        return struct.unpack("<q", val)[0]
+
+    def delete_key(self, key):
+        self._request(_CMD_DEL, key)
+
+    def ping(self) -> bool:
+        status, val = self._request(_CMD_PING, "")
+        return status == 0 and val == b"pong"
+
+    def barrier(self, name="barrier"):
+        """All world_size processes block until everyone arrived."""
+        n = self.add(f"__{name}__count", 1)
+        if n >= self.world_size:
+            self.set(f"__{name}__done", b"1")
+        self.get(f"__{name}__done")  # blocking until released
+
+    def keys_with_prefix(self, prefix) -> list:
+        status, val = self._request(_CMD_LIST, prefix)
+        if status != 0 or not val:
+            return []
+        return val.decode().split("\n")
+
+    def keys_count(self, key) -> int:
+        v = self.get_nowait(key)
+        return 0 if v is None else struct.unpack("<q", v)[0]
+
+    def close(self):
+        if getattr(self, "_fd", -1) >= 0:
+            self._lib.tcp_store_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.tcp_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -266,13 +266,18 @@ class Beta(ExponentialFamily):
     def rsample(self, shape=()):
         shp = self._extend_shape(shape)
         k1, k2 = jax.random.split(random_mod.next_key())
-        a = unwrap(self.alpha).astype(jnp.float32)
-        b = unwrap(self.beta).astype(jnp.float32)
-        ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp))
-        gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp))
-        return Tensor(ga / (ga + gb))
 
-    sample = rsample  # gamma sampling is reparameterized in jax
+        def draw(a, b):
+            # jax.random.gamma is pathwise-differentiable in its shape param
+            ga = jax.random.gamma(k1, jnp.broadcast_to(
+                a.astype(jnp.float32), shp))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(
+                b.astype(jnp.float32), shp))
+            return ga / (ga + gb)
+
+        return apply(draw, self.alpha, self.beta, op_name="beta_rsample")
+
+    sample = Distribution.sample  # sample = no-grad rsample
 
     def log_prob(self, value):
         return apply(
@@ -311,12 +316,16 @@ class Dirichlet(ExponentialFamily):
 
     def rsample(self, shape=()):
         shp = self._extend_shape(shape)
-        c = unwrap(self.concentration).astype(jnp.float32)
-        g = jax.random.gamma(random_mod.next_key(),
-                             jnp.broadcast_to(c, shp))
-        return Tensor(g / g.sum(-1, keepdims=True))
+        key = random_mod.next_key()
 
-    sample = rsample
+        def draw(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(
+                c.astype(jnp.float32), shp))
+            return g / g.sum(-1, keepdims=True)
+
+        return apply(draw, self.concentration, op_name="dirichlet_rsample")
+
+    sample = Distribution.sample
 
     def log_prob(self, value):
         return apply(
@@ -452,7 +461,15 @@ class Multinomial(Distribution):
         idx = jax.random.categorical(
             random_mod.next_key(), jnp.log(p),
             shape=(self.total_count,) + shp + p.shape[:-1])
-        counts = jax.nn.one_hot(idx, p.shape[-1]).sum(0)
+        # scatter-count the draws: memory stays O(batch*K) instead of the
+        # O(total_count*K) a one-hot materialization would need
+        K = p.shape[-1]
+        init = jnp.zeros(shp + p.shape[:-1] + (K,), jnp.float32)
+
+        def count(acc, i):
+            return acc + jax.nn.one_hot(i, K, dtype=jnp.float32), None
+
+        counts, _ = jax.lax.scan(count, init, idx)
         return Tensor(counts)
 
     def log_prob(self, value):

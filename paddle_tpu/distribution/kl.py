@@ -43,7 +43,9 @@ def kl_divergence(p, q):
 
 
 # built-in registrations (kl.py registers these same pairs)
-from .distributions import Normal, Categorical, Uniform, Beta, Dirichlet  # noqa: E402
+from .distributions import (  # noqa: E402
+    Normal, LogNormal, Categorical, Uniform, Beta, Dirichlet,
+)
 from ..framework.tape import apply  # noqa: E402
 
 import jax.numpy as jnp  # noqa: E402
@@ -52,7 +54,27 @@ from jax.scipy.special import gammaln, digamma  # noqa: E402
 
 @register_kl(Normal, Normal)
 def _kl_normal_normal(p, q):
-    return p.kl_divergence(q)
+    return Normal.kl_divergence(p, q)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # exp() is a bijection, so KL is that of the underlying normals
+    return Normal.kl_divergence(p, q)
+
+
+@register_kl(LogNormal, Normal)
+def _kl_lognormal_normal(p, q):
+    raise NotImplementedError(
+        "KL(LogNormal, Normal) has no closed form (different supports); "
+        "Monte-Carlo estimate it from samples")
+
+
+@register_kl(Normal, LogNormal)
+def _kl_normal_lognormal(p, q):
+    raise NotImplementedError(
+        "KL(Normal, LogNormal) has no closed form (different supports); "
+        "Monte-Carlo estimate it from samples")
 
 
 @register_kl(Categorical, Categorical)

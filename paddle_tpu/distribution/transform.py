@@ -214,6 +214,16 @@ class ReshapeTransform(Transform):
         batch = x.shape[:x.ndim - len(self.in_event_shape)]
         return jnp.zeros(batch, x.dtype)
 
+    def forward_shape(self, shape):
+        n = len(shape) - len(self.in_event_shape)
+        assert tuple(shape[n:]) == self.in_event_shape, shape
+        return tuple(shape[:n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(shape) - len(self.out_event_shape)
+        assert tuple(shape[n:]) == self.out_event_shape, shape
+        return tuple(shape[:n]) + self.in_event_shape
+
 
 class ChainTransform(Transform):
     def __init__(self, transforms):

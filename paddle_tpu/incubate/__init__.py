@@ -1,2 +1,3 @@
 """Experimental features (reference: ``python/paddle/incubate/``)."""
 from . import distributed  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,94 @@
+"""Auto-checkpoint: resumable epoch ranges.
+
+Parity: ``/root/reference/python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py`` (:489 save_checkpoint; train_epoch_range generator) —
+periodic, directory-backed checkpointing keyed by a run id, with epoch-range
+tracking so a restarted job resumes at the crashed epoch. The reference's
+HDFS client becomes the local filesystem (point PADDLE_CHECKPOINT_DIR at a
+mounted share for the multi-node case).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+_manager = None
+
+
+class _ACPManager:
+    def __init__(self, run_id=None, checkpoint_dir=None, save_interval=1):
+        self.run_id = run_id or os.getenv("PADDLE_RUN_ID", "acp_default")
+        self.dir = checkpoint_dir or os.getenv(
+            "PADDLE_CHECKPOINT_DIR", "/tmp/paddle_tpu_auto_checkpoint")
+        self.save_interval = int(
+            os.getenv("PADDLE_CHECKPOINT_SAVE_INTERVAL", save_interval))
+        self._objs = {}
+        os.makedirs(self._run_dir(), exist_ok=True)
+
+    def _run_dir(self):
+        return os.path.join(self.dir, self.run_id)
+
+    def _meta_path(self):
+        return os.path.join(self._run_dir(), "meta.json")
+
+    # -------------------------------------------------------------- state
+    def add_save_vars(self, **named_objs):
+        """Register Layers/Optimizers (anything with state_dict /
+        set_state_dict) to be checkpointed each epoch."""
+        self._objs.update(named_objs)
+
+    def restored_epoch(self):
+        if not os.path.exists(self._meta_path()):
+            return -1
+        with open(self._meta_path()) as f:
+            return json.load(f).get("epoch", -1)
+
+    def save_checkpoint(self, epoch):
+        from ...framework import io as io_mod
+        for name, obj in self._objs.items():
+            io_mod.save(obj.state_dict(),
+                        os.path.join(self._run_dir(), f"{name}.pdparams"))
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "time": time.time()}, f)
+        os.replace(tmp, self._meta_path())  # atomic: meta commits the ckpt
+
+    def restore(self):
+        from ...framework import io as io_mod
+        epoch = self.restored_epoch()
+        if epoch < 0:
+            return -1
+        for name, obj in self._objs.items():
+            path = os.path.join(self._run_dir(), f"{name}.pdparams")
+            if os.path.exists(path):
+                obj.set_state_dict(io_mod.load(path))
+        return epoch
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, run_id=None,
+                      checkpoint_dir=None, **named_objs):
+    """Resumable epoch generator (auto_checkpoint.py train_epoch_range).
+
+    Usage::
+
+        for epoch in train_epoch_range(10, model=model, opt=opt):
+            train_one_epoch(...)
+
+    On restart the loop resumes after the last checkpointed epoch with model/
+    opt state restored.
+    """
+    global _manager
+    _manager = _ACPManager(run_id=run_id, checkpoint_dir=checkpoint_dir,
+                           save_interval=save_checkpoint_inter)
+    _manager.add_save_vars(**named_objs)
+    start = _manager.restore() + 1
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        if (epoch + 1) % _manager.save_interval == 0 or \
+                epoch == max_epoch_num - 1:
+            _manager.save_checkpoint(epoch)
+
+
+def get_manager():
+    return _manager

@@ -8,14 +8,31 @@ from .framework.tape import apply
 from .ops._dispatch import unwrap
 
 
+def _frame_last(v, frame_length, hop_length):
+    """[..., n] → [..., num, frame_length] (shared by frame and stft)."""
+    n = v.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])
+    return v[..., idx]
+
+
+def _ola_last(frames, hop_length):
+    """[..., num, frame_length] → [..., n] overlap-add (shared by
+    overlap_add and istft, incl. its window-envelope normalizer)."""
+    num, frame_length = frames.shape[-2], frames.shape[-1]
+    n = frame_length + hop_length * (num - 1)
+    out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+    for i in range(num):  # static unroll; num is trace-time constant
+        out = out.at[..., i * hop_length:i * hop_length + frame_length]\
+            .add(frames[..., i, :])
+    return out
+
+
 def frame(x, frame_length, hop_length, axis=-1, name=None):
     def f(v):
         assert axis in (-1, v.ndim - 1), "frame supports the last axis"
-        n = v.shape[-1]
-        num = 1 + (n - frame_length) // hop_length
-        idx = (jnp.arange(frame_length)[None, :]
-               + hop_length * jnp.arange(num)[:, None])
-        out = v[..., idx]                      # [..., num, frame_length]
+        out = _frame_last(v, frame_length, hop_length)
         return jnp.moveaxis(out, -2, -1)       # paddle: [..., frame_len, num]
     return apply(f, x, op_name="frame")
 
@@ -23,13 +40,7 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
 def overlap_add(x, hop_length, axis=-1, name=None):
     def f(v):
         assert axis in (-1, v.ndim - 1)
-        frame_length, num = v.shape[-2], v.shape[-1]
-        n = frame_length + hop_length * (num - 1)
-        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
-        for i in range(num):  # static unroll; num is trace-time constant
-            out = out.at[..., i * hop_length:i * hop_length + frame_length]\
-                .add(v[..., i])
-        return out
+        return _ola_last(jnp.moveaxis(v, -1, -2), hop_length)
     return apply(f, x, op_name="overlap_add")
 
 
@@ -50,11 +61,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             pad = n_fft // 2
             v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
                         mode=pad_mode)
-        n = v.shape[-1]
-        num = 1 + (n - n_fft) // hop_length
-        idx = (jnp.arange(n_fft)[None, :]
-               + hop_length * jnp.arange(num)[:, None])
-        frames = v[..., idx] * w_full                  # [..., num, n_fft]
+        frames = _frame_last(v, n_fft, hop_length) * w_full  # [...,num,n_fft]
         spec = jnp.fft.rfft(frames, axis=-1) if onesided \
             else jnp.fft.fft(frames, axis=-1)
         if normalized:
@@ -86,13 +93,10 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
             w.astype(frames.dtype))
         frames = frames * w_full
         num = frames.shape[-2]
-        n = n_fft + hop_length * (num - 1)
-        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
-        norm = jnp.zeros((n,), jnp.abs(w_full).dtype)  # real even if complex
-        for i in range(num):
-            sl = slice(i * hop_length, i * hop_length + n_fft)
-            out = out.at[..., sl].add(frames[..., i, :])
-            norm = norm.at[sl].add(w_full ** 2)
+        out = _ola_last(frames, hop_length)
+        # window-envelope normalizer: |w|^2 (real even for complex signals)
+        w2 = jnp.broadcast_to(jnp.abs(w_full) ** 2, (num, n_fft))
+        norm = _ola_last(w2, hop_length)
         out = out / jnp.maximum(norm, 1e-10)
         if center:
             pad = n_fft // 2
