@@ -74,7 +74,7 @@ def main():
         S = args.seq or 128
     elif args.config == "345m":
         cfg = gpt_345m_config(max_position_embeddings=1024)
-        B = args.batch or 8
+        B = args.batch or 16  # measured best tokens/s on v5e (24 OOMs)
         S = args.seq or 1024
     else:
         cfg = gpt_1p3b_config()

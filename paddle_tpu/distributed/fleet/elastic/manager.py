@@ -84,7 +84,7 @@ class ElasticManager:
             int(os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0))
         self.store = store or _MemStore()
         self.enable = self.max_np > 1 or self.fault_tolerance_level > 0
-        self.stopped = False
+        self._stop_event = threading.Event()
         self.need_sync = False
         self._watchers = []
         self._keepalive_thread = None
@@ -117,10 +117,22 @@ class ElasticManager:
                             "expire": time.time() + self.ttl})
         self.store.set(self._node_key(), lease.encode())
 
+    @property
+    def stopped(self):
+        return self._stop_event.is_set()
+
+    @stopped.setter
+    def stopped(self, value):
+        if value:
+            self._stop_event.set()
+        else:
+            self._stop_event.clear()
+
     def _keepalive_loop(self):
         while not self.stopped:
             self._refresh_lease()
-            time.sleep(max(self.ttl / 3.0, 0.05))
+            # Event.wait (not sleep) so exit() unblocks the loop immediately
+            self._stop_event.wait(max(self.ttl / 3.0, 0.05))
 
     def hosts(self):
         """Live (unexpired-lease) nodes."""
@@ -179,5 +191,10 @@ class ElasticManager:
 
     def exit(self, completed=True):
         self.stopped = True
+        # join the keepalive first: an in-flight refresh after the delete
+        # would resurrect the lease as a ghost member for a full TTL
+        if self._keepalive_thread is not None and \
+                self._keepalive_thread.is_alive():
+            self._keepalive_thread.join(timeout=self.ttl)
         self.store.delete_key(self._node_key())
         return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT

@@ -251,28 +251,28 @@ void tcp_store_server_stop(void* handle) {
 
 // client: returns fd (>0) or -1
 int tcp_store_connect(const char* host, int port, int timeout_ms) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return -1;
-  }
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
-  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
-         0) {
-    if (timeout_ms <= 0 || std::chrono::steady_clock::now() > deadline) {
-      ::close(fd);
-      return -1;
+  for (;;) {
+    // a failed connect() leaves the socket in an unspecified state — use a
+    // fresh fd per attempt or Linux keeps failing after the first refusal
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+        0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
     }
+    ::close(fd);
+    if (timeout_ms <= 0 || std::chrono::steady_clock::now() > deadline)
+      return -1;
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  return fd;
 }
 
 void tcp_store_close(int fd) { ::close(fd); }

@@ -29,14 +29,19 @@ def _load_lib():
             return _lib
         if not os.path.exists(_SO) or \
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            # per-pid temp output: N launcher ranks may compile concurrently
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", _SRC, "-o", _SO + ".tmp"],
+                     "-pthread", _SRC, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
-                os.replace(_SO + ".tmp", _SO)
+                os.replace(tmp, _SO)
             except (OSError, subprocess.SubprocessError):
-                return None
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                if not os.path.exists(_SO):
+                    return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
@@ -116,14 +121,38 @@ class TCPStore(Store):
             raise RuntimeError(
                 f"TCPStore: cannot connect to {host}:{self.port}")
 
-    def _request(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
+    def _raw_request(self, fd, cmd, key: str, val: bytes, cap):
         out = ctypes.create_string_buffer(cap)
         out_len = ctypes.c_int(0)
-        with self._lock:  # one in-flight request per connection
+        status = self._lib.tcp_store_request(
+            fd, cmd, key.encode(), len(key.encode()),
+            val, len(val), out, cap, ctypes.byref(out_len))
+        if status == 0 and out_len.value > cap:
+            # value larger than the buffer: retry exact-size (non-blocking
+            # re-read; the key exists now) instead of silently truncating
+            cap2 = out_len.value
+            out = ctypes.create_string_buffer(cap2)
             status = self._lib.tcp_store_request(
-                self._fd, cmd, key.encode(), len(key.encode()),
-                val, len(val), out, cap, ctypes.byref(out_len))
+                fd, _CMD_GET_NOWAIT, key.encode(), len(key.encode()),
+                b"", 0, out, cap2, ctypes.byref(out_len))
+            return status, out.raw[:out_len.value]
         return status, out.raw[:min(out_len.value, cap)]
+
+    def _request(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
+        if cmd == _CMD_GET:
+            # blocking GET gets its own short-lived connection so it never
+            # holds the shared one (a concurrent set() through this object
+            # must be able to release it)
+            fd = self._lib.tcp_store_connect(
+                self.host.encode(), self.port, int(self.timeout * 1000))
+            if fd < 0:
+                return -100, b""
+            try:
+                return self._raw_request(fd, cmd, key, val, cap)
+            finally:
+                self._lib.tcp_store_close(fd)
+        with self._lock:  # one in-flight request per shared connection
+            return self._raw_request(self._fd, cmd, key, val, cap)
 
     def set(self, key, value):
         if isinstance(value, str):
@@ -159,11 +188,13 @@ class TCPStore(Store):
         return status == 0 and val == b"pong"
 
     def barrier(self, name="barrier"):
-        """All world_size processes block until everyone arrived."""
+        """All world_size processes block until everyone arrived. Reusable:
+        each crossing is a distinct generation keyed by arrival count."""
         n = self.add(f"__{name}__count", 1)
-        if n >= self.world_size:
-            self.set(f"__{name}__done", b"1")
-        self.get(f"__{name}__done")  # blocking until released
+        gen = (n - 1) // self.world_size
+        if n % self.world_size == 0:
+            self.set(f"__{name}__done_{gen}", b"1")
+        self.get(f"__{name}__done_{gen}")  # blocking until released
 
     def keys_with_prefix(self, prefix) -> list:
         status, val = self._request(_CMD_LIST, prefix)

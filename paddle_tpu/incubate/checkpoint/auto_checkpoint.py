@@ -46,9 +46,15 @@ class _ACPManager:
 
     def save_checkpoint(self, epoch):
         from ...framework import io as io_mod
+        # write every file to a tmp path, then rename all: a crash mid-save
+        # leaves the previous (meta-committed) checkpoint intact
+        renames = []
         for name, obj in self._objs.items():
-            io_mod.save(obj.state_dict(),
-                        os.path.join(self._run_dir(), f"{name}.pdparams"))
+            final = os.path.join(self._run_dir(), f"{name}.pdparams")
+            io_mod.save(obj.state_dict(), final + ".tmp")
+            renames.append((final + ".tmp", final))
+        for tmp, final in renames:
+            os.replace(tmp, final)
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "time": time.time()}, f)

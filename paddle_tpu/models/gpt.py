@@ -437,12 +437,12 @@ class GPTHybridTrainStep:
 
         eps = cfg.layer_norm_epsilon
         remat = self.remat
-        # auto: flash beats XLA's fused attention for full-lane heads (d=128,
-        # no pad waste) or long sequences; off on the CPU mesh (interpret mode
-        # inside shard_map is slow and adds nothing)
+        # auto: flash beats XLA's fused attention from S>=512 even at d=64
+        # (measured +9% tokens/s on GPT-345M @1024 on v5e — the lane padding
+        # is outweighed by skipping the materialized probs matrix); off on
+        # the CPU mesh (interpret mode inside shard_map is slow)
         if self.use_flash is None:
-            use_flash = (jax.default_backend() == "tpu"
-                         and (cfg.head_dim == 128 or S >= 2048))
+            use_flash = (jax.default_backend() == "tpu" and S >= 512)
         else:
             use_flash = self.use_flash
         use_flash = use_flash and S % 128 == 0 and S >= 128 \
