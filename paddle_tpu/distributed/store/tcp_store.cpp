@@ -27,6 +27,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -42,10 +43,10 @@ struct Store {
 struct Server {
   int listen_fd = -1;
   std::atomic<bool> stop{false};
+  std::atomic<int> active_conns{0};
   std::thread acceptor;
-  std::vector<std::thread> workers;
-  std::vector<int> conn_fds;
-  std::mutex conn_mu;  // guards workers + conn_fds (acceptor vs stop)
+  std::set<int> conn_fds;  // live connections only (pruned on close)
+  std::mutex conn_mu;      // guards conn_fds (acceptor vs stop vs workers)
   Store store;
   int port = 0;
 };
@@ -185,6 +186,11 @@ void serve_conn(Server* srv, int fd) {
     if (!ok) break;
   }
   ::close(fd);
+  {
+    std::lock_guard<std::mutex> lk(srv->conn_mu);
+    srv->conn_fds.erase(fd);
+  }
+  srv->active_conns--;
 }
 
 }  // namespace
@@ -219,13 +225,18 @@ void* tcp_store_server_start(int port, int* out_port) {
         if (srv->stop) break;
         continue;
       }
-      std::lock_guard<std::mutex> lk(srv->conn_mu);
-      if (srv->stop) {
-        ::close(cfd);
-        break;
+      {
+        std::lock_guard<std::mutex> lk(srv->conn_mu);
+        if (srv->stop) {
+          ::close(cfd);
+          break;
+        }
+        srv->conn_fds.insert(cfd);
       }
-      srv->conn_fds.push_back(cfd);
-      srv->workers.emplace_back(serve_conn, srv, cfd);
+      // detached: each worker prunes itself from conn_fds on exit, so a
+      // long-lived server doesn't accumulate joinable-thread stacks
+      srv->active_conns++;
+      std::thread(serve_conn, srv, cfd).detach();
     }
   });
   return srv;
@@ -240,12 +251,13 @@ void tcp_store_server_stop(void* handle) {
   ::close(srv->listen_fd);
   if (srv->acceptor.joinable()) srv->acceptor.join();
   {
-    // force worker recv() loops to return so the joins below terminate
+    // force worker recv() loops to return; workers are detached and prune
+    // themselves, so wait on the active counter instead of joins
     std::lock_guard<std::mutex> lk(srv->conn_mu);
     for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
-  for (auto& w : srv->workers)
-    if (w.joinable()) w.join();
+  for (int spins = 0; srv->active_conns > 0 && spins < 500; ++spins)
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
   delete srv;
 }
 

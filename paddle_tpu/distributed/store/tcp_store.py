@@ -40,7 +40,10 @@ def _load_lib():
             except (OSError, subprocess.SubprocessError):
                 if os.path.exists(tmp):
                     os.unlink(tmp)
-                if not os.path.exists(_SO):
+                # never fall back to a STALE .so — it predates fixes in the
+                # current source; only reuse an existing build if up to date
+                if not os.path.exists(_SO) or \
+                        os.path.getmtime(_SO) < os.path.getmtime(_SRC):
                     return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -128,12 +131,15 @@ class TCPStore(Store):
             fd, cmd, key.encode(), len(key.encode()),
             val, len(val), out, cap, ctypes.byref(out_len))
         if status == 0 and out_len.value > cap:
-            # value larger than the buffer: retry exact-size (non-blocking
-            # re-read; the key exists now) instead of silently truncating
+            # value larger than the buffer: reissue exact-size with an
+            # idempotent command (GET becomes GET_NOWAIT — the key exists
+            # now; LIST/GET_NOWAIT reissue as themselves). ADD replies are
+            # 8 bytes and never land here.
+            recmd = _CMD_GET_NOWAIT if cmd == _CMD_GET else cmd
             cap2 = out_len.value
             out = ctypes.create_string_buffer(cap2)
             status = self._lib.tcp_store_request(
-                fd, _CMD_GET_NOWAIT, key.encode(), len(key.encode()),
+                fd, recmd, key.encode(), len(key.encode()),
                 b"", 0, out, cap2, ctypes.byref(out_len))
             return status, out.raw[:out_len.value]
         return status, out.raw[:min(out_len.value, cap)]
@@ -194,6 +200,8 @@ class TCPStore(Store):
         gen = (n - 1) // self.world_size
         if n % self.world_size == 0:
             self.set(f"__{name}__done_{gen}", b"1")
+            if gen > 0:  # nobody blocks on a past generation — prune it
+                self.delete_key(f"__{name}__done_{gen - 1}")
         self.get(f"__{name}__done_{gen}")  # blocking until released
 
     def keys_with_prefix(self, prefix) -> list:

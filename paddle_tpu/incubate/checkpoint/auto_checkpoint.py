@@ -46,27 +46,37 @@ class _ACPManager:
 
     def save_checkpoint(self, epoch):
         from ...framework import io as io_mod
-        # write every file to a tmp path, then rename all: a crash mid-save
-        # leaves the previous (meta-committed) checkpoint intact
-        renames = []
+        import shutil
+        # versioned checkpoint dir committed atomically by meta: a crash at
+        # ANY point leaves the previous epoch's directory fully intact
+        ckpt_dir = os.path.join(self._run_dir(), f"ckpt_{epoch}")
+        os.makedirs(ckpt_dir, exist_ok=True)
         for name, obj in self._objs.items():
-            final = os.path.join(self._run_dir(), f"{name}.pdparams")
-            io_mod.save(obj.state_dict(), final + ".tmp")
-            renames.append((final + ".tmp", final))
-        for tmp, final in renames:
-            os.replace(tmp, final)
+            io_mod.save(obj.state_dict(),
+                        os.path.join(ckpt_dir, f"{name}.pdparams"))
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"epoch": epoch, "time": time.time()}, f)
+            json.dump({"epoch": epoch, "dir": f"ckpt_{epoch}",
+                       "time": time.time()}, f)
         os.replace(tmp, self._meta_path())  # atomic: meta commits the ckpt
+        # prune superseded checkpoint dirs (keep the committed one)
+        for d in os.listdir(self._run_dir()):
+            if d.startswith("ckpt_") and d != f"ckpt_{epoch}":
+                shutil.rmtree(os.path.join(self._run_dir(), d),
+                              ignore_errors=True)
 
     def restore(self):
         from ...framework import io as io_mod
-        epoch = self.restored_epoch()
-        if epoch < 0:
+        if not os.path.exists(self._meta_path()):
+            return -1
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        epoch = meta.get("epoch", -1)
+        ckpt_dir = os.path.join(self._run_dir(), meta.get("dir", ""))
+        if epoch < 0 or not os.path.isdir(ckpt_dir):
             return -1
         for name, obj in self._objs.items():
-            path = os.path.join(self._run_dir(), f"{name}.pdparams")
+            path = os.path.join(ckpt_dir, f"{name}.pdparams")
             if os.path.exists(path):
                 obj.set_state_dict(io_mod.load(path))
         return epoch

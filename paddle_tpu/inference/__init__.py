@@ -1,0 +1,15 @@
+"""paddle.inference parity — the serving predictor.
+
+Parity: ``/root/reference/paddle/fluid/inference/api/analysis_predictor.h:95``
+(AnalysisPredictor: PrepareProgram → OptimizeInferenceProgram → ZeroCopyRun)
+surfaced in Python as ``Config``/``create_predictor``/``Predictor``.
+
+TPU-native redesign: the IR-pass pipeline + TensorRT subgraph capture is the
+XLA AOT pipeline — jit.save has already exported an optimized StableHLO
+program, so PrepareProgram = deserialize, OptimizeInferenceProgram = XLA
+compile (cached per shape), ZeroCopyRun = the compiled call. The zero-copy
+handle API (get_input_handle / copy_from_cpu / copy_to_cpu) is preserved.
+"""
+from .predictor import (  # noqa: F401
+    Config, Predictor, Tensor as PredictorTensor, create_predictor,
+)

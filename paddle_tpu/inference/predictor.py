@@ -1,0 +1,126 @@
+"""Predictor over jit.save artifacts.
+
+Parity: ``analysis_predictor.h`` + the Python ``paddle.inference`` API
+(Config, create_predictor, input/output handles).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..jit import save_load as jit_io
+
+
+class Config:
+    """paddle.inference.Config parity (the device/perf toggles that map to
+    CUDA/MKLDNN in the reference are accepted and recorded; XLA owns those
+    decisions here)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._path = prog_file
+        self._use_gpu = False
+        self._memory_pool_init_size_mb = 0
+        self._enabled_memory_optim = False
+        self._switch_ir_optim = True
+
+    def set_prog_file(self, path):
+        self._path = path
+
+    def prog_file(self):
+        return self._path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True  # device selection is jax's (TPU-first)
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_memory_optim(self):
+        self._enabled_memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._switch_ir_optim = flag
+
+    def summary(self):
+        return {"prog_file": self._path, "use_gpu": self._use_gpu}
+
+
+class Tensor:
+    """Zero-copy handle (PaddleTensor/ZeroCopyTensor parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, arr):
+        self._data = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._data)
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self._config = config
+        path = config.prog_file()
+        if path is None or not os.path.exists(path + ".pdmodel"):
+            raise ValueError(f"no saved model at {path!r} "
+                             "(expect jit.save artifacts: .pdmodel/.pdiparams)")
+        self._layer = jit_io.load(path)
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+        self._input_specs = meta["input_specs"]
+        self._inputs = [Tensor(f"input_{i}")
+                        for i in range(len(self._input_specs))]
+        self._outputs = []
+
+    def get_input_names(self):
+        return [t.name for t in self._inputs]
+
+    def get_input_handle(self, name):
+        for t in self._inputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        """ZeroCopyRun: either pass numpy inputs directly (returns arrays) or
+        use the handle protocol (copy_from_cpu → run → copy_to_cpu)."""
+        if inputs is not None:
+            vals = [np.asarray(x) for x in inputs]
+        else:
+            vals = [t.copy_to_cpu() for t in self._inputs]
+        out = self._layer(*vals)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        results = []
+        for i, o in enumerate(outs):
+            h = Tensor(f"output_{i}")
+            h.copy_from_cpu(np.asarray(o.numpy()))
+            self._outputs.append(h)
+            results.append(h.copy_to_cpu())
+        return results if inputs is not None else None
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs] or ["output_0"]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

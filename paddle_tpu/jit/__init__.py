@@ -14,3 +14,5 @@ tape IS the trace. Python control flow is captured at trace time per input signa
 """
 from .api import to_static, not_to_static, ignore_module, functional_call, TracedProgram  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+from .save_load import InputSpec  # noqa: F401

@@ -1,0 +1,10 @@
+"""paddle.quantization parity (reference: ``python/paddle/quantization/``)."""
+from .base_quanter import BaseQuanter  # noqa: F401
+from .factory import QuanterFactory, quanter  # noqa: F401
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .functional import fake_quant_dequant_abs_max  # noqa: F401

@@ -1,0 +1,30 @@
+"""BaseQuanter (reference: quantization/base_quanter.py:25)."""
+from __future__ import annotations
+
+import abc
+
+from .. import nn
+
+
+class BaseQuanter(nn.Layer, metaclass=abc.ABCMeta):
+    """A quanter observes tensors in forward and simulates quantization."""
+
+    @abc.abstractmethod
+    def forward(self, input):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def scales(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def zero_points(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def quant_axis(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def bit_length(self):
+        raise NotImplementedError

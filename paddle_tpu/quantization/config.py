@@ -1,0 +1,62 @@
+"""QuantConfig (reference: quantization/config.py:55)."""
+from __future__ import annotations
+
+from .factory import QuanterFactory
+
+
+class SingleLayerConfig:
+    def __init__(self, activation: QuanterFactory, weight: QuanterFactory):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+
+class QuantConfig:
+    """Global + per-layer/type/name quanter configuration."""
+
+    def __init__(self, activation: QuanterFactory = None,
+                 weight: QuanterFactory = None):
+        self._global = SingleLayerConfig(activation, weight) \
+            if (activation or weight) else None
+        self._layer_configs = []   # (layer_instance, cfg)
+        self._type_configs = []    # (layer_type, cfg)
+        self._name_configs = []    # (layer_name, cfg)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs.append(
+                (t, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._name_configs.append(
+                (n, SingleLayerConfig(activation, weight)))
+
+    def _config_for(self, name, layer):
+        for l, cfg in self._layer_configs:
+            if l is layer:
+                return cfg
+        for n, cfg in self._name_configs:
+            if n == name:
+                return cfg
+        for t, cfg in self._type_configs:
+            if isinstance(layer, t):
+                return cfg
+        return self._global

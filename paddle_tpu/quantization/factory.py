@@ -1,0 +1,31 @@
+"""QuanterFactory + @quanter registration (reference: factory.py:52)."""
+from __future__ import annotations
+
+
+class QuanterFactory:
+    """Partial-application holder: instantiated per layer at quantize time."""
+
+    def __init__(self, cls=None, *args, **kwargs):
+        self.partial_class = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.partial_class(*self.args, **self.kwargs)
+
+    def __call__(self, *args, **kwargs):
+        # used as `FactoryAlias(moving_rate=...)` after @quanter registration
+        return QuanterFactory(self.partial_class, *args, **kwargs)
+
+
+def quanter(name):
+    """Class decorator: registers an alias factory under `name` in the
+    quantization namespace (factory.py quanter())."""
+
+    def decorator(cls):
+        import sys
+        mod = sys.modules["paddle_tpu.quantization"]
+        setattr(mod, name, QuanterFactory(cls))
+        return cls
+
+    return decorator

@@ -1,0 +1,34 @@
+"""Quantization primitives.
+
+Parity: the reference's fake_quantize kernels
+(``paddle/phi/kernels/.../fake_quantize_*``) — simulate int-k quantization in
+float with a straight-through gradient estimator, the QAT workhorse.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tape import apply
+
+
+def _fake_qdq(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    dq = q * s / qmax
+    # straight-through: identity gradient through the rounding
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+def fake_quant_dequant_abs_max(x, scale=None, bit_length=8, name=None):
+    """Quantize-dequantize with (given or per-call absmax) scale; gradient is
+    straight-through (fake_quantize_dequantize_abs_max op parity)."""
+    if scale is None:
+        def f(v):
+            return _fake_qdq(v, jnp.max(jnp.abs(v)), bit_length)
+        return apply(f, x, op_name="fake_quant_dequant_abs_max")
+
+    def f(v, s):
+        return _fake_qdq(v, s, bit_length)
+    return apply(f, x, scale, op_name="fake_quant_dequant_abs_max")

@@ -1,0 +1,62 @@
+"""Post-training quantization.
+
+Parity: ``quantization/ptq.py`` — quantize() installs observers, the user
+runs calibration batches, convert() bakes scales into fake-quantized weights.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..ops._dispatch import unwrap
+from .config import QuantConfig
+from .factory import QuanterFactory
+from .quanters import AbsmaxObserver
+from .functional import fake_quant_dequant_abs_max
+from .qat import QuantedWrapper, QUANTABLE_TYPES
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig = None):
+        if config is None:
+            config = QuantConfig(activation=QuanterFactory(AbsmaxObserver),
+                                 weight=QuanterFactory(AbsmaxObserver))
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Install observers on quantable layers (calibration mode)."""
+        self._walk(model, "")
+        model.eval()
+        return model
+
+    def _walk(self, layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, QUANTABLE_TYPES):
+                cfg = self._config._config_for(full, sub)
+                if cfg is None:
+                    continue
+                act = cfg.activation._instance(sub) if cfg.activation else None
+                wq = cfg.weight._instance(sub) if cfg.weight else None
+                layer._sub_layers[name] = QuantedWrapper(sub, act, wq)
+            else:
+                self._walk(sub, full)
+
+    def convert(self, model, inplace=False):
+        """Bake observed scales into fake-quantized weights, remove
+        observers."""
+        self._convert_walk(model)
+        return model
+
+    def _convert_walk(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantedWrapper):
+                inner = sub.inner
+                if sub.weight_quanter is not None:
+                    bits = sub.weight_quanter.bit_length()
+                    wq = fake_quant_dequant_abs_max(inner.weight,
+                                                    bit_length=bits)
+                    inner.weight.set_value(np.asarray(unwrap(wq)))
+                layer._sub_layers[name] = inner
+            else:
+                self._convert_walk(sub)

@@ -1,0 +1,92 @@
+"""Quantization-aware training.
+
+Parity: ``quantization/qat.py`` (class QAT: quantize() wraps target layers
+with weight+activation fake-quanters; convert() strips observers, leaving
+statically-quantized weights) and the legacy ImperativeQuantAware
+(``quantization/imperative/qat.py:52``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..ops._dispatch import unwrap
+from .config import QuantConfig
+from .quanters import FakeQuanterWithAbsMaxObserver
+from .factory import QuanterFactory
+from .functional import fake_quant_dequant_abs_max
+
+QUANTABLE_TYPES = (nn.Linear, nn.Conv2D)
+
+
+class QuantedWrapper(nn.Layer):
+    """Wraps one quantable layer: fake-quant its weight and input."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.weight_quanter is None:
+            return self.inner(x)
+        w = self.weight_quanter(self.inner.weight)
+        # call the layer's functional with the substituted weight (swapping
+        # the attribute would fight Layer.__setattr__'s Parameter registry)
+        inner = self.inner
+        if isinstance(inner, nn.Linear):
+            return F.linear(x, w, inner.bias)
+        if isinstance(inner, nn.Conv2D):
+            return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                            inner._dilation, inner._groups,
+                            inner._data_format)
+        raise TypeError(f"unsupported quantable layer {type(inner)}")
+
+
+class QAT:
+    def __init__(self, config: QuantConfig = None):
+        if config is None:
+            config = QuantConfig(
+                activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+                weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Replace quantable sublayers with QuantedWrapper in place."""
+        assert isinstance(model, nn.Layer)
+        self._walk(model, prefix="")
+        return model
+
+    def _walk(self, layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, QUANTABLE_TYPES):
+                cfg = self._config._config_for(full, sub)
+                if cfg is None:
+                    continue
+                act = cfg.activation._instance(sub) if cfg.activation else None
+                wq = cfg.weight._instance(sub) if cfg.weight else None
+                layer._sub_layers[name] = QuantedWrapper(sub, act, wq)
+            else:
+                self._walk(sub, full)
+
+    def convert(self, model, inplace=False):
+        """Finalize: bake the fake-quantized weights in and drop observers,
+        so inference matches the QAT numerics without quanter layers."""
+        self._convert_walk(model)
+        return model
+
+    def _convert_walk(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantedWrapper):
+                inner = sub.inner
+                if sub.weight_quanter is not None:
+                    wq = sub.weight_quanter(inner.weight)
+                    inner.weight.set_value(np.asarray(unwrap(wq)))
+                layer._sub_layers[name] = inner
+            else:
+                self._convert_walk(sub)

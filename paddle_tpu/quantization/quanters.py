@@ -1,0 +1,75 @@
+"""Concrete quanters/observers.
+
+Parity: ``quantization/quanters/abs_max.py`` (FakeQuanterWithAbsMaxObserver —
+moving-average absmax fake quant for QAT) and the PTQ absmax observer.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap
+from .base_quanter import BaseQuanter
+from .functional import fake_quant_dequant_abs_max
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT quanter: EMA of per-tensor absmax drives the fake-quant scale."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self._scale = None  # lazily initialized from the first batch
+
+    def forward(self, input):
+        cur = float(np.abs(np.asarray(unwrap(input))).max())
+        if self.training:
+            if self._scale is None:
+                self._scale = cur
+            else:
+                r = self._moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        scale = self._scale if self._scale is not None else cur
+        return fake_quant_dequant_abs_max(
+            input, Tensor(jnp.float32(scale)), self._bit_length)
+
+    def scales(self):
+        return Tensor(jnp.float32(self._scale or 0.0))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ observer: tracks the running max absmax, no fake quant applied."""
+
+    def __init__(self, bit_length=8, name=None):
+        super().__init__()
+        self._bit_length = bit_length
+        self._max = 0.0
+
+    def forward(self, input):
+        self._max = max(self._max,
+                        float(np.abs(np.asarray(unwrap(input))).max()))
+        return input
+
+    def scales(self):
+        return Tensor(jnp.float32(self._max))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return self._bit_length

@@ -21,4 +21,6 @@ from .executor import Executor, global_scope  # noqa: F401
 from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
 from ..jit.save_load import InputSpec  # noqa: F401
 from ..nn.functional import *  # noqa: F401,F403  (paddle.static.nn shims live in nn)
+from . import nn  # noqa: F401  (paddle.static.nn: control flow)
+from .nn import while_loop, cond  # noqa: F401
 from .. import amp  # noqa: F401  (paddle.static.amp parity alias)

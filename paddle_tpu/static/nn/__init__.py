@@ -1,0 +1,2 @@
+"""paddle.static.nn parity — control flow + static layer helpers."""
+from .control_flow import while_loop, cond, case, switch_case  # noqa: F401

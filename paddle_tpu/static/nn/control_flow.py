@@ -1,0 +1,158 @@
+"""Static-graph control flow.
+
+Parity: ``/root/reference/python/paddle/static/nn/control_flow.py`` (:402
+while_loop, :874 cond) backed by the while/conditional_block op pair
+(``paddle/fluid/operators/controlflow/``). TPU-native mapping: while →
+``lax.while_loop``, cond → ``lax.cond`` — the structured-control-flow
+primitives XLA compiles directly, instead of interpreter-driven sub-blocks.
+
+Works in both modes:
+- eager Tensors: executes immediately (python loop / branch) — the dygraph
+  behavior of the same APIs;
+- lazy Program capture (paddle.static program guard) or inside
+  ``jit.to_static``: records one lax op. ``cond`` is differentiable;
+  ``while_loop`` is forward-only (reverse-mode through a dynamic while needs
+  the reference's while_grad tape machinery; use lax-scan-style fixed trip
+  counts for trainable loops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework import tape as tape_mod
+from ...framework.tape import apply
+from ...ops._dispatch import unwrap, wrap
+
+
+def _tensors(vals):
+    return [Tensor(v) if not isinstance(v, Tensor) else v for v in vals]
+
+
+def _is_lazy_or_tracer(ts):
+    from ..program import is_lazy
+    return any(is_lazy(t) or isinstance(unwrap(t), jax.core.Tracer)
+               for t in ts if isinstance(t, Tensor))
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Run body(*vars) while cond(*vars) (control_flow.py:402 contract:
+    both take and return the full loop_vars list)."""
+    assert callable(cond) and callable(body)
+    assert isinstance(loop_vars, (list, tuple)) and loop_vars, \
+        "loop_vars must be a non-empty list"
+    loop_vars = _tensors(list(loop_vars))
+
+    if not _is_lazy_or_tracer(loop_vars):
+        # eager: run now (dygraph path of the same API)
+        vals = list(loop_vars)
+        while bool(unwrap(cond(*vals))):
+            out = body(*vals)
+            vals = _tensors(list(out) if isinstance(out, (tuple, list))
+                            else [out])
+        return vals
+
+    def fn(*flat):
+        def c(state):
+            with tape_mod.no_grad_guard():
+                return jnp.asarray(
+                    unwrap(cond(*_tensors(list(state)))), bool).reshape(())
+
+        def b(state):
+            with tape_mod.no_grad_guard():
+                out = body(*_tensors(list(state)))
+            out = list(out) if isinstance(out, (tuple, list)) else [out]
+            return tuple(unwrap(o) for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(flat))
+
+    out = apply(fn, *loop_vars, op_name="while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Branch on a boolean scalar (control_flow.py:874). Differentiable —
+    the whole cond records as one taped op whose vjp runs lax.cond's."""
+    pv = unwrap(pred) if isinstance(pred, Tensor) else pred
+
+    from ..program import is_lazy
+    lazy = (isinstance(pred, Tensor) and is_lazy(pred)) or \
+        isinstance(pv, jax.core.Tracer)
+    if not lazy:
+        return true_fn() if bool(pv) else false_fn()
+
+    def fn(p):
+        def t(_):
+            out = true_fn()
+            return tuple(unwrap(o) for o in (
+                out if isinstance(out, (tuple, list)) else [out]))
+
+        def f(_):
+            out = false_fn()
+            return tuple(unwrap(o) for o in (
+                out if isinstance(out, (tuple, list)) else [out]))
+
+        return jax.lax.cond(jnp.asarray(p, bool).reshape(()), t, f, 0)
+
+    out = apply(fn, pred if isinstance(pred, Tensor) else Tensor(pv),
+                op_name="cond")
+    if isinstance(out, tuple) and len(out) == 1:
+        return out[0]
+    return out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match multi-branch (control_flow.py case)."""
+    for pred, fn in pred_fn_pairs:
+        pv = unwrap(pred) if isinstance(pred, Tensor) else pred
+        if isinstance(pv, jax.core.Tracer):
+            # traced: chain conds
+            rest = pred_fn_pairs[1:]
+            nxt = (lambda: case(rest, default)) if rest else default
+            return cond(pred, fn, nxt)
+        if bool(pv):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default given")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Indexed multi-branch (control_flow.py switch_case)."""
+    iv = unwrap(branch_index) if isinstance(branch_index, Tensor) \
+        else branch_index
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        keys = list(range(len(branch_fns)))
+        fns = list(branch_fns)
+    if not isinstance(iv, jax.core.Tracer):
+        i = int(iv)
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is not None:
+            return default()
+        raise ValueError(f"branch {i} not found and no default")
+
+    def fn(bi):
+        def mk(f):
+            def g(_):
+                out = f()
+                return tuple(unwrap(o) for o in (
+                    out if isinstance(out, (tuple, list)) else [out]))
+            return g
+        all_fns = [mk(f) for f in fns] + ([mk(default)] if default else [])
+        # map branch_index → position; unknown indices hit the default slot
+        idx = jnp.searchsorted(jnp.asarray(keys), bi)
+        known = jnp.isin(bi, jnp.asarray(keys)) if hasattr(jnp, "isin") \
+            else (idx < len(keys))
+        pos = jnp.where(known, idx, len(fns) if default else 0)
+        return jax.lax.switch(jnp.clip(pos, 0, len(all_fns) - 1), all_fns, 0)
+
+    out = apply(fn, branch_index if isinstance(branch_index, Tensor)
+                else Tensor(jnp.asarray(iv)), op_name="switch_case")
+    if isinstance(out, tuple) and len(out) == 1:
+        return out[0]
+    return out

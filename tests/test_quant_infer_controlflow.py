@@ -1,0 +1,173 @@
+"""Quantization / inference Predictor / static control-flow tests.
+
+Parity model: reference quantization tests (QAT improves-or-holds accuracy,
+convert bakes quantized weights), inference API tests (save → Config →
+create_predictor → handles round trip), and control_flow tests (while_loop /
+cond numeric contracts, dygraph == compiled).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer as opt
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, QuanterFactory, FakeQuanterWithAbsMaxObserver,
+    fake_quant_dequant_abs_max,
+)
+from paddle_tpu.quantization.qat import QuantedWrapper
+from paddle_tpu.static.nn import while_loop, cond, switch_case
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+# -------------------------------------------------------------- quant
+def test_fake_quant_dequant_roundtrip_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    y = fake_quant_dequant_abs_max(x, bit_length=8)
+    # 8-bit grid error bound: scale/127
+    assert np.abs(_np(y) - _np(x)).max() <= 1.0 / 127 + 1e-6
+    ops.sum(y).backward()
+    np.testing.assert_allclose(_np(x.grad), np.ones(11), rtol=1e-6)  # STE
+
+
+def test_qat_quantize_convert():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32))
+    ref = _np(net(x))
+
+    qat = QAT()
+    qat.quantize(net)
+    assert isinstance(net._sub_layers["0"], QuantedWrapper)
+    out_q = _np(net(x))
+    # fake-quant output is close to fp but not identical
+    assert np.abs(out_q - ref).max() < 0.2
+    # trains through the quantizers (STE)
+    y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    o = opt.SGD(learning_rate=0.01, parameters=net.parameters())
+    loss = ops.mean((net(x) - y) ** 2)
+    loss.backward()
+    o.step()
+    # convert: wrappers removed, weights baked
+    qat.convert(net)
+    assert isinstance(net._sub_layers["0"], nn.Linear)
+    assert np.isfinite(_np(net(x))).all()
+
+
+def test_qat_respects_type_config():
+    cfg = QuantConfig()
+    cfg.add_type_config(
+        nn.Linear,
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+        weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+    net = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 3))
+    QAT(cfg).quantize(net)
+    assert isinstance(net._sub_layers["0"], QuantedWrapper)
+    assert isinstance(net._sub_layers["1"], nn.Conv2D)  # not configured
+
+
+def test_ptq_observe_convert():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = PTQ()
+    ptq.quantize(net)
+    rng = np.random.default_rng(1)
+    for _ in range(4):  # calibration
+        net(paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32)))
+    w_before = _np(net._sub_layers["0"].inner.weight).copy()
+    ptq.convert(net)
+    assert isinstance(net._sub_layers["0"], nn.Linear)
+    w_after = _np(net._sub_layers["0"].weight)
+    assert not np.allclose(w_before, w_after)       # quantized grid
+    assert np.abs(w_before - w_after).max() < 0.05  # but close
+
+
+# ---------------------------------------------------------- inference
+def test_predictor_roundtrip(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 3))
+    x = np.random.default_rng(2).standard_normal((5, 8)).astype(np.float32)
+    want = _np(net(paddle.to_tensor(x)))
+
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.jit.InputSpec([5, 8], "float32")])
+
+    config = Config(path)
+    pred = create_predictor(config)
+    # direct run
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    # handle protocol
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out2 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_missing_model():
+    from paddle_tpu.inference import Config, create_predictor
+    with pytest.raises(ValueError):
+        create_predictor(Config("/nonexistent/model"))
+
+
+# -------------------------------------------------------- control flow
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.int64(0))
+    s = paddle.to_tensor(np.float32(0))
+    i2, s2 = while_loop(lambda i, s: i < 5,
+                        lambda i, s: [i + 1, s + ops.cast(i, "float32")],
+                        [i, s])
+    assert int(_np(i2)) == 5 and float(_np(s2)) == 10.0
+
+
+def test_while_loop_compiled():
+    @paddle.jit.to_static
+    def count_to(n):
+        i = paddle.to_tensor(np.int64(0))
+        tot = paddle.to_tensor(np.float32(0))
+        i, tot = while_loop(lambda i, t: i < n,
+                            lambda i, t: [i + 1, t + 2.0], [i, tot])
+        return tot
+
+    out = count_to(paddle.to_tensor(np.int64(7)))
+    assert float(_np(out)) == 14.0
+    out2 = count_to(paddle.to_tensor(np.int64(3)))  # same trace, new bound
+    assert float(_np(out2)) == 6.0
+
+
+def test_cond_eager_and_compiled_grad():
+    x = paddle.to_tensor(np.float32(2.0))
+    out = cond(x > 1.0, lambda: x * 2, lambda: x * 3)
+    assert float(_np(out)) == 4.0
+
+    @paddle.jit.to_static
+    def f(x):
+        return cond(x > 0, lambda: x * 2.0, lambda: x * -1.0)
+
+    xp = paddle.to_tensor(np.float32(3.0))
+    xp.stop_gradient = False
+    y = f(xp)
+    assert float(_np(y)) == 6.0
+    y.backward()
+    assert float(_np(xp.grad)) == 2.0  # grad flows through lax.cond
+    xn = paddle.to_tensor(np.float32(-3.0))
+    assert float(_np(f(xn))) == 3.0
+
+
+def test_switch_case():
+    fns = {1: lambda: paddle.to_tensor(np.float32(10)),
+           3: lambda: paddle.to_tensor(np.float32(30))}
+    out = switch_case(paddle.to_tensor(np.int64(3)), fns,
+                      default=lambda: paddle.to_tensor(np.float32(-1)))
+    assert float(_np(out)) == 30.0
+    out = switch_case(paddle.to_tensor(np.int64(7)), fns,
+                      default=lambda: paddle.to_tensor(np.float32(-1)))
+    assert float(_np(out)) == -1.0
