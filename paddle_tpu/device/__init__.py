@@ -1,0 +1,101 @@
+"""paddle.device parity (reference: ``python/paddle/device/__init__.py``
+:329 set_device, :198 _convert_to_place; device/cuda/, device/xpu/).
+
+TPU-native: the device registry is jax's; ``set_device`` selects the default
+jax device, places map to framework.place.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+
+_current = None
+
+
+def _convert_to_place(device: str):
+    d = device.lower()
+    if d == "cpu":
+        return CPUPlace()
+    for prefix, cls in (("tpu", TPUPlace), ("gpu", CUDAPlace),
+                        ("xpu", TPUPlace), ("npu", TPUPlace)):
+        if d.startswith(prefix):
+            idx = int(d.split(":")[1]) if ":" in d else 0
+            return cls(idx)
+    raise ValueError(f"unknown device {device!r}")
+
+
+def set_device(device: str):
+    """Select the default device ('cpu', 'tpu', 'tpu:0', ...)."""
+    global _current
+    place = _convert_to_place(device)
+    kind = "cpu" if isinstance(place, CPUPlace) else None
+    if kind == "cpu":
+        try:
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        except RuntimeError:
+            pass
+    else:
+        backend = jax.default_backend()
+        devs = jax.devices()
+        idx = getattr(place, "device_id", 0) or 0
+        if idx < len(devs):
+            jax.config.update("jax_default_device", devs[idx])
+    _current = device
+    return place
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        return "cpu"
+    return f"tpu:{d.id}"
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+class cuda:
+    """paddle.device.cuda parity shims (no CUDA in the TPU build)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False

@@ -185,11 +185,14 @@ void serve_conn(Server* srv, int fd) {
     }
     if (!ok) break;
   }
-  ::close(fd);
   {
+    // erase BEFORE close: once close() frees the fd number the acceptor may
+    // reuse it for a new connection, and erasing then would drop the live
+    // socket from the set
     std::lock_guard<std::mutex> lk(srv->conn_mu);
     srv->conn_fds.erase(fd);
   }
+  ::close(fd);
   srv->active_conns--;
 }
 
@@ -256,8 +259,13 @@ void tcp_store_server_stop(void* handle) {
     std::lock_guard<std::mutex> lk(srv->conn_mu);
     for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
-  for (int spins = 0; srv->active_conns > 0 && spins < 500; ++spins)
+  for (int spins = 0; srv->active_conns > 0 && spins < 6000; ++spins)
     std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  if (srv->active_conns > 0) {
+    // a worker is still wedged (shouldn't happen: every fd was shutdown);
+    // deliberately leak the Server rather than free memory under its feet
+    return;
+  }
   delete srv;
 }
 

@@ -29,6 +29,17 @@ class DType:
         cls._registry[name] = self
         return self
 
+    # interned singletons: copying must preserve identity (deepcopy of a
+    # Layer would otherwise call __new__ without args and crash)
+    def __deepcopy__(self, memo):
+        return self
+
+    def __copy__(self):
+        return self
+
+    def __reduce__(self):
+        return (DType, (self.name, str(self.np_dtype)))
+
     def __repr__(self):
         return f"paddle_tpu.{self.name}"
 

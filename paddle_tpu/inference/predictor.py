@@ -103,23 +103,29 @@ class Predictor:
             vals = [t.copy_to_cpu() for t in self._inputs]
         out = self._layer(*vals)
         outs = out if isinstance(out, (tuple, list)) else [out]
-        self._outputs = []
         results = []
         for i, o in enumerate(outs):
-            h = Tensor(f"output_{i}")
+            h = self.get_output_handle(f"output_{i}")  # reuse pre-fetched
             h.copy_from_cpu(np.asarray(o.numpy()))
-            self._outputs.append(h)
             results.append(h.copy_to_cpu())
+        self._n_outputs = len(outs)
         return results if inputs is not None else None
 
     def get_output_names(self):
-        return [t.name for t in self._outputs] or ["output_0"]
+        n = getattr(self, "_n_outputs", None)
+        if n is None:
+            return ["output_0"]  # ≥1 output always exists pre-run
+        return [f"output_{i}" for i in range(n)]
 
     def get_output_handle(self, name):
+        # handles may be fetched before the first run (standard paddle
+        # usage order); run() fills whatever handle objects exist by name
         for t in self._outputs:
             if t.name == name:
                 return t
-        raise KeyError(name)
+        h = Tensor(name)
+        self._outputs.append(h)
+        return h
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -8,3 +8,7 @@ from .gpt import (  # noqa: F401
     GPTPretrainingCriterion, GPTHybridTrainStep, gpt_tiny_config,
     gpt_345m_config, gpt_1p3b_config, gpt_13b_config,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
+    bert_tiny_config, bert_base_config,
+)

@@ -1,0 +1,150 @@
+"""BERT model family.
+
+Parity: the reference ships BERT through its test/model corpus (the
+dygraph_to_static bert fixtures and fleet benchmarks —
+``python/paddle/fluid/tests/unittests/dygraph_to_static/bert_dygraph_model.py``);
+PaddleNLP builds the production variant on the same nn.TransformerEncoder
+stack used here. Provides BertModel (+pooler), BertForPretraining
+(masked-LM + next-sentence heads), and a pretraining criterion.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn, ops
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+def bert_tiny_config(**kw):
+    base = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=128,
+                max_position_embeddings=128, type_vocab_size=2,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+def bert_base_config(**kw):
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        S = input_ids.shape[-1]
+        pos = ops.arange(0, S, dtype="int64")
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            # [B, S] 1/0 → additive [B, 1, 1, S]
+            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
+        h = self.embeddings(input_ids, token_type_ids)
+        h = self.encoder(h, src_mask=attention_mask)
+        return h, self.pooler(h)
+
+
+class BertPretrainingHeads(nn.Layer):
+    def __init__(self, cfg: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = getattr(nn.functional, cfg.hidden_act)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder_weight = embedding_weights  # tied to word embeddings
+        self.decoder_bias = self.create_parameter([cfg.vocab_size],
+                                                  is_bias=True)
+        self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        h = self.layer_norm(self.activation(self.transform(sequence_output)))
+        logits = ops.matmul(h, self.decoder_weight, transpose_y=True) \
+            + self.decoder_bias
+        return logits, self.seq_relationship(pooled_output)
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        self.cls = BertPretrainingHeads(
+            bert.config, bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq, pooled)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """Masked-LM + next-sentence loss (ignore_index=-100 masks unused
+    positions, the HF/paddle convention)."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.ce = nn.CrossEntropyLoss(ignore_index=-100)
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        mlm = self.ce(ops.reshape(prediction_scores, [-1, self.vocab_size]),
+                      ops.reshape(masked_lm_labels, [-1]))
+        if next_sentence_labels is None:
+            return mlm
+        nsp = self.ce(seq_relationship_score,
+                      ops.reshape(next_sentence_labels, [-1]))
+        return mlm + nsp

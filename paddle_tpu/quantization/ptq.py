@@ -13,7 +13,10 @@ from .config import QuantConfig
 from .factory import QuanterFactory
 from .quanters import AbsmaxObserver
 from .functional import fake_quant_dequant_abs_max
-from .qat import QuantedWrapper, QUANTABLE_TYPES
+from .qat import (
+    QuantedWrapper, QUANTABLE_TYPES, install_wrappers, _maybe_copy,
+    ConvertedLayer,
+)
 
 
 class PTQ:
@@ -25,26 +28,15 @@ class PTQ:
 
     def quantize(self, model, inplace=False):
         """Install observers on quantable layers (calibration mode)."""
-        self._walk(model, "")
+        model = _maybe_copy(model, inplace)
+        install_wrappers(model, self._config)
         model.eval()
         return model
 
-    def _walk(self, layer, prefix):
-        for name, sub in list(layer._sub_layers.items()):
-            full = f"{prefix}.{name}" if prefix else name
-            if isinstance(sub, QUANTABLE_TYPES):
-                cfg = self._config._config_for(full, sub)
-                if cfg is None:
-                    continue
-                act = cfg.activation._instance(sub) if cfg.activation else None
-                wq = cfg.weight._instance(sub) if cfg.weight else None
-                layer._sub_layers[name] = QuantedWrapper(sub, act, wq)
-            else:
-                self._walk(sub, full)
-
     def convert(self, model, inplace=False):
-        """Bake observed scales into fake-quantized weights, remove
-        observers."""
+        """Bake observed scales into fake-quantized weights + frozen-scale
+        activation quant, remove observers."""
+        model = _maybe_copy(model, inplace)
         self._convert_walk(model)
         return model
 
@@ -57,6 +49,12 @@ class PTQ:
                     wq = fake_quant_dequant_abs_max(inner.weight,
                                                     bit_length=bits)
                     inner.weight.set_value(np.asarray(unwrap(wq)))
-                layer._sub_layers[name] = inner
+                act_max = getattr(sub.act_quanter, "_max", 0.0) \
+                    if sub.act_quanter is not None else 0.0
+                if act_max:
+                    layer._sub_layers[name] = ConvertedLayer(
+                        inner, float(act_max), sub.act_quanter.bit_length())
+                else:
+                    layer._sub_layers[name] = inner
             else:
                 self._convert_walk(sub)

@@ -47,6 +47,47 @@ class QuantedWrapper(nn.Layer):
         raise TypeError(f"unsupported quantable layer {type(inner)}")
 
 
+def install_wrappers(model, config, prefix=""):
+    """Shared QAT/PTQ walk: wrap configured quantable sublayers."""
+    for name, sub in list(model._sub_layers.items()):
+        full = f"{prefix}.{name}" if prefix else name
+        if isinstance(sub, QUANTABLE_TYPES):
+            cfg = config._config_for(full, sub)
+            if cfg is None:
+                continue
+            act = cfg.activation._instance(sub) if cfg.activation else None
+            wq = cfg.weight._instance(sub) if cfg.weight else None
+            model._sub_layers[name] = QuantedWrapper(sub, act, wq)
+        else:
+            install_wrappers(sub, config, full)
+
+
+def _maybe_copy(model, inplace):
+    if inplace:
+        return model
+    import copy
+    return copy.deepcopy(model)  # paddle contract: inplace=False copies
+
+
+class ConvertedLayer(nn.Layer):
+    """Post-convert layer: frozen-scale activation fake-quant + baked
+    (already quantized-grid) weights — inference numerics match QAT eval."""
+
+    def __init__(self, inner, act_scale, act_bits):
+        super().__init__()
+        self.inner = inner
+        self.act_scale = act_scale
+        self.act_bits = act_bits
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor
+        if self.act_scale:
+            x = fake_quant_dequant_abs_max(
+                x, Tensor(jnp.float32(self.act_scale)), self.act_bits)
+        return self.inner(x)
+
+
 class QAT:
     def __init__(self, config: QuantConfig = None):
         if config is None:
@@ -56,27 +97,16 @@ class QAT:
         self._config = config
 
     def quantize(self, model, inplace=False):
-        """Replace quantable sublayers with QuantedWrapper in place."""
+        """Wrap quantable sublayers (returns the copy unless inplace)."""
         assert isinstance(model, nn.Layer)
-        self._walk(model, prefix="")
+        model = _maybe_copy(model, inplace)
+        install_wrappers(model, self._config)
         return model
 
-    def _walk(self, layer, prefix):
-        for name, sub in list(layer._sub_layers.items()):
-            full = f"{prefix}.{name}" if prefix else name
-            if isinstance(sub, QUANTABLE_TYPES):
-                cfg = self._config._config_for(full, sub)
-                if cfg is None:
-                    continue
-                act = cfg.activation._instance(sub) if cfg.activation else None
-                wq = cfg.weight._instance(sub) if cfg.weight else None
-                layer._sub_layers[name] = QuantedWrapper(sub, act, wq)
-            else:
-                self._walk(sub, full)
-
     def convert(self, model, inplace=False):
-        """Finalize: bake the fake-quantized weights in and drop observers,
-        so inference matches the QAT numerics without quanter layers."""
+        """Finalize: bake quantized-grid weights and freeze activation
+        scales, so inference matches the QAT eval numerics."""
+        model = _maybe_copy(model, inplace)
         self._convert_walk(model)
         return model
 
@@ -87,6 +117,12 @@ class QAT:
                 if sub.weight_quanter is not None:
                     wq = sub.weight_quanter(inner.weight)
                     inner.weight.set_value(np.asarray(unwrap(wq)))
-                layer._sub_layers[name] = inner
+                if sub.act_quanter is not None and \
+                        getattr(sub.act_quanter, "_scale", None):
+                    layer._sub_layers[name] = ConvertedLayer(
+                        inner, float(sub.act_quanter._scale),
+                        sub.act_quanter.bit_length())
+                else:
+                    layer._sub_layers[name] = inner
             else:
                 self._convert_walk(sub)

@@ -25,7 +25,18 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self._scale = None  # lazily initialized from the first batch
 
     def forward(self, input):
-        cur = float(np.abs(np.asarray(unwrap(input))).max())
+        import jax
+        v = unwrap(input)
+        if isinstance(v, jax.core.Tracer):
+            # under jit/export: use the frozen observed scale (no host sync,
+            # no EMA update — observation happens in eager steps); fall back
+            # to an in-graph dynamic absmax before any observation
+            if self._scale is None:
+                return fake_quant_dequant_abs_max(
+                    input, bit_length=self._bit_length)
+            return fake_quant_dequant_abs_max(
+                input, Tensor(jnp.float32(self._scale)), self._bit_length)
+        cur = float(np.abs(np.asarray(v)).max())
         if self.training:
             if self._scale is None:
                 self._scale = cur
@@ -58,8 +69,10 @@ class AbsmaxObserver(BaseQuanter):
         self._max = 0.0
 
     def forward(self, input):
-        self._max = max(self._max,
-                        float(np.abs(np.asarray(unwrap(input))).max()))
+        import jax
+        v = unwrap(input)
+        if not isinstance(v, jax.core.Tracer):  # observe only eager batches
+            self._max = max(self._max, float(np.abs(np.asarray(v)).max()))
         return input
 
     def scales(self):

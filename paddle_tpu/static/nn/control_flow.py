@@ -103,19 +103,20 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
 
 
 def case(pred_fn_pairs, default=None, name=None):
-    """First-match multi-branch (control_flow.py case)."""
-    for pred, fn in pred_fn_pairs:
+    """First-match multi-branch (control_flow.py case). With no default, the
+    last fn runs when nothing matches (paddle contract)."""
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+    for i, (pred, fn) in enumerate(pred_fn_pairs):
         pv = unwrap(pred) if isinstance(pred, Tensor) else pred
         if isinstance(pv, jax.core.Tracer):
             # traced: chain conds
-            rest = pred_fn_pairs[1:]
+            rest = pred_fn_pairs[i + 1:]
             nxt = (lambda: case(rest, default)) if rest else default
             return cond(pred, fn, nxt)
         if bool(pv):
             return fn()
-    if default is not None:
-        return default()
-    raise ValueError("no branch taken and no default given")
+    return default()
 
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
@@ -128,13 +129,14 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     else:
         keys = list(range(len(branch_fns)))
         fns = list(branch_fns)
+    if default is None:
+        # paddle contract: a missing default falls through to the LAST branch
+        default = fns[-1]
     if not isinstance(iv, jax.core.Tracer):
         i = int(iv)
         if i in keys:
             return fns[keys.index(i)]()
-        if default is not None:
-            return default()
-        raise ValueError(f"branch {i} not found and no default")
+        return default()
 
     def fn(bi):
         def mk(f):
@@ -143,13 +145,13 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                 return tuple(unwrap(o) for o in (
                     out if isinstance(out, (tuple, list)) else [out]))
             return g
-        all_fns = [mk(f) for f in fns] + ([mk(default)] if default else [])
+        all_fns = [mk(f) for f in fns] + [mk(default)]
         # map branch_index → position; unknown indices hit the default slot
         idx = jnp.searchsorted(jnp.asarray(keys), bi)
-        known = jnp.isin(bi, jnp.asarray(keys)) if hasattr(jnp, "isin") \
-            else (idx < len(keys))
-        pos = jnp.where(known, idx, len(fns) if default else 0)
-        return jax.lax.switch(jnp.clip(pos, 0, len(all_fns) - 1), all_fns, 0)
+        safe = jnp.clip(idx, 0, len(keys) - 1)
+        known = jnp.asarray(keys)[safe] == bi
+        pos = jnp.where(known, safe, len(fns))
+        return jax.lax.switch(pos, all_fns, 0)
 
     out = apply(fn, branch_index if isinstance(branch_index, Tensor)
                 else Tensor(jnp.asarray(iv)), op_name="switch_case")

@@ -1,0 +1,121 @@
+"""Vision model zoo + BERT + device tests.
+
+Parity model: reference vision model tests (test_vision_models.py) run each
+family forward at 1x3x224x224 and check output shape; BERT fixture follows
+dygraph_to_static/bert_dygraph_model.py (pretraining loss trains down).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer as opt
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, size=64):
+    rng = np.random.default_rng(0)
+    return paddle.to_tensor(
+        rng.standard_normal((n, 3, size, size)).astype(np.float32))
+
+
+@pytest.mark.parametrize("builder,size", [
+    (lambda: M.densenet121(num_classes=10), 64),
+    (lambda: M.squeezenet1_0(num_classes=10), 64),
+    (lambda: M.squeezenet1_1(num_classes=10), 64),
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=10), 64),
+    (lambda: M.mobilenet_v3_small(num_classes=10), 64),
+    (lambda: M.mobilenet_v3_large(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), 64),
+    (lambda: M.googlenet(num_classes=10), 64),
+    (lambda: M.inception_v3(num_classes=10), 128),
+])
+def test_vision_model_forward(builder, size):
+    paddle.seed(0)
+    net = builder()
+    net.eval()
+    out = net(_img(1, size))
+    assert tuple(out.shape) == (1, 10)
+    assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_densenet_trains():
+    paddle.seed(1)
+    net = M.densenet121(num_classes=2)
+    o = opt.Adam(learning_rate=1e-3, parameters=net.parameters())
+    x = _img(4, 64)
+    y = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+    lossfn = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(3):
+        loss = lossfn(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(np.asarray(loss._value)))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_pretraining_trains():
+    from paddle_tpu.models.bert import (
+        BertModel, BertForPretraining, BertPretrainingCriterion,
+        bert_tiny_config,
+    )
+    paddle.seed(2)
+    cfg = bert_tiny_config()
+    model = BertForPretraining(BertModel(cfg))
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+    rng = np.random.default_rng(3)
+    B, S = 4, 32
+    ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64)
+    mlm_labels = np.where(rng.random((B, S)) < 0.15, ids, -100)
+    nsp = rng.integers(0, 2, (B,)).astype(np.int64)
+    mask = np.ones((B, S), np.int64)
+    mask[:, S - 4:] = 0  # padding tail
+
+    losses = []
+    for _ in range(8):
+        scores, seq_rel = model(paddle.to_tensor(ids),
+                                attention_mask=paddle.to_tensor(mask))
+        loss = crit(scores, seq_rel, paddle.to_tensor(mlm_labels),
+                    paddle.to_tensor(nsp))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(np.asarray(loss._value)))
+    assert losses[-1] < losses[0], losses
+    # tied embeddings: decoder weight IS the word embedding table
+    emb = model.bert.embeddings.word_embeddings.weight
+    assert model.cls.decoder_weight is emb
+
+
+def test_bert_compiled_matches_eager():
+    from paddle_tpu.models.bert import BertModel, bert_tiny_config
+    paddle.seed(4)
+    bert = BertModel(bert_tiny_config())
+    bert.eval()
+    ids = np.random.default_rng(5).integers(0, 1024, (2, 16)).astype(np.int64)
+
+    seq_eager, pooled_eager = bert(paddle.to_tensor(ids))
+
+    @paddle.jit.to_static
+    def f(x):
+        return bert(x)
+
+    seq_jit, pooled_jit = f(paddle.to_tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq_eager._value),
+                               np.asarray(seq_jit._value), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_device_api():
+    from paddle_tpu import device
+    d = device.get_device()
+    assert isinstance(d, str)
+    assert device.device_count() >= 1
+    p = device.set_device("cpu")
+    assert repr(p) is not None
+    assert device.get_device() == "cpu"
+    assert not device.is_compiled_with_npu()
+    assert device.cuda.device_count() == 0

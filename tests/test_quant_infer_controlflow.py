@@ -41,7 +41,7 @@ def test_qat_quantize_convert():
     ref = _np(net(x))
 
     qat = QAT()
-    qat.quantize(net)
+    net = qat.quantize(net)  # inplace=False returns the quantized copy
     assert isinstance(net._sub_layers["0"], QuantedWrapper)
     out_q = _np(net(x))
     # fake-quant output is close to fp but not identical
@@ -52,9 +52,10 @@ def test_qat_quantize_convert():
     loss = ops.mean((net(x) - y) ** 2)
     loss.backward()
     o.step()
-    # convert: wrappers removed, weights baked
-    qat.convert(net)
-    assert isinstance(net._sub_layers["0"], nn.Linear)
+    # convert: wrappers replaced, weights baked, activation scales frozen
+    net = qat.convert(net, inplace=True)
+    from paddle_tpu.quantization.qat import ConvertedLayer
+    assert isinstance(net._sub_layers["0"], (nn.Linear, ConvertedLayer))
     assert np.isfinite(_np(net(x))).all()
 
 
@@ -65,23 +66,27 @@ def test_qat_respects_type_config():
         activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
         weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
     net = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 3))
-    QAT(cfg).quantize(net)
-    assert isinstance(net._sub_layers["0"], QuantedWrapper)
-    assert isinstance(net._sub_layers["1"], nn.Conv2D)  # not configured
+    q = QAT(cfg).quantize(net)
+    assert isinstance(q._sub_layers["0"], QuantedWrapper)
+    assert isinstance(q._sub_layers["1"], nn.Conv2D)  # not configured
+    assert isinstance(net._sub_layers["0"], nn.Linear)  # original untouched
 
 
 def test_ptq_observe_convert():
     paddle.seed(1)
     net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
     ptq = PTQ()
-    ptq.quantize(net)
+    net = ptq.quantize(net)
     rng = np.random.default_rng(1)
     for _ in range(4):  # calibration
         net(paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32)))
     w_before = _np(net._sub_layers["0"].inner.weight).copy()
-    ptq.convert(net)
-    assert isinstance(net._sub_layers["0"], nn.Linear)
-    w_after = _np(net._sub_layers["0"].weight)
+    net = ptq.convert(net, inplace=True)
+    from paddle_tpu.quantization.qat import ConvertedLayer
+    first = net._sub_layers["0"]
+    assert isinstance(first, (nn.Linear, ConvertedLayer))
+    w_after = _np(first.weight if isinstance(first, nn.Linear)
+                  else first.inner.weight)
     assert not np.allclose(w_before, w_after)       # quantized grid
     assert np.abs(w_before - w_after).max() < 0.05  # but close
 
