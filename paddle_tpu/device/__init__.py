@@ -39,11 +39,12 @@ def set_device(device: str):
         except RuntimeError:
             pass
     else:
-        backend = jax.default_backend()
         devs = jax.devices()
         idx = getattr(place, "device_id", 0) or 0
-        if idx < len(devs):
-            jax.config.update("jax_default_device", devs[idx])
+        if idx >= len(devs):
+            raise ValueError(
+                f"device index {idx} out of range ({len(devs)} devices)")
+        jax.config.update("jax_default_device", devs[idx])
     _current = device
     return place
 

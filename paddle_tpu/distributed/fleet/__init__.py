@@ -102,3 +102,6 @@ class meta_parallel:
 
 def get_hybrid_communicate_group_():
     return get_hybrid_cg()
+from . import utils  # noqa: F401,E402
+from . import metrics  # noqa: F401,E402
+from . import elastic  # noqa: F401,E402

@@ -1,0 +1,1 @@
+from .metric import sum, max, min, auc, mae, rmse, acc  # noqa: F401

@@ -1,0 +1,80 @@
+"""Globally-reduced metrics for distributed evaluation.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/metrics/metric.py``
+— each helper all-reduces a local statistic over the workers before the final
+scalar math (the PS-era global AUC/MAE pattern). The reduction goes through
+the eager collective API (identity on one controller, psum-shaped on a
+mesh group).
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+from ....framework.tensor import Tensor
+from ....ops._dispatch import unwrap
+from ...collective import all_reduce, ReduceOp
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(unwrap(x))
+    return np.asarray(x)
+
+
+def _reduced(arr, op=ReduceOp.SUM, scope=None, util=None):
+    # reduce over trainer PROCESSES (the reference's trainer group), not the
+    # device mesh — on one controller the local stat already covers all
+    # devices, and a mesh-axis psum would multiply it by the axis size
+    from ... import env as env_mod
+    if env_mod.get_world_size() <= 1:
+        return np.asarray(arr)
+    t = Tensor(np.asarray(arr))
+    all_reduce(t, op=op)
+    return np.asarray(unwrap(t))
+
+
+def sum(input, scope=None, util=None):
+    return float(_reduced(_np(input).sum()))
+
+
+def max(input, scope=None, util=None):
+    return float(_reduced(_np(input).max(), op=ReduceOp.MAX))
+
+
+def min(input, scope=None, util=None):
+    return float(_reduced(_np(input).min(), op=ReduceOp.MIN))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    return float(_reduced(_np(abserr).sum())) / \
+        float(_reduced(np.asarray(total_ins_num, np.float64)))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(
+        float(_reduced(_np(sqrerr).sum()))
+        / float(_reduced(np.asarray(total_ins_num, np.float64)))))
+
+
+def acc(correct, total, scope=None, util=None):
+    return float(_reduced(np.asarray(correct, np.float64))) / \
+        float(_reduced(np.asarray(total, np.float64)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative score histograms
+    (metric.py auc — the bucketed trapezoid over the reduced histograms)."""
+    pos = _reduced(_np(stat_pos).astype(np.float64))
+    neg = _reduced(_np(stat_neg).astype(np.float64))
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))

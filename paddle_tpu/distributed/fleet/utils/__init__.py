@@ -1,0 +1,2 @@
+"""fleet.utils parity (reference: ``distributed/fleet/utils/``)."""
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401

@@ -1,0 +1,16 @@
+"""Parameter-server training (reference: ``paddle/fluid/distributed/ps/`` +
+``python/paddle/distributed/ps/``).
+
+Scope note (honest): the reference's brpc PS (100B-feature sparse tables
+sharded over CPU server nodes) is represented here by the same table/
+accessor/client architecture with an in-process client — the reference's own
+test fixture (``ps/service/ps_local_client.h``: "in-process PS, no brpc",
+SURVEY §4.5). The table layer is host-resident (unbounded vocab never
+touches HBM; only touched rows move to device), which is the PS value
+proposition on TPU hosts. A networked transport can ride the native
+TCPStore; multi-host serving is future work.
+"""
+from .table import MemorySparseTable, MemoryDenseTable, SGDAccessor, AdagradAccessor  # noqa: F401
+from .local_client import PsLocalClient  # noqa: F401
+from .the_one_ps import TheOnePs  # noqa: F401
+from .embedding import DistributedEmbedding  # noqa: F401

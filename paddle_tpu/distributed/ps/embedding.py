@@ -1,0 +1,65 @@
+"""DistributedEmbedding: device model + host PS sparse table.
+
+Parity: the ``paddle.static.nn.sparse_embedding`` + pull/push op pair
+(``operators/pscore/distributed_lookup_table_op.cc``) — rows live in the PS
+table (host, unbounded vocab); the forward pulls only the touched rows to
+the device, the backward pushes their gradients straight into the table's
+accessor (the PS async-SGD contract: the optimizer for these rows IS the
+table accessor, not the device optimizer).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...autograd import PyLayer
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unwrap
+
+
+class _PullPush(PyLayer):
+    @staticmethod
+    def forward(ctx, ids, anchor, client, table_id):
+        # `anchor` is a scalar float parameter whose only job is to give the
+        # tape a differentiable input, so backward (the grad push into the
+        # table) actually runs for integer ids
+        idv = np.asarray(unwrap(ids)).reshape(-1)
+        rows = client.pull_sparse(table_id, idv)
+        ctx.ctx_data = (client, table_id, idv)
+        import jax.numpy as jnp
+        out_shape = tuple(unwrap(ids).shape) + (rows.shape[-1],)
+        return Tensor(jnp.asarray(rows.reshape(out_shape))
+                      + unwrap(anchor) * 0.0)
+
+    @staticmethod
+    def backward(ctx, grad):
+        client, table_id, idv = ctx.ctx_data
+        g = np.asarray(unwrap(grad)).reshape(len(idv), -1)
+        client.push_sparse_grad(table_id, idv, g)
+        import jax.numpy as jnp
+        return Tensor(jnp.zeros((1,), jnp.float32))  # anchor gets zero grad
+
+
+class DistributedEmbedding(nn.Layer):
+    """Embedding whose weight is a PS sparse table.
+
+    The table accessor applies updates at backward time (async-SGD shape);
+    the layer itself exposes no trainable device parameter.
+    """
+
+    def __init__(self, ps, emb_dim, accessor="adagrad", lr=0.05):
+        super().__init__()
+        self.ps = ps
+        self.table_id = ps.add_sparse_table(emb_dim, accessor=accessor,
+                                            lr=lr)
+        self.emb_dim = emb_dim
+        # tape anchor (see _PullPush); receives only zero grads
+        self.anchor = self.create_parameter([1])
+
+    def forward(self, ids):
+        return _PullPush.apply(ids, self.anchor, self.ps.client,
+                               self.table_id)
+
+    @property
+    def table(self):
+        return self.ps.client.get_table(self.table_id)

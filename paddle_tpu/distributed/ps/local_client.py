@@ -1,0 +1,47 @@
+"""In-process PS client.
+
+Parity: ``/root/reference/paddle/fluid/distributed/ps/service/
+ps_local_client.h`` — the brpc client's interface served by tables in the
+same process (the reference's own no-network fixture).
+"""
+from __future__ import annotations
+
+from .table import MemorySparseTable, MemoryDenseTable
+
+
+class PsLocalClient:
+    def __init__(self):
+        self._tables = {}
+
+    # -- table management (ps_client create/load/save surface) -------------
+    def create_sparse_table(self, table_id, emb_dim, accessor=None, **kw):
+        self._tables[table_id] = MemorySparseTable(emb_dim, accessor, **kw)
+        return self._tables[table_id]
+
+    def create_dense_table(self, table_id, shape, accessor=None, **kw):
+        self._tables[table_id] = MemoryDenseTable(shape, accessor, **kw)
+        return self._tables[table_id]
+
+    def get_table(self, table_id):
+        return self._tables[table_id]
+
+    # -- sparse ------------------------------------------------------------
+    def pull_sparse(self, table_id, ids):
+        return self._tables[table_id].pull(ids)
+
+    def push_sparse_grad(self, table_id, ids, grads):
+        self._tables[table_id].push(ids, grads)
+
+    # -- dense -------------------------------------------------------------
+    def pull_dense(self, table_id):
+        return self._tables[table_id].pull()
+
+    def push_dense_grad(self, table_id, grad):
+        self._tables[table_id].push(grad)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, table_id, path):
+        self._tables[table_id].save(path)
+
+    def load(self, table_id, path):
+        self._tables[table_id].load(path)

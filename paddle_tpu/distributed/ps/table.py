@@ -1,0 +1,134 @@
+"""PS tables + accessors.
+
+Parity: ``/root/reference/paddle/fluid/distributed/ps/table/``
+(memory_sparse_table.cc, memory_dense_table.cc) and the accessor family
+(ctr_accessor.cc — per-feature optimizer state stored inline with the row).
+Host numpy keeps tables out of HBM; rows materialize on first touch with the
+configured initializer, the sparse-table contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class SGDAccessor:
+    """Plain SGD on rows (sparse_sgd_rule parity)."""
+
+    slots = 0
+
+    def __init__(self, learning_rate=0.01):
+        self.lr = learning_rate
+
+    def init_slots(self, dim):
+        return ()
+
+    def update(self, row, grad, slots):
+        row -= self.lr * grad
+        return slots
+
+
+class AdagradAccessor:
+    """Per-feature adagrad (sparse_adagrad_rule parity)."""
+
+    slots = 1
+
+    def __init__(self, learning_rate=0.05, initial_g2sum=0.0, epsilon=1e-10):
+        self.lr = learning_rate
+        self.g0 = initial_g2sum
+        self.eps = epsilon
+
+    def init_slots(self, dim):
+        return (np.full(dim, self.g0, np.float32),)
+
+    def update(self, row, grad, slots):
+        (g2,) = slots
+        g2 += grad * grad
+        row -= self.lr * grad / (np.sqrt(g2) + self.eps)
+        return (g2,)
+
+
+class MemorySparseTable:
+    """Unbounded-vocab sparse table: feature id → (row, accessor slots)."""
+
+    def __init__(self, emb_dim, accessor=None, initializer=None, seed=0):
+        self.emb_dim = emb_dim
+        self.accessor = accessor or SGDAccessor()
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: self._rng.uniform(-0.05, 0.05, emb_dim)
+            .astype(np.float32))
+        self._rows: dict[int, np.ndarray] = {}
+        self._slots: dict[int, tuple] = {}
+
+    def _ensure(self, fid):
+        if fid not in self._rows:
+            self._rows[fid] = self._init()
+            self._slots[fid] = self.accessor.init_slots(self.emb_dim)
+        return self._rows[fid]
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        return np.stack([self._ensure(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), self.emb_dim)
+        # duplicate ids accumulate (the reference merges by key pre-update)
+        acc: dict[int, np.ndarray] = {}
+        for i, g in zip(ids, grads):
+            fid = int(i)
+            acc[fid] = acc.get(fid, 0) + g
+        for fid, g in acc.items():
+            self._ensure(fid)
+            self._slots[fid] = self.accessor.update(
+                self._rows[fid], g, self._slots[fid])
+
+    @property
+    def size(self):
+        return len(self._rows)
+
+    def save(self, path):
+        ids = np.array(list(self._rows), np.int64)
+        rows = np.stack(list(self._rows.values())) if self._rows \
+            else np.zeros((0, self.emb_dim), np.float32)
+        # accessor slot state rides along (ctr_accessor stores it inline with
+        # the row): without it, a restore resets adagrad g2sum and the first
+        # post-restore updates use the full learning rate
+        slot_arrays = {}
+        for s in range(self.accessor.slots):
+            slot_arrays[f"slot_{s}"] = np.stack(
+                [self._slots[int(i)][s] for i in ids]) if len(ids) \
+                else np.zeros((0, self.emb_dim), np.float32)
+        np.savez(path, ids=ids, rows=rows, **slot_arrays)
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        n_slots = self.accessor.slots
+        for j, (fid, row) in enumerate(zip(data["ids"], data["rows"])):
+            self._rows[int(fid)] = row.astype(np.float32)
+            if n_slots and f"slot_0" in data:
+                self._slots[int(fid)] = tuple(
+                    data[f"slot_{s}"][j].astype(np.float32)
+                    for s in range(n_slots))
+            else:
+                self._slots[int(fid)] = self.accessor.init_slots(
+                    self.emb_dim)
+
+
+class MemoryDenseTable:
+    """Dense parameter block on the server (memory_dense_table.cc)."""
+
+    def __init__(self, shape, accessor=None, initializer=None, seed=0):
+        rng = np.random.default_rng(seed)
+        self.param = (initializer() if initializer
+                      else rng.uniform(-0.05, 0.05, shape)
+                      .astype(np.float32))
+        self.accessor = accessor or SGDAccessor()
+        self._slots = self.accessor.init_slots(self.param.shape)
+
+    def pull(self):
+        return self.param.copy()
+
+    def push(self, grad):
+        self._slots = self.accessor.update(self.param,
+                                           np.asarray(grad), self._slots)

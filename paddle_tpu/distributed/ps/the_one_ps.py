@@ -1,0 +1,49 @@
+"""TheOnePs runtime facade.
+
+Parity: ``/root/reference/python/paddle/distributed/ps/the_one_ps.py`` —
+builds the PS runtime (tables from configs, client, server lifecycle) that
+``fleet.init`` wires for parameter-server roles.
+"""
+from __future__ import annotations
+
+from .local_client import PsLocalClient
+from .table import SGDAccessor, AdagradAccessor
+
+_ACCESSORS = {"sgd": SGDAccessor, "adagrad": AdagradAccessor,
+              "SparseSGDRule": SGDAccessor,
+              "SparseAdaGradRule": AdagradAccessor}
+
+
+class TheOnePs:
+    def __init__(self, role_maker=None, strategy=None):
+        self.role_maker = role_maker
+        self.strategy = strategy
+        self.client = PsLocalClient()
+        self._next_table_id = 0
+
+    def add_sparse_table(self, emb_dim, accessor="adagrad", lr=0.05, **kw):
+        tid = self._next_table_id
+        self._next_table_id += 1
+        acc = _ACCESSORS[accessor](learning_rate=lr)
+        self.client.create_sparse_table(tid, emb_dim, acc, **kw)
+        return tid
+
+    def add_dense_table(self, shape, accessor="sgd", lr=0.01, **kw):
+        tid = self._next_table_id
+        self._next_table_id += 1
+        acc = _ACCESSORS[accessor](learning_rate=lr)
+        self.client.create_dense_table(tid, shape, acc, **kw)
+        return tid
+
+    # lifecycle parity shims (server runs in-process)
+    def init_server(self, *a, **kw):
+        return self
+
+    def run_server(self):
+        return self
+
+    def init_worker(self):
+        return self
+
+    def stop_worker(self):
+        return self

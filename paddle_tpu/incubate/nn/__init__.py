@@ -1,0 +1,6 @@
+"""paddle.incubate.nn parity — fused transformer layers."""
+from .layer.fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedBiasDropoutResidualLayerNorm,
+)
+from .layer.fused_ec_moe import FusedEcMoe  # noqa: F401

@@ -1,0 +1,40 @@
+"""Fused single-device expert-choice style MoE.
+
+Parity: ``/root/reference/python/paddle/incubate/nn/layer/fused_ec_moe.py``
+(FusedEcMoe over phi/kernels/fusion/moe_kernel.h) — the dense batched-expert
+formulation used when all experts fit one device: gate → softmax weights →
+batched expert FFN einsum, no capacity/dropping.
+"""
+from __future__ import annotations
+
+from .... import nn, ops
+from ....nn import functional as F
+
+
+class FusedEcMoe(nn.Layer):
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        assert act_type in ("gelu", "relu")
+        self.act = act_type
+        self.bmm_weight0 = self.create_parameter(
+            [num_experts, hidden_size, inter_size])
+        self.bmm_bias0 = self.create_parameter([num_experts, 1, inter_size],
+                                               is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            [num_experts, inter_size, hidden_size])
+        self.bmm_bias1 = self.create_parameter([num_experts, 1, hidden_size],
+                                               is_bias=True)
+        self.gate = nn.Linear(hidden_size, num_experts)
+
+    def forward(self, x, gate_logits=None):
+        # x [B, S, H]; dense mixture: every token runs every expert, combined
+        # by softmax gate weights (the fused kernel's math)
+        logits = self.gate(x) if gate_logits is None else gate_logits
+        w = F.softmax(logits, axis=-1)                       # [B,S,E]
+        h = ops.einsum("bsh,ehi->ebsi", x, self.bmm_weight0) \
+            + ops.unsqueeze(self.bmm_bias0, 1)
+        h = getattr(F, self.act)(h)
+        y = ops.einsum("ebsi,eih->ebsh", h, self.bmm_weight1) \
+            + ops.unsqueeze(self.bmm_bias1, 1)
+        return ops.einsum("bse,ebsh->bsh", w, y)

@@ -1,0 +1,130 @@
+"""Fused transformer layers.
+
+Parity: ``/root/reference/python/paddle/incubate/nn/layer/fused_transformer.py``
+(:82 FusedBiasDropoutResidualLayerNorm, :192 FusedMultiHeadAttention,
+:479 FusedFeedForward, :707 FusedTransformerEncoderLayer) backed by the
+fused_attention/fused_feedforward CUDA ops
+(``paddle/fluid/operators/fused/fused_attention_op.cu``).
+
+TPU-native: "fused" means one traced region XLA fuses — the attention core
+additionally routes through the Pallas flash kernel when shapes allow, which
+is the actual analog of the reference's hand-fused FMHA.
+"""
+from __future__ import annotations
+
+from .... import nn, ops
+from ....nn import functional as F
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """out = LayerNorm(residual + dropout(x + bias)) (op parity :82)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, x, residual):
+        return self.norm(residual + self.dropout(x + self.linear_bias))
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Pre/post-LN multi-head self-attention with fused qkv (parity :192)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = nn.Linear(embed_dim, embed_dim)
+        self.pre_ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        B, S, _ = x.shape
+        qkv = ops.reshape(self.qkv(x), [B, S, 3, self.num_heads,
+                                        self.head_dim])
+        q = ops.reshape(qkv[:, :, 0], [B, S, self.num_heads, self.head_dim])
+        k = ops.reshape(qkv[:, :, 1], [B, S, self.num_heads, self.head_dim])
+        v = ops.reshape(qkv[:, :, 2], [B, S, self.num_heads, self.head_dim])
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            training=self.training)
+        out = self.out_proj(ops.reshape(attn, [B, S, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """LN + linear-act-dropout-linear-residual block (parity :479)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward)
+        self.linear2 = nn.Linear(dim_feedforward, d_model)
+        self.pre_ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.pre_ln(src)
+        out = self.linear2(self.act_dropout(self.activation(
+            self.linear1(src))))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """FusedMultiHeadAttention + FusedFeedForward (parity :707)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
+            else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            act_dropout_rate=act_dropout_rate, activation=activation,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -84,6 +84,15 @@ class Predictor:
         self._inputs = [Tensor(f"input_{i}")
                         for i in range(len(self._input_specs))]
         self._outputs = []
+        # output arity is known statically from the exported program
+        try:
+            out_avals = self._layer._exported.out_info
+        except AttributeError:
+            out_avals = getattr(self._layer._exported, "out_avals", None)
+        try:
+            self._n_outputs = len(out_avals) if out_avals is not None else 1
+        except TypeError:
+            self._n_outputs = 1
 
     def get_input_names(self):
         return [t.name for t in self._inputs]
@@ -103,19 +112,16 @@ class Predictor:
             vals = [t.copy_to_cpu() for t in self._inputs]
         out = self._layer(*vals)
         outs = out if isinstance(out, (tuple, list)) else [out]
+        self._n_outputs = len(outs)
         results = []
         for i, o in enumerate(outs):
             h = self.get_output_handle(f"output_{i}")  # reuse pre-fetched
             h.copy_from_cpu(np.asarray(o.numpy()))
             results.append(h.copy_to_cpu())
-        self._n_outputs = len(outs)
         return results if inputs is not None else None
 
     def get_output_names(self):
-        n = getattr(self, "_n_outputs", None)
-        if n is None:
-            return ["output_0"]  # ≥1 output always exists pre-run
-        return [f"output_{i}" for i in range(n)]
+        return [f"output_{i}" for i in range(self._n_outputs)]
 
     def get_output_handle(self, name):
         # handles may be fetched before the first run (standard paddle
@@ -123,6 +129,8 @@ class Predictor:
         for t in self._outputs:
             if t.name == name:
                 return t
+        if name not in self.get_output_names():
+            raise KeyError(name)
         h = Tensor(name)
         self._outputs.append(h)
         return h

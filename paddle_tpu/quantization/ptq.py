@@ -49,11 +49,13 @@ class PTQ:
                     wq = fake_quant_dequant_abs_max(inner.weight,
                                                     bit_length=bits)
                     inner.weight.set_value(np.asarray(unwrap(wq)))
-                act_max = getattr(sub.act_quanter, "_max", 0.0) \
-                    if sub.act_quanter is not None else 0.0
-                if act_max:
+                act_scale = 0.0
+                if sub.act_quanter is not None:
+                    act_scale = float(np.asarray(
+                        unwrap(sub.act_quanter.scales())))
+                if act_scale > 0.0:
                     layer._sub_layers[name] = ConvertedLayer(
-                        inner, float(act_max), sub.act_quanter.bit_length())
+                        inner, act_scale, sub.act_quanter.bit_length())
                 else:
                     layer._sub_layers[name] = inner
             else:

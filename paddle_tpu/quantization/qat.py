@@ -117,11 +117,15 @@ class QAT:
                 if sub.weight_quanter is not None:
                     wq = sub.weight_quanter(inner.weight)
                     inner.weight.set_value(np.asarray(unwrap(wq)))
-                if sub.act_quanter is not None and \
-                        getattr(sub.act_quanter, "_scale", None):
+                act_scale = 0.0
+                if sub.act_quanter is not None:
+                    # BaseQuanter API, not a private attribute — any quanter
+                    # exposing scales()/bit_length() freezes correctly
+                    act_scale = float(np.asarray(
+                        unwrap(sub.act_quanter.scales())))
+                if act_scale > 0.0:
                     layer._sub_layers[name] = ConvertedLayer(
-                        inner, float(sub.act_quanter._scale),
-                        sub.act_quanter.bit_length())
+                        inner, act_scale, sub.act_quanter.bit_length())
                 else:
                     layer._sub_layers[name] = inner
             else:

@@ -32,9 +32,27 @@ class _Inception(nn.Layer):
                           axis=1)
 
 
+class _AuxHead(nn.Layer):
+    """Aux classifier branch (googlenet.py out1/out2)."""
+
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _ConvBN(in_ch, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(ops.flatten(x, 1)))
+        return self.fc2(self.dropout(x))
+
+
 class GoogLeNet(nn.Layer):
-    """Main branch only by default; aux classifiers available in training
-    (out, aux1, aux2) like the reference."""
+    """Returns (out, aux1, aux2) like the reference (googlenet.py forward);
+    aux heads hang off inception 4a and 4d."""
 
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()
@@ -64,11 +82,15 @@ class GoogLeNet(nn.Layer):
         if num_classes > 0:
             self.dropout = nn.Dropout(0.2)
             self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
 
     def forward(self, x):
         x = self.inc3(self.stem(x))
         x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
         x = self.inc4bcd(x)
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
         x = self.pool4(self.inc4e(x))
         x = self.inc5(x)
         if self.with_pool:
@@ -76,6 +98,7 @@ class GoogLeNet(nn.Layer):
         if self.num_classes > 0:
             x = ops.flatten(x, 1)
             x = self.fc(self.dropout(x))
+            return x, aux1, aux2
         return x
 
 

@@ -52,6 +52,9 @@ class SqueezeNet(nn.Layer):
         if self.num_classes > 0:
             x = self.classifier(x)
             x = ops.flatten(x, 1)
+        elif self.with_pool:
+            # feature-extractor configuration: pooled [B, 512, 1, 1]
+            x = nn.AdaptiveAvgPool2D(1)(x)
         return x
 
 

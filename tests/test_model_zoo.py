@@ -26,7 +26,6 @@ def _img(n=1, size=64):
     (lambda: M.mobilenet_v3_small(num_classes=10), 64),
     (lambda: M.mobilenet_v3_large(num_classes=10), 64),
     (lambda: M.shufflenet_v2_x0_25(num_classes=10), 64),
-    (lambda: M.googlenet(num_classes=10), 64),
     (lambda: M.inception_v3(num_classes=10), 128),
 ])
 def test_vision_model_forward(builder, size):
@@ -36,6 +35,15 @@ def test_vision_model_forward(builder, size):
     out = net(_img(1, size))
     assert tuple(out.shape) == (1, 10)
     assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_googlenet_returns_aux():
+    paddle.seed(0)
+    net = M.googlenet(num_classes=10)
+    net.eval()
+    out, aux1, aux2 = net(_img(1, 64))
+    for o in (out, aux1, aux2):
+        assert tuple(o.shape) == (1, 10)
 
 
 def test_densenet_trains():
