@@ -1,0 +1,3 @@
+"""paddle.audio parity (reference: ``python/paddle/audio/``)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

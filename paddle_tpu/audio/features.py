@@ -1,0 +1,90 @@
+"""Audio feature layers.
+
+Parity: ``/root/reference/python/paddle/audio/features/layers.py``
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC) — Layers composing
+signal.stft with the functional filterbanks.
+"""
+from __future__ import annotations
+
+from .. import nn, ops
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap
+from . import functional as AF
+from .. import signal as signal_mod
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = signal_mod.stft(x, self.n_fft, hop_length=self.hop_length,
+                               win_length=self.win_length,
+                               window=self.window, center=self.center,
+                               pad_mode=self.pad_mode)
+        mag = ops.abs(spec)
+        if self.power == 1.0:
+            return mag
+        return mag ** self.power
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)           # [..., freq, time]
+        return ops.einsum("mf,...ft->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), ref_value=self.ref_value,
+                              amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        mel = self.log_mel(x)                 # [..., n_mels, time]
+        return ops.einsum("mk,...mt->...kt", self.dct, mel)

@@ -1,0 +1,143 @@
+"""Audio DSP functionals.
+
+Parity: ``/root/reference/python/paddle/audio/functional/functional.py``
+(hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/compute_fbank_matrix/
+power_to_db/create_dct) and ``window.py`` (get_window). Formulas follow the
+same librosa-compatible (HTK-optional) conventions as the reference.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap, wrap
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = np.asarray(unwrap(freq) if isinstance(freq, Tensor) else freq,
+                   np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    if scalar:
+        return float(mel)
+    return wrap(jnp.asarray(mel, jnp.float32)) if isinstance(freq, Tensor) \
+        else mel.astype(np.float32)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = np.asarray(unwrap(mel) if isinstance(mel, Tensor) else mel,
+                   np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    if scalar:
+        return float(hz)
+    return wrap(jnp.asarray(hz, jnp.float32)) if isinstance(mel, Tensor) \
+        else hz.astype(np.float32)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return wrap(jnp.asarray(mel_to_hz(mels, htk), jnp.float32))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return wrap(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_f = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    mel_f = np.asarray(mel_to_hz(mel_pts, htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    weights = np.zeros((n_mels, len(fft_f)), np.float64)
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return wrap(jnp.asarray(weights, jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    from ..framework.tape import apply
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply(f, spect, op_name="power_to_db")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (functional.py create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return wrap(jnp.asarray(dct, jnp.float32))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """'hann'|'hamming'|'blackman'|('gaussian', std)|'bohman'|'triang' etc."""
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    N = win_length if not fftbins else win_length + 1
+    n = np.arange(N, dtype=np.float64)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / (N - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / (N - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / (N - 1))
+             + 0.08 * np.cos(4 * math.pi * n / (N - 1)))
+    elif name == "gaussian":
+        std = args[0] if args else 0.4 * (N - 1) / 2
+        w = np.exp(-0.5 * ((n - (N - 1) / 2) / std) ** 2)
+    elif name == "triang":
+        w = 1 - np.abs((n - (N - 1) / 2) / ((N - 1) / 2 + 0.5))
+    elif name == "bartlett":
+        w = 1 - np.abs((n - (N - 1) / 2) / ((N - 1) / 2))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return wrap(jnp.asarray(w, jnp.float32))

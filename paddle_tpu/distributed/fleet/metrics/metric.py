@@ -8,7 +8,6 @@ mesh group).
 """
 from __future__ import annotations
 
-import builtins
 
 import numpy as np
 

@@ -1,0 +1,5 @@
+"""paddle.geometric parity (reference: ``python/paddle/geometric/``)."""
+from .math import (  # noqa: F401
+    segment_sum, segment_mean, segment_min, segment_max,
+)
+from .message_passing import send_u_recv, send_ue_recv  # noqa: F401

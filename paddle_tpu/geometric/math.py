@@ -1,0 +1,65 @@
+"""Graph segment reductions.
+
+Parity: ``/root/reference/python/paddle/geometric/math.py`` → phi segment
+kernels. TPU-native: jax.ops.segment_* lower to sorted scatter-reduce, the
+XLA-efficient form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tape import apply
+from ..ops._dispatch import unwrap
+
+
+def _zero_empty(out, ids, n, dtype):
+    """Reference graph_send_recv zero-initializes: segments receiving no
+    rows yield 0, not the reduction identity (±inf for min/max)."""
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape[0], jnp.int32), ids,
+                              num_segments=n)
+    mask = (cnt > 0).reshape((n,) + (1,) * (out.ndim - 1))
+    return jnp.where(mask, out, jnp.zeros((), dtype))
+
+
+def _segment(op_name, jax_fn, data, segment_ids, zero_fill=False):
+    ids = unwrap(segment_ids)
+
+    def f(d):
+        if isinstance(ids, jax.core.Tracer):
+            raise ValueError("segment ops need concrete segment_ids")
+        n = int(jnp.max(jnp.asarray(ids)).item()) + 1
+        out = jax_fn(d, jnp.asarray(ids), num_segments=n)
+        if zero_fill:
+            out = _zero_empty(out, jnp.asarray(ids), n, d.dtype)
+        return out
+
+    return apply(f, data, op_name=op_name)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    ids = unwrap(segment_ids)
+
+    def f(d):
+        n = int(jnp.max(jnp.asarray(ids)).item()) + 1
+        s = jax.ops.segment_sum(d, jnp.asarray(ids), num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(d.shape[0], d.dtype),
+                                  jnp.asarray(ids), num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return s / jnp.maximum(cnt, 1).reshape(shape)
+
+    return apply(f, data, op_name="segment_mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids,
+                    zero_fill=True)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids,
+                    zero_fill=True)

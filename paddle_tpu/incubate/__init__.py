@@ -1,3 +1,4 @@
 """Experimental features (reference: ``python/paddle/incubate/``)."""
 from . import distributed  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,2 @@
+"""paddle.incubate.optimizer parity."""
+from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401

@@ -85,10 +85,7 @@ class Predictor:
                         for i in range(len(self._input_specs))]
         self._outputs = []
         # output arity is known statically from the exported program
-        try:
-            out_avals = self._layer._exported.out_info
-        except AttributeError:
-            out_avals = getattr(self._layer._exported, "out_avals", None)
+        out_avals = getattr(self._layer._exported, "out_avals", None)
         try:
             self._n_outputs = len(out_avals) if out_avals is not None else 1
         except TypeError:

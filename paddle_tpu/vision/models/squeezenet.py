@@ -46,6 +46,9 @@ class SqueezeNet(nn.Layer):
             self.classifier = nn.Sequential(
                 nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
                 nn.AdaptiveAvgPool2D(1))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.relu_feat = nn.ReLU()
 
     def forward(self, x):
         x = self.features(x)
@@ -53,8 +56,9 @@ class SqueezeNet(nn.Layer):
             x = self.classifier(x)
             x = ops.flatten(x, 1)
         elif self.with_pool:
-            # feature-extractor configuration: pooled [B, 512, 1, 1]
-            x = nn.AdaptiveAvgPool2D(1)(x)
+            # feature extractor (reference forward): relu → pool → [B, 512]
+            x = self.pool(self.relu_feat(x))
+            x = ops.squeeze(x, axis=[2, 3])
         return x
 
 

@@ -1,0 +1,157 @@
+"""Audio features + geometric ops tests.
+
+Oracles: closed-form DSP identities (HTK mel formula, DCT orthogonality,
+hann == numpy.hanning periodic, slaney filterbank row sums) and numpy loop
+implementations for segment/message-passing ops — the reference tests use
+librosa the same way.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio.features import (
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)
+from paddle_tpu.geometric import (
+    segment_sum, segment_mean, segment_min, segment_max, send_u_recv,
+    send_ue_recv,
+)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+# ---------------------------------------------------------------- audio
+def test_mel_conversions():
+    # HTK closed form round trip
+    assert abs(AF.hz_to_mel(1000.0, htk=True)
+               - 2595 * math.log10(1 + 1000 / 700)) < 1e-9
+    assert abs(AF.mel_to_hz(AF.hz_to_mel(440.0, htk=True), htk=True)
+               - 440.0) < 1e-6
+    # slaney round trip incl. the log region
+    for f in (250.0, 999.0, 4000.0, 8000.0):
+        assert abs(AF.mel_to_hz(AF.hz_to_mel(f)) - f) / f < 1e-6
+
+
+def test_windows_match_numpy():
+    w = _np(AF.get_window("hann", 64))
+    want = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(64) / 64)  # periodic
+    np.testing.assert_allclose(w, want, atol=1e-6)
+    w = _np(AF.get_window("hamming", 32, fftbins=False))
+    np.testing.assert_allclose(w, np.hamming(32), atol=1e-6)
+
+
+def test_fbank_matrix_properties():
+    fb = _np(AF.compute_fbank_matrix(16000, 512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has some support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_dct_orthogonality():
+    d = _np(AF.create_dct(16, 40, norm="ortho"))
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(16), atol=1e-5)
+
+
+def test_power_to_db():
+    s = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+    db = _np(AF.power_to_db(s, top_db=None))
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+
+def test_feature_layers_shapes():
+    sig = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 4000))
+        .astype(np.float32))
+    spec = Spectrogram(n_fft=256, hop_length=128)(sig)
+    assert _np(spec).shape[1] == 129
+    mel = MelSpectrogram(sr=16000, n_fft=256, hop_length=128, n_mels=32)(sig)
+    assert _np(mel).shape[1] == 32
+    logmel = LogMelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                               n_mels=32)(sig)
+    assert np.isfinite(_np(logmel)).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, hop_length=128,
+                n_mels=32)(sig)
+    assert _np(mfcc).shape[1] == 13
+
+
+def test_spectrogram_parseval():
+    """Energy in the spectrogram tracks signal energy (sanity physics)."""
+    t = np.linspace(0, 1, 4000).astype(np.float32)
+    sig = np.sin(2 * np.pi * 440 * t)
+    spec = _np(Spectrogram(n_fft=256, hop_length=64, power=2.0)(
+        paddle.to_tensor(sig[None])))
+    # a pure tone concentrates energy in one bin row
+    peak_bin = spec[0].mean(axis=1).argmax()
+    freq = peak_bin * 4000 / 256
+    assert abs(freq - 440) < 40
+
+
+# ------------------------------------------------------------ geometric
+def test_segment_ops_oracle():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                                     np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    np.testing.assert_allclose(_np(segment_sum(data, ids)),
+                               [[4, 6], [12, 14]])
+    np.testing.assert_allclose(_np(segment_mean(data, ids)),
+                               [[2, 3], [6, 7]])
+    np.testing.assert_allclose(_np(segment_min(data, ids)),
+                               [[1, 2], [5, 6]])
+    np.testing.assert_allclose(_np(segment_max(data, ids)),
+                               [[3, 4], [7, 8]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 1, 1, 2], np.int64))
+    out = segment_sum(data, ids)
+    ops.sum(out).backward()
+    np.testing.assert_allclose(_np(data.grad), np.ones((4, 2)))
+
+
+def test_send_u_recv_oracle():
+    x = paddle.to_tensor(np.array([[1.], [2.], [4.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+    out = _np(send_u_recv(x, src, dst, reduce_op="sum"))
+    # dst0 <- x[0]=1 ; dst1 <- x[0]+x[2]=5 ; dst2 <- x[1]=2
+    np.testing.assert_allclose(out, [[1.], [5.], [2.]])
+    out = _np(send_u_recv(x, src, dst, reduce_op="max"))
+    np.testing.assert_allclose(out, [[1.], [4.], [2.]])
+    out = _np(send_u_recv(x, src, dst, reduce_op="mean"))
+    np.testing.assert_allclose(out, [[1.], [2.5], [2.]])
+
+
+def test_send_ue_recv():
+    x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+    e = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int64))
+    dst = paddle.to_tensor(np.array([1, 0], np.int64))
+    out = _np(send_ue_recv(x, e, src, dst, message_op="add",
+                           reduce_op="sum"))
+    np.testing.assert_allclose(out, [[22.], [11.]])
+    out = _np(send_ue_recv(x, e, src, dst, message_op="mul",
+                           reduce_op="sum"))
+    np.testing.assert_allclose(out, [[40.], [10.]])
+
+
+def test_incubate_fused_lamb_alias():
+    from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+    from paddle_tpu import nn
+    net = nn.Linear(4, 4)
+    o = DistributedFusedLamb(learning_rate=1e-3,
+                             parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = ops.mean(net(x) ** 2)
+    loss.backward()
+    o.step()
+    o.clear_grad()
