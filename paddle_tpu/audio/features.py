@@ -7,15 +7,13 @@ signal.stft with the functional filterbanks.
 from __future__ import annotations
 
 from .. import nn, ops
-from ..framework.tensor import Tensor
-from ..ops._dispatch import unwrap
 from . import functional as AF
 from .. import signal as signal_mod
 
 
 class Spectrogram(nn.Layer):
     def __init__(self, n_fft=512, hop_length=None, win_length=None,
-                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
                  dtype="float32"):
         super().__init__()
         self.n_fft = n_fft

@@ -115,7 +115,8 @@ def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
 
 
 def get_window(window, win_length, fftbins=True, dtype="float32"):
-    """'hann'|'hamming'|'blackman'|('gaussian', std)|'bohman'|'triang' etc."""
+    """Supported: 'hann', 'hamming', 'blackman', ('gaussian', std),
+    'triang', 'bartlett'."""
     if isinstance(window, (tuple, list)):
         name, *args = window
     else:

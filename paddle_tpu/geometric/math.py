@@ -13,6 +13,21 @@ from ..framework.tape import apply
 from ..ops._dispatch import unwrap
 
 
+def _reduce_rows(msgs, ids, n, reduce_op):
+    """Shared row reduction for segment + message-passing ops: sum/mean with
+    count-guarded divide, min/max with empty segments zero-filled."""
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, ids, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape[0], msgs.dtype), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (msgs.ndim - 1)
+        return s / jnp.maximum(cnt, 1).reshape(shape)
+    fn = jax.ops.segment_min if reduce_op == "min" else jax.ops.segment_max
+    return _zero_empty(fn(msgs, ids, num_segments=n), ids, n, msgs.dtype)
+
+
 def _zero_empty(out, ids, n, dtype):
     """Reference graph_send_recv zero-initializes: segments receiving no
     rows yield 0, not the reduction identity (±inf for min/max)."""

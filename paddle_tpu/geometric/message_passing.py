@@ -6,42 +6,32 @@ kernels: gather source-node features along edges, reduce at destinations.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from ..framework.tape import apply
 from ..ops._dispatch import unwrap
+from .math import _reduce_rows
 
-_REDUCERS = {
-    "sum": jax.ops.segment_sum,
-    "mean": None,  # handled explicitly
-    "min": jax.ops.segment_min,
-    "max": jax.ops.segment_max,
-}
+_REDUCE_OPS = ("sum", "mean", "min", "max")
+
+
+def _n_out(out_size, x):
+    # reference contract: out_size <= 0 (or None) means "not used"
+    if out_size is not None and out_size > 0:
+        return int(out_size)
+    return int(jnp.asarray(unwrap(x)).shape[0])
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
     """out[d] = reduce_{e: dst[e]=d} x[src[e]] (send_recv.py:30)."""
-    assert reduce_op in _REDUCERS, reduce_op
+    assert reduce_op in _REDUCE_OPS, reduce_op
     src = jnp.asarray(unwrap(src_index))
     dst = jnp.asarray(unwrap(dst_index))
-    n_out = out_size if out_size is not None else \
-        int(jnp.asarray(unwrap(x)).shape[0])
+    n_out = _n_out(out_size, x)
 
     def f(xv):
-        msgs = xv[src]
-        if reduce_op == "mean":
-            s = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
-            cnt = jax.ops.segment_sum(jnp.ones(len(dst), xv.dtype), dst,
-                                      num_segments=n_out)
-            shape = (n_out,) + (1,) * (xv.ndim - 1)
-            return s / jnp.maximum(cnt, 1).reshape(shape)
-        out = _REDUCERS[reduce_op](msgs, dst, num_segments=n_out)
-        if reduce_op in ("min", "max"):
-            from .math import _zero_empty
-            out = _zero_empty(out, dst, n_out, xv.dtype)
-        return out
+        return _reduce_rows(xv[src], dst, n_out, reduce_op)
 
     return apply(f, x, op_name=f"send_u_recv_{reduce_op}")
 
@@ -50,11 +40,10 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
     """Edge-featured variant: message = x[src] (op) y[edge]."""
     assert message_op in ("add", "sub", "mul", "div")
-    assert reduce_op in _REDUCERS
+    assert reduce_op in _REDUCE_OPS
     src = jnp.asarray(unwrap(src_index))
     dst = jnp.asarray(unwrap(dst_index))
-    n_out = out_size if out_size is not None else \
-        int(jnp.asarray(unwrap(x)).shape[0])
+    n_out = _n_out(out_size, x)
 
     def f(xv, yv):
         m = xv[src]
@@ -66,16 +55,6 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
             m = m * yv
         else:
             m = m / yv
-        if reduce_op == "mean":
-            s = jax.ops.segment_sum(m, dst, num_segments=n_out)
-            cnt = jax.ops.segment_sum(jnp.ones(len(dst), xv.dtype), dst,
-                                      num_segments=n_out)
-            shape = (n_out,) + (1,) * (xv.ndim - 1)
-            return s / jnp.maximum(cnt, 1).reshape(shape)
-        out = _REDUCERS[reduce_op](m, dst, num_segments=n_out)
-        if reduce_op in ("min", "max"):
-            from .math import _zero_empty
-            out = _zero_empty(out, dst, n_out, xv.dtype)
-        return out
+        return _reduce_rows(m, dst, n_out, reduce_op)
 
     return apply(f, x, y, op_name=f"send_ue_recv_{message_op}_{reduce_op}")

@@ -137,6 +137,9 @@ def gpt_block(p, x, eps, mp_axis=None, use_flash=False):
     return x + m + p["b2"]
 
 
+_CE_CHUNK = 2048  # tokens per chunk: logits buffer ~= 2048*V*4B ≈ 400MB @50k
+
+
 def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
                                  loss_mask=None):
     """LM head + softmax CE over an mp-sharded vocab (mp_layers.py:501 parity).
@@ -144,7 +147,49 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
     h [B,S,H], wte_local [V_local,H], labels [B,S] global ids. Stable global
     logsumexp via pmax/psum over the mp axis; the target logit is picked on the
     rank owning the label id and psum'ed. Returns mean loss over (masked) tokens.
+
+    Memory: the [tokens, V] logits are never materialized whole — tokens are
+    processed in remat'ed chunks (lax.map + checkpoint), which is what lets
+    batch scale past the fp32-logits HBM cliff (3.3GB at B16/S1024/V50k).
     """
+    B, S, _H = h.shape
+    N = B * S
+    if mp_axis is None and N > _CE_CHUNK and wte_local.shape[0] >= 16384:
+        v_total = wte_local.shape[0]
+        hf = h.reshape(N, -1)
+        lf = labels.reshape(N)
+        mf = loss_mask.reshape(N).astype(jnp.float32) \
+            if loss_mask is not None else jnp.ones(N, jnp.float32)
+        # pad to the chunk boundary with mask-0 tokens so the gate is
+        # shape-independent (no fallback to the full-logits HBM cliff)
+        pad = (-N) % _CE_CHUNK
+        if pad:
+            hf = jnp.concatenate([hf, jnp.zeros((pad, hf.shape[1]),
+                                                hf.dtype)])
+            lf = jnp.concatenate([lf, jnp.zeros(pad, lf.dtype)])
+            mf = jnp.concatenate([mf, jnp.zeros(pad, jnp.float32)])
+
+        def per_chunk(args):
+            hc, lc, mc = args
+            lg = jnp.einsum("nh,vh->nv", hc, wte_local).astype(jnp.float32)
+            mx = jax.lax.stop_gradient(jnp.max(lg, -1))
+            lse = jnp.log(jnp.sum(jnp.exp(lg - mx[:, None]), -1)) + mx
+            # out-of-range ids (e.g. -1 padding) contribute tgt=0, matching
+            # the full path's in_range handling
+            in_r = (lc >= 0) & (lc < v_total)
+            safe = jnp.clip(lc, 0, v_total - 1)
+            tgt = jnp.where(
+                in_r, jnp.take_along_axis(lg, safe[:, None], -1)[:, 0], 0.0)
+            ls = lse - tgt
+            return jnp.sum(ls * mc), jnp.sum(mc)
+
+        n_chunks = (N + pad) // _CE_CHUNK
+        chunks = (hf.reshape(n_chunks, _CE_CHUNK, -1),
+                  lf.reshape(n_chunks, _CE_CHUNK),
+                  mf.reshape(n_chunks, _CE_CHUNK))
+        sums, counts = jax.lax.map(jax.checkpoint(per_chunk), chunks)
+        return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)
+
     logits = jnp.einsum("bsh,vh->bsv", h, wte_local).astype(jnp.float32)
     v_local = logits.shape[-1]
     if mp_axis is not None:

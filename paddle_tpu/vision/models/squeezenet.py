@@ -43,9 +43,10 @@ class SqueezeNet(nn.Layer):
                 _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
                 _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
         if num_classes > 0:
+            # convs separate from pooling: with_pool=False keeps the spatial
+            # class map (reference fully-convolutional use)
             self.classifier = nn.Sequential(
-                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
-                nn.AdaptiveAvgPool2D(1))
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         self.relu_feat = nn.ReLU()
@@ -54,8 +55,11 @@ class SqueezeNet(nn.Layer):
         x = self.features(x)
         if self.num_classes > 0:
             x = self.classifier(x)
-            x = ops.flatten(x, 1)
-        elif self.with_pool:
+            if self.with_pool:
+                x = self.pool(x)
+                x = ops.flatten(x, 1)           # [B, num_classes]
+            return x                            # else [B, C, H, W]
+        if self.with_pool:
             # feature extractor (reference forward): relu → pool → [B, 512]
             x = self.pool(self.relu_feat(x))
             x = ops.squeeze(x, axis=[2, 3])

@@ -104,3 +104,32 @@ def test_gpt_sync_params_back():
     assert not np.array_equal(w_before, w_after)
     np.testing.assert_array_equal(
         w_after, np.asarray(step.params["blocks"]["wqkv"][0]))
+
+
+def test_chunked_vocab_ce_matches_full():
+    """The remat-chunked CE path (large vocab) must equal the full-logits
+    path in value and gradient."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import vocab_parallel_cross_entropy
+
+    rng = np.random.default_rng(0)
+    B, S, H, V = 2, 2048, 32, 32768  # N=4096, V>=16384 -> chunked
+    h = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((V, H)) * 0.02, jnp.float32)
+    lab = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+
+    def full(hh, ww):
+        lg = jnp.einsum("bsh,vh->bsv", hh, ww).astype(jnp.float32)
+        m = jax.lax.stop_gradient(jnp.max(lg, -1))
+        lse = jnp.log(jnp.sum(jnp.exp(lg - m[..., None]), -1)) + m
+        tgt = jnp.take_along_axis(lg, lab[..., None], -1)[..., 0]
+        return jnp.mean(lse - tgt)
+
+    got = float(vocab_parallel_cross_entropy(h, w, lab))
+    want = float(full(h, w))
+    assert abs(got - want) < 1e-4
+    g1 = jax.grad(lambda a, b: vocab_parallel_cross_entropy(a, b, lab))(h, w)
+    g2 = jax.grad(full)(h, w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-4, atol=1e-6)
