@@ -1,0 +1,14 @@
+"""Automatic SParsity (n:m structured pruning).
+
+Parity: ``/root/reference/python/paddle/incubate/asp/`` (asp.py:217 decorate,
+:303 prune_model, :917 OptimizerWithSparsityGuarantee; utils.py mask algos).
+TPU note: n:m sparsity is a CUDA-sparse-tensor-core feature; on TPU the value
+is model compression / distillation prep, so the masks are exact but compute
+stays dense — the semantics (prune → masked training via a decorated
+optimizer) match the reference.
+"""
+from .asp import (  # noqa: F401
+    calculate_density, decorate, prune_model, reset_excluded_layers,
+    set_excluded_layers, check_sparsity, check_layer_sparsity,
+    create_mask, clear_masks,
+)

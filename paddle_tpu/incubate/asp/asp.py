@@ -1,0 +1,125 @@
+"""ASP implementation (reference asp.py / utils.py condensed)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...ops._dispatch import unwrap
+
+_SUPPORTED = (nn.Linear, nn.Conv2D)
+_excluded: set = set()
+_masks: dict = {}  # id(param) -> (param, np mask)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x):
+    v = np.asarray(unwrap(x) if not isinstance(x, np.ndarray) else x)
+    return float(np.count_nonzero(v)) / max(v.size, 1)
+
+
+def _reduction_view(w, layer):
+    """2-D view with the REDUCTION dim last — the axis n:m sparsity targets
+    (the reference transposes fc weights / flattens conv kernels the same
+    way for sparse-tensor-core layout)."""
+    w = np.asarray(w)
+    if isinstance(layer, nn.Conv2D):
+        out_ch = w.shape[0]
+        return w.reshape(out_ch, -1), lambda v: v.reshape(w.shape)
+    # Linear weight is [in, out]: reduction dim is in (axis 0)
+    return w.T, lambda v: v.T
+
+
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    """n:m mask over the last axis: keep the n largest magnitudes per group
+    of m (utils.py get_mask_1d)."""
+    if func_name not in ("mask_1d",):
+        raise NotImplementedError(
+            f"mask algo {func_name!r} not implemented (only mask_1d); the "
+            "2d algos target cuSPARSELt tiles the TPU build has no use for")
+    w = np.asarray(weight)
+    shape = w.shape
+    flat = w.reshape(-1, shape[-1])
+    cols = shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros((flat.shape[0], pad),
+                                              w.dtype)], 1)
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols].reshape(shape)
+    return mask.astype(w.dtype)
+
+
+def check_sparsity(weight, n=2, m=4, func_name="mask_1d"):
+    w = np.asarray(weight)
+    flat = np.abs(w.reshape(-1, w.shape[-1]))
+    cols = w.shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros((flat.shape[0], pad))], 1)
+    groups = (flat.reshape(flat.shape[0], -1, m) != 0).sum(-1)
+    return bool((groups <= n).all())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported layers' weights to n:m along the reduction dim and
+    register masks so a decorated optimizer keeps them sparse (asp.py:303)."""
+    pruned = {}
+    for name, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, _SUPPORTED):
+            continue
+        w = layer.weight
+        if getattr(w, "name", None) in _excluded or name in _excluded:
+            continue
+        view, restore = _reduction_view(np.asarray(unwrap(w)), layer)
+        mask = restore(create_mask(view, mask_algo, n, m))
+        w.set_value((np.asarray(unwrap(w)) * mask).astype(mask.dtype))
+        if with_mask:
+            _masks[id(w)] = (w, mask)
+        pruned[name or type(model).__name__] = mask
+    return pruned
+
+
+def check_layer_sparsity(layer, n=2, m=4):
+    """n:m check in the same reduction-dim view prune_model used."""
+    view, _ = _reduction_view(np.asarray(unwrap(layer.weight)), layer)
+    return check_sparsity(view, n=n, m=m)
+
+
+def clear_masks():
+    """Drop all registered masks (also releases the pruned params)."""
+    _masks.clear()
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the masks after every step (asp.py:917): pruned weights
+    stay exactly zero through training. Only masks belonging to THIS
+    optimizer's parameters are applied — decorating optimizer B never
+    rewrites model A's weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self):
+        self._optimizer.step()
+        own = {id(p) for p in (self._optimizer._parameter_list or [])}
+        for pid, (w, mask) in list(_masks.items()):
+            if pid in own:
+                w.set_value((np.asarray(unwrap(w)) * mask)
+                            .astype(mask.dtype))
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,8 @@
+"""paddle.text parity (reference: ``python/paddle/text/``).
+
+The dataset classes (Imdb/Imikolov/Movielens/...) require network downloads
+and are provided by `paddle_tpu.text.datasets` shells that raise with a clear
+message offline; viterbi decoding is implemented natively.
+"""
+from .viterbi_decode import viterbi_decode, ViterbiDecoder  # noqa: F401
+from . import datasets  # noqa: F401

