@@ -36,6 +36,18 @@ def _reduction_view(w, layer):
     return w.T, lambda v: v.T
 
 
+def _grouped(w, m):
+    """[rows, ceil(cols/m), m] zero-padded group view over the last axis —
+    the single grouping used by both mask creation and checking."""
+    w = np.asarray(w)
+    flat = w.reshape(-1, w.shape[-1])
+    pad = (-w.shape[-1]) % m
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros((flat.shape[0], pad), flat.dtype)], 1)
+    return flat.reshape(flat.shape[0], -1, m)
+
+
 def create_mask(weight, func_name="mask_1d", n=2, m=4):
     """n:m mask over the last axis: keep the n largest magnitudes per group
     of m (utils.py get_mask_1d)."""
@@ -45,28 +57,17 @@ def create_mask(weight, func_name="mask_1d", n=2, m=4):
             "2d algos target cuSPARSELt tiles the TPU build has no use for")
     w = np.asarray(weight)
     shape = w.shape
-    flat = w.reshape(-1, shape[-1])
     cols = shape[-1]
-    pad = (-cols) % m
-    if pad:
-        flat = np.concatenate([flat, np.zeros((flat.shape[0], pad),
-                                              w.dtype)], 1)
-    groups = flat.reshape(flat.shape[0], -1, m)
+    groups = _grouped(w, m)
     order = np.argsort(-np.abs(groups), axis=-1)
     mask = np.zeros_like(groups)
     np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
-    mask = mask.reshape(flat.shape)[:, :cols].reshape(shape)
+    mask = mask.reshape(groups.shape[0], -1)[:, :cols].reshape(shape)
     return mask.astype(w.dtype)
 
 
 def check_sparsity(weight, n=2, m=4, func_name="mask_1d"):
-    w = np.asarray(weight)
-    flat = np.abs(w.reshape(-1, w.shape[-1]))
-    cols = w.shape[-1]
-    pad = (-cols) % m
-    if pad:
-        flat = np.concatenate([flat, np.zeros((flat.shape[0], pad))], 1)
-    groups = (flat.reshape(flat.shape[0], -1, m) != 0).sum(-1)
+    groups = (_grouped(weight, m) != 0).sum(-1)
     return bool((groups <= n).all())
 
 
@@ -101,21 +102,38 @@ def clear_masks():
 
 
 class OptimizerWithSparsityGuarantee:
-    """Re-applies the masks after every step (asp.py:917): pruned weights
-    stay exactly zero through training. Only masks belonging to THIS
+    """Re-applies the masks after every step/minimize (asp.py:917): pruned
+    weights stay exactly zero through training. Only masks belonging to THIS
     optimizer's parameters are applied — decorating optimizer B never
     rewrites model A's weights."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
+        self._own = {id(p) for p in (optimizer._parameter_list or [])}
+        self._device_masks = {}  # id(param) -> jnp mask (lazily staged)
+
+    def _apply_masks(self):
+        import jax.numpy as jnp
+        for pid, (w, mask) in list(_masks.items()):
+            if pid not in self._own:
+                continue
+            dm = self._device_masks.get(pid)
+            if dm is None:
+                dm = jnp.asarray(mask)
+                self._device_masks[pid] = dm
+            # device-side multiply: no host round trip per step
+            w._value = unwrap(w) * dm
 
     def step(self):
         self._optimizer.step()
-        own = {id(p) for p in (self._optimizer._parameter_list or [])}
-        for pid, (w, mask) in list(_masks.items()):
-            if pid in own:
-                w.set_value((np.asarray(unwrap(w)) * mask)
-                            .astype(mask.dtype))
+        self._apply_masks()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._optimizer.minimize(loss, startup_program, parameters,
+                                       no_grad_set)
+        self._apply_masks()
+        return out
 
     def __getattr__(self, item):
         return getattr(self._optimizer, item)

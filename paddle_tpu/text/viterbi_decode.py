@@ -70,12 +70,12 @@ def viterbi_decode(potentials, transition_params, lengths,
     """Returns (scores [B], paths [B, S]) — highest-scoring tag sequence.
     Decode is inference-only (no gradient), matching the reference op."""
     from ..ops._dispatch import apply_nondiff
-    lens = jnp.asarray(unwrap(lengths))
 
-    def f(pot, trans):
-        return _viterbi(pot, trans, lens, include_bos_eos_tag)
+    def f(pot, trans, lens):
+        return _viterbi(pot, trans, jnp.asarray(lens), include_bos_eos_tag)
 
-    scores, paths = apply_nondiff(f, potentials, transition_params,
+    # lengths rides through the dispatcher so static/lazy mode resolves it
+    scores, paths = apply_nondiff(f, potentials, transition_params, lengths,
                                   op_name="viterbi_decode")
     return scores, paths
 
