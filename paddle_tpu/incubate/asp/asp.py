@@ -117,12 +117,14 @@ class OptimizerWithSparsityGuarantee:
         for pid, (w, mask) in list(_masks.items()):
             if pid not in self._own:
                 continue
-            dm = self._device_masks.get(pid)
-            if dm is None:
-                dm = jnp.asarray(mask)
-                self._device_masks[pid] = dm
+            # cache keyed by the mask object so a re-prune (new mask for the
+            # same param) restages instead of applying the stale pattern
+            cached = self._device_masks.get(pid)
+            if cached is None or cached[0] is not mask:
+                cached = (mask, jnp.asarray(mask))
+                self._device_masks[pid] = cached
             # device-side multiply: no host round trip per step
-            w._value = unwrap(w) * dm
+            w._value = unwrap(w) * cached[1]
 
     def step(self):
         self._optimizer.step()

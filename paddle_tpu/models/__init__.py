@@ -5,7 +5,8 @@ meta_parallel/pipeline_parallel.py + mpu layers in the reference repo.
 """
 from .gpt import (  # noqa: F401
     GPTConfig, GPTDecoderLayer, GPTEmbeddings, GPTModel, GPTForPretraining,
-    GPTPretrainingCriterion, GPTHybridTrainStep, gpt_tiny_config,
+    GPTPretrainingCriterion, GPTHybridTrainStep, GPTGenerator,
+    gpt_tiny_config,
     gpt_345m_config, gpt_1p3b_config, gpt_13b_config,
 )
 from .bert import (  # noqa: F401

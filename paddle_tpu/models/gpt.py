@@ -44,6 +44,7 @@ from ..ops._dispatch import apply, unwrap
 __all__ = [
     "GPTConfig", "GPTDecoderLayer", "GPTEmbeddings", "GPTModel",
     "GPTForPretraining", "GPTPretrainingCriterion", "GPTHybridTrainStep",
+    "GPTGenerator",
     "gpt_tiny_config", "gpt_345m_config", "gpt_1p3b_config", "gpt_13b_config",
 ]
 
@@ -99,7 +100,7 @@ def _ln(x, w, b, eps):
     return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
 
 
-def gpt_block(p, x, eps, mp_axis=None, use_flash=False):
+def gpt_block(p, x, eps, mp_axis=None, use_flash=False, return_kv=False):
     """One pre-LN decoder block. Pure jax.
 
     p: dict of (possibly mp-sliced) tensors:
@@ -134,7 +135,10 @@ def gpt_block(p, x, eps, mp_axis=None, use_flash=False):
     m = u @ p["w2"]
     if mp_axis is not None:
         m = jax.lax.psum(m, mp_axis)
-    return x + m + p["b2"]
+    out = x + m + p["b2"]
+    if return_kv:  # decode prefill captures this block's K/V cache
+        return out, k, v
+    return out
 
 
 _CE_CHUNK = 2048  # tokens per chunk: logits buffer ~= 2048*V*4B ≈ 400MB @50k
@@ -634,3 +638,144 @@ class GPTHybridTrainStep:
         g.embeddings.position_embeddings._value = self.params["wpe"]
         g.lnf_w._value = self.params["lnf_w"]
         g.lnf_b._value = self.params["lnf_b"]
+
+
+# ---------------------------------------------------------------------------
+# autoregressive generation (KV-cache incremental decode)
+# ---------------------------------------------------------------------------
+
+def gpt_block_with_kv(p, x, eps):
+    """gpt_block that also returns this block's K/V for cache prefill —
+    single source of truth: delegates to gpt_block(return_kv=True)."""
+    return gpt_block(p, x, eps, return_kv=True)
+
+
+def gpt_block_decode(p, x_t, k_cache, v_cache, pos, eps):
+    """One-token decode step against a static-length KV cache.
+
+    x_t [B,1,H]; caches [B,Smax,nh,d]; pos = index this token writes. The
+    attention mask is positional (arange <= pos), so the whole step is one
+    fixed-shape XLA program regardless of how far decoding has advanced.
+    """
+    h = _ln(x_t, p["ln1_w"], p["ln1_b"], eps)
+    qkv = jnp.einsum("bsh,hknd->bsknd", h, p["wqkv"]) + p["bqkv"]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B,1,nh,d]
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    d = q.shape[-1]
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k_cache) / math.sqrt(d)
+    mask = (jnp.arange(k_cache.shape[1]) <= pos)[None, None, None, :]
+    logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x_t.dtype)
+    attn = jnp.einsum("bnst,btnd->bsnd", probs, v_cache)
+    o = jnp.einsum("bsnd,ndh->bsh", attn, p["wo"])
+    x_t = x_t + o + p["bo"]
+    h2 = _ln(x_t, p["ln2_w"], p["ln2_b"], eps)
+    u = jax.nn.gelu(h2 @ p["w1"] + p["b1"], approximate=True)
+    return x_t + u @ p["w2"] + p["b2"], k_cache, v_cache
+
+
+class GPTGenerator:
+    """Compiled autoregressive decoder (the serving-side counterpart of
+    GPTHybridTrainStep): prefill computes the prompt's KV caches in one
+    full-attention pass, then a lax.scan emits tokens one cached step at a
+    time — the standard TPU decode loop, one fixed XLA program per
+    (batch, prompt_len, max_new_tokens) signature.
+
+    Sampling: greedy (temperature=0) or temperature + optional top-k.
+    """
+
+    def __init__(self, model, temperature=0.0, top_k=0, seed=0):
+        gpt = model.gpt if hasattr(model, "gpt") else model
+        self.cfg = gpt.config
+        self.blocks = {k: jnp.stack([getattr(l, k)._value
+                                     for l in gpt.layers])
+                       for k in _BLOCK_KEYS}
+        self.wte = gpt.embeddings.word_embeddings._value
+        self.wpe = gpt.embeddings.position_embeddings._value
+        self.lnf_w = gpt.lnf_w._value
+        self.lnf_b = gpt.lnf_b._value
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = seed
+        self._compiled = {}
+
+    def _sample(self, logits, key):
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, -1)
+        logits = logits / self.temperature
+        if self.top_k > 0:
+            kth = jnp.sort(logits, -1)[..., -self.top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    def _build(self, B, S_prompt, max_new):
+        cfg = self.cfg
+        eps = cfg.layer_norm_epsilon
+        S_max = S_prompt + max_new
+        assert S_max <= cfg.max_position_embeddings, \
+            f"{S_max} > max_position_embeddings"
+        blocks, wte, wpe = self.blocks, self.wte, self.wpe
+        lnf_w, lnf_b = self.lnf_w, self.lnf_b
+
+        def run(ids, key):
+            # ---- prefill: full pass, capture KV per layer
+            h = wte[ids] + wpe[jnp.arange(S_prompt)]
+
+            def pre(x, p_slice):
+                out, k, v = gpt_block_with_kv(p_slice, x, eps)
+                return out, (k, v)
+
+            h, (ks, vs) = jax.lax.scan(pre, h, blocks)
+            # ks [L,B,S_prompt,nh,hd] → padded caches [L,B,S_max,nh,hd]
+            pad = ((0, 0), (0, 0), (0, max_new), (0, 0), (0, 0))
+            k_caches = jnp.pad(ks, pad)
+            v_caches = jnp.pad(vs, pad)
+            h_last = _ln(h[:, -1:], lnf_w, lnf_b, eps)
+            logits = jnp.einsum("bsh,vh->bsv", h_last, wte)[:, 0]
+            key, sub = jax.random.split(key)
+            tok = self._sample(logits, sub)
+
+            # ---- decode loop
+            def step(carry, i):
+                tok, k_caches, v_caches, key = carry
+                pos = S_prompt + i
+                x_t = wte[tok][:, None, :] + wpe[pos][None, None, :]
+
+                def layer(x_and_i, p_and_caches):
+                    x, = x_and_i
+                    p_slice, kc, vc = p_and_caches
+                    x, kc, vc = gpt_block_decode(p_slice, x, kc, vc, pos,
+                                                 eps)
+                    return (x,), (kc, vc)
+
+                (x_t,), (k_caches, v_caches) = jax.lax.scan(
+                    layer, (x_t,), (blocks, k_caches, v_caches))
+                h_t = _ln(x_t, lnf_w, lnf_b, eps)
+                logits = jnp.einsum("bsh,vh->bsv", h_t, wte)[:, 0]
+                key, sub = jax.random.split(key)
+                nxt = self._sample(logits, sub)
+                return (nxt, k_caches, v_caches, key), tok
+
+            (last, _, _, _), toks = jax.lax.scan(
+                step, (tok, k_caches, v_caches, key),
+                jnp.arange(max_new - 1)) if max_new > 1 else \
+                ((tok, None, None, key), jnp.zeros((0, B), tok.dtype))
+            out = jnp.concatenate([toks, last[None]], 0)  # [max_new, B]
+            return jnp.swapaxes(out, 0, 1)
+
+        return jax.jit(run)
+
+    def __call__(self, input_ids, max_new_tokens=32):
+        ids = jnp.asarray(unwrap(input_ids)
+                          if not isinstance(input_ids, np.ndarray)
+                          else input_ids)
+        B, S = ids.shape
+        sig = (B, S, max_new_tokens)
+        if sig not in self._compiled:
+            self._compiled[sig] = self._build(B, S, max_new_tokens)
+        # advance per call: repeated sampling yields distinct completions
+        self._calls = getattr(self, "_calls", 0) + 1
+        key = jax.random.fold_in(jax.random.key(self.seed), self._calls)
+        new = self._compiled[sig](ids, key)
+        return Tensor(jnp.concatenate([ids, new], axis=1))

@@ -133,3 +133,63 @@ def test_chunked_vocab_ce_matches_full():
     g2 = jax.grad(full)(h, w)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=2e-4, atol=1e-6)
+
+
+def test_generator_matches_full_forward_greedy():
+    """KV-cache incremental decode == repeated full-forward argmax."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import (
+        GPTForPretraining, GPTModel, GPTGenerator, gpt_tiny_config,
+        gpt_block, _ln, _BLOCK_KEYS,
+    )
+    paddle.seed(0)
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    gen = GPTGenerator(model, temperature=0.0)
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    out = np.asarray(gen(prompt, max_new_tokens=6)._value)
+    assert out.shape == (2, 14)
+    np.testing.assert_array_equal(out[:, :8], prompt)
+
+    # oracle: full forward + argmax, token by token
+    gpt = model.gpt
+    blocks = {k: jnp.stack([getattr(l, k)._value for l in gpt.layers])
+              for k in _BLOCK_KEYS}
+    wte = gpt.embeddings.word_embeddings._value
+    wpe = gpt.embeddings.position_embeddings._value
+    eps = cfg.layer_norm_epsilon
+
+    def full_next(ids):
+        h = wte[ids] + wpe[jnp.arange(ids.shape[1])]
+        import jax
+        h, _ = jax.lax.scan(lambda x, p: (gpt_block(p, x, eps), None),
+                            h, blocks)
+        h = _ln(h, gpt.lnf_w._value, gpt.lnf_b._value, eps)
+        logits = jnp.einsum("bsh,vh->bsv", h, wte)
+        return np.asarray(jnp.argmax(logits[:, -1], -1))
+
+    ids = prompt.copy()
+    for t in range(6):
+        nxt = full_next(jnp.asarray(ids))
+        np.testing.assert_array_equal(out[:, 8 + t], nxt,
+                                      err_msg=f"token {t}")
+        ids = np.concatenate([ids, nxt[:, None].astype(np.int32)], 1)
+
+
+def test_generator_sampling_modes():
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTModel,
+                                       GPTGenerator, gpt_tiny_config)
+    paddle.seed(1)
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    prompt = np.zeros((1, 4), np.int32)
+    g1 = GPTGenerator(model, temperature=1.0, top_k=8, seed=7)
+    g2 = GPTGenerator(model, temperature=1.0, top_k=8, seed=7)
+    o1 = np.asarray(g1(prompt, max_new_tokens=8)._value)
+    o2 = np.asarray(g2(prompt, max_new_tokens=8)._value)
+    np.testing.assert_array_equal(o1, o2)  # same seed, same sample
+    g3 = GPTGenerator(model, temperature=1.0, top_k=8, seed=8)
+    o3 = np.asarray(g3(prompt, max_new_tokens=8)._value)
+    assert o3.shape == o1.shape  # different seed may differ; just runs

@@ -83,6 +83,13 @@ def test_unary_matches_numpy(name, pfn, nfn, x):
     np.testing.assert_allclose(got, nfn(x), rtol=2e-5, atol=1e-6)
 
 
+def test_erf_known_values():
+    x = np.array([0.0, 1.0, -1.0, 2.0], np.float32)
+    got = _np(ops.erf(paddle.to_tensor(x)))
+    want = np.array([0.0, 0.8427008, -0.8427008, 0.9953223], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.parametrize("name,pfn,nfn", BINARY,
                          ids=[b[0] for b in BINARY])
 def test_binary_matches_numpy(name, pfn, nfn):
@@ -98,10 +105,12 @@ def test_reduction_matches_numpy(name, pfn, nfn, axis):
     np.testing.assert_allclose(got, nfn(A, axis=axis), rtol=2e-5, atol=2e-6)
 
 
-@pytest.mark.parametrize("name,pfn,nfn,x", [
-    u for u in UNARY if u[0] in
-    ("exp", "log", "sqrt", "tanh", "sin", "square", "abs")
-], ids=["exp", "log", "sqrt", "tanh", "sin", "square", "abs"])
+_GRAD_CASES = [u for u in UNARY if u[0] in
+               ("exp", "log", "sqrt", "tanh", "sin", "square", "abs")]
+
+
+@pytest.mark.parametrize("name,pfn,nfn,x", _GRAD_CASES,
+                         ids=[u[0] for u in _GRAD_CASES])
 def test_unary_grad_matches_finite_difference(name, pfn, nfn, x):
     """check_grad parity (op_test.py:2122): analytic vs central difference."""
     t = paddle.to_tensor(x.astype(np.float64))
