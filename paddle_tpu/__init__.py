@@ -40,7 +40,7 @@ def __getattr__(name):
         "nn", "optimizer", "amp", "autograd", "io", "vision", "static", "jit",
         "distributed", "incubate", "models", "kernels", "profiler", "utils",
         "metric", "device", "hapi", "distribution", "sparse", "fft", "signal",
-        "text", "audio", "quantization", "inference", "geometric",
+        "text", "audio", "quantization", "inference", "geometric", "hub",
     }
     if name in _lazy:
         try:

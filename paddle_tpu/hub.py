@@ -1,0 +1,64 @@
+"""paddle.hub parity (reference: ``python/paddle/hapi/hub.py``).
+
+Zero-egress environment: the github/gitee sources (which clone repos at call
+time) raise a clear error; the ``local`` source — a directory containing an
+``hubconf.py`` — is fully supported, which is also how the reference resolves
+models after the first download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(f"unknown source {source!r}")
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"hub source {source!r} clones over the network; this offline "
+            "build supports source='local' with a directory containing "
+            "hubconf.py")
+
+
+def list(repo_dir, source="github", force_reload=False, **kwargs):
+    """Entrypoints published by the repo's hubconf.py."""
+    if source != "local":
+        _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False, **kwargs):
+    if source != "local":
+        _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    if source != "local":
+        _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return fn(**kwargs)

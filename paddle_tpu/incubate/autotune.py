@@ -1,0 +1,34 @@
+"""paddle.incubate.autotune parity (reference: ``incubate/autotune.py`` —
+set_config toggling kernel/layout/dataloader autotuning).
+
+TPU mapping: kernel autotune IS the XLA autotuner (always on; the reference's
+cudnn-algo cache has no analog to manage), layout tuning is GSPMD's, so the
+knob that remains actionable is the dataloader worker count. The config is
+recorded and queryable for parity."""
+from __future__ import annotations
+
+import json
+
+_config = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False},
+}
+
+
+def set_config(config=None):
+    """Accepts a dict or a path to a json file (reference contract)."""
+    global _config
+    if config is None:
+        for v in _config.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for k, v in config.items():
+        _config.setdefault(k, {}).update(v)
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}

@@ -121,3 +121,29 @@ def test_asp_conv_reduction_dim_and_scoping():
     o.step()
     np.testing.assert_allclose(_np(conv.weight), before)
     asp.clear_masks()
+
+
+def test_hub_local_source(tmp_path):
+    import paddle_tpu.hub as hub
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    '''a tiny model builder'''\n"
+        "    return {'scale': scale}\n")
+    assert hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model", source="local")
+    assert hub.load(str(tmp_path), "tiny_model", source="local",
+                    scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError):
+        hub.load(str(tmp_path), "tiny_model", source="github")
+
+
+def test_incubate_autotune_config(tmp_path):
+    from paddle_tpu.incubate import autotune
+    autotune.set_config({"dataloader": {"enable": True}})
+    assert autotune.get_config()["dataloader"]["enable"]
+    cfg_file = tmp_path / "at.json"
+    cfg_file.write_text('{"kernel": {"enable": false}}')
+    autotune.set_config(str(cfg_file))
+    assert not autotune.get_config()["kernel"]["enable"]
+    autotune.set_config(None)
+    assert autotune.get_config()["kernel"]["enable"]
