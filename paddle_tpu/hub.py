@@ -18,11 +18,22 @@ def _load_hubconf(repo_dir):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
-    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    # unique module name per repo path; register only after a clean exec so a
+    # raising hubconf never leaves a half-initialized module importable
+    name = f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(repo_dir)))}"
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules["paddle_tpu_hubconf"] = mod
     spec.loader.exec_module(mod)
+    sys.modules[name] = mod
     return mod
+
+
+def _get_entry(repo_dir, model):
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return fn
 
 
 def _check_source(source):
@@ -37,28 +48,17 @@ def _check_source(source):
 
 def list(repo_dir, source="github", force_reload=False, **kwargs):
     """Entrypoints published by the repo's hubconf.py."""
-    if source != "local":
-        _check_source(source)
+    _check_source(source)
     mod = _load_hubconf(repo_dir)
     return [k for k, v in vars(mod).items()
             if callable(v) and not k.startswith("_")]
 
 
 def help(repo_dir, model, source="github", force_reload=False, **kwargs):
-    if source != "local":
-        _check_source(source)
-    mod = _load_hubconf(repo_dir)
-    fn = getattr(mod, model, None)
-    if fn is None:
-        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
-    return fn.__doc__
+    _check_source(source)
+    return _get_entry(repo_dir, model).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
-    if source != "local":
-        _check_source(source)
-    mod = _load_hubconf(repo_dir)
-    fn = getattr(mod, model, None)
-    if fn is None:
-        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
-    return fn(**kwargs)
+    _check_source(source)
+    return _get_entry(repo_dir, model)(**kwargs)

@@ -31,4 +31,5 @@ def set_config(config=None):
 
 
 def get_config():
-    return {k: dict(v) for k, v in _config.items()}
+    import copy
+    return copy.deepcopy(_config)
