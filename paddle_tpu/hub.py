@@ -7,9 +7,9 @@ models after the first download.
 """
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
-import sys
 
 _HUBCONF = "hubconf.py"
 
@@ -18,13 +18,15 @@ def _load_hubconf(repo_dir):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
-    # unique module name per repo path; register only after a clean exec so a
-    # raising hubconf never leaves a half-initialized module importable
-    name = f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(repo_dir)))}"
+    # deterministic per-repo module name (md5 of the path — stable across
+    # processes so pickled hub objects resolve); no sys.modules entry: every
+    # call re-execs hubconf, so a registry would be a leak, not a cache
+    digest = hashlib.md5(
+        os.path.abspath(repo_dir).encode()).hexdigest()[:12]
+    name = f"paddle_tpu_hubconf_{digest}"
     spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    sys.modules[name] = mod
     return mod
 
 

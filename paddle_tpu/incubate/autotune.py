@@ -7,6 +7,7 @@ knob that remains actionable is the dataloader worker count. The config is
 recorded and queryable for parity."""
 from __future__ import annotations
 
+import copy
 import json
 
 _config = {
@@ -31,5 +32,4 @@ def set_config(config=None):
 
 
 def get_config():
-    import copy
     return copy.deepcopy(_config)
