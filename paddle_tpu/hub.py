@@ -10,28 +10,45 @@ from __future__ import annotations
 import hashlib
 import importlib.util
 import os
+import sys
 
 _HUBCONF = "hubconf.py"
 
 
-def _load_hubconf(repo_dir):
-    path = os.path.join(repo_dir, _HUBCONF)
+def _repo_module_name(repo_dir):
+    # deterministic per-repo module name: sha256 (not md5 — FIPS builds
+    # reject md5, and an env-dependent fallback would change the name a
+    # pickle baked in)
+    digest = hashlib.sha256(os.path.abspath(repo_dir).encode()).hexdigest()
+    return f"paddle_tpu_hubconf_{digest[:12]}"
+
+
+def _load_hubconf(repo_dir, force_reload=False):
+    path = os.path.abspath(os.path.join(repo_dir, _HUBCONF))
     if not os.path.exists(path):
         raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
-    # deterministic per-repo module name (md5 of the path — stable across
-    # processes so pickled hub objects resolve); no sys.modules entry: every
-    # call re-execs hubconf, so a registry would be a leak, not a cache
-    digest = hashlib.md5(
-        os.path.abspath(repo_dir).encode()).hexdigest()[:12]
-    name = f"paddle_tpu_hubconf_{digest}"
+    name = _repo_module_name(repo_dir)
+    # cache per repo path: re-exec'ing on every call would replace the
+    # registered classes and break pickling of previously loaded objects
+    # (pickle checks the class in sys.modules is the *same object*)
+    mod = sys.modules.get(name)
+    if (mod is not None and not force_reload
+            and getattr(mod, "__file__", None) == path):
+        return mod
     spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    # register so classes defined in hubconf pickle (pickle imports the
+    # defining module by name at dump time). Unpickling in a *fresh*
+    # process requires one prior hub call on the same repo path to
+    # re-register the module — same contract as the reference, which needs
+    # the hub repo present locally.
+    sys.modules[name] = mod
     return mod
 
 
-def _get_entry(repo_dir, model):
-    mod = _load_hubconf(repo_dir)
+def _get_entry(repo_dir, model, force_reload=False):
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None:
         raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
@@ -51,16 +68,16 @@ def _check_source(source):
 def list(repo_dir, source="github", force_reload=False, **kwargs):
     """Entrypoints published by the repo's hubconf.py."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [k for k, v in vars(mod).items()
             if callable(v) and not k.startswith("_")]
 
 
 def help(repo_dir, model, source="github", force_reload=False, **kwargs):
     _check_source(source)
-    return _get_entry(repo_dir, model).__doc__
+    return _get_entry(repo_dir, model, force_reload).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
     _check_source(source)
-    return _get_entry(repo_dir, model)(**kwargs)
+    return _get_entry(repo_dir, model, force_reload)(**kwargs)

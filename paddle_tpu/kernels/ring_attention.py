@@ -81,8 +81,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
         _vary(jnp.zeros((B, H, Sl), jnp.float32)),
         kt, vt,
     )
-    (o, m, l, _, _), _ = lax.scan(jax.checkpoint(step), init,
-                                  jnp.arange(n))
+    (o, m, l, _, _), _ = lax.scan(
+        jax.checkpoint(step, prevent_cse=False), init, jnp.arange(n))
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.einsum("bhsd->bshd", out).astype(q.dtype)
 
