@@ -41,6 +41,7 @@ def __getattr__(name):
         "distributed", "incubate", "models", "kernels", "profiler", "utils",
         "metric", "device", "hapi", "distribution", "sparse", "fft", "signal",
         "text", "audio", "quantization", "inference", "geometric", "hub",
+        "onnx",
     }
     if name in _lazy:
         try:
@@ -60,6 +61,7 @@ def __getattr__(name):
         "Model": ("hapi", "Model"),
         "summary": ("hapi", "summary"),
         "callbacks": ("hapi", "callbacks"),
+        "flops": ("hapi", "flops"),
     }
     if name in _lazy_attrs:
         mod_name, attr = _lazy_attrs[name]

@@ -24,3 +24,4 @@ from . import utils  # noqa: F401,E402
 from . import auto_parallel  # noqa: F401,E402
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401,E402
 from . import ps  # noqa: F401,E402
+from . import rpc  # noqa: F401,E402

@@ -1,0 +1,18 @@
+"""paddle.distributed.rpc parity (reference:
+``python/paddle/distributed/rpc/rpc.py:73 init_rpc, :141 rpc_sync,
+:179 rpc_async`` over a brpc C++ agent, ``internal.py`` PythonFunc pickling).
+
+TPU-native design: the control plane stays host-side — a threaded TCP agent
+per worker executes pickled ``PythonFunc`` requests (the reference's exact
+wire payload, ``internal.py:18``), with rendezvous + barriers over the
+native TCPStore (our C++ ``store/tcp_store.cpp``) instead of brpc + the
+reference's C++ TCPStore. Futures are ``concurrent.futures.Future``
+(reference FutureWrapper parity: ``.wait()``).
+"""
+from .rpc import (WorkerInfo, get_all_worker_infos, get_current_worker_info,
+                  get_worker_info, init_rpc, rpc_async, rpc_sync, shutdown)
+
+__all__ = [
+    "init_rpc", "shutdown", "rpc_async", "rpc_sync", "get_worker_info",
+    "get_all_worker_infos", "get_current_worker_info", "WorkerInfo",
+]

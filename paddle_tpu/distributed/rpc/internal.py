@@ -1,0 +1,23 @@
+"""Serialization protocol (reference ``distributed/rpc/internal.py``).
+
+PythonFunc is the wire payload: a pickled (func, args, kwargs) triple the
+remote agent unpickles and executes. Same trust model as the reference's
+brpc path: RPC peers are the job's own trainer processes (pickle implies
+code execution — never expose the agent beyond the training cluster).
+"""
+import pickle
+from collections import namedtuple
+
+PythonFunc = namedtuple("PythonFunc", ["func", "args", "kwargs"])
+
+
+def _serialize(obj) -> bytes:
+    return pickle.dumps(obj)
+
+
+def _deserialize(blob: bytes):
+    return pickle.loads(blob)
+
+
+def _run_py_func(python_func):
+    return python_func.func(*python_func.args, **python_func.kwargs)

@@ -1,0 +1,225 @@
+"""RPC agent: execute Python functions on remote trainer processes.
+
+Reference parity: ``python/paddle/distributed/rpc/rpc.py`` (init_rpc
+:73, rpc_sync :141, rpc_async :179, shutdown, get_*_worker_info*) —
+the C++ brpc agent + C++ TCPStore replaced by a threaded socket agent
+and the repo's native TCPStore (``distributed/store``).
+
+Wire format: 8-byte little-endian length + pickle. Request = PythonFunc;
+response = ("ok", result) | ("err", formatted traceback).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+import traceback
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..store import TCPStore
+from .internal import PythonFunc, _deserialize, _run_py_func, _serialize
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = -1
+
+_agent = None
+_agent_lock = threading.Lock()
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed the connection")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, blob: bytes):
+    sock.sendall(struct.pack("<q", len(blob)) + blob)
+
+
+def _recv_msg(sock) -> bytes:
+    (n,) = struct.unpack("<q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            blob = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        try:
+            result = _run_py_func(_deserialize(blob))
+            reply = ("ok", result)
+        except BaseException:  # ship the full traceback to the caller
+            reply = ("err", traceback.format_exc())
+        try:
+            _send_msg(self.request, _serialize(reply))
+        except (BrokenPipeError, ConnectionError):
+            pass  # caller timed out / went away
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _Agent:
+    def __init__(self, name, rank, world_size, store, infos, server):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.infos = infos  # list[WorkerInfo], rank-ordered
+        self.by_name = {i.name: i for i in infos}
+        self.server = server
+        self.pool = ThreadPoolExecutor(
+            max_workers=int(os.environ.get("PADDLE_RPC_CLIENT_THREADS", 16)),
+            thread_name_prefix="rpc-client")
+
+    def call(self, to, fn, args, kwargs, timeout, deadline=None):
+        info = self.by_name.get(to)
+        if info is None:
+            raise ValueError(f"unknown rpc worker {to!r}; known: "
+                             f"{sorted(self.by_name)}")
+        blob = _serialize(PythonFunc(fn, tuple(args or ()),
+                                     dict(kwargs or {})))
+        if deadline is not None:
+            # async path: the deadline was fixed at submit time, so queue
+            # wait in the client pool counts against the caller's timeout
+            to_s = deadline - time.monotonic()
+            if to_s <= 0:
+                raise TimeoutError(f"rpc to {to!r} timed out in queue")
+        elif timeout is None or timeout <= 0:
+            to_s = None
+        else:
+            to_s = float(timeout)
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=to_s) as sock:
+            _send_msg(sock, blob)
+            status, payload = _deserialize(_recv_msg(sock))
+        if status == "err":
+            raise RuntimeError(
+                f"rpc to {to!r} raised remotely:\n{payload}")
+        return payload
+
+    def submit(self, to, fn, args, kwargs, timeout) -> Future:
+        deadline = None if timeout is None or timeout <= 0 \
+            else time.monotonic() + float(timeout)
+        return self.pool.submit(self.call, to, fn, args, kwargs, timeout,
+                                deadline)
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.pool.shutdown(wait=False)
+
+
+def _get_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    return _agent
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Rendezvous all workers and start this worker's RPC agent.
+
+    Env-var contract mirrors the reference (rpc.py:118-139):
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_WORKER_ENDPOINT /
+    PADDLE_MASTER_ENDPOINT.
+    """
+    global _agent
+    with _agent_lock:
+        if _agent is not None:
+            raise RuntimeError("rpc already initialized")
+        rank = int(os.environ["PADDLE_TRAINER_ID"]) if rank is None else rank
+        world_size = (int(os.environ["PADDLE_TRAINERS_NUM"])
+                      if world_size is None else world_size)
+        master_endpoint = master_endpoint or \
+            os.environ["PADDLE_MASTER_ENDPOINT"]
+        master_addr, master_port = master_endpoint.rsplit(":", 1)
+
+        worker_endpoint = os.environ.get("PADDLE_WORKER_ENDPOINT")
+        if worker_endpoint:
+            ip, port = worker_endpoint.rsplit(":", 1)
+            server = _Server((ip, int(port)), _Handler)
+        else:
+            ip = "127.0.0.1"
+            server = _Server((ip, 0), _Handler)  # OS-assigned free port
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name=f"rpc-server-{name}").start()
+
+        try:
+            store = TCPStore(master_addr, int(master_port),
+                             is_master=(rank == 0), world_size=world_size,
+                             timeout=float(os.environ.get(
+                                 "FLAGS_stop_check_timeout", 900)))
+            store.set(f"rpc/worker/{rank}",
+                      _serialize(WorkerInfo(name, rank, ip, port)))
+            infos = [_deserialize(store.get(f"rpc/worker/{r}"))
+                     for r in range(world_size)]
+            names = [i.name for i in infos]
+            if len(set(names)) != len(names):
+                raise ValueError(
+                    f"worker names must be unique, got {names}")
+        except BaseException:
+            # release the bound port so a retry on a fixed
+            # PADDLE_WORKER_ENDPOINT doesn't hit EADDRINUSE
+            server.shutdown()
+            server.server_close()
+            raise
+
+        _agent = _Agent(name, rank, world_size, store, infos, server)
+        # all agents up before anyone issues calls
+        store.barrier("rpc_init")
+        return
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the result."""
+    return _get_agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Run ``fn`` on worker ``to``; returns a Future (``.wait()``/
+    ``.result()``)."""
+    fut = _get_agent().submit(to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # reference FutureWrapper.wait parity
+    return fut
+
+
+def shutdown():
+    """Barrier with all peers, then stop the agent (reference rpc.py:268)."""
+    global _agent
+    with _agent_lock:
+        if _agent is None:
+            return
+        _agent.store.barrier("rpc_shutdown")
+        _agent.stop()
+        _agent = None
+
+
+def get_worker_info(name):
+    info = _get_agent().by_name.get(name)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {name!r}")
+    return info
+
+
+def get_all_worker_infos():
+    return list(_get_agent().infos)
+
+
+def get_current_worker_info():
+    a = _get_agent()
+    return a.by_name[a.name]

@@ -1,6 +1,7 @@
 """High-level Keras-like API (reference: ``python/paddle/hapi/``)."""
 from .model import Model  # noqa: F401
 from .model import summary  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, CallbackList, ProgBarLogger, ModelCheckpoint, LRScheduler,

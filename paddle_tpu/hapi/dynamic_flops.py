@@ -1,0 +1,153 @@
+"""paddle.flops — dynamic FLOPs counter (reference
+``python/paddle/hapi/dynamic_flops.py:28 flops, :215 dynamic_flops``).
+
+Hooks every leaf Layer, runs one forward on zeros of ``input_size`` (or
+the given tensors) and sums per-type multiply-accumulate counts with the
+reference's formulas. Custom layers get counted via ``custom_ops``.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+
+
+def _numel(t):
+    return int(np.prod(t.shape)) if hasattr(t, "shape") else 0
+
+
+def count_convNd(m, x, y):
+    x = x[0]
+    kernel_ops = int(np.prod(m.weight.shape[2:]))
+    bias_ops = 1 if getattr(m, "bias", None) is not None else 0
+    in_c = x.shape[1]
+    m.total_ops += _numel(y) * (in_c // m._groups * kernel_ops + bias_ops)
+
+
+def count_linear(m, x, y):
+    # weight is [in, out] here (reference stores [out, in]; formula uses in)
+    m.total_ops += int(m.weight.shape[0]) * _numel(y)
+
+
+def count_bn(m, x, y):
+    m.total_ops += 2 * _numel(x[0])
+
+
+def count_act_elementwise(m, x, y):
+    m.total_ops += _numel(x[0])
+
+
+def count_zero_ops(m, x, y):
+    m.total_ops += 0
+
+
+def count_avgpool(m, x, y):
+    m.total_ops += _numel(y)
+
+
+def count_adap_avgpool(m, x, y):
+    kernel = np.array(x[0].shape[2:]) // np.array(y.shape[2:])
+    m.total_ops += int(np.prod(kernel) + 1) * _numel(y)
+
+
+register_hooks = {
+    nn.Conv1D: count_convNd, nn.Conv2D: count_convNd, nn.Conv3D: count_convNd,
+    nn.Conv1DTranspose: count_convNd, nn.Conv2DTranspose: count_convNd,
+    nn.Conv3DTranspose: count_convNd,
+    nn.BatchNorm1D: count_bn, nn.BatchNorm2D: count_bn,
+    nn.BatchNorm3D: count_bn, nn.SyncBatchNorm: count_bn,
+    nn.ReLU: count_zero_ops, nn.ReLU6: count_zero_ops,
+    nn.Dropout: count_zero_ops,
+    nn.LeakyReLU: count_act_elementwise,
+    nn.Linear: count_linear,
+    nn.AvgPool1D: count_avgpool, nn.AvgPool2D: count_avgpool,
+    nn.AvgPool3D: count_avgpool,
+    nn.AdaptiveAvgPool1D: count_adap_avgpool,
+    nn.AdaptiveAvgPool2D: count_adap_avgpool,
+    nn.AdaptiveAvgPool3D: count_adap_avgpool,
+}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs (MAC count) of ``net`` at ``input_size`` (e.g. [1, 3, 224, 224]).
+    ``custom_ops``: {LayerType: fn(layer, inputs, output)} overrides/extends
+    the built-in table."""
+    inputs = paddle.to_tensor(
+        np.zeros(input_size, np.float32))
+    return dynamic_flops(net, inputs, custom_ops=custom_ops,
+                         print_detail=print_detail)
+
+
+def _lookup_count_fn(typ, custom_ops):
+    """Exact type first, then isinstance walk so subclasses of covered
+    layers are still counted."""
+    fn = custom_ops.get(typ, register_hooks.get(typ))
+    if fn is not None:
+        return fn
+    for base, f in {**register_hooks, **custom_ops}.items():
+        if issubclass(typ, base):
+            return f
+    return None
+
+
+def dynamic_flops(model, inputs, custom_ops=None, print_detail=False):
+    handles = []
+    custom_ops = custom_ops or {}
+
+    def add_hooks(m):
+        m.total_ops = 0
+        m.total_params = sum(_numel(p) for p in m.parameters())
+        fn = _lookup_count_fn(type(m), custom_ops)
+        if fn is not None:
+            handles.append(m.register_forward_post_hook(fn))
+        elif list(m.parameters()):
+            # reference parity: flag uncovered layers instead of silently
+            # reporting a partial number (dynamic_flops.py "Cannot find
+            # suitable count function")
+            warnings.warn(
+                f"Cannot find suitable count function for "
+                f"{type(m).__name__}. Treat it as zero FLOPs.")
+        # io shapes for the detail table
+        def io_hook(mm, x, y):
+            mm._flops_in = tuple(x[0].shape) if x else ()
+            out = y[0] if isinstance(y, (list, tuple)) else y
+            mm._flops_out = tuple(out.shape)
+        handles.append(m.register_forward_post_hook(io_hook))
+
+    # dedup by id: a layer object shared under two attribute names (weight
+    # tying) must be hooked and summed exactly once
+    leaves, seen = [], set()
+    for m in model.sublayers(include_self=True):
+        if len(m.sublayers()) == 0 and id(m) not in seen:
+            seen.add(id(m))
+            leaves.append(m)
+    for m in leaves:
+        add_hooks(m)
+
+    training = model.training
+    model.eval()
+    if not isinstance(inputs, (tuple, list)):
+        inputs = (inputs,)
+    model(*inputs)
+    if training:
+        model.train()
+    for h in handles:
+        h.remove()
+
+    total_ops = sum(getattr(m, "total_ops", 0) for m in leaves)
+    total_params = sum(getattr(m, "total_params", 0) for m in leaves)
+    if print_detail:
+        print(f"{'Layer':40s} {'Input':20s} {'Output':20s} "
+              f"{'Params':>12s} {'FLOPs':>14s}")
+        for m in leaves:
+            print(f"{type(m).__name__:40s} "
+                  f"{str(getattr(m, '_flops_in', '')):20s} "
+                  f"{str(getattr(m, '_flops_out', '')):20s} "
+                  f"{getattr(m, 'total_params', 0):12d} "
+                  f"{getattr(m, 'total_ops', 0):14d}")
+        print(f"Total GFlops: {total_ops / 1e9:.4f}  "
+              f"Total Params: {total_params / 1e6:.2f}M")
+    return int(total_ops)

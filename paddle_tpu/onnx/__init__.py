@@ -1,0 +1,4 @@
+"""paddle.onnx parity (reference ``python/paddle/onnx/__init__.py``)."""
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

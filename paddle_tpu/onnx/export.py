@@ -1,0 +1,41 @@
+"""paddle.onnx.export parity (reference ``python/paddle/onnx/export.py:107``
+— a thin delegation to the external ``paddle2onnx`` converter).
+
+TPU-native note: the portable serving artifact of this framework is
+StableHLO via ``paddle.jit.save`` (loadable by any XLA runtime, including
+TPU serving). ONNX export remains available exactly like the reference —
+by delegating to ``paddle2onnx`` when that optional package is installed —
+and otherwise raises with the StableHLO alternative spelled out.
+"""
+import os
+
+from ..utils import try_import
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ``path + '.onnx'``.
+
+    Mirrors the reference signature (layer, path, input_spec,
+    opset_version, output_spec via **configs). Requires the optional
+    ``paddle2onnx`` package, exactly like the reference.
+    """
+    file_prefix = os.path.basename(path)
+    if file_prefix == "":
+        raise ValueError(
+            "The input path MUST be format of dirname/file_prefix "
+            f"[dirname\\file_prefix in Windows system], but "
+            f"the file_prefix is empty in received path: {path}")
+    save_file = path + ".onnx"
+
+    p2o = try_import(
+        "paddle2onnx",
+        err_msg=(
+            "paddle.onnx.export requires the optional 'paddle2onnx' "
+            "package, which is not installed in this environment. For a "
+            "portable serving artifact use paddle.jit.save(layer, path, "
+            "input_spec=...) — it emits StableHLO, loadable by any XLA "
+            "runtime (CPU/GPU/TPU)."))
+    p2o.dygraph2onnx(layer, save_file, input_spec=input_spec,
+                     opset_version=opset_version, **configs)

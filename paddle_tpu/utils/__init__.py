@@ -1,0 +1,17 @@
+"""paddle.utils parity (reference: ``python/paddle/utils/__init__.py`` —
+__all__ = ['deprecated', 'run_check', 'require_version', 'try_import']).
+
+TPU-native notes: ``run_check`` (reference ``install_check.py``) drives a
+tiny training step on the attached XLA device instead of CUDA;
+``dlpack`` wraps jax's zero-copy dlpack bridge; ``download`` is gated for
+the zero-egress environment.
+"""
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .install_check import run_check, require_version  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "unique_name", "dlpack"]

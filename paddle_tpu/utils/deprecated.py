@@ -1,0 +1,35 @@
+"""@deprecated decorator (reference ``utils/deprecated.py:122``)."""
+import functools
+import warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API as deprecated.
+
+    level 0: no-op marker; 1: warn on call; 2: raise on call — the
+    reference's escalation ladder (deprecated.py:44-56).
+    """
+    def decorator(func):
+        lines = [f"API '{getattr(func, '__module__', '?')}."
+                 f"{func.__name__}' is deprecated"]
+        if since:
+            lines.append(f"since {since}")
+        if update_to:
+            lines.append(f", use '{update_to}' instead")
+        if reason:
+            lines.append(f". Reason: {reason}")
+        msg = " ".join(lines)
+        doc = func.__doc__ or ""
+        func.__doc__ = f"Warning: {msg}\n\n{doc}"
+
+        if level == 0:
+            return func
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator

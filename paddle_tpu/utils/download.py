@@ -1,0 +1,38 @@
+"""paddle.utils.download parity (reference ``utils/download.py`` —
+get_weights_path_from_url + cached download helpers).
+
+Zero-egress environment: URLs cannot be fetched. Cache hits (a file
+already present under WEIGHTS_HOME) resolve normally so pre-seeded
+weights work; anything else raises with instructions.
+"""
+import hashlib
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5check(path, md5sum):
+    h = hashlib.md5(usedforsecurity=False)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir=WEIGHTS_HOME, md5sum=None,
+                      check_exist=True):
+    fname = os.path.basename(url)
+    path = os.path.join(root_dir, fname)
+    if os.path.exists(path):
+        if md5sum is not None and not _md5check(path, md5sum):
+            raise RuntimeError(
+                f"cached file {path} fails its md5 check ({md5sum}); "
+                f"the pre-seeded file is corrupt or wrong — replace it.")
+        return path
+    raise RuntimeError(
+        f"cannot download {url!r}: this build runs without network egress. "
+        f"Place the file at {path} (or pass a local path) and retry.")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

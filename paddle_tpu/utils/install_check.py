@@ -1,0 +1,90 @@
+"""run_check / require_version (reference ``utils/install_check.py:309`` —
+a tiny SimpleLayer fit on each device; version gate helpers).
+
+TPU-native: drives one forward+backward+step of a 2-layer net on the
+attached XLA device (TPU on hardware, CPU on the virtual mesh) and a
+second compiled (jit) step, checking the two losses agree — the same
+"static and dynamic both work" assertion the reference makes.
+"""
+import re
+
+import numpy as np
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless min_version <= paddle version <= max_version.
+
+    Version strings: "major.minor.patch" (reference install_check-style
+    semantics; "0.0.0" dev builds always pass).
+    """
+    import paddle_tpu as paddle
+
+    def parse(v):
+        parts = re.findall(r"\d+", str(v))
+        return tuple(int(p) for p in (parts + ["0", "0", "0"])[:3])
+
+    for v, nm in ((min_version, "min_version"),
+                  (max_version, "max_version")):
+        if v is not None and not re.fullmatch(r"[\d.]+", str(v)):
+            raise ValueError(f"{nm} must look like '1.4.0', got {v!r}")
+    cur = parse(paddle.__version__)
+    if cur == (0, 0, 0):
+        return  # dev build
+    if parse(min_version) > cur:
+        raise Exception(
+            f"paddle version {paddle.__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"paddle version {paddle.__version__} > allowed {max_version}")
+
+
+def run_check():
+    """Smoke-test the installation on the attached device; prints a verdict
+    (reference install_check.run_check parity)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    dev = jax.devices()[0]
+
+    class SimpleNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 1)
+
+        def forward(self, x):
+            return self.fc2(paddle.tanh(self.fc1(x)))
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 1)).astype(np.float32))
+
+    def one_loss(net):
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        pred = net(x)
+        loss = paddle.mean(paddle.square(pred - y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss.numpy())
+
+    paddle.seed(0)
+    eager = one_loss(SimpleNet())
+    paddle.seed(0)
+    net2 = SimpleNet()
+    static_fwd = paddle.jit.to_static(net2)
+    pred = static_fwd(x)
+    compiled = float(paddle.mean(paddle.square(pred - y)).numpy())
+    if not (np.isfinite(eager) and np.isfinite(compiled)
+            and abs(eager - compiled) < 1e-3):
+        raise RuntimeError(
+            f"run_check FAILED on {dev.device_kind}: eager={eager} "
+            f"compiled={compiled}")
+    print(f"Paddle-TPU works well on 1 {dev.platform.upper()} "
+          f"({dev.device_kind}).")
+    print("Paddle-TPU is installed successfully!")
+
+
+__all__ = ["run_check", "require_version"]

@@ -1,0 +1,17 @@
+"""Optional-dependency import (reference ``utils/lazy_import.py:41``)."""
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    """Import an optional third-party module, raising a clear error if the
+    environment does not provide it."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        if err_msg is None:
+            err_msg = (
+                f"Failed importing {module_name}. This likely means that "
+                f"some paddle modules require additional dependencies that "
+                f"have to be manually installed (usually with `pip install "
+                f"{module_name}`).")
+        raise ImportError(err_msg) from e

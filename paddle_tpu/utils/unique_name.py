@@ -1,0 +1,46 @@
+"""paddle.utils.unique_name parity (reference ``fluid/unique_name.py:80
+generate, :131 switch, :184 guard``)."""
+import contextlib
+from collections import defaultdict
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids = defaultdict(int)
+        self.prefix = prefix
+
+    def __call__(self, key):
+        n = self.ids[key]
+        self.ids[key] += 1
+        return f"{self.prefix}{key}_{n}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """Next unique name for `key`: "key_0", "key_1", ..."""
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator; returns the previous one."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope with a fresh (or given) name generator; restores on exit."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

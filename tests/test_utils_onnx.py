@@ -1,0 +1,152 @@
+"""paddle.utils (try_import/deprecated/unique_name/dlpack/require_version/
+run_check), paddle.flops, paddle.onnx.export."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.utils import (deprecated, dlpack, require_version, run_check,
+                              try_import, unique_name)
+
+
+def test_try_import():
+    assert try_import("math").sqrt(4) == 2.0
+    with pytest.raises(ImportError, match="no_such_module_xyz"):
+        try_import("no_such_module_xyz")
+    with pytest.raises(ImportError, match="custom message"):
+        try_import("no_such_module_xyz", "custom message")
+
+
+def test_deprecated_levels():
+    @deprecated(since="2.0", update_to="paddle.new_api", level=1)
+    def old(x):
+        return x + 1
+
+    with pytest.warns(DeprecationWarning, match="new_api"):
+        assert old(1) == 2
+
+    @deprecated(level=2, reason="gone")
+    def dead():
+        pass
+
+    with pytest.raises(RuntimeError, match="gone"):
+        dead()
+
+    @deprecated()  # level 0: marker only
+    def fine(x):
+        return x
+
+    assert fine(3) == 3 and "deprecated" in fine.__doc__
+
+
+def test_unique_name():
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("conv") == "conv_0"
+        with unique_name.guard("block_"):
+            assert unique_name.generate("fc") == "block_fc_0"
+        assert unique_name.generate("fc") == "fc_2"
+
+
+def test_require_version():
+    require_version("0.0.1")  # current 0.1.0 >= 0.0.1
+    with pytest.raises(Exception):
+        require_version("999.0.0")
+    with pytest.raises(ValueError):
+        require_version("not-a-version")
+
+
+def test_run_check(capsys):
+    run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_dlpack_roundtrip():
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = dlpack.to_dlpack(t)
+    back = dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(
+        np.asarray(back.numpy()),
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    # torch interop (torch tensors speak __dlpack__)
+    torch = pytest.importorskip("torch")
+    tt = torch.arange(4, dtype=torch.float32)
+    back2 = dlpack.from_dlpack(tt)
+    np.testing.assert_allclose(np.asarray(back2.numpy()), [0, 1, 2, 3])
+
+
+def test_flops_linear_and_conv():
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    n = paddle.flops(net, [2, 16])
+    # MACs: 2*16*32 + 2*32*4 = 1024 + 256
+    assert n == 2 * 16 * 32 + 2 * 32 * 4, n
+
+    conv = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU6())
+    n2 = paddle.flops(conv, [1, 3, 8, 8], print_detail=True)
+    # out numel (1*8*8*8) * (in_c/groups * k*k + bias)
+    assert n2 == 8 * 8 * 8 * (3 * 9 + 1), n2
+
+
+def test_flops_dedup_warn_and_subclass():
+    # weight tying: the same Layer object under two names counts once
+    shared = nn.Linear(8, 8)
+    net = nn.Sequential(shared, shared)
+    assert paddle.flops(net, [1, 8]) == 2 * (8 * 8)
+
+    # subclass of a covered type still counts via the isinstance walk
+    class MyLinear(nn.Linear):
+        pass
+
+    assert paddle.flops(MyLinear(4, 4), [1, 4]) == 4 * 4
+
+    # uncovered parametered layer warns instead of silently undercounting
+    class Weird(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter([3])
+
+        def forward(self, x):
+            return x
+
+    with pytest.warns(UserWarning, match="zero FLOPs"):
+        paddle.flops(Weird(), [2, 3])
+
+
+def test_download_md5_gate(tmp_path):
+    from paddle_tpu.utils import download
+    f = tmp_path / "w.bin"
+    f.write_bytes(b"abc")
+    import hashlib
+    good = hashlib.md5(b"abc", usedforsecurity=False).hexdigest()
+    p = download.get_path_from_url("http://x/w.bin", root_dir=str(tmp_path),
+                                   md5sum=good)
+    assert p == str(f)
+    with pytest.raises(RuntimeError, match="md5"):
+        download.get_path_from_url("http://x/w.bin", root_dir=str(tmp_path),
+                                   md5sum="0" * 32)
+    with pytest.raises(RuntimeError, match="egress"):
+        download.get_path_from_url("http://x/missing.bin",
+                                   root_dir=str(tmp_path))
+
+
+def test_flops_custom_ops():
+    class Doubler(nn.Layer):
+        def forward(self, x):
+            return x * 2
+
+    def count_doubler(m, x, y):
+        m.total_ops += 1234
+
+    net = Doubler()
+    assert paddle.flops(net, [4, 4], custom_ops={Doubler: count_doubler}) \
+        == 1234
+
+
+def test_onnx_export_requires_paddle2onnx(tmp_path):
+    net = nn.Linear(4, 2)
+    with pytest.raises(ImportError, match="StableHLO"):
+        paddle.onnx.export(net, str(tmp_path / "m"))
+    with pytest.raises(ValueError, match="file_prefix is empty"):
+        paddle.onnx.export(net, str(tmp_path) + "/")
