@@ -7,10 +7,12 @@ accessor/client architecture with an in-process client — the reference's own
 test fixture (``ps/service/ps_local_client.h``: "in-process PS, no brpc",
 SURVEY §4.5). The table layer is host-resident (unbounded vocab never
 touches HBM; only touched rows move to device), which is the PS value
-proposition on TPU hosts. A networked transport can ride the native
-TCPStore; multi-host serving is future work.
+proposition on TPU hosts. The networked transport (``service.py``:
+``run_server`` + sharded ``PsRpcClient``) rides the socket RPC agent +
+native TCPStore — the brpc_ps_server/client analog.
 """
 from .table import MemorySparseTable, MemoryDenseTable, SGDAccessor, AdagradAccessor  # noqa: F401
 from .local_client import PsLocalClient  # noqa: F401
 from .the_one_ps import TheOnePs  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401
+from .service import PsRpcClient, run_server  # noqa: F401

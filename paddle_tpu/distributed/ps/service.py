@@ -1,0 +1,235 @@
+"""Networked parameter-server service over the RPC agent.
+
+Parity: ``/root/reference/paddle/fluid/distributed/ps/service/
+brpc_ps_server.cc`` / ``brpc_ps_client.cc`` — create/pull/push/save/load
+RPCs against sharded tables on dedicated server processes. The brpc
+transport is replaced by the repo's socket RPC agent
+(``distributed/rpc``); rendezvous rides the native TCPStore.
+
+Sharding follows the reference: sparse feature ids are routed to server
+``fid % num_servers`` (each server owns a hash-shard of the embedding
+table); dense tables live whole on server 0 (the reference splits dense
+rows across servers only past a size threshold).
+
+Roles: server processes call ``run_server(name)`` which joins the RPC
+world and blocks serving table RPCs until every worker has called
+``PsRpcClient.stop_server()`` + shut down. Worker processes build a
+``PsRpcClient`` with the server names.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .local_client import PsLocalClient
+from .table import AdagradAccessor, SGDAccessor
+
+# process-global service state: RPC handlers are module-level functions
+# (pickled by reference), so on the server process they resolve to these
+# and operate on the server's own tables.
+_local = PsLocalClient()
+_stop = threading.Event()
+
+_ACCESSORS = {"sgd": SGDAccessor, "adagrad": AdagradAccessor}
+
+
+def _make_accessor(spec):
+    if spec is None or isinstance(spec, str):
+        return _ACCESSORS[spec or "sgd"]()
+    kind, kw = spec
+    return _ACCESSORS[kind](**kw)
+
+
+class _ZeroInit:
+    """Pickleable zero-row initializer (lambdas can't cross the wire)."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __call__(self):
+        return np.zeros(self.dim, np.float32)
+
+
+def _resolve_init(kw, dim):
+    kw = dict(kw)
+    if kw.get("initializer") == "zeros":
+        kw["initializer"] = _ZeroInit(dim)
+    return kw
+
+
+# ------------------------------------------------------------------
+# server-side handlers (executed on the PS process via rpc)
+# ------------------------------------------------------------------
+
+def _srv_create_sparse(table_id, emb_dim, accessor_spec, kw):
+    _local.create_sparse_table(table_id, emb_dim,
+                               _make_accessor(accessor_spec),
+                               **_resolve_init(kw, emb_dim))
+    return True
+
+
+def _srv_create_dense(table_id, shape, accessor_spec, kw):
+    _local.create_dense_table(table_id, shape,
+                              _make_accessor(accessor_spec), **kw)
+    return True
+
+
+def _srv_pull_sparse(table_id, ids):
+    return np.asarray(_local.pull_sparse(table_id, np.asarray(ids)))
+
+
+def _srv_push_sparse(table_id, ids, grads):
+    _local.push_sparse_grad(table_id, np.asarray(ids), np.asarray(grads))
+    return True
+
+
+def _srv_pull_dense(table_id):
+    return np.asarray(_local.pull_dense(table_id))
+
+
+def _srv_push_dense(table_id, grad):
+    _local.push_dense_grad(table_id, np.asarray(grad))
+    return True
+
+
+def _srv_save(table_id, path):
+    _local.save(table_id, path)
+    return True
+
+
+def _srv_load(table_id, path):
+    _local.load(table_id, path)
+    return True
+
+
+def _srv_table_size(table_id):
+    return _local.get_table(table_id).size
+
+
+def _srv_sparse_dim(table_id):
+    return _local.get_table(table_id).emb_dim
+
+
+def _srv_stop():
+    _stop.set()
+    return True
+
+
+def run_server(name, rank=None, world_size=None, master_endpoint=None):
+    """PS server main: join the RPC world as ``name`` and serve until every
+    worker has sent stop (reference ``brpc_ps_server.cc`` start/stop
+    lifecycle)."""
+    from .. import rpc
+    _stop.clear()
+    rpc.init_rpc(name, rank=rank, world_size=world_size,
+                 master_endpoint=master_endpoint)
+    _stop.wait()
+    rpc.shutdown()
+
+
+# ------------------------------------------------------------------
+# worker-side client
+# ------------------------------------------------------------------
+
+class PsRpcClient:
+    """PsLocalClient's surface against remote sharded servers.
+
+    ``servers``: rpc worker names of the PS processes, in shard order.
+    The calling process must already be in the same rpc world
+    (``rpc.init_rpc``).
+    """
+
+    def __init__(self, servers):
+        from .. import rpc
+        self._rpc = rpc
+        self.servers = list(servers)
+        self._sparse_dims = {}
+        if not self.servers:
+            raise ValueError("need at least one PS server name")
+
+    # -- table management ---------------------------------------------------
+    def create_sparse_table(self, table_id, emb_dim, accessor=None, **kw):
+        self._sparse_dims[table_id] = emb_dim
+        for s in self.servers:
+            self._rpc.rpc_sync(s, _srv_create_sparse,
+                               args=(table_id, emb_dim, accessor, kw))
+
+    def create_dense_table(self, table_id, shape, accessor=None, **kw):
+        self._rpc.rpc_sync(self.servers[0], _srv_create_dense,
+                           args=(table_id, shape, accessor, kw))
+
+    # -- sparse (id -> shard fid % n, reference brpc_ps_client routing) -----
+    def _shard(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self.servers)
+        owner = ids % n
+        return ids, owner
+
+    def _dim(self, table_id):
+        if table_id not in self._sparse_dims:
+            self._sparse_dims[table_id] = self._rpc.rpc_sync(
+                self.servers[0], _srv_sparse_dim, args=(table_id,))
+        return self._sparse_dims[table_id]
+
+    def pull_sparse(self, table_id, ids):
+        ids_flat, owner = self._shard(ids)
+        n = len(self.servers)
+        futs = []
+        for s in range(n):
+            sel = ids_flat[owner == s]
+            futs.append(self._rpc.rpc_async(
+                self.servers[s], _srv_pull_sparse, args=(table_id, sel))
+                if sel.size else None)
+        out = np.zeros((ids_flat.size, self._dim(table_id)), np.float32)
+        for s in range(n):
+            if futs[s] is not None:
+                out[owner == s] = futs[s].result()
+        shape = tuple(np.asarray(ids).shape) + (out.shape[-1],)
+        return out.reshape(shape)
+
+    def push_sparse_grad(self, table_id, ids, grads):
+        ids_flat, owner = self._shard(ids)
+        grads = np.asarray(grads).reshape(ids_flat.size, -1)
+        futs = []
+        for s in range(len(self.servers)):
+            mask = owner == s
+            if mask.any():
+                futs.append(self._rpc.rpc_async(
+                    self.servers[s], _srv_push_sparse,
+                    args=(table_id, ids_flat[mask], grads[mask])))
+        for f in futs:
+            f.result()
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, table_id):
+        return self._rpc.rpc_sync(self.servers[0], _srv_pull_dense,
+                                  args=(table_id,))
+
+    def push_dense_grad(self, table_id, grad):
+        self._rpc.rpc_sync(self.servers[0], _srv_push_dense,
+                           args=(table_id, np.asarray(grad)))
+
+    # -- persistence / lifecycle -------------------------------------------
+    def save(self, table_id, path):
+        # each server saves its shard under a per-shard suffix
+        futs = [self._rpc.rpc_async(s, _srv_save,
+                                    args=(table_id, f"{path}.shard{i}"))
+                for i, s in enumerate(self.servers)]
+        for f in futs:
+            f.result()
+
+    def load(self, table_id, path):
+        futs = [self._rpc.rpc_async(s, _srv_load,
+                                    args=(table_id, f"{path}.shard{i}"))
+                for i, s in enumerate(self.servers)]
+        for f in futs:
+            f.result()
+
+    def table_size(self, table_id):
+        return sum(self._rpc.rpc_sync(s, _srv_table_size, args=(table_id,))
+                   for s in self.servers)
+
+    def stop_server(self):
+        for s in self.servers:
+            self._rpc.rpc_sync(s, _srv_stop)

@@ -52,19 +52,23 @@ def _recv_msg(sock) -> bytes:
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
-        try:
-            blob = _recv_msg(self.request)
-        except ConnectionError:
-            return
-        try:
-            result = _run_py_func(_deserialize(blob))
-            reply = ("ok", result)
-        except BaseException:  # ship the full traceback to the caller
-            reply = ("err", traceback.format_exc())
-        try:
-            _send_msg(self.request, _serialize(reply))
-        except (BrokenPipeError, ConnectionError):
-            pass  # caller timed out / went away
+        # persistent connection: serve requests until the peer hangs up
+        # (clients pool connections — per-call connect/teardown would
+        # dominate hot PS pull/push loops)
+        while True:
+            try:
+                blob = _recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            try:
+                result = _run_py_func(_deserialize(blob))
+                reply = ("ok", result)
+            except BaseException:  # ship the full traceback to the caller
+                reply = ("err", traceback.format_exc())
+            try:
+                _send_msg(self.request, _serialize(reply))
+            except (BrokenPipeError, ConnectionError, OSError):
+                return  # caller timed out / went away
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -84,6 +88,24 @@ class _Agent:
         self.pool = ThreadPoolExecutor(
             max_workers=int(os.environ.get("PADDLE_RPC_CLIENT_THREADS", 16)),
             thread_name_prefix="rpc-client")
+        # connection pool: peer name -> list of idle persistent sockets
+        self._conns = {}
+        self._conns_lock = threading.Lock()
+
+    def _acquire(self, peer, info, timeout):
+        with self._conns_lock:
+            free = self._conns.setdefault(peer, [])
+            sock = free.pop() if free else None
+        if sock is None:
+            sock = socket.create_connection((info.ip, info.port),
+                                            timeout=timeout)
+        else:
+            sock.settimeout(timeout)
+        return sock
+
+    def _release(self, peer, sock):
+        with self._conns_lock:
+            self._conns.setdefault(peer, []).append(sock)
 
     def call(self, to, fn, args, kwargs, timeout, deadline=None):
         info = self.by_name.get(to)
@@ -102,10 +124,18 @@ class _Agent:
             to_s = None
         else:
             to_s = float(timeout)
-        with socket.create_connection((info.ip, info.port),
-                                      timeout=to_s) as sock:
+        sock = self._acquire(to, info, to_s)
+        try:
             _send_msg(sock, blob)
             status, payload = _deserialize(_recv_msg(sock))
+        except BaseException:
+            # half-used connection has undefined stream state — drop it
+            try:
+                sock.close()
+            finally:
+                pass
+            raise
+        self._release(to, sock)
         if status == "err":
             raise RuntimeError(
                 f"rpc to {to!r} raised remotely:\n{payload}")
@@ -121,6 +151,14 @@ class _Agent:
         self.server.shutdown()
         self.server.server_close()
         self.pool.shutdown(wait=False)
+        with self._conns_lock:
+            for socks in self._conns.values():
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._conns.clear()
 
 
 def _get_agent() -> _Agent:

@@ -138,7 +138,14 @@ def dynamic_flops(model, inputs, custom_ops=None, print_detail=False):
         h.remove()
 
     total_ops = sum(getattr(m, "total_ops", 0) for m in leaves)
-    total_params = sum(getattr(m, "total_params", 0) for m in leaves)
+    # dedup by Parameter identity: a tied weight shared by two leaf layers
+    # counts once (per-leaf m.total_params stays as-is for the table)
+    seen_p, total_params = set(), 0
+    for m in leaves:
+        for p in m.parameters():
+            if id(p) not in seen_p:
+                seen_p.add(id(p))
+                total_params += _numel(p)
     if print_detail:
         print(f"{'Layer':40s} {'Input':20s} {'Output':20s} "
               f"{'Params':>12s} {'FLOPs':>14s}")

@@ -1,0 +1,12 @@
+"""paddle.incubate.autograd parity (reference:
+``python/paddle/incubate/autograd/functional.py:22 vjp, :80 jvp,
+:171 Jacobian, :260 Hessian``).
+
+TPU-native: these are direct functional transforms (jax.vjp / jax.jvp /
+jacrev / hessian) applied to paddle-surface functions — no primitive-op
+program rewriting (the reference's prim/orig2prim machinery exists to
+build what jax already is).
+"""
+from .functional import Hessian, Jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]

@@ -1,0 +1,182 @@
+"""Functional autodiff over paddle-surface functions.
+
+``func`` takes and returns paddle Tensors; internally it is retraced as a
+pure jax function (the Tensor wrapper carries tracers the same way
+jit.to_static does), so vjp/jvp/Jacobian/Hessian compose with jit and
+sharding like any jax transform.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+def _pure(func, n_in):
+    """paddle-surface callable -> jax-pure callable on jnp values."""
+    def f(*vals):
+        outs = func(*[Tensor(v) for v in vals]) if n_in > 1 \
+            else func(Tensor(vals[0]))
+        outs_t = _as_tuple(outs)
+        return tuple(_unwrap(o) for o in outs_t), isinstance(outs,
+                                                             (tuple, list))
+    return f
+
+
+def _rewrap(vals, was_seq):
+    ts = tuple(Tensor(v) for v in vals)
+    return ts if was_seq else ts[0]
+
+
+def vjp(func, xs, v=None):
+    """Vector-Jacobian product. Returns (func_out, vjp_result); ``v``
+    defaults to all-ones of the output shape (reference functional.py:22)."""
+    xs_t = _as_tuple(xs)
+    vals = tuple(_unwrap(x) for x in xs_t)
+    f = _pure(func, len(vals))
+
+    seq_box = {}
+
+    def g(*a):
+        outs, was_seq = f(*a)
+        seq_box["out"] = was_seq
+        return outs
+
+    ys, pullback = jax.vjp(g, *vals)
+    if v is None:
+        cots = tuple(jnp.ones_like(y) for y in ys)
+    else:
+        cots = tuple(_unwrap(t) for t in _as_tuple(v))
+        if len(cots) != len(ys):
+            raise ValueError(
+                f"v has {len(cots)} tensors but func returned {len(ys)}")
+    grads = pullback(cots)
+    return (_rewrap(ys, seq_box["out"]),
+            _rewrap(grads, isinstance(xs, (tuple, list))))
+
+
+def jvp(func, xs, v=None):
+    """Jacobian-vector product. Returns (func_out, jvp_result); ``v``
+    defaults to all-ones of the input shape (reference functional.py:80)."""
+    xs_t = _as_tuple(xs)
+    vals = tuple(_unwrap(x) for x in xs_t)
+    f = _pure(func, len(vals))
+
+    seq_box = {}
+
+    def g(*a):
+        outs, was_seq = f(*a)
+        seq_box["out"] = was_seq
+        return outs
+
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        tangents = tuple(_unwrap(t) for t in _as_tuple(v))
+        if len(tangents) != len(vals):
+            raise ValueError(
+                f"v has {len(tangents)} tensors but xs has {len(vals)}")
+    ys, dots = jax.jvp(g, vals, tangents)
+    return (_rewrap(ys, seq_box["out"]),
+            _rewrap(dots, seq_box["out"]))
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference functional.py:171).
+
+    For ``ys = func(xs)`` with single input/output, J has shape
+    [ys.numel(), xs.numel()] when both are flattened (reference's
+    last-axis contraction convention: J[i, j] = dy_flat[i]/dx_flat[j]).
+    Index/slice like an array; ``[:]`` materializes everything.
+    """
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_t = _as_tuple(xs)
+        if len(xs_t) != 1:
+            raise ValueError("Jacobian supports a single input tensor")
+        val = _unwrap(xs_t[0])
+        f = _pure(func, 1)
+
+        def g(a):
+            outs, _ = f(a)
+            if len(outs) != 1:
+                raise ValueError("Jacobian supports a single output tensor")
+            return outs[0]
+
+        if is_batched:
+            # per-sample Jacobian: vmap(jacrev) over batch axis 0 -> no
+            # cross-sample terms materialized, [B, yn, xn]
+            per = jax.vmap(jax.jacrev(lambda a: g(a[None])[0]))(val)
+            b = per.shape[0]
+            # per: [B, *y_sample, *x_sample]; x_sample = val.shape[1:]
+            y_ndim = per.ndim - val.ndim
+            yn = 1
+            for s in per.shape[1:1 + y_ndim]:
+                yn *= s
+            self._mat = per.reshape(b, yn, -1)
+        else:
+            jac = jax.jacrev(g)(val)  # [*y.shape, *x.shape]
+            yn = 1
+            for s in jac.shape[:jac.ndim - val.ndim]:
+                yn *= s
+            self._mat = jac.reshape(yn, val.size)
+
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    def __getitem__(self, item):
+        return Tensor(self._mat[item])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._mat)
+
+
+class Hessian:
+    """Hessian of a scalar-output function (reference functional.py:260):
+    H[i, j] = d2y / dx_flat[i] dx_flat[j]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "batched Hessian is not supported; vmap the function "
+                "instead")
+        xs_t = _as_tuple(xs)
+        if len(xs_t) != 1:
+            raise ValueError("Hessian supports a single input tensor")
+        val = _unwrap(xs_t[0])
+        f = _pure(func, 1)
+
+        def g(a):
+            outs, _ = f(a)
+            y = outs[0]
+            if y.size != 1:
+                raise ValueError("Hessian needs a scalar-output func")
+            return y.reshape(())
+
+        h = jax.hessian(g)(val)  # [*x.shape, *x.shape]
+        n = val.size
+        self._mat = h.reshape(n, n)
+
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    def __getitem__(self, item):
+        return Tensor(self._mat[item])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._mat)

@@ -19,6 +19,9 @@ from .program import (  # noqa: F401
 )
 from .executor import Executor, global_scope  # noqa: F401
 from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
+from .serde import (  # noqa: F401
+    save_program, load_program, serialize_program, deserialize_program,
+)
 from ..jit.save_load import InputSpec  # noqa: F401
 from ..nn.functional import *  # noqa: F401,F403  (paddle.static.nn shims live in nn)
 from . import nn  # noqa: F401  (paddle.static.nn: control flow)

@@ -44,6 +44,24 @@ class LazyNode:
         self.n_outputs = len(out_avals)
 
 
+def make_placeholder(shape, dtype, lazy, name=None):
+    """Symbolic Tensor carrying a ShapeDtypeStruct + a ``_lazy`` ref —
+    single construction point for feeds, op outputs, and deserialized
+    placeholders."""
+    t = Tensor.__new__(Tensor)
+    t._value = (shape if isinstance(shape, jax.ShapeDtypeStruct)
+                else jax.ShapeDtypeStruct(tuple(shape), dtype))
+    t.stop_gradient = True
+    t._grad = None
+    t._node = None
+    t._out_index = lazy[1] if lazy[0] != "feed" else 0
+    t.name = name
+    t.persistable = False
+    t._is_param = False
+    t._lazy = lazy
+    return t
+
+
 def make_lazy_output(fn, args, kwargs, op_name):
     """Create lazy output tensor(s) for an op applied to >=1 lazy input."""
     avals = []
@@ -63,20 +81,9 @@ def make_lazy_output(fn, args, kwargs, op_name):
     multi = isinstance(out_shape, (tuple, list))
     outs_avals = list(out_shape) if multi else [out_shape]
     node = LazyNode(fn, list(args), kwargs, outs_avals, op_name)
-    outs = []
-    for i, av in enumerate(outs_avals):
-        t = Tensor.__new__(Tensor)
-        t._value = av  # ShapeDtypeStruct placeholder
-        t.stop_gradient = True
-        t._grad = None
-        t._node = None
-        t._out_index = i
-        t.name = None
-        t.persistable = False
-        t._is_param = False
-        t._lazy = (node, i)
-        default_main_program()._nodes.append(node)
-        outs.append(t)
+    default_main_program()._nodes.append(node)
+    outs = [make_placeholder(av, None, (node, i))
+            for i, av in enumerate(outs_avals)]
     return tuple(outs) if multi else outs[0]
 
 
@@ -105,6 +112,23 @@ class Program:
 
     def __repr__(self):
         return f"Program(nodes={len(self._nodes)}, feeds={list(self._feeds)})"
+
+    # -- ProgramDesc parity: debug string + binary round trip ------------
+    def to_string(self, throw_on_error=False, with_details=False):
+        from .serde import program_to_string
+        return program_to_string(self)
+
+    __str__ = to_string
+
+    def serialize_to_string(self, fetch_vars=None) -> bytes:
+        from .serde import serialize_program
+        return serialize_program(self, fetch_vars)
+
+    @staticmethod
+    def parse_from_string(binary: bytes) -> "Program":
+        from .serde import deserialize_program
+        prog, _, _ = deserialize_program(binary)
+        return prog
 
     # set by Optimizer.minimize under static mode
     def _record_minimize(self, optimizer, loss):
@@ -144,15 +168,6 @@ def name_scope(prefix=None):
 def data(name, shape, dtype="float32", lod_level=0):
     """paddle.static.data: a named feed placeholder (symbolic tensor)."""
     shape = [1 if (s is None or s < 0) else int(s) for s in shape]
-    t = Tensor.__new__(Tensor)
-    t._value = jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(dtype))
-    t.stop_gradient = True
-    t._grad = None
-    t._node = None
-    t._out_index = 0
-    t.name = name
-    t.persistable = False
-    t._is_param = False
-    t._lazy = ("feed", name)
+    t = make_placeholder(shape, to_jax_dtype(dtype), ("feed", name), name)
     default_main_program()._feeds[name] = t
     return t

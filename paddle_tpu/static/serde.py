@@ -1,0 +1,251 @@
+"""Program serialization — ProgramDesc parity.
+
+Reference: ``paddle/fluid/framework/framework.proto`` (ProgramDesc) with
+``Program.parse_from_string`` / ``desc.serialize_to_string``
+(``python/paddle/fluid/framework.py``). The TPU-native Program is a lazy
+op DAG over jax callables, so the wire format is a structural encoding of
+that DAG: per-node (op fn, kwargs, arg refs, output avals), feeds by
+name, parameters by value. Op fns serialize by module reference (the
+whole `paddle.*` op surface is module-level); a Program that captured a
+closure op raises with the offending op named — compiled artifacts for
+such programs serialize via ``save_inference_model`` (StableHLO) instead.
+"""
+from __future__ import annotations
+
+import importlib
+import marshal
+import pickle
+import sys
+import types
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+from .program import LazyNode, Program
+
+_MAGIC = b"PTPROG01"
+_PYTAG = f"{sys.version_info.major}.{sys.version_info.minor}"
+
+
+def _serialize_fn(fn, op_name):
+    """Op callables serialize by reference when importable, else by value
+    (code object + closure cells — the op layer wraps many ops in small
+    lambdas). Code objects are marshal'd, which ties by-value programs to
+    the python minor version; the payload records it and load checks."""
+    try:
+        blob = pickle.dumps(fn)
+        pickle.loads(blob)
+        return ("ref", blob)
+    except Exception:
+        pass
+    # jit-wrapped jax callables (PjitFunction, e.g. jnp.tanh) don't pickle
+    # but resolve cleanly by module + qualname
+    mod, qn = getattr(fn, "__module__", None), getattr(fn, "__qualname__", "")
+    if mod and qn and "<locals>" not in qn and "<lambda>" not in qn:
+        try:
+            obj = importlib.import_module(mod)
+            for part in qn.split("."):
+                obj = getattr(obj, part)
+            # identity only: resolving a bound method's qualname yields the
+            # unbound class function — serializing that would silently drop
+            # `self` and miscompute at load time
+            if obj is fn:
+                return ("named", mod, qn)
+        except Exception:
+            pass
+    if not isinstance(fn, types.FunctionType):
+        raise ValueError(
+            f"op {op_name!r} captured a non-serializable callable "
+            f"({type(fn).__name__}); serialize this program as a compiled "
+            f"artifact via save_inference_model instead")
+    try:
+        cells = tuple(pickle.dumps(c.cell_contents)
+                      for c in (fn.__closure__ or ()))
+        return ("code", marshal.dumps(fn.__code__), fn.__module__,
+                fn.__name__, pickle.dumps(fn.__defaults__),
+                pickle.dumps(fn.__kwdefaults__), cells)
+    except Exception as e:
+        raise ValueError(
+            f"op {op_name!r} captured a closure over non-serializable "
+            f"state; serialize this program as a compiled artifact via "
+            f"save_inference_model instead") from e
+
+
+def _deserialize_fn(enc):
+    if enc[0] == "ref":
+        return pickle.loads(enc[1])
+    if enc[0] == "named":
+        obj = importlib.import_module(enc[1])
+        for part in enc[2].split("."):
+            obj = getattr(obj, part)
+        return obj
+    _, code_blob, module, name, defaults, kwdefaults, cells = enc
+    code = marshal.loads(code_blob)
+    try:
+        g = importlib.import_module(module).__dict__
+    except Exception:
+        import jax.numpy as jnp
+        g = {"jax": jax, "jnp": jnp, "np": np}
+    closure = tuple(types.CellType(pickle.loads(c)) for c in cells)
+    fn = types.FunctionType(code, g, name, pickle.loads(defaults),
+                            closure or None)
+    fn.__kwdefaults__ = pickle.loads(kwdefaults)
+    return fn
+
+
+def _aval(t):
+    v = t._value if isinstance(t, Tensor) else t
+    return (tuple(v.shape), str(np.dtype(v.dtype)))
+
+
+def _encode_arg(a, node_idx, param_idx, params):
+    if isinstance(a, Tensor):
+        lz = getattr(a, "_lazy", None)
+        if lz is not None:
+            if lz[0] == "feed":
+                return ("feed", lz[1])
+            return ("lazy", node_idx[id(lz[0])], lz[1])
+        if isinstance(a, Parameter):
+            if id(a) not in param_idx:
+                param_idx[id(a)] = len(params)
+                params.append({
+                    "name": a.name,
+                    "value": np.asarray(a._value),
+                    "trainable": a.trainable,
+                })
+            return ("param", param_idx[id(a)])
+        return ("tensor", np.asarray(a._value))
+    return ("const", a)
+
+
+def serialize_program(program: Program, fetch_vars=None) -> bytes:
+    """Program (+ optional fetch tensors) -> bytes."""
+    node_idx = {id(n): i for i, n in enumerate(program._nodes)}
+    params, param_idx = [], {}
+    nodes_enc = []
+    for n in program._nodes:
+        fn_blob = _serialize_fn(n.fn, n.name)
+        try:
+            pickle.dumps(n.kwargs)
+        except Exception as e:
+            raise ValueError(
+                f"op {n.name!r} has non-serializable kwargs; serialize "
+                f"this program via save_inference_model instead") from e
+        nodes_enc.append({
+            "name": n.name,
+            "fn": fn_blob,
+            "kwargs": n.kwargs,
+            "args": [_encode_arg(a, node_idx, param_idx, params)
+                     for a in n.args],
+            "out_avals": [(tuple(av.shape), str(np.dtype(av.dtype)))
+                          for av in n.out_avals],
+        })
+    feeds_enc = {name: _aval(t) for name, t in program._feeds.items()}
+    fetches_enc = []
+    for t in (fetch_vars or []):
+        lz = getattr(t, "_lazy", None)
+        if lz is None or lz[0] == "feed":
+            raise ValueError("fetch_vars must be graph outputs")
+        fetches_enc.append((node_idx[id(lz[0])], lz[1]))
+    payload = {"nodes": nodes_enc, "feeds": feeds_enc, "params": params,
+               "fetches": fetches_enc, "random_seed": program.random_seed,
+               "python": _PYTAG}
+    return _MAGIC + pickle.dumps(payload, protocol=4)
+
+
+def _placeholder(shape, dtype, lazy, name=None):
+    from .program import make_placeholder
+    return make_placeholder(tuple(shape), np.dtype(dtype), lazy, name)
+
+
+def deserialize_program(blob: bytes):
+    """bytes -> (Program, feed_tensors{name: Tensor}, fetch_tensors[list]).
+
+    The returned Program is self-contained: run it with
+    ``Executor.run(program, feed=..., fetch_list=fetches)``.
+    """
+    if not blob.startswith(_MAGIC):
+        raise ValueError("not a serialized paddle_tpu Program")
+    payload = pickle.loads(blob[len(_MAGIC):])
+    if payload["python"] != _PYTAG and any(
+            ne["fn"][0] == "code" for ne in payload["nodes"]):
+        raise ValueError(
+            f"program was serialized under python {payload['python']} with "
+            f"by-value ops; load it under the same python minor version "
+            f"(running {_PYTAG})")
+
+    prog = Program()
+    prog.random_seed = payload["random_seed"]
+    feeds = {name: _placeholder(sh, dt, ("feed", name), name)
+             for name, (sh, dt) in payload["feeds"].items()}
+    prog._feeds = dict(feeds)
+    params = [Parameter(jax.numpy.asarray(p["value"]), name=p["name"],
+                        trainable=p["trainable"])
+              for p in payload["params"]]
+
+    nodes: list[LazyNode] = []
+    outs_of: list[list[Tensor]] = []
+    for ne in payload["nodes"]:
+        args = []
+        for kind, *rest in ne["args"]:
+            if kind == "feed":
+                args.append(feeds[rest[0]])
+            elif kind == "lazy":
+                args.append(outs_of[rest[0]][rest[1]])
+            elif kind == "param":
+                args.append(params[rest[0]])
+            elif kind == "tensor":
+                args.append(Tensor(jax.numpy.asarray(rest[0])))
+            else:
+                args.append(rest[0])
+        out_avals = [jax.ShapeDtypeStruct(tuple(sh), np.dtype(dt))
+                     for sh, dt in ne["out_avals"]]
+        node = LazyNode(_deserialize_fn(ne["fn"]), args, ne["kwargs"],
+                        out_avals, ne["name"])
+        nodes.append(node)
+        outs_of.append([_placeholder(av.shape, av.dtype, (node, i))
+                        for i, av in enumerate(out_avals)])
+    prog._nodes = nodes
+    fetches = [outs_of[ni][oi] for ni, oi in payload["fetches"]]
+    return prog, feeds, fetches
+
+
+def save_program(program, path, fetch_vars=None):
+    """paddle.static parity: persist the Program structure itself (the
+    reference's .pdmodel ProgramDesc bytes)."""
+    with open(path, "wb") as f:
+        f.write(serialize_program(program, fetch_vars))
+
+
+def load_program(path):
+    with open(path, "rb") as f:
+        return deserialize_program(f.read())
+
+
+def program_to_string(program: Program) -> str:
+    """ProgramDesc debug-string parity (`print(program)` shows ops/vars)."""
+    lines = [f"Program(random_seed={program.random_seed})"]
+    for name, t in program._feeds.items():
+        sh, dt = _aval(t)
+        lines.append(f"  feed {name}: {dt}{list(sh)}")
+    node_idx = {id(n): i for i, n in enumerate(program._nodes)}
+    for i, n in enumerate(program._nodes):
+        parts = []
+        for a in n.args:
+            if isinstance(a, Tensor):
+                lz = getattr(a, "_lazy", None)
+                if lz is None:
+                    parts.append(a.name or
+                                 ("param" if isinstance(a, Parameter)
+                                  else "tensor"))
+                elif lz[0] == "feed":
+                    parts.append(f"feed:{lz[1]}")
+                else:
+                    parts.append(f"%{node_idx[id(lz[0])]}.{lz[1]}")
+            else:
+                parts.append(repr(a))
+        outs = ", ".join(f"{str(np.dtype(av.dtype))}{list(av.shape)}"
+                         for av in n.out_avals)
+        lines.append(f"  %{i} = {n.name}({', '.join(parts)}) -> {outs}")
+    return "\n".join(lines)

@@ -1,0 +1,75 @@
+"""paddle.incubate.autograd functional transforms vs analytic oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.autograd import Hessian, Jacobian, jvp, vjp
+
+
+def test_vjp_matches_reference_example():
+    def func(x):
+        return paddle.matmul(x, x)
+
+    x = paddle.ones([2, 2], dtype="float32")
+    out, g = vjp(func, x)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 2 * np.ones((2, 2)))
+    np.testing.assert_allclose(np.asarray(g.numpy()), 4 * np.ones((2, 2)))
+
+    v = paddle.to_tensor(np.array([[1.0, 0.0], [0.0, 0.0]], np.float32))
+    _, g2 = vjp(func, x, v)
+    np.testing.assert_allclose(np.asarray(g2.numpy()),
+                               [[2.0, 1.0], [1.0, 0.0]])
+
+
+def test_jvp_scalar_and_multi_input():
+    def func(x):
+        return paddle.sum(paddle.square(x))
+
+    x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    out, dot = jvp(func, x)  # v = ones -> sum(2x)
+    assert float(out.numpy()) == 5.0
+    assert float(dot.numpy()) == pytest.approx(2 * (0 + 1 + 2))
+
+    def f2(a, b):
+        return paddle.sum(a * b)
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    va = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    vb = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+    _, dot2 = jvp(f2, [a, b], [va, vb])
+    # d(sum(ab)) = b.va + a.vb = 3 + 2
+    assert float(dot2.numpy()) == pytest.approx(5.0)
+
+
+def test_jacobian_full_and_batched():
+    A = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+
+    def lin(x):
+        return paddle.matmul(x, paddle.to_tensor(A))
+
+    x = paddle.ones([1, 2], dtype="float32")
+    J = Jacobian(lin, x)
+    assert J.shape == (2, 2)
+    np.testing.assert_allclose(J[:].numpy(), A.T)
+
+    xb = paddle.ones([3, 2], dtype="float32")
+    Jb = Jacobian(lin, xb, is_batched=True)
+    assert Jb.shape == (3, 2, 2)
+    for i in range(3):
+        np.testing.assert_allclose(Jb[i].numpy(), A.T)
+
+
+def test_hessian_quadratic():
+    Q = np.array([[2.0, 1.0], [1.0, 4.0]], np.float32)
+
+    def quad(x):
+        return 0.5 * paddle.sum(x * paddle.matmul(x, paddle.to_tensor(Q)))
+
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+    H = Hessian(quad, x)
+    assert H.shape == (2, 2)
+    np.testing.assert_allclose(H[:].numpy(), Q, atol=1e-6)
+
+    with pytest.raises(ValueError, match="scalar"):
+        Hessian(lambda x: x, x)

@@ -1,0 +1,112 @@
+"""Program serialization (ProgramDesc parity): round-trip structure,
+to_string, executor runs on the deserialized DAG."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _build_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.nn.Linear(8, 3)
+        y = w(paddle.to_tensor_static(x)) if hasattr(paddle,
+                                                     "to_tensor_static") else \
+            w(x)
+        out = paddle.tanh(y)
+    return main, x, out, w
+
+
+def test_roundtrip_matches_original():
+    static.enable_static()
+    try:
+        main, x, out, lin = _build_program()
+        blob = main.serialize_to_string(fetch_vars=[out])
+        assert blob[:8] == b"PTPROG01"
+
+        prog2, feeds2, fetches2 = static.deserialize_program(blob)
+        assert list(feeds2) == ["x"]
+        assert len(fetches2) == 1
+
+        exe = static.Executor()
+        feed = {"x": np.random.default_rng(0)
+                .standard_normal((4, 8)).astype(np.float32)}
+        want = exe.run(main, feed=feed, fetch_list=[out])[0]
+        got = static.Executor().run(prog2, feed=feed,
+                                    fetch_list=fetches2)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_save_load_program_file(tmp_path):
+    static.enable_static()
+    try:
+        main, x, out, _ = _build_program()
+        path = str(tmp_path / "prog.pdmodel")
+        static.save_program(main, path, fetch_vars=[out])
+        prog2, feeds2, fetches2 = static.load_program(path)
+        feed = {"x": np.ones((4, 8), np.float32)}
+        want = static.Executor().run(main, feed=feed, fetch_list=[out])[0]
+        got = static.Executor().run(prog2, feed=feed,
+                                    fetch_list=fetches2)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_parse_from_string_and_to_string():
+    static.enable_static()
+    try:
+        main, x, out, _ = _build_program()
+        s = main.to_string()
+        assert "feed x" in s and "%0" in s
+        prog2 = static.Program.parse_from_string(
+            main.serialize_to_string())
+        assert len(prog2._nodes) == len(main._nodes)
+        assert str(prog2).count("%") >= 1
+    finally:
+        static.disable_static()
+
+
+def test_closure_op_serializes_by_value():
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            scale = 3.0
+            from paddle_tpu.framework.tape import apply
+            y = apply(lambda v: v * scale, x, op_name="closure_scale")
+        blob = main.serialize_to_string(fetch_vars=[y])
+        prog2, _, fetches2 = static.deserialize_program(blob)
+        out = static.Executor().run(
+            prog2, feed={"x": np.array([1.0, 2.0], np.float32)},
+            fetch_list=fetches2)[0]
+        np.testing.assert_allclose(out, [3.0, 6.0])
+    finally:
+        static.disable_static()
+
+
+def test_unserializable_capture_raises_clear_error():
+    import threading
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            lock = threading.Lock()
+            from paddle_tpu.framework.tape import apply
+
+            def weird(v):
+                assert lock is not None
+                return v * 2
+
+            y = apply(weird, x, op_name="locked_op")
+        with pytest.raises(ValueError, match="locked_op"):
+            main.serialize_to_string(fetch_vars=[y])
+    finally:
+        static.disable_static()

@@ -1,0 +1,97 @@
+"""Networked PS service: 2 server processes + this process as worker.
+
+Reference test model (SURVEY §4.3): real multiprocess on one host over
+loopback, like the brpc PS tests.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_ps_service_end_to_end(tmp_path):
+    server_script = tmp_path / "ps_server.py"
+    server_script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed.ps import service
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        service.run_server(f"ps{rank}")
+        print("server-exit-ok", flush=True)
+    """))
+    port = _free_port()
+    world = 3  # 2 servers + this worker
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu", "REPO": REPO,
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_MASTER_ENDPOINT": f"127.0.0.1:{port}"}
+    procs = [subprocess.Popen(
+        [sys.executable, str(server_script)],
+        env={**env_base, "PADDLE_TRAINER_ID": str(rank)},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)]
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsRpcClient
+    rpc.init_rpc("trainer0", rank=2, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        client = PsRpcClient(["ps0", "ps1"])
+        client.create_sparse_table(0, emb_dim=4, accessor="sgd",
+                                   initializer="zeros")
+        client.create_dense_table(1, shape=[3], accessor="sgd")
+
+        ids = np.array([0, 1, 2, 3, 10, 11], np.int64)
+        rows = client.pull_sparse(0, ids)
+        assert rows.shape == (6, 4)
+        np.testing.assert_allclose(rows, 0.0)
+
+        # push grads: sgd lr=0.01 default -> rows become -lr*grad
+        grads = np.ones((6, 4), np.float32)
+        client.push_sparse_grad(0, ids, grads)
+        rows2 = client.pull_sparse(0, ids)
+        np.testing.assert_allclose(rows2, -0.01, rtol=1e-5)
+        # shard routing really splits ids across the two servers
+        assert client.table_size(0) == 6
+        # 2-D id batches keep their shape
+        rows3 = client.pull_sparse(0, ids.reshape(2, 3))
+        assert rows3.shape == (2, 3, 4)
+        # empty batch: shape-correct (0, dim) result, no crash
+        empty = client.pull_sparse(0, np.array([], np.int64))
+        assert empty.shape == (0, 4)
+
+        dense = client.pull_dense(1)
+        client.push_dense_grad(1, np.ones(3, np.float32))
+        np.testing.assert_allclose(client.pull_dense(1), dense - 0.01,
+                                   rtol=1e-5)
+
+        # save/load shard round trip
+        client.save(0, str(tmp_path / "t0"))
+        client.push_sparse_grad(0, ids, grads)  # diverge
+        client.load(0, str(tmp_path / "t0"))
+        np.testing.assert_allclose(client.pull_sparse(0, ids), -0.01,
+                                   rtol=1e-5)
+
+    finally:
+        # always release the servers first — rpc.shutdown() barriers with
+        # them, so a test failure must not leave them waiting forever
+        try:
+            client.stop_server()
+        except Exception:
+            for p in procs:
+                p.kill()
+        rpc.shutdown()
+    for rank, p in enumerate(procs):
+        out = p.communicate(timeout=60)[0]
+        assert p.returncode == 0, f"ps{rank} failed:\n{out}"
+        assert "server-exit-ok" in out

@@ -131,6 +131,18 @@ def test_download_md5_gate(tmp_path):
                                    root_dir=str(tmp_path))
 
 
+def test_flops_tied_parameter_counts_once(capsys):
+    # two distinct Linear layers sharing ONE Parameter (classic weight tying)
+    a = nn.Linear(8, 8)
+    b = nn.Linear(8, 8)
+    b.weight = a.weight
+    net = nn.Sequential(a, b)
+    paddle.flops(net, [1, 8], print_detail=True)
+    out = capsys.readouterr().out
+    # total params: shared weight 64 once + two biases
+    assert f"{(64 + 8 + 8) / 1e6:.2f}M" in out
+
+
 def test_flops_custom_ops():
     class Doubler(nn.Layer):
         def forward(self, x):
