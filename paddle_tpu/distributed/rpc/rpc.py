@@ -132,7 +132,7 @@ class _Agent:
             # half-used connection has undefined stream state — drop it
             try:
                 sock.close()
-            finally:
+            except OSError:
                 pass
             raise
         self._release(to, sock)
@@ -236,13 +236,17 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
     return fut
 
 
-def shutdown():
-    """Barrier with all peers, then stop the agent (reference rpc.py:268)."""
+def shutdown(graceful=True):
+    """Barrier with all peers, then stop the agent (reference rpc.py:268).
+
+    ``graceful=False`` skips the peer barrier — for teardown after peers
+    are known dead (a barrier would wait out the full store timeout)."""
     global _agent
     with _agent_lock:
         if _agent is None:
             return
-        _agent.store.barrier("rpc_shutdown")
+        if graceful:
+            _agent.store.barrier("rpc_shutdown")
         _agent.stop()
         _agent = None
 

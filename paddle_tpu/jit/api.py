@@ -138,13 +138,38 @@ class TracedProgram:
 
 
 class StaticFunction:
-    """@to_static wrapper with per-signature program cache."""
+    """@to_static wrapper with per-signature program cache.
 
-    def __init__(self, fn, layer=None, input_spec=None, build_strategy=None):
+    Tracing is the fast path; a data-dependent Python branch/loop raises
+    TracerBoolConversionError, on which the source is AST-transformed
+    (dy2static) once and retraced — the reference's ProgramTranslator
+    always-AST pipeline, applied lazily.
+    """
+
+    def __init__(self, fn, layer=None, input_spec=None, build_strategy=None,
+                 origin=None):
         self._fn = fn
         self._layer = layer
         self._cache: dict = {}
+        # (unbound original fn, bound self) for the AST fallback — the
+        # Layer path wraps forward in a lambda whose source is useless
+        self._origin = origin
+        self._ast_applied = False
         functools.update_wrapper(self, fn)
+
+    def _apply_ast_fallback(self):
+        from .dy2static import ast_transform
+        if self._ast_applied:
+            return False
+        self._ast_applied = True
+        if self._origin is not None:
+            raw, bound_self = self._origin
+            transformed = ast_transform(raw)
+            self._fn = (lambda *a, **kw: transformed(bound_self, *a, **kw))
+        else:
+            self._fn = ast_transform(self._fn)
+        self._cache.clear()
+        return True
 
     def _sig(self, args):
         parts = []
@@ -186,7 +211,14 @@ class StaticFunction:
         if prog is None:
             prog = TracedProgram(fn, self._layer)
             self._cache[key] = prog
-        return prog(*call_args)
+        try:
+            return prog(*call_args)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError):
+            # data-dependent python control flow: AST-transform and retrace
+            if not self._apply_ast_fallback():
+                raise
+            return self.__call__(*args, **kwargs)
 
     @property
     def concrete_programs(self):
@@ -200,7 +232,8 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     def wrap(f):
         if isinstance(f, Layer):
             sf = StaticFunction(lambda *a, **kw: type(f).forward(f, *a, **kw),
-                                layer=f, input_spec=input_spec)
+                                layer=f, input_spec=input_spec,
+                                origin=(type(f).forward, f))
             f.forward = sf
             # calling the layer goes through __call__ → hooks → sf
             return f

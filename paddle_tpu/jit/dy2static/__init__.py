@@ -1,0 +1,18 @@
+"""dy2static — AST compilation of dynamic Python control flow.
+
+Reference: ``python/paddle/jit/dy2static/`` (program_translator.py:272
+StaticFunction, ast_transformer.py + ~20 transformers rewriting
+if/while/for/boolops into conditional_block/while ops).
+
+TPU-native design: the same source-to-source rewrite, but the runtime
+convert operators lower onto ``lax.cond`` / ``lax.while_loop`` through
+``paddle.static.nn`` (one structured-control-flow primitive each) instead
+of interpreter sub-blocks. The trace-based ``to_static`` stays the fast
+path; when a trace hits data-dependent Python control flow
+(TracerBoolConversionError), the function is AST-transformed and retraced
+automatically.
+"""
+from . import convert_operators  # noqa: F401
+from .transformer import ast_transform, Dy2StaticError  # noqa: F401
+
+__all__ = ["ast_transform", "convert_operators", "Dy2StaticError"]

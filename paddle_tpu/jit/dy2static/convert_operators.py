@@ -1,0 +1,152 @@
+"""Runtime convert operators the transformed AST calls.
+
+Reference: ``python/paddle/jit/dy2static/convert_operators.py``
+(convert_ifelse, convert_while_loop, convert_logical_and/or/not). Python
+values keep exact Python semantics (short-circuit, truthiness, object
+results); traced/lazy Tensors lower to lax primitives via
+``paddle.static.nn``.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unwrap
+
+
+def _is_traced(x):
+    if not isinstance(x, Tensor):
+        return False
+    from ...static.program import is_lazy
+    return is_lazy(x) or isinstance(unwrap(x), jax.core.Tracer)
+
+
+class _Undefined:
+    """Placeholder for a name unbound before the branch (reference
+    UndefinedVar parity) — surfaces only if the user's code read a name
+    that no execution path defined."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def opt(thunk):
+    """Evaluate a name thunk, tolerating unbound names."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEFINED
+
+
+def convert_ifelse(pred, true_fn, false_fn, inits=(), n_outs=None,
+                   names=None):
+    """Branch; branch fns take the union of branch-assigned names as
+    parameters (initial values in ``inits``) and return them as a tuple —
+    the transformer wires the assignment back. ``n_outs`` fixes the
+    arity of the assignment form (static.nn.cond collapses 1-tuples)."""
+    if _is_traced(pred):
+        from ...static.nn import cond
+
+        def run(fn, branch):
+            out = fn(*inits)
+            # a name unbound before the `if` and assigned in only one
+            # branch would leak the UNDEFINED sentinel into lax.cond —
+            # diagnose it by name instead of an opaque jax TypeError
+            if isinstance(out, tuple):
+                _check_defined(out, names, f"`if` ({branch} branch exit)")
+            return out
+
+        out = cond(pred, lambda: run(true_fn, "true"),
+                   lambda: run(false_fn, "false"))
+        if n_outs is not None and n_outs == 1 \
+                and not isinstance(out, tuple):
+            out = (out,)
+        return out
+    pv = bool(unwrap(pred)) if isinstance(pred, Tensor) else bool(pred)
+    return true_fn(*inits) if pv else false_fn(*inits)
+
+
+def convert_while_loop(cond_fn, body_fn, init_vars, names=None):
+    """While; cond/body take and return the full loop-var tuple.
+
+    The dispatch follows the CONDITION, not the carried values: a python
+    condition keeps exact python-loop semantics (which a jit trace
+    unrolls — e.g. desugared ``for i in range(3)`` over tensor
+    accumulators), while a traced condition lowers to lax.while_loop.
+    A condition that becomes traced mid-loop switches over at that point.
+    """
+    vals = tuple(init_vars)
+    probe = cond_fn(*vals)
+    while not _is_traced(probe):
+        if not bool(unwrap(probe) if isinstance(probe, Tensor) else probe):
+            return vals
+        out = body_fn(*vals)
+        vals = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        probe = cond_fn(*vals)
+    _check_defined(vals, names, "while loop")
+    from ...static.nn import while_loop
+    out = while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)), list(vals))
+    return tuple(out)
+
+
+def _check_defined(vals, names, where):
+    bad = [names[i] if names and i < len(names) else f"#{i}"
+           for i, v in enumerate(vals) if v is UNDEFINED]
+    if bad:
+        from .transformer import Dy2StaticError
+        raise Dy2StaticError(
+            f"dy2static: variable(s) {', '.join(map(repr, bad))} are used "
+            f"in a tensor-dependent {where} but have no value on every "
+            f"path before it; initialize them first")
+
+
+def range_cond(it, stop, step):
+    """Generic `for ... in range(...)` continuation predicate: works for
+    positive and negative steps, python or Tensor operands."""
+    if any(_is_traced(v) or isinstance(v, Tensor) for v in (it, stop, step)):
+        import jax.numpy as jnp
+        itv, stv, spv = (unwrap(v) if isinstance(v, Tensor) else v
+                         for v in (it, stop, step))
+        return Tensor(jnp.where(spv > 0, itv < stv, itv > stv))
+    return it < stop if step > 0 else it > stop
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_traced(x):
+        from ... import ops
+        return ops.logic.logical_and(x, y_fn())
+    xv = bool(unwrap(x)) if isinstance(x, Tensor) else x
+    if not xv:
+        return x  # python `and` returns the falsy operand itself
+    y = y_fn()
+    if _is_traced(y):
+        from ... import ops
+        return ops.logic.logical_and(x, y)
+    return y
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_traced(x):
+        from ... import ops
+        return ops.logic.logical_or(x, y_fn())
+    xv = bool(unwrap(x)) if isinstance(x, Tensor) else x
+    if xv:
+        return x
+    y = y_fn()
+    if _is_traced(y):
+        from ... import ops
+        return ops.logic.logical_or(x, y)
+    return y
+
+
+def convert_logical_not(x):
+    if _is_traced(x) or isinstance(x, Tensor):
+        from ... import ops
+        return ops.logic.logical_not(x) if _is_traced(x) \
+            else (not bool(unwrap(x)))
+    return not x

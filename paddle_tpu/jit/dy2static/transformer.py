@@ -1,0 +1,387 @@
+"""Source-to-source AST rewrite of Python control flow.
+
+Reference architecture: ``python/paddle/jit/dy2static/ast_transformer.py``
++ per-construct transformers (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py). This is the TPU-native core subset:
+
+- ``if`` over tensor predicates  -> convert_ifelse (lax.cond)
+- ``while``                      -> convert_while_loop (lax.while_loop)
+- ``for _ in range(...)``        -> desugared to while
+- ``and`` / ``or`` / ``not``     -> convert_logical_* (lazy operands)
+
+Rewrites are semantics-preserving for plain Python values (the convert
+operators keep truthiness/short-circuit), so the whole function is always
+transformed.
+
+Known limits (clear errors): ``break``/``continue`` inside a converted
+loop, ``return`` inside a converted branch (single-return-per-branch
+``if/else`` is supported and rewritten to ``return convert_ifelse(...)``).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+
+from . import convert_operators as _ops_mod
+
+_JST = "_jst"
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Top-level-scope names a statement list assigns (no nested defs)."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    # do not descend into new scopes
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ListComp(self, node):
+        for g in node.generators:
+            self.visit(g.iter)
+
+    visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loaded(node):
+    v = _LoadedNames()
+    v.visit(node)
+    return v.names
+
+
+class _FindsBreak(ast.NodeVisitor):
+    """break/continue belonging to THIS loop (not nested ones)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    visit_Continue = visit_Break
+
+    def visit_While(self, node):
+        pass  # nested loop owns its breaks
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _has_own_break(stmts):
+    v = _FindsBreak()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _FindsReturn(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _has_return(stmts):
+    v = _FindsReturn()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _lambda0(body_expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=body_expr)
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, fn_assigned):
+        self._n = 0
+        self._fn_assigned = fn_assigned  # names assigned anywhere in the fn
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # ---------------- boolean operators ------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = _jst_call(op, [_lambda0(v), _lambda0(expr)])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # ---------------- if / else --------------------------------------
+    def visit_If(self, node):
+        # break/continue can't move into a nested branch function (python
+        # SyntaxError); such an `if` stays python — its enclosing loop
+        # either stays python too, or visit_While rejects it with a clear
+        # error before transforming children
+        if _has_own_break(node.body) or _has_own_break(node.orelse):
+            return node
+        self.generic_visit(node)
+        i = self._uid()
+
+        # single-return-per-branch: rewrite to `return convert_ifelse(...)`
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Return)
+                and len(node.orelse) == 1
+                and isinstance(node.orelse[0], ast.Return)):
+            t = _lambda0(node.body[0].value or ast.Constant(None))
+            f = _lambda0(node.orelse[0].value or ast.Constant(None))
+            return ast.Return(value=_jst_call(
+                "convert_ifelse", [node.test, t, f]))
+
+        if _has_return(node.body) or _has_return(node.orelse):
+            # mixed return/assign branches stay python — a tensor predicate
+            # will surface the standard trace error with this location
+            return node
+
+        out_names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        tname, fname = f"_jst_true_{i}", f"_jst_false_{i}"
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in out_names], ctx=ast.Load()))
+
+        def mkfn(name, body):
+            # assigned names are PARAMETERS (read-modify-write like
+            # `x = x + 1` would otherwise hit UnboundLocalError in the
+            # nested scope); read-only outer names stay closure reads
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in out_names],
+                    vararg=None, kwonlyargs=[], kw_defaults=[],
+                    kwarg=None, defaults=[]),
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[], returns=None, type_params=[])
+
+        inits = ast.Tuple(
+            elts=[_jst_call("opt", [_lambda0(_name(n))])
+                  for n in out_names],
+            ctx=ast.Load())
+        call = _jst_call("convert_ifelse",
+                         [node.test, _name(tname), _name(fname), inits,
+                          ast.Constant(len(out_names)),
+                          ast.Tuple(elts=[ast.Constant(n)
+                                          for n in out_names],
+                                    ctx=ast.Load())])
+        if out_names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                         for n in out_names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [mkfn(tname, node.body), mkfn(fname, node.orelse), assign]
+
+    # ---------------- while ------------------------------------------
+    def visit_While(self, node):
+        # checks run BEFORE child transformation (a converted inner `if`
+        # would hide its break inside a nested function)
+        if _has_own_break(node.body):
+            raise Dy2StaticError(
+                "dy2static: break/continue inside a converted while loop "
+                "is not supported; restructure with the loop condition")
+        if _has_return(node.body):
+            raise Dy2StaticError(
+                "dy2static: return inside a converted while loop is not "
+                "supported")
+        if node.orelse:
+            raise Dy2StaticError("dy2static: while/else is not supported")
+        self.generic_visit(node)
+        i = self._uid()
+        loop_names = sorted(
+            (_assigned(node.body) | _loaded(node.test)) & self._fn_assigned)
+        if not loop_names:
+            return node  # nothing carried: leave as python
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in loop_names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cname, bname = f"_jst_cond_{i}", f"_jst_body_{i}"
+        cond_fn = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=node.body + [ast.Return(value=ast.Tuple(
+                elts=[_name(n) for n in loop_names], ctx=ast.Load()))],
+            decorator_list=[], returns=None, type_params=[])
+        inits = ast.Tuple(
+            elts=[_jst_call("opt", [_lambda0(_name(n))])
+                  for n in loop_names],
+            ctx=ast.Load())
+        names = ast.Tuple(elts=[ast.Constant(n) for n in loop_names],
+                          ctx=ast.Load())
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                     for n in loop_names],
+                               ctx=ast.Store())],
+            value=_jst_call("convert_while_loop",
+                            [_name(cname), _name(bname), inits, names]))
+        return [cond_fn, body_fn, assign]
+
+    # ---------------- for ... in range(...) ---------------------------
+    def visit_For(self, node):
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and isinstance(node.target, ast.Name)
+                and not node.orelse):
+            self.generic_visit(node)
+            return node  # python iteration (static under trace)
+        i = self._uid()
+        r = node.iter.args
+        start = r[0] if len(r) >= 2 else ast.Constant(0)
+        stop = r[1] if len(r) >= 2 else r[0]
+        step = r[2] if len(r) >= 3 else ast.Constant(1)
+        it, st, sp = f"_jst_it_{i}", f"_jst_stop_{i}", f"_jst_step_{i}"
+        # the synthetic iterator/target become loop carries of the
+        # generated while — register them so the While transform keeps them
+        self._fn_assigned |= {it, st, sp, node.target.id}
+        init = [
+            ast.Assign(targets=[_name(it, ast.Store())], value=start),
+            ast.Assign(targets=[_name(st, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(sp, ast.Store())], value=step),
+            # loop target bound before entry (body reassigns it first
+            # thing; an unbound name would fail building the init tuple)
+            ast.Assign(targets=[ast.Name(id=node.target.id,
+                                         ctx=ast.Store())],
+                       value=_name(it)),
+        ]
+        body = (
+            [ast.Assign(targets=[ast.Name(id=node.target.id,
+                                          ctx=ast.Store())],
+                        value=_name(it))]
+            + node.body
+            + [ast.Assign(targets=[_name(it, ast.Store())],
+                          value=ast.BinOp(left=_name(it), op=ast.Add(),
+                                          right=_name(sp)))])
+        loop = ast.While(
+            test=_jst_call("range_cond", [_name(it), _name(st), _name(sp)]),
+            body=body, orelse=[])
+        out = init + [self.visit(loop)]
+        flat = []
+        for s in out:
+            flat.extend(s if isinstance(s, list) else [s])
+        return flat
+
+
+def ast_transform(fn):
+    """Rewrite ``fn``'s control flow; returns a new function object.
+
+    Free (closure) variables are rebound by value at transform time; the
+    rewritten source is attached as ``__dy2static_source__``.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Dy2StaticError(
+            f"dy2static: cannot read source of {fn!r} (interactive or "
+            f"builtin function?)") from e
+    tree = ast.parse(src)
+    fdef = next(n for n in tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    if isinstance(fdef, ast.AsyncFunctionDef):
+        raise Dy2StaticError("dy2static: async functions are unsupported")
+    fdef.decorator_list = []  # don't re-run @to_static et al.
+
+    fn_assigned = _assigned(fdef.body) | {
+        a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                        + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        fn_assigned.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        fn_assigned.add(fdef.args.kwarg.arg)
+
+    new_tree = ast.Module(
+        body=[ControlFlowTransformer(fn_assigned).visit(fdef)],
+        type_ignores=[])
+    ast.fix_missing_locations(new_tree)
+
+    ns = dict(fn.__globals__)
+    ns[_JST] = _ops_mod
+    # closures: rebind free variables by value
+    for name, cell in zip(fn.__code__.co_freevars, fn.__closure__ or ()):
+        try:
+            ns[name] = cell.cell_contents
+        except ValueError:
+            pass  # unfilled cell (recursive def): resolved via globals
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__dy2static_source__ = ast.unparse(new_tree)
+    if isinstance(fn, types.MethodType):
+        new_fn = types.MethodType(new_fn, fn.__self__)
+    return new_fn

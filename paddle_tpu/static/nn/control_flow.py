@@ -45,13 +45,26 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     loop_vars = _tensors(list(loop_vars))
 
     if not _is_lazy_or_tracer(loop_vars):
-        # eager: run now (dygraph path of the same API)
-        vals = list(loop_vars)
-        while bool(unwrap(cond(*vals))):
-            out = body(*vals)
-            vals = _tensors(list(out) if isinstance(out, (tuple, list))
-                            else [out])
-        return vals
+        # concrete loop vars: probe the condition — it may still be traced
+        # through a closure (e.g. `while n < paddle.sum(x)` with python n
+        # inside to_static), which needs the lax path below. In lazy
+        # program capture the probe records dead nodes; roll them back.
+        from ..program import default_main_program, is_lazy
+        prog = default_main_program()
+        mark = len(prog._nodes)
+        probe = cond(*loop_vars)
+        if isinstance(probe, Tensor) and is_lazy(probe):
+            del prog._nodes[mark:]
+        if not _is_lazy_or_tracer([probe] if isinstance(probe, Tensor)
+                                  else []):
+            # eager: run now (dygraph path of the same API)
+            vals = list(loop_vars)
+            while bool(unwrap(probe)):
+                out = body(*vals)
+                vals = _tensors(list(out) if isinstance(out, (tuple, list))
+                                else [out])
+                probe = cond(*vals)
+            return vals
 
     def fn(*flat):
         def c(state):

@@ -1,0 +1,201 @@
+"""dy2static AST transformation: tensor-dependent python control flow
+under jit.to_static, checked against eager execution (the reference's
+dygraph_to_static test model — dygraph output == to_static output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import Dy2StaticError, ast_transform
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+# ---------------------------------------------------------------- if/else
+def test_if_assignment_branches():
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y + 1
+
+    sf = paddle.jit.to_static(f)
+    for v in ([1.0, 2.0], [-5.0, 1.0]):
+        want = f(_t(v)).numpy()
+        got = sf(_t(v)).numpy()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_if_read_modify_write():
+    def f(x):
+        acc = x * 0
+        if paddle.max(x) > 1:
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    for v in ([2.0, 0.0], [0.5, 0.5]):
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+
+
+def test_if_single_return_per_branch():
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x * 10
+        else:
+            return -x
+
+    sf = paddle.jit.to_static(f)
+    for v in ([1.0], [-3.0]):
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+
+
+# ---------------------------------------------------------------- while
+def test_while_tensor_cond():
+    def f(x):
+        s = paddle.zeros([1])
+        while paddle.sum(s) < 10:
+            s = s + x
+        return s
+
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(np.asarray(sf(_t([3.0])).numpy()),
+                               np.asarray(f(_t([3.0])).numpy()))
+
+
+# ---------------------------------------------------------------- for/range
+def test_for_range_python_bounds():
+    def f(x):
+        out = x * 0
+        for i in range(4):
+            out = out + x * float(i)
+        return out
+
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(np.asarray(sf(_t([1.0, 2.0])).numpy()),
+                               np.asarray(f(_t([1.0, 2.0])).numpy()))
+
+
+# ---------------------------------------------------------------- bool ops
+def test_logical_ops_on_tensors():
+    def f(x):
+        if (paddle.sum(x) > 0) and (paddle.max(x) < 5):
+            return x + 100
+        else:
+            return x - 100
+
+    sf = paddle.jit.to_static(f)
+    for v in ([1.0], [9.0], [-1.0]):
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+
+
+def test_python_semantics_preserved():
+    # plain python truthiness/short-circuit still behaves exactly
+    def f(flag, x):
+        out = x
+        if flag and x is not None:
+            out = x * 2
+        n = 0
+        while n < 3:
+            n += 1
+        for k in range(2):
+            out = out + k
+        return out, n
+
+    g = ast_transform(f)
+    a, n = g(True, _t([1.0]))
+    np.testing.assert_allclose(np.asarray(a.numpy()), [3.0])
+    assert n == 3
+    b, _ = g(False, _t([1.0]))
+    np.testing.assert_allclose(np.asarray(b.numpy()), [2.0])
+
+
+# ---------------------------------------------------------------- layers
+def test_layer_forward_with_tensor_branch():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if paddle.sum(h) > 0:
+                h = h * 2
+            else:
+                h = h * 0.5
+            return h
+
+    net = Gate()
+    x = _t(np.random.default_rng(0).standard_normal((2, 4)))
+    want = np.asarray(net(x).numpy())
+    paddle.jit.to_static(net)
+    got = np.asarray(net(x).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ---------------------------------------------------------------- limits
+def test_break_raises_clear_error():
+    def f(x):
+        s = x
+        while paddle.sum(s) < 10:
+            if paddle.max(s) > 3:
+                break
+            s = s + 1
+        return s
+
+    with pytest.raises(Dy2StaticError, match="break"):
+        ast_transform(f)
+
+
+def test_while_name_first_assigned_in_body():
+    # python-cond loop: y is first bound inside the body — fine
+    def f(x):
+        i = 0
+        while i < 3:
+            y = x * i
+            i += 1
+        return y
+
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(np.asarray(sf(_t([2.0])).numpy()), [4.0])
+
+    # tensor-cond loop: same pattern cannot lower to lax — named error
+    def g(x):
+        i = paddle.zeros([1])
+        while paddle.sum(i) < 3:
+            y = x * 2
+            i = i + 1
+        return y
+
+    sg = paddle.jit.to_static(g)
+    with pytest.raises(Dy2StaticError, match="'y'"):
+        sg(_t([1.0]))
+
+
+def test_if_one_sided_unbound_name_diagnosed():
+    def f(x):
+        if paddle.sum(x) > 0:
+            z = x * 2
+        return x
+
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="'z'"):
+        sf(_t([1.0]))
+
+
+def test_transformed_source_attached():
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    g = ast_transform(f)
+    assert "convert_ifelse" in g.__dy2static_source__

@@ -87,10 +87,13 @@ def test_ps_service_end_to_end(tmp_path):
         # them, so a test failure must not leave them waiting forever
         try:
             client.stop_server()
+            rpc.shutdown()
         except Exception:
             for p in procs:
                 p.kill()
-        rpc.shutdown()
+            # peers are dead: a graceful barrier would hang for the full
+            # store timeout
+            rpc.shutdown(graceful=False)
     for rank, p in enumerate(procs):
         out = p.communicate(timeout=60)[0]
         assert p.returncode == 0, f"ps{rank} failed:\n{out}"

@@ -132,15 +132,16 @@ def test_download_md5_gate(tmp_path):
 
 
 def test_flops_tied_parameter_counts_once(capsys):
-    # two distinct Linear layers sharing ONE Parameter (classic weight tying)
-    a = nn.Linear(8, 8)
-    b = nn.Linear(8, 8)
+    # two distinct Linear layers sharing ONE Parameter (classic weight
+    # tying); sized so dedup (1.00M) vs double-count (2.00M) actually
+    # differ in the printed 2-decimal total
+    a = nn.Linear(1000, 1000)
+    b = nn.Linear(1000, 1000)
     b.weight = a.weight
     net = nn.Sequential(a, b)
-    paddle.flops(net, [1, 8], print_detail=True)
+    paddle.flops(net, [1, 1000], print_detail=True)
     out = capsys.readouterr().out
-    # total params: shared weight 64 once + two biases
-    assert f"{(64 + 8 + 8) / 1e6:.2f}M" in out
+    assert f"{(1000 * 1000 + 1000 + 1000) / 1e6:.2f}M" in out, out
 
 
 def test_flops_custom_ops():
