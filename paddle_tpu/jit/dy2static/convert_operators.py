@@ -23,11 +23,23 @@ def _is_traced(x):
 
 class _Undefined:
     """Placeholder for a name unbound before the branch (reference
-    UndefinedVar parity) — surfaces only if the user's code read a name
-    that no execution path defined."""
+    UndefinedVar parity). Python's own behavior — fine to stay unbound,
+    error only on USE — is mirrored by raising from every operation
+    (bool/arith/compare/attr/index/call), mimicking UnboundLocalError at
+    the use site instead of an opaque value leaking downstream."""
 
     def __repr__(self):
         return "<dy2static undefined>"
+
+    def _scream(self, *a, **kw):
+        raise UnboundLocalError(
+            "dy2static: variable used before assignment (it has no value "
+            "on the execution path taken through converted control flow)")
+
+    __bool__ = __getattr__ = __call__ = __getitem__ = _scream
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _scream
+    __truediv__ = __rtruediv__ = __matmul__ = __neg__ = __len__ = _scream
+    __lt__ = __le__ = __gt__ = __ge__ = __iter__ = _scream
 
 
 UNDEFINED = _Undefined()

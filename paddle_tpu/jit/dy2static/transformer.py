@@ -203,7 +203,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
             # will surface the standard trace error with this location
             return node
 
-        out_names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        # synthesized helper defs from already-converted nested ifs/loops
+        # are branch-local, not data flow — carrying them as outputs would
+        # feed function objects into lax.cond
+        out_names = sorted(
+            n for n in (_assigned(node.body) | _assigned(node.orelse))
+            if not n.startswith("_jst_"))
         tname, fname = f"_jst_true_{i}", f"_jst_false_{i}"
         ret = ast.Return(value=ast.Tuple(
             elts=[_name(n) for n in out_names], ctx=ast.Load()))
@@ -245,17 +250,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
     # ---------------- while ------------------------------------------
     def visit_While(self, node):
         # checks run BEFORE child transformation (a converted inner `if`
-        # would hide its break inside a nested function)
-        if _has_own_break(node.body):
-            raise Dy2StaticError(
-                "dy2static: break/continue inside a converted while loop "
-                "is not supported; restructure with the loop condition")
-        if _has_return(node.body):
-            raise Dy2StaticError(
-                "dy2static: return inside a converted while loop is not "
-                "supported")
-        if node.orelse:
-            raise Dy2StaticError("dy2static: while/else is not supported")
+        # would hide its break inside a nested function). Loops with
+        # break/continue/return or an else clause stay plain python —
+        # correct for python conditions; a tensor condition then surfaces
+        # the standard trace error at this location (lax.while_loop cannot
+        # express early exit).
+        if _has_own_break(node.body) or _has_return(node.body) \
+                or node.orelse:
+            return node
         self.generic_visit(node)
         i = self._uid()
         loop_names = sorted(
@@ -297,7 +299,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "range"
                 and isinstance(node.target, ast.Name)
-                and not node.orelse):
+                and not node.orelse) \
+                or _has_own_break(node.body) or _has_return(node.body):
             self.generic_visit(node)
             return node  # python iteration (static under trace)
         i = self._uid()

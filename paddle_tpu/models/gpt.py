@@ -548,7 +548,35 @@ class GPTHybridTrainStep:
                 return jax.lax.pmean(total, ("dp", "sharding"))
 
             n_ticks = n_micro + pp - 1
+            rotate = [(i, (i + 1) % pp) for i in range(pp)]
 
+            if n_ticks <= 32:
+                # Python-unrolled GPipe ticks: n_ticks is static, so the
+                # inject/head gating folds to compile time, XLA can overlap
+                # adjacent ticks' compute with the ppermute hops, and the
+                # scan-partial-eval artifact that runs the whole forward
+                # twice under value_and_grad never appears
+                state = jnp.zeros_like(xs[0])
+                total = jnp.zeros((), jnp.float32)
+                for t in range(n_ticks):
+                    if t < n_micro:
+                        state = jnp.where(stage == 0, xs[t], state)
+                    state = apply_blocks(state)
+                    mi = t - (pp - 1)
+                    if 0 <= mi < n_micro:
+                        # cond skips the big vocab einsum on non-final
+                        # stages; stage is uniform within each mp group,
+                        # so the psum/pmax inside head stay collective-safe
+                        total = total + jax.lax.cond(
+                            stage == pp - 1,
+                            lambda s=state, l=labs[mi]: head(s, l),
+                            lambda: jnp.zeros((), jnp.float32))
+                    state = jax.lax.ppermute(state, "pp", rotate)
+                # mean over micro-batches and over dp/sharding batch shards
+                total = jax.lax.psum(total, "pp") / n_micro
+                return jax.lax.pmean(total, ("dp", "sharding"))
+
+            # long schedules: lax.scan keeps compile time bounded
             def tick(carry, t):
                 state, total = carry
                 inject = jnp.take(xs, jnp.clip(t, 0, n_micro - 1), axis=0)
@@ -558,15 +586,11 @@ class GPTHybridTrainStep:
                 mi = t - (pp - 1)
                 valid = (stage == pp - 1) & (mi >= 0) & (mi < n_micro)
                 lab = jnp.take(labs, jnp.clip(mi, 0, n_micro - 1), axis=0)
-                # cond skips the big vocab einsum on non-final stages / fill
-                # ticks; `valid` is uniform within each mp group, so the
-                # psum/pmax inside head stay collective-safe
                 loss_t = jax.lax.cond(
                     valid, lambda: head(state, lab),
                     lambda: jnp.zeros((), jnp.float32))
                 total = total + loss_t
-                state = jax.lax.ppermute(
-                    state, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+                state = jax.lax.ppermute(state, "pp", rotate)
                 return (state, total), None
 
             state0 = jnp.zeros_like(xs[0])

@@ -140,8 +140,39 @@ def test_layer_forward_with_tensor_branch():
 
 
 # ---------------------------------------------------------------- limits
-def test_break_raises_clear_error():
+def test_elif_chain_and_nested_if():
     def f(x):
+        if paddle.sum(x) > 10:
+            y = x * 3
+        elif paddle.sum(x) > 0:
+            y = x * 2
+            if paddle.max(x) > 1.5:
+                y = y + 100
+        else:
+            y = -x
+        return y
+
+    sf = paddle.jit.to_static(f)
+    for v in ([20.0], [1.0], [1.8], [-4.0]):
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+
+
+def test_python_loop_with_break_stays_python():
+    # plain python loop with break must keep working through the transform
+    def f(x):
+        out = x
+        for i in range(10):
+            if i > 3:
+                break
+            out = out + 1
+        return out
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(_t([0.0])).numpy()), [4.0])
+
+    # tensor-cond loop with break cannot lower: standard trace error
+    def h(x):
         s = x
         while paddle.sum(s) < 10:
             if paddle.max(s) > 3:
@@ -149,8 +180,24 @@ def test_break_raises_clear_error():
             s = s + 1
         return s
 
-    with pytest.raises(Dy2StaticError, match="break"):
-        ast_transform(f)
+    import jax
+    sh = paddle.jit.to_static(h)
+    with pytest.raises((jax.errors.TracerBoolConversionError,
+                        jax.errors.ConcretizationTypeError)):
+        sh(_t([1.0]))
+
+
+def test_unbound_name_errors_on_use():
+    def f(flag, x):
+        if flag:
+            y = x * 2
+        return y
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(True, _t([1.0])).numpy()),
+                               [2.0])
+    with pytest.raises(UnboundLocalError):
+        g(False, _t([1.0])) * 2  # use of the unbound result screams
 
 
 def test_while_name_first_assigned_in_body():
