@@ -48,8 +48,122 @@ def peak_flops_per_chip():
     return 459e12
 
 
+def _timed_static_train(build, feed, args):
+    """Shared static-path measurement scaffold: build the program under
+    AMP bf16, run warmup, then `steps` pipelined runs (device-resident
+    feeds, one trailing sync — the tunnel's per-step host round-trip
+    would otherwise dominate). Returns (seconds, final_loss)."""
+    from paddle_tpu import amp, static
+
+    static.enable_static()
+    try:
+        main_prog = static.Program()
+        with static.program_guard(main_prog):
+            with amp.auto_cast(enable=True, dtype="bfloat16"):
+                loss = build()
+        exe = static.Executor()
+        for _ in range(max(args.warmup, 1)):
+            out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                          return_numpy=False)
+        float(np.asarray(out[0]._value))  # sync: warmup/compile done
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                          return_numpy=False)
+        final = float(np.asarray(out[0]._value))
+        return time.perf_counter() - t0, final
+    finally:
+        static.disable_static()
+
+
+def bench_resnet50(args):
+    """BASELINE config #1: ResNet50 imgs/sec on the compiled static path
+    (fluid-executor parity) with static AMP bf16."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.vision.models import resnet50
+
+    B = args.batch or 64
+
+    def build():
+        img = static.data("img", [B, 3, 224, 224], "float32")
+        label = static.data("label", [B], "int64")
+        net = resnet50(num_classes=1000)
+        loss = paddle.nn.functional.cross_entropy(net(img), label)
+        opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=net.parameters())
+        opt.minimize(loss)
+        return loss
+
+    rng = np.random.default_rng(0)
+    feed = {"img": jnp.asarray(rng.standard_normal(
+                (B, 3, 224, 224)).astype(np.float32)),
+            "label": jnp.asarray(rng.integers(0, 1000, B).astype(np.int64))}
+    dt, final = _timed_static_train(build, feed, args)
+    ips = B * args.steps / dt
+    # ~4.1 GFLOP/img fwd; x3 for fwd+bwd
+    mfu = ips * 3 * 4.1e9 / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "resnet50_imgs_per_sec_per_chip",
+        "value": round(ips, 1), "unit": "imgs/s/chip", "vs_baseline": 1.0,
+        "extras": {"mfu": round(mfu, 4), "batch": B, "steps": args.steps,
+                   "final_loss": round(final, 4), "amp": "bfloat16"},
+    }))
+
+
+def bench_bert(args):
+    """BASELINE config #2: BERT-base pretrain tokens/sec on the static
+    (fluid-executor parity) path with static AMP bf16."""
+    import jax.numpy as jnp
+    from paddle_tpu import optimizer, static
+    from paddle_tpu.models.bert import (BertForPretraining, BertModel,
+                                        BertPretrainingCriterion,
+                                        bert_base_config)
+
+    cfg = bert_base_config()
+    B = args.batch or 16
+    S = args.seq or 512
+
+    def build():
+        ids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        model = BertForPretraining(BertModel(cfg))
+        logits, nsp = model(ids)
+        loss = BertPretrainingCriterion(cfg.vocab_size)(logits, nsp, labels)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        opt.minimize(loss)
+        return loss
+
+    rng = np.random.default_rng(0)
+    feed = {"ids": jnp.asarray(rng.integers(
+                0, cfg.vocab_size, (B, S)).astype(np.int64)),
+            "labels": jnp.asarray(rng.integers(
+                0, cfg.vocab_size, (B, S)).astype(np.int64))}
+    dt, final = _timed_static_train(build, feed, args)
+    tps = B * S * args.steps / dt
+    # adapt the GPT flops helper to BertConfig field names
+    gptish = type("C", (), dict(
+        hidden_size=cfg.hidden_size, num_layers=cfg.num_hidden_layers,
+        vocab_size=cfg.vocab_size,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings))
+    fpt, n_params = model_flops_per_token(gptish, S)
+    mfu = tps * fpt / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tps, 1), "unit": "tokens/s/chip", "vs_baseline": 1.0,
+        "extras": {"mfu": round(mfu, 4), "n_params": n_params, "batch": B,
+                   "seq": S, "steps": args.steps,
+                   "final_loss": round(final, 4), "amp": "bfloat16"},
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt",
+                    choices=["gpt", "resnet50", "bert"])
     ap.add_argument("--config", default="345m",
                     choices=["tiny", "345m", "1.3b"])
     ap.add_argument("--steps", type=int, default=10)
@@ -57,6 +171,11 @@ def main():
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--seq", type=int, default=0)
     args = ap.parse_args()
+
+    if args.model == "resnet50":
+        return bench_resnet50(args)
+    if args.model == "bert":
+        return bench_bert(args)
 
     import jax
     sys.path.insert(0, ".")

@@ -83,8 +83,21 @@ def enable_grad():
 # ---------------------------------------------------------------------------
 
 
+def _make_cast(mode, low):
+    if mode == "white":
+        def cast(v):
+            return v.astype(low) if v.dtype == jnp.float32 else v
+    else:
+        def cast(v):
+            return v.astype(jnp.float32) if v.dtype == low else v
+    cast.mode, cast.low = mode, low
+    return cast
+
+
 def _amp_cast_fn(op_name):
-    """Return a value-cast fn for this op under the active amp state, or None."""
+    """Return a value-cast fn for this op under the active amp state, or None.
+    The fn carries ``.mode``/``.low`` so the lazy path can record a
+    serializable wrapper instead of this closure."""
     try:
         from ..amp.auto_cast import current_amp_state, WHITE_LIST, BLACK_LIST
     except ImportError:
@@ -99,14 +112,29 @@ def _amp_cast_fn(op_name):
     low = to_jax_dtype(st.dtype)
 
     if white:
-        def cast(v):
-            return v.astype(low) if v.dtype == jnp.float32 else v
-        return cast
+        return _make_cast("white", low)
     if black:
-        def cast(v):
-            return v.astype(jnp.float32) if v.dtype == low else v
-        return cast
+        return _make_cast("black", low)
     return None
+
+
+class AmpWrappedOp:
+    """An op fn with the AMP white/black-list cast folded in — a plain
+    object (fn, mode, dtype) so static/serde can serialize AMP-built
+    programs (a closure here would be unpicklable)."""
+
+    def __init__(self, fn, mode, low):
+        self.fn = fn
+        self.mode = mode
+        self.low = low
+        self.__name__ = getattr(fn, "__name__", "op")
+
+    def __call__(self, *vals, **kw):
+        cast = _make_cast(self.mode, self.low)
+        vals = [cast(v) if hasattr(v, "dtype")
+                and jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v in vals]
+        return self.fn(*vals, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -171,8 +199,16 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
     if any(isinstance(a, Tensor) and getattr(a, "_lazy", None) is not None
            for a in args):
         from ..static.program import make_lazy_output
-        return make_lazy_output(fn, args, kwargs,
-                                op_name or getattr(fn, "__name__", "op"))
+        name = op_name or getattr(fn, "__name__", "op")
+        amp_cast = _amp_cast_fn(name)
+        if amp_cast is not None:
+            # static AMP (reference fluid/contrib/mixed_precision): the
+            # white/black-list cast is recorded INSIDE the op, so lazy
+            # programs built under amp.auto_cast run low-precision too.
+            # AmpWrappedOp (not a closure) keeps the node serializable —
+            # static/serde special-cases it.
+            fn = AmpWrappedOp(fn, amp_cast.mode, amp_cast.low)
+        return make_lazy_output(fn, args, kwargs, name)
 
     name_for_amp = op_name or getattr(fn, "__name__", "op")
     amp_cast = _amp_cast_fn(name_for_amp)

@@ -36,7 +36,15 @@ class _Undefined:
             "dy2static: variable used before assignment (it has no value "
             "on the execution path taken through converted control flow)")
 
-    __bool__ = __getattr__ = __call__ = __getitem__ = _scream
+    def __getattr__(self, name):
+        # AttributeError (not UnboundLocalError): hasattr()/getattr(default)
+        # probes (protocol sniffing, deepcopy) must see "absent" instead of
+        # exploding; the message still names the real cause
+        raise AttributeError(
+            "dy2static: variable used before assignment (it has no value "
+            "on the execution path taken through converted control flow)")
+
+    __bool__ = __call__ = __getitem__ = _scream
     __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _scream
     __truediv__ = __rtruediv__ = __matmul__ = __neg__ = __len__ = _scream
     __lt__ = __le__ = __gt__ = __ge__ = __iter__ = _scream

@@ -13,9 +13,11 @@ Rewrites are semantics-preserving for plain Python values (the convert
 operators keep truthiness/short-circuit), so the whole function is always
 transformed.
 
-Known limits (clear errors): ``break``/``continue`` inside a converted
-loop, ``return`` inside a converted branch (single-return-per-branch
-``if/else`` is supported and rewritten to ``return convert_ifelse(...)``).
+Degradation contract: constructs lax cannot express — ``break``/
+``continue``/``return`` inside a loop, mixed return/assign branches —
+stay plain python (correct for python conditions; tensor conditions then
+surface the standard trace error at that location). Single-return-per-
+branch ``if/else`` IS converted, to ``return convert_ifelse(...)``.
 """
 from __future__ import annotations
 
@@ -181,9 +183,9 @@ class ControlFlowTransformer(ast.NodeTransformer):
     # ---------------- if / else --------------------------------------
     def visit_If(self, node):
         # break/continue can't move into a nested branch function (python
-        # SyntaxError); such an `if` stays python — its enclosing loop
-        # either stays python too, or visit_While rejects it with a clear
-        # error before transforming children
+        # SyntaxError); such an `if` stays python, and its enclosing loop
+        # stays python too (visit_While/visit_For leave break-carrying
+        # loops untransformed)
         if _has_own_break(node.body) or _has_own_break(node.orelse):
             return node
         self.generic_visit(node)
@@ -257,6 +259,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # express early exit).
         if _has_own_break(node.body) or _has_return(node.body) \
                 or node.orelse:
+            # still transform nested constructs (visit_If refuses ifs that
+            # contain this loop's break, so nothing moves it into a
+            # nested function)
+            self.generic_visit(node)
             return node
         self.generic_visit(node)
         i = self._uid()

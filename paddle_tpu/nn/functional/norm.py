@@ -58,8 +58,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
     args = [a for a in (weight, bias) if a is not None]
     out, (bm, bv) = apply(f, x, *args, op_name="batch_norm", has_aux=True)
-    # update running stats (momentum convention: new = m*old + (1-m)*batch)
-    if isinstance(running_mean, Tensor):
+    # update running stats (momentum convention: new = m*old + (1-m)*batch).
+    # Lazy program capture: batch stats are symbolic and the static
+    # Executor has no buffer write-back channel, so running stats keep
+    # their values (the reference's static BN writes them via in-program
+    # ops; train-then-infer within one Program is unaffected because
+    # inference-mode BN records its own normalization op chain).
+    from ...static.program import is_lazy
+    if isinstance(running_mean, Tensor) and not is_lazy(bm):
         running_mean._value = momentum * rm + (1.0 - momentum) * bm._value
         running_var._value = momentum * rv + (1.0 - momentum) * bv._value
     return out

@@ -87,7 +87,9 @@ def _eval_graph(fetch_vars, feed_vals, param_map):
             vals = [eval_tensor(a) if isinstance(a, Tensor) else a
                     for a in node.args]
             out = node.fn(*vals, **node.kwargs)
-            memo[id(node)] = out if isinstance(out, (tuple, list)) else (out,)
+            # flatten to match the node's flat out_avals (nested outputs
+            # from has_aux ops like batch_norm)
+            memo[id(node)] = jax.tree_util.tree_leaves(out)
         return memo[id(node)][idx]
 
     return [eval_tensor(t) for t in fetch_vars]

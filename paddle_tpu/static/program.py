@@ -33,15 +33,17 @@ def static_build() -> bool:
 
 
 class LazyNode:
-    __slots__ = ("fn", "args", "kwargs", "out_avals", "name", "n_outputs")
+    __slots__ = ("fn", "args", "kwargs", "out_avals", "name", "n_outputs",
+                 "treedef")
 
-    def __init__(self, fn, args, kwargs, out_avals, name):
+    def __init__(self, fn, args, kwargs, out_avals, name, treedef=None):
         self.fn = fn
         self.args = args  # Tensors (lazy or concrete) and constants
         self.kwargs = kwargs
-        self.out_avals = out_avals
+        self.out_avals = out_avals  # FLAT leaves of the output structure
         self.name = name
         self.n_outputs = len(out_avals)
+        self.treedef = treedef
 
 
 def make_placeholder(shape, dtype, lazy, name=None):
@@ -49,7 +51,10 @@ def make_placeholder(shape, dtype, lazy, name=None):
     single construction point for feeds, op outputs, and deserialized
     placeholders."""
     t = Tensor.__new__(Tensor)
-    t._value = (shape if isinstance(shape, jax.ShapeDtypeStruct)
+    # dtype=None: `shape` is already an aval from eval_shape — possibly a
+    # NESTED tuple of ShapeDtypeStructs (e.g. batch_norm's aux state) —
+    # stored verbatim
+    t._value = (shape if dtype is None
                 else jax.ShapeDtypeStruct(tuple(shape), dtype))
     t.stop_gradient = True
     t._grad = None
@@ -78,13 +83,15 @@ def make_lazy_output(fn, args, kwargs, op_name):
 
     out_shape = jax.eval_shape(
         shaped, *[a for a in avals])
-    multi = isinstance(out_shape, (tuple, list))
-    outs_avals = list(out_shape) if multi else [out_shape]
-    node = LazyNode(fn, list(args), kwargs, outs_avals, op_name)
+    # outputs may be NESTED (e.g. has_aux ops: (out, (mean, var))) — flatten
+    # for the node, mirror the structure with placeholder tensors
+    flat_avals, treedef = jax.tree_util.tree_flatten(out_shape)
+    node = LazyNode(fn, list(args), kwargs, flat_avals, op_name)
+    node.treedef = treedef
     default_main_program()._nodes.append(node)
     outs = [make_placeholder(av, None, (node, i))
-            for i, av in enumerate(outs_avals)]
-    return tuple(outs) if multi else outs[0]
+            for i, av in enumerate(flat_avals)]
+    return jax.tree_util.tree_unflatten(treedef, outs)
 
 
 def is_lazy(t) -> bool:

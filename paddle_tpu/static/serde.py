@@ -33,6 +33,10 @@ def _serialize_fn(fn, op_name):
     (code object + closure cells — the op layer wraps many ops in small
     lambdas). Code objects are marshal'd, which ties by-value programs to
     the python minor version; the payload records it and load checks."""
+    from ..framework.tape import AmpWrappedOp
+    if isinstance(fn, AmpWrappedOp):
+        return ("amp", fn.mode, str(np.dtype(fn.low)),
+                _serialize_fn(fn.fn, op_name))
     try:
         blob = pickle.dumps(fn)
         pickle.loads(blob)
@@ -73,6 +77,11 @@ def _serialize_fn(fn, op_name):
 
 
 def _deserialize_fn(enc):
+    if enc[0] == "amp":
+        from ..framework.tape import AmpWrappedOp
+        import jax.numpy as jnp
+        return AmpWrappedOp(_deserialize_fn(enc[3]), enc[1],
+                            jnp.dtype(enc[2]))
     if enc[0] == "ref":
         return pickle.loads(enc[1])
     if enc[0] == "named":
@@ -168,8 +177,11 @@ def deserialize_program(blob: bytes):
     if not blob.startswith(_MAGIC):
         raise ValueError("not a serialized paddle_tpu Program")
     payload = pickle.loads(blob[len(_MAGIC):])
+    def _has_code(enc):
+        return enc[0] == "code" or (enc[0] == "amp" and _has_code(enc[3]))
+
     if payload["python"] != _PYTAG and any(
-            ne["fn"][0] == "code" for ne in payload["nodes"]):
+            _has_code(ne["fn"]) for ne in payload["nodes"]):
         raise ValueError(
             f"program was serialized under python {payload['python']} with "
             f"by-value ops; load it under the same python minor version "

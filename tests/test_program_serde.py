@@ -91,6 +91,27 @@ def test_closure_op_serializes_by_value():
         static.disable_static()
 
 
+def test_amp_program_serializes():
+    from paddle_tpu import amp
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            with amp.auto_cast(enable=True, dtype="bfloat16"):
+                lin = paddle.nn.Linear(8, 3)
+                out = paddle.tanh(lin(x))
+        blob = main.serialize_to_string(fetch_vars=[out])
+        prog2, _, fetches2 = static.deserialize_program(blob)
+        feed = {"x": np.ones((4, 8), np.float32)}
+        want = static.Executor().run(main, feed=feed, fetch_list=[out])[0]
+        got = static.Executor().run(prog2, feed=feed,
+                                    fetch_list=fetches2)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    finally:
+        static.disable_static()
+
+
 def test_unserializable_capture_raises_clear_error():
     import threading
     static.enable_static()
