@@ -94,6 +94,19 @@ def _make_cast(mode, low):
     return cast
 
 
+def _static_capture() -> bool:
+    """True while static mode is on (enable_static). Deliberately the
+    session-wide flag, not a program_guard scope: reference static-mode
+    semantics record EVERY op — `paddle.tanh(w)` under enable_static
+    appends to the default main program and returns a Variable there too;
+    eager values require disable_static() or Executor.run."""
+    try:
+        from ..static.program import static_build
+        return static_build()
+    except ImportError:
+        return False
+
+
 def _amp_cast_fn(op_name):
     """Return a value-cast fn for this op under the active amp state, or None.
     The fn carries ``.mode``/``.low`` so the lazy path can record a
@@ -195,9 +208,17 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
     """
     from .tensor import Tensor  # local: avoid import cycle
 
-    # static-graph recording: any lazy input routes the op into the Program DAG
-    if any(isinstance(a, Tensor) and getattr(a, "_lazy", None) is not None
-           for a in args):
+    # static-graph recording: any lazy input routes the op into the Program
+    # DAG. Under program capture, ops consuming concrete Parameters must
+    # ALSO record: executed eagerly they would enter the program as baked
+    # constants — silently frozen weights (position-embedding lookups,
+    # stacked MoE expert weights) and 100MB+ HLO literals.
+    lazy_in = any(isinstance(a, Tensor) and getattr(a, "_lazy", None)
+                  is not None for a in args)
+    if not lazy_in and _static_capture():
+        from .tensor import Parameter
+        lazy_in = any(isinstance(a, Parameter) for a in args)
+    if lazy_in:
         from ..static.program import make_lazy_output
         name = op_name or getattr(fn, "__name__", "op")
         amp_cast = _amp_cast_fn(name)

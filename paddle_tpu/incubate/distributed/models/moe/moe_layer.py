@@ -50,26 +50,55 @@ class ExpertLayer(nn.Layer):
         return self.h4toh(x)
 
 
-def _dispatch_prep(x, val, idx, num_expert, capacity):
-    """Pure-jax: build dispatched expert inputs + combine weights.
+def _dispatch_indices(idx, num_expert, capacity):
+    """Pure-jax, int-only: slot assignment for gather-based dispatch.
 
-    x [S, M] (diff), val [S, k] (diff), idx [S, k] int32.
-    Returns (expert_in [E, C, M], combine [S, E, C]).
+    The earlier design materialized dense [S, E, C] dispatch/combine
+    tensors and moved tokens with O(S*E*C*M) einsums — hundreds of times
+    the expert FLOPs, and minutes of TPU compile per layer. Gathers are
+    the TPU-native form (global_scatter/gather in the reference are
+    exactly index-routed sends): O(S*k*M) data movement.
+
+    idx [S, k] int32 expert choices (k = priority order). Returns
+      slot_token [E*C] int32: token feeding each expert slot (S = empty),
+      comb_idx  [S, k] int32: flat slot for each choice (E*C = dropped).
     """
     S, k = idx.shape
     E, C = num_expert, capacity
-    # priority-major one-hot masks: all 1st choices claim capacity before 2nd
-    masks = jax.nn.one_hot(idx.T, E, dtype=x.dtype)          # [k, S, E]
-    flat = masks.reshape(k * S, E)
-    pos = jnp.cumsum(flat, axis=0) - 1.0                      # running slot id
-    within = flat * (pos < C).astype(x.dtype)                 # drop overflow
-    loc = jax.nn.one_hot(
-        jnp.clip(pos, 0, C - 1).astype(jnp.int32), C, dtype=x.dtype)
-    disp_k = (loc * within[..., None]).reshape(k, S, E, C)
-    combine = jnp.einsum("ks,ksec->sec", val.astype(x.dtype).T, disp_k)
-    dispatch = disp_k.sum(0)                                  # [S, E, C]
-    expert_in = jnp.einsum("sec,sm->ecm", dispatch, x)
-    return expert_in, combine
+    # priority-major running per-expert counter: all 1st choices claim
+    # capacity before any 2nd choice (GShard rule)
+    oh = jax.nn.one_hot(idx.T, E, dtype=jnp.float32)          # [k, S, E]
+    pos = jnp.cumsum(oh.reshape(k * S, E), axis=0) - 1.0
+    e_f = idx.T.reshape(-1).astype(jnp.int32)                 # [k*S]
+    slot_f = jnp.take_along_axis(
+        pos, e_f[:, None], axis=1)[:, 0].astype(jnp.int32)
+    within = slot_f < C
+    token_f = jnp.tile(jnp.arange(S, dtype=jnp.int32), k)
+    flat_ec = jnp.where(within, e_f * C + slot_f, E * C)
+    # unique per (expert, slot) by construction of the running counter;
+    # out-of-capacity entries scatter out of bounds and are dropped
+    slot_token = jnp.full((E * C,), S, jnp.int32).at[flat_ec].set(
+        token_f, mode="drop")
+    comb_idx = flat_ec.reshape(k, S).T                         # [S, k]
+    return slot_token, comb_idx
+
+
+def _gather_dispatch(x, slot_token):
+    """x [S, M] -> expert inputs [E*C, M]; empty slots read a zero row."""
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[-1]), x.dtype)], axis=0)
+    return xp[slot_token]
+
+
+def _gather_combine(expert_out_flat, val, comb_idx):
+    """expert_out_flat [E*C, M], val [S, k], comb_idx [S, k] ->
+    y [S, M] = sum_k val * expert_out[slot]; dropped tokens (idx == E*C)
+    read the zero pad row and contribute nothing."""
+    ep = jnp.concatenate(
+        [expert_out_flat,
+         jnp.zeros((1, expert_out_flat.shape[-1]), expert_out_flat.dtype)],
+        axis=0)
+    g = ep[comb_idx]                                           # [S, k, M]
+    return jnp.einsum("skm,sk->sm", g, val.astype(g.dtype))
 
 
 class MoELayer(nn.Layer):
@@ -160,9 +189,12 @@ class MoELayer(nn.Layer):
         val = ops.reshape(val, [S, self.top_k])
         idx = ops.reshape(idx, [S, self.top_k]).astype("int32")
 
-        expert_in, combine = apply(
-            _dispatch_prep, x, val, idx, num_expert=E, capacity=C,
-            op_name="moe_dispatch")
+        slot_token, comb_idx = apply(
+            _dispatch_indices, idx, num_expert=E, capacity=C,
+            op_name="moe_dispatch_idx")
+        expert_in = ops.reshape(
+            apply(_gather_dispatch, x, slot_token, op_name="moe_dispatch"),
+            [E, C, self.d_model])
 
         ep = self._ep_axis()
         if ep is not None:
@@ -179,7 +211,9 @@ class MoELayer(nn.Layer):
         if ep is not None:
             expert_out = with_sharding_constraint(expert_out, P(ep, None, None))
 
-        y = ops.einsum("sec,ecm->sm", combine, expert_out)
+        y = apply(_gather_combine,
+                  ops.reshape(expert_out, [E * C, self.d_model]), val,
+                  comb_idx, op_name="moe_combine")
         return ops.reshape(y, orig_shape)
 
     def _experts_stacked(self, expert_in):

@@ -257,14 +257,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # correct for python conditions; a tensor condition then surfaces
         # the standard trace error at this location (lax.while_loop cannot
         # express early exit).
+        # transform nested constructs either way (visit_If refuses ifs
+        # that contain this loop's break, so nothing moves it into a
+        # nested function)
+        self.generic_visit(node)
         if _has_own_break(node.body) or _has_return(node.body) \
                 or node.orelse:
-            # still transform nested constructs (visit_If refuses ifs that
-            # contain this loop's break, so nothing moves it into a
-            # nested function)
-            self.generic_visit(node)
             return node
-        self.generic_visit(node)
         i = self._uid()
         loop_names = sorted(
             (_assigned(node.body) | _loaded(node.test)) & self._fn_assigned)

@@ -13,3 +13,7 @@ from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
     bert_tiny_config, bert_base_config,
 )
+from .ernie import (  # noqa: F401
+    ErnieMoeConfig, ErnieMoeModel, ErnieMoeForPretraining,
+    ernie_moe_tiny_config, ernie_moe_base_config,
+)

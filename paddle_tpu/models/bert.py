@@ -30,6 +30,7 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
 
 
 def bert_tiny_config(**kw):
@@ -43,6 +44,23 @@ def bert_tiny_config(**kw):
 
 def bert_base_config(**kw):
     return BertConfig(**kw)
+
+
+def _init_weights(root: nn.Layer, std: float):
+    """Reference BERT init (init_weights in the bert fixtures /
+    transformers): every Linear/Embedding weight ~ Normal(0, 0.02),
+    biases 0, LayerNorm untouched (ones/zeros). Without this the default
+    Embedding init (std 1.0) puts the tied-decoder logits at ~10x scale
+    and the initial masked-LM loss at ~115 instead of ln(V) ~= 10.3."""
+    import jax.numpy as jnp
+    from ..nn import initializer as I
+    init = I.Normal(0.0, std)
+    for m in root.sublayers(include_self=True):
+        if isinstance(m, (nn.Linear, nn.Embedding)):
+            w = m.weight
+            w._value = init(list(w.shape), w._value.dtype)
+            if isinstance(m, nn.Linear) and m.bias is not None:
+                m.bias._value = jnp.zeros_like(m.bias._value)
 
 
 class BertEmbeddings(nn.Layer):
@@ -88,6 +106,7 @@ class BertModel(nn.Layer):
             act_dropout=0.0, normalize_before=False)
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
         self.pooler = BertPooler(cfg)
+        _init_weights(self, cfg.initializer_range)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         if attention_mask is not None:

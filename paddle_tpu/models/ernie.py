@@ -1,0 +1,139 @@
+"""ERNIE-3.0-style MoE model family (BASELINE config #5).
+
+Parity anchors: the reference trains ERNIE-MoE with
+``incubate/distributed/models/moe/moe_layer.py:260 MoELayer`` (gshard
+gate, global_scatter/gather all-to-all) inside a BERT-shaped encoder —
+this file composes the same pieces from this repo: the transformer
+encoder stack with every ``moe_every``-th FFN replaced by an MoELayer of
+``ExpertLayer`` FFN experts (expert-parallel over the ``sep``/sharding
+axis when the topology has one; dense single-chip otherwise).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn, ops
+from ..incubate.distributed.models.moe import ExpertLayer, MoELayer
+from .bert import BertEmbeddings, _init_weights
+
+
+@dataclass
+class ErnieMoeConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_experts: int = 8
+    top_k: int = 2
+    moe_every: int = 2          # every 2nd layer's FFN is MoE (ERNIE/GShard)
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def ernie_moe_tiny_config(**kw):
+    base = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=4,
+                num_attention_heads=2, intermediate_size=128,
+                num_experts=4, max_position_embeddings=128)
+    base.update(kw)
+    return ErnieMoeConfig(**base)
+
+
+def ernie_moe_base_config(**kw):
+    return ErnieMoeConfig(**kw)
+
+
+class _MoeFfnBlock(nn.Layer):
+    """Post-LN encoder block with an MoE FFN (self-attn + MoE + residuals)."""
+
+    def __init__(self, cfg: ErnieMoeConfig):
+        super().__init__()
+        self.attn = nn.MultiHeadAttention(
+            cfg.hidden_size, cfg.num_attention_heads,
+            dropout=cfg.attention_probs_dropout_prob)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.moe = MoELayer(
+            cfg.hidden_size,
+            [ExpertLayer(cfg.hidden_size, cfg.intermediate_size,
+                         act=cfg.hidden_act)
+             for _ in range(cfg.num_experts)],
+            gate={"type": "gshard", "top_k": cfg.top_k})
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, x, src_mask=None):
+        x = self.ln1(x + self.attn(x, x, x, attn_mask=src_mask))
+        return self.ln2(x + self.moe(x))
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, cfg: ErnieMoeConfig):
+        super().__init__()
+        self.inner = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+
+    def forward(self, x, src_mask=None):
+        return self.inner(x, src_mask=src_mask)
+
+
+class ErnieMoeModel(nn.Layer):
+    def __init__(self, cfg: ErnieMoeConfig):
+        super().__init__()
+        self.config = cfg
+        from .bert import BertConfig
+        bcfg = BertConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            type_vocab_size=cfg.type_vocab_size,
+            hidden_dropout_prob=cfg.hidden_dropout_prob,
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.embeddings = BertEmbeddings(bcfg)
+        blocks = []
+        for i in range(cfg.num_hidden_layers):
+            if cfg.moe_every and (i + 1) % cfg.moe_every == 0:
+                blocks.append(_MoeFfnBlock(cfg))
+            else:
+                blocks.append(_DenseBlock(cfg))
+        self.layers = nn.LayerList(blocks)
+        _init_weights(self, cfg.initializer_range)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
+        h = self.embeddings(input_ids, token_type_ids)
+        for blk in self.layers:
+            h = blk(h, src_mask=attention_mask)
+        return h
+
+
+class ErnieMoeForPretraining(nn.Layer):
+    """Masked-LM head over the MoE encoder (tied embeddings)."""
+
+    def __init__(self, model: ErnieMoeModel):
+        super().__init__()
+        self.ernie = model
+        cfg = model.config
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder_weight = model.embeddings.word_embeddings.weight
+        self.decoder_bias = self.create_parameter([cfg.vocab_size],
+                                                  is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(nn.functional.gelu(self.transform(h)))
+        return ops.matmul(h, self.decoder_weight, transpose_y=True) \
+            + self.decoder_bias

@@ -211,5 +211,8 @@ def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
 
 
 def one_hot(x, num_classes, name=None):
-    v = unwrap(x)
-    return wrap(jax.nn.one_hot(v, int(num_classes), dtype=jnp.float32))
+    # through the tape so lazy-program capture and tracing both work
+    from ..framework.tape import apply
+    n = int(num_classes)
+    return apply(lambda v: jax.nn.one_hot(v, n, dtype=jnp.float32), x,
+                 op_name="one_hot")
