@@ -251,12 +251,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     # ---------------- while ------------------------------------------
     def visit_While(self, node):
-        # checks run BEFORE child transformation (a converted inner `if`
-        # would hide its break inside a nested function). Loops with
-        # break/continue/return or an else clause stay plain python —
-        # correct for python conditions; a tensor condition then surfaces
-        # the standard trace error at this location (lax.while_loop cannot
-        # express early exit).
+        # children transform first; the break/return detectors still see
+        # through that because visit_If refuses to convert ifs containing
+        # this loop's break, and converted single-return ifs remain Return
+        # nodes. Loops with break/continue/return or an else clause stay
+        # plain python — correct for python conditions; a tensor condition
+        # then surfaces the standard trace error at this location
+        # (lax.while_loop cannot express early exit).
         # transform nested constructs either way (visit_If refuses ifs
         # that contain this loop's break, so nothing moves it into a
         # nested function)

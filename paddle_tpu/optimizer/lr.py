@@ -163,6 +163,30 @@ class LambdaDecay(LRScheduler):
         return self.base_lr * self.lr_lambda(self.last_epoch)
 
 
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) each epoch (reference lr.py
+    MultiplicativeDecay: cumulative product of the per-epoch factors)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch <= 0:
+            self._memo = (self.last_epoch, self.base_lr)
+            return self.base_lr
+        memo = getattr(self, "_memo", None)
+        if memo is not None and memo[0] == self.last_epoch - 1:
+            cur = memo[1] * self.lr_lambda(self.last_epoch)  # O(1) step()
+        else:  # arbitrary jump (step(epoch=N), state restore): recompute
+            cur = self.base_lr
+            for epoch in range(1, self.last_epoch + 1):
+                cur = cur * self.lr_lambda(epoch)
+        self._memo = (self.last_epoch, cur)
+        return cur
+
+
 class ReduceOnPlateau(LRScheduler):
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,

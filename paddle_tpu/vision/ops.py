@@ -1,0 +1,323 @@
+"""paddle.vision.ops — detection operators.
+
+Parity: reference ``python/paddle/vision/ops.py`` (nms :1851, roi_align
+:1626, roi_pool :1502, box_coder :571, yolo_box :261, ConvNormActivation
+:1794 — CUDA kernels under ``paddle/fluid/operators/detection/``).
+
+TPU-native: all ops are pure jnp/lax — NMS is a fixed-trip greedy
+suppression over the IoU matrix (compiles under jit; no dynamic output
+shapes: callers slice by the returned count), RoI ops are bilinear /
+max gathers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tape import apply
+from ..framework.tensor import Tensor
+from .. import nn
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "RoIAlign", "RoIPool", "ConvNormActivation"]
+
+
+def _iou_matrix(boxes):
+    """boxes [N, 4] (x1, y1, x2, y2) -> [N, N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard-NMS (reference ops.py:1851 semantics).
+
+    Returns the kept box indices, highest score first. With
+    ``category_idxs``/``categories``, suppression runs per category
+    (boxes of different categories never suppress each other). ``top_k``
+    caps the number of returned indices.
+    """
+    def f(b, *opt):
+        n = b.shape[0]
+        s = opt[0] if opt else jnp.arange(n, 0, -1, dtype=jnp.float32)
+        iou = _iou_matrix(b)
+        if category_idxs is not None:
+            cats = jnp.asarray(
+                category_idxs._value if isinstance(category_idxs, Tensor)
+                else category_idxs)
+            same = cats[:, None] == cats[None, :]
+            iou = jnp.where(same, iou, 0.0)
+        order = jnp.argsort(-s)
+        iou_o = iou[order][:, order]  # score-descending order
+
+        def body(i, keep):
+            # suppressed if any higher-scored KEPT box overlaps > thresh
+            over = (iou_o[i] > iou_threshold) & keep
+            sup = jnp.any(over & (jnp.arange(n) < i))
+            return keep.at[i].set(~sup)
+
+        keep = jax.lax.fori_loop(0, n, body,
+                                 jnp.ones((n,), bool))
+        kept_sorted = jnp.where(keep, jnp.arange(n), n)  # n = dropped
+        sel = jnp.sort(kept_sorted)  # keep score order (already ordered)
+        idx = order[jnp.clip(sel, 0, n - 1)]
+        idx = jnp.where(sel < n, idx, -1)
+        return idx, jnp.sum(keep.astype(jnp.int32))
+
+    args = [boxes] + ([scores] if scores is not None else [])
+    idx, count = apply(f, *args, op_name="nms")
+    from ..static.program import is_lazy
+    if is_lazy(count):
+        raise RuntimeError(
+            "nms produces a data-dependent number of boxes and cannot be "
+            "captured in a static Program / jit trace; run it eagerly "
+            "(dygraph) on host-side post-processing")
+    import numpy as np
+    iv = np.asarray(idx._value)
+    cnt = int(count._value)
+    kept = iv[iv >= 0][:cnt]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype("int64")))
+
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x [...]: bilinear sample (border clamp)."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(y - y0, 0.0, 1.0)
+    lx = jnp.clip(x - x0, 0.0, 1.0)
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference ops.py:1626): bilinear-sampled average pooling
+    per output bin. x [N, C, H, W]; boxes [R, 4] in input coords;
+    boxes_num [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xv, bv, bn):
+        R = bv.shape[0]
+        img_of = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(R),
+                                  side="right")
+        off = 0.5 if aligned else 0.0
+        # sampling_ratio=-1 means adaptive ceil(roi/bin) per RoI in the
+        # reference CUDA kernel; XLA needs static shapes, so we use a
+        # fixed 4-point grid — pass sampling_ratio explicitly for exact
+        # reference parity on large RoIs
+        sr = sampling_ratio if sampling_ratio > 0 else 4
+
+        def one_roi(r):
+            b = bv[r] * spatial_scale - off
+            w = jnp.maximum(b[2] - b[0], 1e-6 if aligned else 1.0)
+            h = jnp.maximum(b[3] - b[1], 1e-6 if aligned else 1.0)
+            bin_h, bin_w = h / ph, w / pw
+            frac = (jnp.arange(sr) + 0.5) / sr
+            ys = b[1] + (jnp.arange(ph)[:, None] + frac[None, :]) * bin_h
+            xs = b[0] + (jnp.arange(pw)[:, None] + frac[None, :]) * bin_w
+            feat = xv[img_of[r]]
+            vals = _bilinear(feat, ys.reshape(-1)[:, None],
+                             xs.reshape(-1)[None, :])  # [C, ph*sr, pw*sr]
+            vals = vals.reshape(feat.shape[0], ph, sr, pw, sr)
+            return vals.mean(axis=(2, 4))
+
+        return jax.vmap(one_roi)(jnp.arange(R))
+
+    return apply(f, x, boxes, boxes_num, op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (reference ops.py:1502): max over quantized bins."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xv, bv, bn):
+        R = bv.shape[0]
+        H, W = xv.shape[-2:]
+        img_of = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(R),
+                                  side="right")
+
+        def one_roi(r):
+            b = jnp.round(bv[r] * spatial_scale).astype(jnp.int32)
+            x1, y1 = b[0], b[1]
+            w = jnp.maximum(b[2] - x1 + 1, 1)
+            h = jnp.maximum(b[3] - y1 + 1, 1)
+            feat = xv[img_of[r]]
+
+            ys = jnp.arange(H)[None, :]      # bin membership masks
+            y_lo = (y1 + jnp.floor(jnp.arange(ph) * h / ph)).astype(
+                jnp.int32)[:, None]
+            y_hi = (y1 + jnp.ceil((jnp.arange(ph) + 1) * h / ph)).astype(
+                jnp.int32)[:, None]
+            my = (ys >= y_lo) & (ys < jnp.maximum(y_hi, y_lo + 1)) \
+                & (ys >= 0) & (ys < H)       # [ph, H]
+            xs = jnp.arange(W)[None, :]
+            x_lo = (x1 + jnp.floor(jnp.arange(pw) * w / pw)).astype(
+                jnp.int32)[:, None]
+            x_hi = (x1 + jnp.ceil((jnp.arange(pw) + 1) * w / pw)).astype(
+                jnp.int32)[:, None]
+            mx = (xs >= x_lo) & (xs < jnp.maximum(x_hi, x_lo + 1)) \
+                & (xs >= 0) & (xs < W)       # [pw, W]
+            neg = jnp.finfo(feat.dtype).min
+            masked = jnp.where(my[None, :, None, :, None]
+                               & mx[None, None, :, None, :],
+                               feat[:, None, None, :, :], neg)
+            return masked.max(axis=(3, 4))   # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.arange(R))
+
+    return apply(f, x, boxes, boxes_num, op_name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference ops.py:571)."""
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        phh = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / phh[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / phh[None, :]),
+            ], axis=-1)
+            return out / pbv[None, :, :]
+        # decode_center_size: priors lie along dim `axis` of the target
+        # (reference contract); 2-D targets use priors row-for-row
+        if tb.ndim == 2:
+            exp = lambda a: a
+            pbv_b = pbv
+        else:
+            exp = lambda a: jnp.expand_dims(a, 1 - axis)
+            pbv_b = jnp.expand_dims(pbv, 1 - axis)
+        dcx = exp(pcx) + tb[..., 0] * pbv_b[..., 0] * exp(pw)
+        dcy = exp(pcy) + tb[..., 1] * pbv_b[..., 1] * exp(phh)
+        dw = jnp.exp(tb[..., 2] * pbv_b[..., 2]) * exp(pw)
+        dh = jnp.exp(tb[..., 3] * pbv_b[..., 3]) * exp(phh)
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                         axis=-1)
+
+    return apply(f, prior_box, prior_box_var, target_box,
+                 op_name="box_coder")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output into boxes + scores (reference
+    ops.py:261). x [N, A*(5+C), H, W]; returns (boxes [N, A*H*W, 4],
+    scores [N, A*H*W, C])."""
+    A = len(anchors) // 2
+    if iou_aware:
+        # reference iou-aware layout prepends A iou channels and blends
+        # conf^(1-f) * iou^f — not implemented here; fail loudly instead
+        # of reshaping the head into garbage boxes
+        raise NotImplementedError(
+            "yolo_box(iou_aware=True) is not supported; decode the plain "
+            "head (A*(5+class_num) channels) or blend iou externally")
+
+    def f(xv, im):
+        N, _, H, W = xv.shape
+        v = xv.reshape(N, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(v[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / W
+        by = (sig(v[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / H
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        bw = jnp.exp(v[:, :, 2]) * aw / in_w
+        bh = jnp.exp(v[:, :, 3]) * ah / in_h
+        conf = sig(v[:, :, 4])
+        probs = sig(v[:, :, 5:]) * conf[:, :, None]
+        conf_mask = (conf > conf_thresh).astype(xv.dtype)
+        imh = im[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = im[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw * 0.5) * imw
+        y1 = (by - bh * 0.5) * imh
+        x2 = (bx + bw * 0.5) * imw
+        y2 = (by + bh * 0.5) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
+        boxes = boxes.transpose(0, 1, 3, 2, 4).reshape(N, A * H * W, 4)
+        scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(N, A * H * W, class_num)
+        return boxes, scores
+
+    return apply(f, x, img_size, op_name="yolo_box")
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class ConvNormActivation(nn.Sequential):
+    """Conv2D + norm + activation block (reference ops.py:1794)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

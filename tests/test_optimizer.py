@@ -118,6 +118,13 @@ def test_lr_schedulers():
     c.last_epoch = 10
     np.testing.assert_allclose(c.get_lr(), 0.0, atol=1e-7)
 
+    m = opt.lr.MultiplicativeDecay(1.0, lambda e: 0.5)
+    mv = [m()]
+    for _ in range(3):
+        m.step()
+        mv.append(m())
+    np.testing.assert_allclose(mv, [1.0, 0.5, 0.25, 0.125], rtol=1e-6)
+
 
 def test_scheduler_drives_optimizer():
     sched = opt.lr.StepDecay(0.5, step_size=1, gamma=0.1)

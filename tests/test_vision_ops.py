@@ -1,0 +1,165 @@
+"""paddle.vision.ops detection operators vs numpy oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision import ops as V
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            # iou
+            x1 = max(boxes[i, 0], boxes[j, 0])
+            y1 = max(boxes[i, 1], boxes[j, 1])
+            x2 = min(boxes[i, 2], boxes[j, 2])
+            y2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a + b - inter) > thresh:
+                sup[j] = True
+    return np.array(keep)
+
+
+def test_nms_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    n = 32
+    xy = rng.uniform(0, 50, (n, 2)).astype(np.float32)
+    wh = rng.uniform(5, 25, (n, 2)).astype(np.float32)
+    boxes = np.concatenate([xy, xy + wh], 1)
+    scores = rng.uniform(0, 1, n).astype(np.float32)
+    kept = np.asarray(V.nms(paddle.to_tensor(boxes), 0.4,
+                            paddle.to_tensor(scores)).numpy())
+    want = _np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(kept, want)
+    # top_k cap
+    kept2 = np.asarray(V.nms(paddle.to_tensor(boxes), 0.4,
+                             paddle.to_tensor(scores), top_k=3).numpy())
+    np.testing.assert_array_equal(kept2, want[:3])
+
+
+def test_nms_per_category():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [0, 0, 10, 10]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    cats = np.array([0, 0, 1], np.int64)
+    kept = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                            paddle.to_tensor(scores),
+                            category_idxs=paddle.to_tensor(cats),
+                            categories=[0, 1]).numpy())
+    # box1 suppressed by box0 (same cat); box2 survives (different cat)
+    np.testing.assert_array_equal(sorted(kept), [0, 2])
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every pooled value equals the constant
+    x = np.full((1, 3, 16, 16), 7.0, np.float32)
+    boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+    out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([1], np.int32)), 4)
+    o = np.asarray(out.numpy())
+    assert o.shape == (1, 3, 4, 4)
+    np.testing.assert_allclose(o, 7.0, rtol=1e-5)
+
+
+def test_roi_align_linear_gradient_field():
+    # f(x, y) = x: pooled bin centers must read back their x coordinate
+    H = W = 16
+    x = np.tile(np.arange(W, dtype=np.float32), (H, 1))[None, None]
+    boxes = np.array([[4.0, 4.0, 12.0, 12.0]], np.float32)
+    out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([1], np.int32)), 2,
+                      aligned=False)
+    o = np.asarray(out.numpy())[0, 0]
+    # bins span [4,8] and [8,12] in x: centers 6 and 10
+    np.testing.assert_allclose(o[:, 0], 6.0, atol=0.6)
+    np.testing.assert_allclose(o[:, 1], 10.0, atol=0.6)
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 6, 6] = 9.0
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], np.float32)
+    out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                     paddle.to_tensor(np.array([1], np.int32)), 2)
+    o = np.asarray(out.numpy())[0, 0]
+    assert o[0, 0] == 5.0 and o[1, 1] == 9.0
+
+
+def test_box_coder_encode_decode_roundtrip():
+    priors = np.array([[10, 10, 30, 30], [5, 5, 15, 25]], np.float32)
+    pvar = np.ones((2, 4), np.float32)
+    targets = np.array([[12, 8, 33, 35]], np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(pvar),
+                      paddle.to_tensor(targets), "encode_center_size")
+    assert tuple(enc.shape) == (1, 2, 4)  # [targets, priors, 4]
+    # priors lie along dim 1 of enc -> axis=1
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(pvar),
+                      enc, "decode_center_size", axis=1)
+    d = np.asarray(dec.numpy())
+    np.testing.assert_allclose(d[0, 0], targets[0], rtol=1e-5)
+    np.testing.assert_allclose(d[0, 1], targets[0], rtol=1e-5)
+    # axis=0: same codes transposed to [priors, targets, 4]
+    enc_t = paddle.to_tensor(
+        np.transpose(np.asarray(enc.numpy()), (1, 0, 2)))
+    dec0 = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(pvar),
+                       enc_t, "decode_center_size", axis=0)
+    d0 = np.asarray(dec0.numpy())
+    np.testing.assert_allclose(d0[0, 0], targets[0], rtol=1e-5)
+    np.testing.assert_allclose(d0[1, 0], targets[0], rtol=1e-5)
+
+
+def test_yolo_box_shapes_and_range():
+    rng = np.random.default_rng(0)
+    A, C, H, W = 2, 4, 3, 3
+    x = rng.standard_normal((2, A * (5 + C), H, W)).astype(np.float32)
+    img = np.array([[32, 32], [64, 48]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[10, 13, 16, 30], class_num=C,
+                               conf_thresh=0.0, downsample_ratio=8)
+    b = np.asarray(boxes.numpy())
+    s = np.asarray(scores.numpy())
+    assert b.shape == (2, A * H * W, 4) and s.shape == (2, A * H * W, C)
+    assert (s >= 0).all() and (s <= 1).all()
+    assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+
+
+def test_nms_rejects_static_capture():
+    from paddle_tpu import static
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            b = static.data("b", [8, 4], "float32")
+            with pytest.raises(RuntimeError, match="dygraph"):
+                V.nms(b, 0.4)
+    finally:
+        static.disable_static()
+
+
+def test_yolo_box_iou_aware_not_supported():
+    with pytest.raises(NotImplementedError, match="iou_aware"):
+        V.yolo_box(paddle.to_tensor(np.zeros((1, 16, 2, 2), np.float32)),
+                   paddle.to_tensor(np.array([[32, 32]], np.int32)),
+                   anchors=[10, 13], class_num=2, conf_thresh=0.1,
+                   downsample_ratio=16, iou_aware=True)
+
+
+def test_conv_norm_activation_block():
+    blk = V.ConvNormActivation(3, 8, kernel_size=3)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 3, 8, 8)).astype(np.float32))
+    y = blk(x)
+    assert tuple(y.shape) == (2, 8, 8, 8)
+    assert float(paddle.min(y).numpy()) >= 0.0  # ReLU applied
