@@ -1,2 +1,7 @@
 """paddle.static.nn parity — control flow + static layer helpers."""
 from .control_flow import while_loop, cond, case, switch_case  # noqa: F401
+from .common import (  # noqa: F401
+    fc, embedding, sparse_embedding, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose, batch_norm, layer_norm, group_norm, instance_norm,
+    data_norm, prelu, bilinear_tensor_product, py_func,
+)

@@ -1,0 +1,223 @@
+"""paddle.static.nn layer-building functions.
+
+Parity: reference ``python/paddle/static/nn/common.py`` (fc, conv2d,
+batch_norm, embedding, ...) — functions that create parameters on first
+use and record the op into the Program. TPU-native: each call constructs
+the corresponding nn.Layer (one per call site, like the reference's
+fresh-parameter semantics) and applies it; parameters live on the
+default startup scope via the Layer itself.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ...framework.tensor import Tensor
+
+__all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+           "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+           "instance_norm", "prelu", "bilinear_tensor_product", "py_func",
+           "data_norm", "sparse_embedding"]
+
+
+def _flatten_to_2d(x, num_flatten_dims):
+    from ... import ops
+    shape = [int(s) for s in x.shape]
+    lead = 1
+    for s in shape[:num_flatten_dims]:
+        lead *= s
+    rest = 1
+    for s in shape[num_flatten_dims:]:
+        rest *= s
+    return ops.reshape(x, [lead, rest]), shape[:num_flatten_dims]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully connected (reference common.py fc): flattens dims from
+    ``num_flatten_dims`` on, one Linear per input; output keeps the
+    leading dims: shape[:num_flatten_dims] + [size]."""
+    from ... import ops
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = None
+    lead_shape = None
+    for xi in xs:
+        flat, lead = _flatten_to_2d(xi, num_flatten_dims)
+        lead_shape = lead_shape or lead
+        lin = nn.Linear(int(flat.shape[-1]), size, weight_attr=weight_attr,
+                        bias_attr=bias_attr if out is None else False)
+        y = lin(flat)
+        out = y if out is None else out + y
+    if len(lead_shape) != 1:
+        out = ops.reshape(out, lead_shape + [size])
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                       weight_attr=param_attr)
+    return emb(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """Reference sparse_embedding targets the brpc PS; single-program
+    semantics are identical to a dense embedding lookup."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    c_in = int(input.shape[1 if data_format == "NCHW" else -1])
+    conv = nn.Conv2D(c_in, num_filters, filter_size, stride, padding,
+                     dilation=dilation, groups=groups,
+                     weight_attr=param_attr, bias_attr=bias_attr,
+                     data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    c_in = int(input.shape[1 if data_format == "NCHW" else -1])
+    conv = nn.Conv2DTranspose(c_in, num_filters, filter_size, stride,
+                              padding, dilation=dilation, groups=groups,
+                              weight_attr=param_attr, bias_attr=bias_attr,
+                              data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    c_in = int(input.shape[1 if data_format == "NCDHW" else -1])
+    conv = nn.Conv3D(c_in, num_filters, filter_size, stride, padding,
+                     dilation=dilation, groups=groups,
+                     weight_attr=param_attr, bias_attr=bias_attr,
+                     data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    c_in = int(input.shape[1 if data_format == "NCDHW" else -1])
+    conv = nn.Conv3DTranspose(c_in, num_filters, filter_size, stride,
+                              padding, dilation=dilation, groups=groups,
+                              weight_attr=param_attr, bias_attr=bias_attr,
+                              data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kw):
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    bn = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_layout) if input.ndim == 4 else \
+        nn.BatchNorm1D(c, momentum=momentum, epsilon=epsilon,
+                       weight_attr=param_attr, bias_attr=bias_attr)
+    bn.training = not is_test
+    out = bn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = nn.LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = ln(input)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    gn = nn.GroupNorm(groups, c, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_layout)
+    out = gn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = int(input.shape[1])
+    inorm = nn.InstanceNorm2D(c, epsilon=epsilon, weight_attr=param_attr,
+                              bias_attr=bias_attr) if input.ndim == 4 else \
+        nn.InstanceNorm1D(c, epsilon=epsilon, weight_attr=param_attr,
+                          bias_attr=bias_attr)
+    return inorm(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", **kw):
+    """Reference data_norm ≈ batch norm without the affine scale coupling;
+    mapped to instance-independent batch_norm semantics."""
+    return batch_norm(input, act=act, epsilon=epsilon,
+                      param_attr=param_attr, data_layout=data_layout)
+
+
+class _ElementPReLU(nn.Layer):
+    """Per-element alpha (reference prelu mode='element'): weight shaped
+    like one sample, broadcast over the batch dim."""
+
+    def __init__(self, sample_shape, weight_attr):
+        super().__init__()
+        from ...nn import initializer as I
+        self.weight = self.create_parameter(
+            list(sample_shape), attr=weight_attr,
+            default_initializer=I.Constant(0.25))
+
+    def forward(self, x):
+        pos = F.relu(x)
+        return pos + self.weight * (x - pos)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "element":
+        layer = _ElementPReLU([int(s) for s in x.shape[1:]], param_attr)
+        return layer(x)
+    if mode == "all":
+        num = 1
+    else:  # channel
+        num = int(x.shape[1 if data_format == "NCHW" else -1])
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    layer = nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference py_func escapes to host python inside a static program.
+    XLA programs cannot call back into python mid-graph; eager tensors
+    run func immediately, lazy capture raises with the jax-native
+    alternative named."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    from ..program import is_lazy
+    if any(isinstance(t, Tensor) and is_lazy(t) for t in xs):
+        raise NotImplementedError(
+            "py_func cannot run host python inside a compiled Program; "
+            "use jax.pure_callback via a custom op, or compute eagerly")
+    return func(*xs)

@@ -44,6 +44,18 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     (boxes of different categories never suppress each other). ``top_k``
     caps the number of returned indices.
     """
+    if categories is not None and category_idxs is not None:
+        import numpy as _np
+        cats_np = _np.asarray(category_idxs._value
+                              if isinstance(category_idxs, Tensor)
+                              else category_idxs)
+        bad = set(_np.unique(cats_np).tolist()) - set(
+            int(c) for c in categories)
+        if bad:
+            raise ValueError(
+                f"category_idxs contains ids {sorted(bad)} not listed in "
+                f"categories {list(categories)}")
+
     def f(b, *opt):
         n = b.shape[0]
         s = opt[0] if opt else jnp.arange(n, 0, -1, dtype=jnp.float32)
@@ -178,10 +190,16 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
             mx = (xs >= x_lo) & (xs < jnp.maximum(x_hi, x_lo + 1)) \
                 & (xs >= 0) & (xs < W)       # [pw, W]
             neg = jnp.finfo(feat.dtype).min
-            masked = jnp.where(my[None, :, None, :, None]
-                               & mx[None, None, :, None, :],
-                               feat[:, None, None, :, :], neg)
-            return masked.max(axis=(3, 4))   # [C, ph, pw]
+            # separable per-axis maxima: O(C*ph*H*pw) intermediates, not
+            # the O(C*ph*pw*H*W) dense mask
+            m1 = jnp.where(mx[None, None, :, :], feat[:, :, None, :],
+                           neg).max(-1)                      # [C, H, pw]
+            m2 = jnp.where(my[None, :, :, None], m1[:, None, :, :],
+                           neg).max(2)                       # [C, ph, pw]
+            # bins fully outside the map output 0 (reference semantics for
+            # unclipped proposals), not float-min
+            empty = (~my.any(1))[:, None] | (~mx.any(1))[None, :]
+            return jnp.where(empty[None], 0.0, m2)
 
         return jax.vmap(one_roi)(jnp.arange(R))
 
@@ -272,8 +290,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
             y1 = jnp.clip(y1, 0, imh - 1)
             x2 = jnp.clip(x2, 0, imw - 1)
             y2 = jnp.clip(y2, 0, imh - 1)
+        # both flatten (a, h, w)-major so boxes[i] pairs with scores[i]
         boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
-        boxes = boxes.transpose(0, 1, 3, 2, 4).reshape(N, A * H * W, 4)
+        boxes = boxes.reshape(N, A * H * W, 4)        # [N,A,H,W,4] flat
         scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
         scores = scores.reshape(N, A * H * W, class_num)
         return boxes, scores

@@ -1,0 +1,157 @@
+"""paddle.static.nn layer-building functions: record into a Program and
+execute with trained parameters (reference static/nn/common.py surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import nn as snn
+
+
+def _run(build, feeds):
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            fetch = build()
+        exe = static.Executor()
+        return exe.run(main, feed=feeds, fetch_list=[fetch])[0]
+    finally:
+        static.disable_static()
+
+
+def test_fc_flatten_and_activation():
+    x_np = np.random.default_rng(0).standard_normal((2, 3, 4)) \
+        .astype(np.float32)
+
+    def build():
+        x = static.data("x", [2, 3, 4], "float32")
+        return snn.fc(x, size=5, num_flatten_dims=1, activation="relu")
+
+    out = _run(build, {"x": x_np})
+    assert out.shape == (2, 5)
+    assert (out >= 0).all()
+
+
+def test_embedding_and_conv2d():
+    ids_np = np.array([[1, 2], [3, 0]], np.int64)
+
+    def build():
+        ids = static.data("ids", [2, 2], "int64")
+        return snn.embedding(ids, size=[10, 6])
+
+    assert _run(build, {"ids": ids_np}).shape == (2, 2, 6)
+
+    img_np = np.random.default_rng(0).standard_normal((2, 3, 8, 8)) \
+        .astype(np.float32)
+
+    def build2():
+        img = static.data("img", [2, 3, 8, 8], "float32")
+        return snn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                          act="relu")
+
+    out = _run(build2, {"img": img_np})
+    assert out.shape == (2, 4, 8, 8) and (out >= 0).all()
+
+
+def test_norms_and_prelu():
+    x_np = np.random.default_rng(0).standard_normal((2, 4, 6, 6)) \
+        .astype(np.float32)
+
+    def build():
+        x = static.data("x", [2, 4, 6, 6], "float32")
+        h = snn.batch_norm(x)
+        h = snn.group_norm(h, groups=2)
+        h = snn.instance_norm(h)
+        return snn.prelu(h, mode="channel")
+
+    assert _run(build, {"x": x_np}).shape == (2, 4, 6, 6)
+
+    def build_ln():
+        x = static.data("x", [2, 4, 6, 6], "float32")
+        return snn.layer_norm(x, begin_norm_axis=2)
+
+    out = _run(build_ln, {"x": x_np})
+    np.testing.assert_allclose(out.mean(axis=(2, 3)), 0.0, atol=1e-4)
+
+
+def test_fc_keeps_leading_dims():
+    x_np = np.ones((2, 3, 4, 5), np.float32)
+
+    def build():
+        x = static.data("x", [2, 3, 4, 5], "float32")
+        return snn.fc(x, size=7, num_flatten_dims=2)
+
+    assert _run(build, {"x": x_np}).shape == (2, 3, 7)
+
+
+def test_prelu_element_mode():
+    x_np = np.random.default_rng(0).standard_normal((2, 3, 4, 4)) \
+        .astype(np.float32)
+
+    def build():
+        x = static.data("x", [2, 3, 4, 4], "float32")
+        return snn.prelu(x, mode="element")
+
+    out = _run(build, {"x": x_np})
+    # default alpha 0.25: negatives scaled, positives passed through
+    np.testing.assert_allclose(
+        out, np.where(x_np > 0, x_np, 0.25 * x_np), rtol=1e-5)
+
+
+def test_bilinear_and_fc_multi_input():
+    a_np = np.ones((3, 4), np.float32)
+    b_np = np.ones((3, 5), np.float32)
+
+    def build():
+        a = static.data("a", [3, 4], "float32")
+        b = static.data("b", [3, 5], "float32")
+        return snn.bilinear_tensor_product(a, b, size=7)
+
+    assert _run(build, {"a": a_np, "b": b_np}).shape == (3, 7)
+
+    def build2():
+        a = static.data("a", [3, 4], "float32")
+        b = static.data("b", [3, 5], "float32")
+        return snn.fc([a, b], size=6)
+
+    assert _run(build2, {"a": a_np, "b": b_np}).shape == (3, 6)
+
+
+def test_py_func_eager_and_lazy():
+    doubled = snn.py_func(lambda t: t * 2, paddle.to_tensor(
+        np.array([1.0, 2.0], np.float32)), out=None)
+    np.testing.assert_allclose(np.asarray(doubled.numpy()), [2.0, 4.0])
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            with pytest.raises(NotImplementedError, match="pure_callback"):
+                snn.py_func(lambda t: t, x, out=None)
+    finally:
+        static.disable_static()
+
+
+def test_static_nn_params_train():
+    # fc weights actually update through minimize
+    x_np = np.random.default_rng(0).standard_normal((4, 3)).astype(np.float32)
+    y_np = np.ones((4, 1), np.float32)
+    static.enable_static()
+    try:
+        from paddle_tpu import optimizer
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 3], "float32")
+            y = static.data("y", [4, 1], "float32")
+            pred = snn.fc(x, size=1)
+            loss = paddle.mean(paddle.square(pred - y))
+            opt = optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        losses = [float(exe.run(main, feed={"x": x_np, "y": y_np},
+                                fetch_list=[loss])[0]) for _ in range(5)]
+        assert losses[-1] < losses[0]
+    finally:
+        static.disable_static()

@@ -120,6 +120,37 @@ def test_box_coder_encode_decode_roundtrip():
     np.testing.assert_allclose(d0[1, 0], targets[0], rtol=1e-5)
 
 
+def test_yolo_box_box_score_alignment():
+    # one very confident cell at (h=0, w=1) on a 1x2x3 grid: the flat index
+    # of its nonzero box must equal the flat index of its nonzero score
+    A, C, H, W = 1, 2, 2, 3
+    x = np.full((1, A * (5 + C), H, W), -12.0, np.float32)
+    x[0, 4, 0, 1] = 12.0   # objectness at that cell
+    x[0, 5, 0, 1] = 12.0   # class 0 prob
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([[32, 32]],
+                                                         np.int32)),
+                               anchors=[10, 13], class_num=C,
+                               conf_thresh=0.5, downsample_ratio=16)
+    b = np.asarray(boxes.numpy())[0]
+    s = np.asarray(scores.numpy())[0]
+    box_idx = np.flatnonzero(np.abs(b).sum(-1) > 0)
+    score_idx = np.flatnonzero(s.sum(-1) > 0.5)
+    np.testing.assert_array_equal(box_idx, score_idx)
+    assert box_idx.tolist() == [0 * W + 1]  # (h=0, w=1) h-major
+
+
+def test_roi_pool_outside_bins_are_zero():
+    x = np.ones((1, 1, 8, 8), np.float32)
+    boxes = np.array([[-6.0, -6.0, 1.0, 1.0]], np.float32)
+    out = np.asarray(V.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1], np.int32)), 2).numpy())[0, 0]
+    assert out[1, 1] == 1.0          # in-image bin
+    assert (out[:1, :] == 0).all() and out[1, 0] == 0  # outside bins: 0
+    assert np.isfinite(out).all()
+
+
 def test_yolo_box_shapes_and_range():
     rng = np.random.default_rng(0)
     A, C, H, W = 2, 4, 3, 3
