@@ -399,7 +399,7 @@ class GPTHybridTrainStep:
     def __init__(self, model, config: GPTConfig, hcg, n_micro=None, lr=1e-4,
                  beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.01,
                  grad_clip_norm=1.0, remat=True, compute_dtype=None,
-                 use_flash=None):
+                 use_flash=None, virtual_pp_degree=1):
         gpt = model.gpt if isinstance(model, GPTForPretraining) else model
         self.model = model
         self.gpt = gpt
@@ -408,10 +408,13 @@ class GPTHybridTrainStep:
         self.mesh = hcg.mesh
         pp = self.mesh.shape["pp"]
         mp = self.mesh.shape["mp"]
-        assert config.num_layers % pp == 0, "layers must divide pp"
+        vpp = int(virtual_pp_degree or 1)
+        assert config.num_layers % (pp * vpp) == 0, \
+            "layers must divide pp * virtual_pp_degree"
         assert config.num_heads % mp == 0, "heads must divide mp"
         assert config.vocab_size % mp == 0, "vocab must divide mp"
         self.n_micro = n_micro or max(pp, 1)
+        self.vpp = vpp
         self.hyper = (lr, beta1, beta2, eps, weight_decay, grad_clip_norm)
         self.remat = remat
         # AMP-O2 style: master params stay f32, forward runs in compute_dtype
@@ -424,8 +427,21 @@ class GPTHybridTrainStep:
         self._compiled = None
         self._t = 0
 
-        # stack per-layer params; keep references to write trained values back
-        self._layer_refs = {k: [getattr(l, k) for l in gpt.layers]
+        # stack per-layer params; keep references to write trained values
+        # back. With virtual pipeline stages (pp_layers.py:520 interleave
+        # parity) stage s owns layer chunks {c*pp + s}: permute the
+        # stacking order so each stage's pp-shard holds its vpp chunks
+        # contiguously ([vpp, chunk_len] after the local reshape).
+        L = config.num_layers
+        chunk_len = L // (pp * vpp)
+        if vpp > 1:
+            order = [l for s in range(pp) for c in range(vpp)
+                     for l in range((c * pp + s) * chunk_len,
+                                    (c * pp + s + 1) * chunk_len)]
+        else:
+            order = list(range(L))
+        layers = [gpt.layers[i] for i in order]
+        self._layer_refs = {k: [getattr(l, k) for l in layers]
                             for k in _BLOCK_KEYS}
         blocks = {k: jnp.stack([unwrap(p) for p in refs])
                   for k, refs in self._layer_refs.items()}
@@ -476,6 +492,7 @@ class GPTHybridTrainStep:
         mesh = self.mesh
         pp = mesh.shape["pp"]
         mp = mesh.shape["mp"]
+        vpp = self.vpp
         n_micro = self.n_micro
         B, S = ids.shape
         assert B % n_micro == 0, "batch must divide micro-batches"
@@ -520,9 +537,11 @@ class GPTHybridTrainStep:
                 # measured +19% step time on v5e
                 blk = jax.checkpoint(blk, prevent_cse=False)
 
-            def apply_blocks(x):
-                out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x,
-                                      blocks_local)
+            def apply_blocks(x, chunk=None):
+                bl = blocks_local if chunk is None else \
+                    {k: v.reshape((vpp, -1) + v.shape[1:])[chunk]
+                     for k, v in blocks_local.items()}
+                out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, bl)
                 return out
 
             def head(x, lab):
@@ -549,6 +568,81 @@ class GPTHybridTrainStep:
 
             n_ticks = n_micro + pp - 1
             rotate = [(i, (i + 1) % pp) for i in range(pp)]
+
+            if vpp > 1:
+                # Virtual pipeline stages (pp_layers.py:520 /
+                # PipelineParallelWithInterleave parity): stage s owns
+                # layer chunks {c*pp + s}. Breadth-first schedule: one
+                # GPipe round per chunk; between rounds the collected
+                # last-stage outputs hop once back to stage 0 as the next
+                # chunk's inputs. The head runs only in the final round.
+                unroll = n_ticks <= 32  # same compile-time bound as vpp=1
+
+                def run_round_unrolled(cur_in, c, last, total):
+                    collect = jnp.zeros_like(xs)
+                    state = jnp.zeros_like(xs[0])
+                    for t in range(n_ticks):
+                        if t < n_micro:
+                            state = jnp.where(stage == 0, cur_in[t], state)
+                        state = apply_blocks(state, chunk=c)
+                        mi = t - (pp - 1)
+                        if 0 <= mi < n_micro:
+                            if last:
+                                total = total + jax.lax.cond(
+                                    stage == pp - 1,
+                                    lambda s=state, l=labs[mi]: head(s, l),
+                                    lambda: jnp.zeros((), jnp.float32))
+                            else:
+                                collect = collect.at[mi].set(
+                                    jnp.where(stage == pp - 1, state,
+                                              collect[mi]))
+                        state = jax.lax.ppermute(state, "pp", rotate)
+                    return collect, total
+
+                def run_round_scan(cur_in, c, last, total):
+                    def tick(carry, t):
+                        state, tot, collect = carry
+                        inject = jnp.take(cur_in,
+                                          jnp.clip(t, 0, n_micro - 1),
+                                          axis=0)
+                        state = jnp.where((stage == 0) & (t < n_micro),
+                                          inject, state)
+                        state = apply_blocks(state, chunk=c)
+                        mi = t - (pp - 1)
+                        valid = (mi >= 0) & (mi < n_micro)
+                        mi_c = jnp.clip(mi, 0, n_micro - 1)
+                        if last:
+                            lab = jnp.take(labs, mi_c, axis=0)
+                            tot = tot + jax.lax.cond(
+                                valid & (stage == pp - 1),
+                                lambda: head(state, lab),
+                                lambda: jnp.zeros((), jnp.float32))
+                        else:
+                            cur = jax.lax.dynamic_index_in_dim(
+                                collect, mi_c, 0, keepdims=False)
+                            new = jnp.where(valid & (stage == pp - 1),
+                                            state, cur)
+                            collect = jax.lax.dynamic_update_index_in_dim(
+                                collect, new, mi_c, 0)
+                        state = jax.lax.ppermute(state, "pp", rotate)
+                        return (state, tot, collect), None
+
+                    init = (jnp.zeros_like(xs[0]), total,
+                            jnp.zeros_like(xs))
+                    (_, total, collect), _ = jax.lax.scan(
+                        tick, init, jnp.arange(n_ticks))
+                    return collect, total
+
+                run_round = run_round_unrolled if unroll else run_round_scan
+                cur_in = xs
+                total = jnp.zeros((), jnp.float32)
+                for c in range(vpp):
+                    last = c == vpp - 1
+                    collect, total = run_round(cur_in, c, last, total)
+                    if not last:
+                        cur_in = jax.lax.ppermute(collect, "pp", rotate)
+                total = jax.lax.psum(total, "pp") / n_micro
+                return jax.lax.pmean(total, ("dp", "sharding"))
 
             if n_ticks <= 32:
                 # Python-unrolled GPipe ticks: n_ticks is static, so the

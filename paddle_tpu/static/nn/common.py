@@ -19,6 +19,27 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
            "data_norm", "sparse_embedding"]
 
 
+def _apply_act(out, act):
+    if not act:
+        return out
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {act!r} for static.nn layer")
+    return fn(out)
+
+
+def _derive_transpose_kernel(in_sizes, out_sizes, stride, padding, dilation):
+    """filter_size=None with output_size set (reference contract):
+    k = ((out - (in-1)*stride + 2*pad) - 1) // dilation + 1 per axis."""
+    def norm(v, n):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+    n = len(in_sizes)
+    s, p, d = (norm(stride, n), norm(padding, n), norm(dilation, n))
+    o = norm(out_sizes, n)
+    return [((o[i] - (in_sizes[i] - 1) * s[i] + 2 * p[i]) - 1) // d[i] + 1
+            for i in range(n)]
+
+
 def _flatten_to_2d(x, num_flatten_dims):
     from ... import ops
     shape = [int(s) for s in x.shape]
@@ -49,9 +70,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
         out = y if out is None else out + y
     if len(lead_shape) != 1:
         out = ops.reshape(out, lead_shape + [size])
-    if activation is not None:
-        out = getattr(F, activation)(out)
-    return out
+    return _apply_act(out, activation)
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -78,8 +97,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                      dilation=dilation, groups=groups,
                      weight_attr=param_attr, bias_attr=bias_attr,
                      data_format=data_format)
-    out = conv(input)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(conv(input), act)
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
@@ -87,12 +105,20 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      param_attr=None, bias_attr=None, act=None,
                      data_format="NCHW", name=None):
     c_in = int(input.shape[1 if data_format == "NCHW" else -1])
+    spatial = [int(s) for s in (input.shape[2:] if data_format == "NCHW"
+                                else input.shape[1:-1])]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv2d_transpose needs filter_size or output_size")
+        filter_size = _derive_transpose_kernel(spatial, output_size, stride,
+                                               padding, dilation)
     conv = nn.Conv2DTranspose(c_in, num_filters, filter_size, stride,
                               padding, dilation=dilation, groups=groups,
                               weight_attr=param_attr, bias_attr=bias_attr,
                               data_format=data_format)
-    out = conv(input)
-    return getattr(F, act)(out) if act else out
+    out = conv(input, output_size=output_size)
+    return _apply_act(out, act)
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -103,8 +129,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                      dilation=dilation, groups=groups,
                      weight_attr=param_attr, bias_attr=bias_attr,
                      data_format=data_format)
-    out = conv(input)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(conv(input), act)
 
 
 def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
@@ -112,26 +137,37 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      param_attr=None, bias_attr=None, act=None,
                      data_format="NCDHW", name=None):
     c_in = int(input.shape[1 if data_format == "NCDHW" else -1])
+    spatial = [int(s) for s in (input.shape[2:] if data_format == "NCDHW"
+                                else input.shape[1:-1])]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose needs filter_size or output_size")
+        filter_size = _derive_transpose_kernel(spatial, output_size, stride,
+                                               padding, dilation)
     conv = nn.Conv3DTranspose(c_in, num_filters, filter_size, stride,
                               padding, dilation=dilation, groups=groups,
                               weight_attr=param_attr, bias_attr=bias_attr,
                               data_format=data_format)
-    out = conv(input)
-    return getattr(F, act)(out) if act else out
+    out = conv(input, output_size=output_size)
+    return _apply_act(out, act)
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                name=None, **kw):
-    c = int(input.shape[1 if data_layout == "NCHW" else -1])
-    bn = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
-                        weight_attr=param_attr, bias_attr=bias_attr,
-                        data_format=data_layout) if input.ndim == 4 else \
-        nn.BatchNorm1D(c, momentum=momentum, epsilon=epsilon,
-                       weight_attr=param_attr, bias_attr=bias_attr)
+    # channels-first layouts are NC*: NCHW, NCDHW, NCL
+    c = int(input.shape[1 if data_layout.startswith("NC") else -1])
+    kwargs = dict(momentum=momentum, epsilon=epsilon,
+                  weight_attr=param_attr, bias_attr=bias_attr)
+    if input.ndim == 5:
+        bn = nn.BatchNorm3D(c, data_format=data_layout, **kwargs)
+    elif input.ndim == 4:
+        bn = nn.BatchNorm2D(c, data_format=data_layout, **kwargs)
+    else:
+        bn = nn.BatchNorm1D(c, **kwargs)
     bn.training = not is_test
-    out = bn(input)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(bn(input), act)
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
@@ -141,8 +177,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
     ln = nn.LayerNorm(shape, epsilon=epsilon,
                       weight_attr=param_attr if scale else False,
                       bias_attr=bias_attr if shift else False)
-    out = ln(input)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(ln(input), act)
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
@@ -150,8 +185,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     c = int(input.shape[1 if data_layout == "NCHW" else -1])
     gn = nn.GroupNorm(groups, c, epsilon=epsilon, weight_attr=param_attr,
                       bias_attr=bias_attr, data_format=data_layout)
-    out = gn(input)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(gn(input), act)
 
 
 def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
@@ -205,8 +239,7 @@ def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
                             bias_attr=None, name=None):
     layer = nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
                         weight_attr=param_attr, bias_attr=bias_attr)
-    out = layer(x, y)
-    return getattr(F, act)(out) if act else out
+    return _apply_act(layer(x, y), act)
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):

@@ -76,6 +76,29 @@ def test_gpt_hybrid_step_trains():
     assert "pp" in spec and any("mp" in (s or ()) for s in spec)
 
 
+def test_gpt_virtual_pipeline_matches_oracle():
+    """pp=2 x virtual_pp_degree=2 (interleave parity: pp_layers.py:520)
+    must track the pp=1 oracle step-for-step, including the chunk
+    permutation of the stacked layer params."""
+    cfg = gpt_tiny_config()  # 4 layers -> 2 stages x 2 chunks x 1 layer
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, cfg.vocab_size, size=(4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    losses = {}
+    for pp, vpp in ((1, 1), (2, 2)):
+        mesh_mod._global_mesh, mesh_mod._hcg = None, None
+        paddle.seed(123)
+        hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1,
+                                     pp_degree=pp)
+        model = GPTForPretraining(GPTModel(cfg))
+        step = GPTHybridTrainStep(model, cfg, hcg, n_micro=2, lr=1e-3,
+                                  virtual_pp_degree=vpp)
+        losses[(pp, vpp)] = [float(step(ids, labels).numpy())
+                             for _ in range(3)]
+    np.testing.assert_allclose(losses[(2, 2)], losses[(1, 1)], rtol=1e-5)
+
+
 def test_gpt_hybrid_remat_matches_noremat():
     mesh_mod._global_mesh, mesh_mod._hcg = None, None
     cfg = gpt_tiny_config()
