@@ -151,6 +151,10 @@ def gpt_block(p, x, eps, mp_axis=None, use_flash=False, return_kv=False):
     return out
 
 
+# tick loops unroll up to this trip count (compile-time bound); longer
+# schedules use lax.scan. Patchable for tests of the scan path.
+_UNROLL_TICKS = 32
+
 _CE_CHUNK = 2048  # tokens per chunk: logits buffer ~= 2048*V*4B ≈ 400MB @50k
 
 
@@ -576,7 +580,7 @@ class GPTHybridTrainStep:
                 # GPipe round per chunk; between rounds the collected
                 # last-stage outputs hop once back to stage 0 as the next
                 # chunk's inputs. The head runs only in the final round.
-                unroll = n_ticks <= 32  # same compile-time bound as vpp=1
+                unroll = n_ticks <= _UNROLL_TICKS  # same bound as vpp=1
 
                 def run_round_unrolled(cur_in, c, last, total):
                     collect = jnp.zeros_like(xs)
@@ -644,7 +648,7 @@ class GPTHybridTrainStep:
                 total = jax.lax.psum(total, "pp") / n_micro
                 return jax.lax.pmean(total, ("dp", "sharding"))
 
-            if n_ticks <= 32:
+            if n_ticks <= _UNROLL_TICKS:
                 # Python-unrolled GPipe ticks: n_ticks is static, so the
                 # inject/head gating folds to compile time, XLA can overlap
                 # adjacent ticks' compute with the ppermute hops, and the

@@ -103,6 +103,35 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     pad = _norm_padding(padding, n)
     dn_spec = _dim_numbers(n, channel_last)
 
+    if output_size is not None:
+        # reference contract: output_size picks the result size within the
+        # stride-sized ambiguity window by setting output_padding
+        if any(out_pad):
+            raise ValueError(
+                "pass either output_size or output_padding, not both")
+        osz = list(output_size) if isinstance(output_size, (list, tuple)) \
+            else [int(output_size)] * n
+        in_sp = [int(s) for s in
+                 (x.shape[1:1 + n] if channel_last else x.shape[2:2 + n])]
+        k = [int(s) for s in weight.shape[2:2 + n]]
+        k_eff = [dilation[i] * (k[i] - 1) + 1 for i in range(n)]
+        if pad == "VALID":
+            pads_n = [(0, 0)] * n
+        elif pad == "SAME":
+            pads_n = [((k_eff[i] - stride[i] + 1) // 2,) * 2
+                      for i in range(n)]
+        else:
+            pads_n = list(pad)
+        expected = [(in_sp[i] - 1) * stride[i] - pads_n[i][0]
+                    - pads_n[i][1] + k_eff[i] for i in range(n)]
+        out_pad = tuple(int(osz[i]) - expected[i] for i in range(n))
+        for i in range(n):
+            if not 0 <= out_pad[i] < max(stride[i], 1):
+                raise ValueError(
+                    f"output_size {osz} unreachable: axis {i} expects a "
+                    f"size in [{expected[i]}, "
+                    f"{expected[i] + max(stride[i], 1) - 1}]")
+
     def f(v, w, *b):
         # paddle transpose-conv weight layout: [in, out/groups, *k] (IOHW)
         # grad-of-conv formulation: lhs-dilate input by stride

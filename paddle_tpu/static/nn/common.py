@@ -31,6 +31,10 @@ def _apply_act(out, act):
 def _derive_transpose_kernel(in_sizes, out_sizes, stride, padding, dilation):
     """filter_size=None with output_size set (reference contract):
     k = ((out - (in-1)*stride + 2*pad) - 1) // dilation + 1 per axis."""
+    if isinstance(padding, str):
+        raise ValueError(
+            "deriving filter_size from output_size needs numeric padding; "
+            f"got padding={padding!r} — pass filter_size explicitly")
     def norm(v, n):
         return list(v) if isinstance(v, (list, tuple)) else [v] * n
     n = len(in_sizes)
@@ -165,7 +169,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     elif input.ndim == 4:
         bn = nn.BatchNorm2D(c, data_format=data_layout, **kwargs)
     else:
-        bn = nn.BatchNorm1D(c, **kwargs)
+        bn = nn.BatchNorm1D(c, data_format=data_layout, **kwargs)
     bn.training = not is_test
     return _apply_act(bn(input), act)
 

@@ -99,6 +99,33 @@ def test_gpt_virtual_pipeline_matches_oracle():
     np.testing.assert_allclose(losses[(2, 2)], losses[(1, 1)], rtol=1e-5)
 
 
+def test_gpt_virtual_pipeline_scan_path_matches_oracle(monkeypatch):
+    """Force the lax.scan tick rounds (long-schedule fallback) by dropping
+    the unroll threshold; numerics must still track the oracle."""
+    from paddle_tpu.models import gpt as gpt_mod
+    cfg = gpt_tiny_config()
+    rng = np.random.default_rng(8)
+    ids = rng.integers(0, cfg.vocab_size, size=(4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    paddle.seed(321)
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=1)
+    model = GPTForPretraining(GPTModel(cfg))
+    oracle = GPTHybridTrainStep(model, cfg, hcg, n_micro=2, lr=1e-3)
+    want = [float(oracle(ids, labels).numpy()) for _ in range(2)]
+
+    monkeypatch.setattr(gpt_mod, "_UNROLL_TICKS", 0)
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    paddle.seed(321)
+    hcg2 = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=2)
+    model2 = GPTForPretraining(GPTModel(cfg))
+    step2 = GPTHybridTrainStep(model2, cfg, hcg2, n_micro=2, lr=1e-3,
+                               virtual_pp_degree=2)
+    got = [float(step2(ids, labels).numpy()) for _ in range(2)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 def test_gpt_hybrid_remat_matches_noremat():
     mesh_mod._global_mesh, mesh_mod._hcg = None, None
     cfg = gpt_tiny_config()

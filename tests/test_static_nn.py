@@ -118,6 +118,43 @@ def test_bilinear_and_fc_multi_input():
     assert _run(build2, {"a": a_np, "b": b_np}).shape == (3, 6)
 
 
+def test_conv_transpose_output_size_honored():
+    x_np = np.ones((1, 3, 8, 8), np.float32)
+
+    def build():
+        x = static.data("x", [1, 3, 8, 8], "float32")
+        # k=3, s=2, in=8 -> ambiguity window [17, 18]; request 18
+        return snn.conv2d_transpose(x, 4, filter_size=3, stride=2,
+                                    output_size=[18, 18])
+
+    assert _run(build, {"x": x_np}).shape == (1, 4, 18, 18)
+
+    # derived kernel from output_size, no filter_size
+    def build2():
+        x = static.data("x", [1, 3, 8, 8], "float32")
+        return snn.conv2d_transpose(x, 4, stride=2, output_size=[17, 17])
+
+    assert _run(build2, {"x": x_np}).shape == (1, 4, 17, 17)
+
+    # unreachable size names the valid window
+    def build3():
+        x = static.data("x", [1, 3, 8, 8], "float32")
+        return snn.conv2d_transpose(x, 4, filter_size=3, stride=2,
+                                    output_size=[40, 40])
+
+    with pytest.raises(ValueError, match="unreachable"):
+        _run(build3, {"x": x_np})
+
+    # string padding cannot derive a kernel: clear error
+    def build4():
+        x = static.data("x", [1, 3, 8, 8], "float32")
+        return snn.conv2d_transpose(x, 4, stride=2, output_size=[16, 16],
+                                    padding="SAME")
+
+    with pytest.raises(ValueError, match="filter_size"):
+        _run(build4, {"x": x_np})
+
+
 def test_py_func_eager_and_lazy():
     doubled = snn.py_func(lambda t: t * 2, paddle.to_tensor(
         np.array([1.0, 2.0], np.float32)), out=None)
