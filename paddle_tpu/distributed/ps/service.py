@@ -107,6 +107,12 @@ def _srv_table_size(table_id):
     return _local.get_table(table_id).size
 
 
+def _srv_table_kind(table_id):
+    from .table import MemoryDenseTable
+    return ("dense" if isinstance(_local.get_table(table_id),
+                                  MemoryDenseTable) else "sparse")
+
+
 def _srv_sparse_dim(table_id):
     return _local.get_table(table_id).emb_dim
 
@@ -145,17 +151,23 @@ class PsRpcClient:
         self._rpc = rpc
         self.servers = list(servers)
         self._sparse_dims = {}
+        # dense tables exist only on servers[0] (create_dense_table), so
+        # save/load/table_size must not fan out for them; kind is cached
+        # here but servers[0] is the source of truth (_srv_table_kind)
+        self._kinds = {}
         if not self.servers:
             raise ValueError("need at least one PS server name")
 
     # -- table management ---------------------------------------------------
     def create_sparse_table(self, table_id, emb_dim, accessor=None, **kw):
+        self._kinds[table_id] = "sparse"
         self._sparse_dims[table_id] = emb_dim
         for s in self.servers:
             self._rpc.rpc_sync(s, _srv_create_sparse,
                                args=(table_id, emb_dim, accessor, kw))
 
     def create_dense_table(self, table_id, shape, accessor=None, **kw):
+        self._kinds[table_id] = "dense"
         self._rpc.rpc_sync(self.servers[0], _srv_create_dense,
                            args=(table_id, shape, accessor, kw))
 
@@ -211,24 +223,36 @@ class PsRpcClient:
                            args=(table_id, np.asarray(grad)))
 
     # -- persistence / lifecycle -------------------------------------------
+    def _table_servers(self, table_id):
+        """Servers holding a shard of ``table_id`` (dense → servers[0] only,
+        mirroring pull_dense/push_dense_grad routing). A client that didn't
+        create the table itself asks servers[0] for the kind — the dense/
+        sparse distinction is server-side truth, not per-client state."""
+        if table_id not in self._kinds:
+            self._kinds[table_id] = self._rpc.rpc_sync(
+                self.servers[0], _srv_table_kind, args=(table_id,))
+        if self._kinds[table_id] == "dense":
+            return self.servers[:1]
+        return self.servers
+
     def save(self, table_id, path):
         # each server saves its shard under a per-shard suffix
         futs = [self._rpc.rpc_async(s, _srv_save,
                                     args=(table_id, f"{path}.shard{i}"))
-                for i, s in enumerate(self.servers)]
+                for i, s in enumerate(self._table_servers(table_id))]
         for f in futs:
             f.result()
 
     def load(self, table_id, path):
         futs = [self._rpc.rpc_async(s, _srv_load,
                                     args=(table_id, f"{path}.shard{i}"))
-                for i, s in enumerate(self.servers)]
+                for i, s in enumerate(self._table_servers(table_id))]
         for f in futs:
             f.result()
 
     def table_size(self, table_id):
         return sum(self._rpc.rpc_sync(s, _srv_table_size, args=(table_id,))
-                   for s in self.servers)
+                   for s in self._table_servers(table_id))
 
     def stop_server(self):
         for s in self.servers:

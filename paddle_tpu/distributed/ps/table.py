@@ -132,3 +132,24 @@ class MemoryDenseTable:
     def push(self, grad):
         self._slots = self.accessor.update(self.param,
                                            np.asarray(grad), self._slots)
+
+    @property
+    def size(self):
+        return int(self.param.size)
+
+    def save(self, path):
+        slots = {f"slot_{s}": np.asarray(self._slots[s])
+                 for s in range(self.accessor.slots)}
+        np.savez(path, param=self.param, **slots)
+
+    def load(self, path):
+        with np.load(path if path.endswith(".npz")
+                     else path + ".npz") as data:
+            self.param = data["param"].astype(np.float32)
+            if self.accessor.slots and "slot_0" in data:
+                self._slots = tuple(data[f"slot_{s}"].astype(np.float32)
+                                    for s in range(self.accessor.slots))
+            else:
+                # no slot state in the file: reset rather than keep stale
+                # accumulator state from before the load (sparse parity)
+                self._slots = self.accessor.init_slots(self.param.shape)

@@ -66,7 +66,16 @@ class _Handler(socketserver.BaseRequestHandler):
             except BaseException:  # ship the full traceback to the caller
                 reply = ("err", traceback.format_exc())
             try:
-                _send_msg(self.request, _serialize(reply))
+                wire = _serialize(reply)
+            except BaseException:
+                # the handler's result doesn't pickle: surface THAT error
+                # instead of dropping the connection on the caller
+                wire = _serialize((
+                    "err",
+                    "rpc reply could not be serialized:\n"
+                    + traceback.format_exc()))
+            try:
+                _send_msg(self.request, wire)
             except (BrokenPipeError, ConnectionError, OSError):
                 return  # caller timed out / went away
 

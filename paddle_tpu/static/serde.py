@@ -9,6 +9,18 @@ name, parameters by value. Op fns serialize by module reference (the
 whole `paddle.*` op surface is module-level); a Program that captured a
 closure op raises with the offending op named — compiled artifacts for
 such programs serialize via ``save_inference_model`` (StableHLO) instead.
+
+.. warning:: **Trust boundary.** Unlike the reference's protobuf
+   ProgramDesc, this wire format embeds Python callables (pickled
+   references and marshal'd code objects) that are **executed** when the
+   program is deserialized and run. Loading a program file is therefore
+   equivalent to importing a Python module: only load programs you (or a
+   party you trust) produced. The outer envelope is parsed with a
+   restricted unpickler that allowlists plain containers + numpy types,
+   so a malformed file cannot instantiate arbitrary classes at parse
+   time — but the op-callable blobs inside it are unrestricted by
+   design. For an artifact that is safe to exchange, use
+   ``save_inference_model`` (StableHLO bytes, no Python code).
 """
 from __future__ import annotations
 
@@ -26,6 +38,40 @@ from .program import LazyNode, Program
 
 _MAGIC = b"PTPROG01"
 _PYTAG = f"{sys.version_info.major}.{sys.version_info.minor}"
+
+
+class _EnvelopeUnpickler(pickle.Unpickler):
+    """Restricted unpickler for the outer payload envelope.
+
+    The envelope holds only containers, scalars, numpy arrays/dtypes and
+    nested ``bytes`` blobs (op callables, deserialized separately under
+    the documented trust model). Anything else — i.e. any attempt to
+    instantiate an arbitrary class at parse time — is rejected.
+    """
+
+    _BUILTINS = {"complex", "set", "frozenset", "slice", "range",
+                 "bytearray"}
+    _NP_FUNCS = {"_reconstruct", "scalar", "_frombuffer"}
+
+    def find_class(self, module, name):
+        if module == "builtins" and name in self._BUILTINS:
+            return super().find_class(module, name)
+        if (module in ("numpy.core.multiarray", "numpy._core.multiarray",
+                       "numpy.core.numeric", "numpy._core.numeric")
+                and name in self._NP_FUNCS):
+            return super().find_class(module, name)
+        if module in ("numpy", "numpy.core", "numpy._core", "ml_dtypes"):
+            obj = super().find_class(module, name)
+            if isinstance(obj, type):  # ndarray, dtype, scalar types (bf16)
+                return obj
+        raise pickle.UnpicklingError(
+            f"program envelope may not reference {module}.{name}; the file "
+            f"is corrupt or was not produced by save_program")
+
+
+def _loads_envelope(blob):
+    import io as _io
+    return _EnvelopeUnpickler(_io.BytesIO(blob)).load()
 
 
 def _serialize_fn(fn, op_name):
@@ -136,10 +182,14 @@ def serialize_program(program: Program, fetch_vars=None) -> bytes:
     for n in program._nodes:
         fn_blob = _serialize_fn(n.fn, n.name)
         try:
-            pickle.dumps(n.kwargs)
+            # validate against the LOAD-time restricted envelope, not just
+            # pickleability — otherwise a program saves fine and then fails
+            # to load with a misleading "corrupt file" error
+            _loads_envelope(pickle.dumps(n.kwargs, protocol=4))
         except Exception as e:
             raise ValueError(
-                f"op {n.name!r} has non-serializable kwargs; serialize "
+                f"op {n.name!r} has kwargs outside the serializable "
+                f"envelope (containers/scalars/numpy only); serialize "
                 f"this program via save_inference_model instead") from e
         nodes_enc.append({
             "name": n.name,
@@ -160,7 +210,16 @@ def serialize_program(program: Program, fetch_vars=None) -> bytes:
     payload = {"nodes": nodes_enc, "feeds": feeds_enc, "params": params,
                "fetches": fetches_enc, "random_seed": program.random_seed,
                "python": _PYTAG}
-    return _MAGIC + pickle.dumps(payload, protocol=4)
+    blob = pickle.dumps(payload, protocol=4)
+    try:
+        # whole-payload check catches const args etc. the per-node kwargs
+        # check can't attribute; producer fails here, not the consumer
+        _loads_envelope(blob)
+    except pickle.UnpicklingError as e:
+        raise ValueError(
+            f"program contains a constant outside the serializable "
+            f"envelope: {e}; serialize via save_inference_model instead")
+    return _MAGIC + blob
 
 
 def _placeholder(shape, dtype, lazy, name=None):
@@ -176,7 +235,7 @@ def deserialize_program(blob: bytes):
     """
     if not blob.startswith(_MAGIC):
         raise ValueError("not a serialized paddle_tpu Program")
-    payload = pickle.loads(blob[len(_MAGIC):])
+    payload = _loads_envelope(blob[len(_MAGIC):])
     def _has_code(enc):
         return enc[0] == "code" or (enc[0] == "amp" and _has_code(enc[3]))
 
@@ -225,12 +284,21 @@ def deserialize_program(blob: bytes):
 
 def save_program(program, path, fetch_vars=None):
     """paddle.static parity: persist the Program structure itself (the
-    reference's .pdmodel ProgramDesc bytes)."""
+    reference's .pdmodel ProgramDesc bytes).
+
+    The file embeds Python code (see module warning): only load it with
+    ``load_program`` in an environment that trusts its producer."""
     with open(path, "wb") as f:
         f.write(serialize_program(program, fetch_vars))
 
 
 def load_program(path):
+    """Load a program saved by ``save_program``.
+
+    .. warning:: Executes embedded Python callables when the program is
+       run (and unpickles them at load time) — only load files you or a
+       trusted party produced. See the module-level trust-boundary note.
+    """
     with open(path, "rb") as f:
         return deserialize_program(f.read())
 

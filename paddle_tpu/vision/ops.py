@@ -22,6 +22,12 @@ __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "RoIAlign", "RoIPool", "ConvNormActivation"]
 
 
+_NMS_DYGRAPH_ONLY = (
+    "nms produces a data-dependent number of boxes and cannot be "
+    "captured in a static Program / jit trace; run it eagerly "
+    "(dygraph) on host-side post-processing")
+
+
 def _iou_matrix(boxes):
     """boxes [N, 4] (x1, y1, x2, y2) -> [N, N] IoU."""
     x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
@@ -44,6 +50,12 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     (boxes of different categories never suppress each other). ``top_k``
     caps the number of returned indices.
     """
+    from ..static.program import is_lazy
+    if is_lazy(boxes) or (scores is not None and is_lazy(scores)) or (
+            category_idxs is not None and is_lazy(category_idxs)):
+        # fail before tracing: the later ._value reads would crash on a
+        # ShapeDtypeStruct/tracer with an opaque error
+        raise RuntimeError(_NMS_DYGRAPH_ONLY)
     if categories is not None and category_idxs is not None:
         import numpy as _np
         cats_np = _np.asarray(category_idxs._value
@@ -85,12 +97,8 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     args = [boxes] + ([scores] if scores is not None else [])
     idx, count = apply(f, *args, op_name="nms")
-    from ..static.program import is_lazy
     if is_lazy(count):
-        raise RuntimeError(
-            "nms produces a data-dependent number of boxes and cannot be "
-            "captured in a static Program / jit trace; run it eagerly "
-            "(dygraph) on host-side post-processing")
+        raise RuntimeError(_NMS_DYGRAPH_ONLY)
     import numpy as np
     iv = np.asarray(idx._value)
     cnt = int(count._value)

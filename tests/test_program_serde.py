@@ -131,3 +131,20 @@ def test_unserializable_capture_raises_clear_error():
             main.serialize_to_string(fetch_vars=[y])
     finally:
         static.disable_static()
+
+
+def test_envelope_rejects_arbitrary_classes():
+    """The outer payload envelope must not instantiate arbitrary classes
+    (round-2 advice: loading untrusted bytes shouldn't execute at parse
+    time — op blobs are gated behind the documented trust model)."""
+    import os
+    import pickle
+    from paddle_tpu.static import serde
+
+    class Evil:
+        def __reduce__(self):
+            return (os.path.join, ("pwn", "ed"))
+
+    blob = serde._MAGIC + pickle.dumps({"nodes": Evil()})
+    with pytest.raises(pickle.UnpicklingError, match="may not reference"):
+        serde.deserialize_program(blob)

@@ -75,6 +75,16 @@ def test_ps_service_end_to_end(tmp_path):
         np.testing.assert_allclose(client.pull_dense(1), dense - 0.01,
                                    rtol=1e-5)
 
+        # dense tables live only on servers[0]: save/load/table_size must
+        # route there instead of fanning out (round-2 advice — a fan-out
+        # raised a remote KeyError on ps1)
+        client.table_size(1)
+        client.save(1, str(tmp_path / "d1"))
+        client.push_dense_grad(1, np.ones(3, np.float32))  # diverge
+        client.load(1, str(tmp_path / "d1"))
+        np.testing.assert_allclose(client.pull_dense(1), dense - 0.01,
+                                   rtol=1e-5)
+
         # save/load shard round trip
         client.save(0, str(tmp_path / "t0"))
         client.push_sparse_grad(0, ids, grads)  # diverge

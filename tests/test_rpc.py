@@ -31,6 +31,10 @@ def _boom():
     raise ValueError("remote kaboom")
 
 
+def _unpicklable_reply():
+    return lambda x: x  # local lambdas don't pickle
+
+
 @pytest.fixture
 def rpc_world1():
     from paddle_tpu.distributed import rpc
@@ -60,6 +64,18 @@ def test_rpc_remote_exception_and_infos(rpc_world1):
     assert rpc.get_all_worker_infos() == [me]
     with pytest.raises(ValueError):
         rpc.rpc_sync("nosuch", _add, args=(1, 2))
+
+
+def test_rpc_unpicklable_reply_is_diagnosable(rpc_world1):
+    """A result that fails to pickle must surface the serialization error
+    to the caller, not kill the handler thread/connection (round-2
+    advice)."""
+    rpc = rpc_world1
+    with pytest.raises(RuntimeError,
+                       match="reply could not be serialized"):
+        rpc.rpc_sync("worker0", _unpicklable_reply)
+    # and the connection stays usable afterwards
+    assert rpc.rpc_sync("worker0", _add, args=(1, 2)) == 3
 
 
 def test_rpc_two_processes(tmp_path):
