@@ -25,3 +25,5 @@ from . import auto_parallel  # noqa: F401,E402
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401,E402
 from . import ps  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+from . import fleet_executor  # noqa: F401,E402

@@ -12,3 +12,9 @@ happen in the compiler. What remains here is the thin user surface.
 """
 from .interface import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .cost_model import (  # noqa: F401
+    Cluster, Cost, CostEstimator, ModelSpec,
+)
+from .tuner import (  # noqa: F401
+    OptimizationTuner, ParallelTuner, Trial, TrialStatus, TunableSpace,
+)

@@ -1,0 +1,265 @@
+"""Parallel-strategy tuner over the analytic cost model.
+
+Parity: ``/root/reference/python/paddle/distributed/auto_parallel/tuner/``
+— ``tunable_space.py:21 TunableSpace`` / ``trial.py:34 Trial`` search
+primitives, ``parallel_tuner.py`` (mesh-shape search) and
+``optimization_tuner.py:196 OptimizationTuner`` (pass-config search,
+profile-driven). The TPU build searches the same space — (dp, mp, pp,
+sharding, micro_batches, recompute) — but scores candidates with the
+closed-form roofline ``CostEstimator`` instead of launching profiling
+jobs, so a full sweep over every divisor factorization of the slice is
+instant and deterministic.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+
+from .cost_model import Cluster, Cost, CostEstimator, ModelSpec
+
+__all__ = ["TunableSpace", "Trial", "TrialStatus", "ParallelTuner",
+           "OptimizationTuner"]
+
+
+class _Variable:
+    def __init__(self, name, default):
+        self.name = name
+        self.default = default
+
+    def random_value(self, rng):
+        return self.default
+
+
+class _Fixed(_Variable):
+    pass
+
+
+class _Boolean(_Variable):
+    def __init__(self, name, default=False):
+        super().__init__(name, default)
+
+    def random_value(self, rng):
+        return bool(rng.getrandbits(1))
+
+
+class _Choice(_Variable):
+    def __init__(self, name, values, default=None):
+        if not values:
+            raise ValueError("choice needs at least one value")
+        super().__init__(name, values[0] if default is None else default)
+        self.values = list(values)
+
+    def random_value(self, rng):
+        return rng.choice(self.values)
+
+
+class _IntRange(_Variable):
+    def __init__(self, name, start, stop, step=1, default=None):
+        super().__init__(name, start if default is None else default)
+        self.start, self.stop, self.step = start, stop, step
+
+    def random_value(self, rng):
+        return rng.randrange(self.start, self.stop, self.step)
+
+
+class _FloatRange(_Variable):
+    def __init__(self, name, start, stop, step=None, default=None):
+        super().__init__(name, start if default is None else default)
+        self.start, self.stop, self.step = start, stop, step
+
+    def random_value(self, rng):
+        if self.step:
+            n = int((self.stop - self.start) / self.step)
+            return self.start + self.step * rng.randrange(n + 1)
+        return rng.uniform(self.start, self.stop)
+
+
+class TunableSpace:
+    """Declarative hyper-space (reference tunable_space.py:21)."""
+
+    def __init__(self):
+        self._variables = {}
+        self._values = {}
+
+    @property
+    def variables(self):
+        return self._variables
+
+    @property
+    def values(self):
+        return self._values
+
+    def _register(self, tv):
+        if tv.name not in self._variables:
+            self._variables[tv.name] = tv
+            self._values[tv.name] = tv.default
+        return self._values[tv.name]
+
+    def fixed(self, name, default):
+        return self._register(_Fixed(name, default))
+
+    def boolean(self, name, default=False):
+        return self._register(_Boolean(name, default))
+
+    def choice(self, name, values, default=None):
+        return self._register(_Choice(name, values, default))
+
+    def int_range(self, name, start, stop, step=1, default=None):
+        return self._register(_IntRange(name, start, stop, step, default))
+
+    def float_range(self, name, start, stop, step=None, default=None):
+        return self._register(_FloatRange(name, start, stop, step,
+                                          default))
+
+    def get_value(self, name):
+        return self._values[name]
+
+    def set_value(self, name, value):
+        if name not in self._variables:
+            raise KeyError(name)
+        self._values[name] = value
+
+    def sample(self, rng):
+        return {n: v.random_value(rng) for n, v in self._variables.items()}
+
+    def __contains__(self, name):
+        return name in self._variables
+
+    def __getitem__(self, name):
+        return self.get_value(name)
+
+    def __setitem__(self, name, value):
+        self.set_value(name, value)
+
+
+class TrialStatus:
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    STOPPED = "STOPPED"
+    INVALID = "INVALID"
+
+
+class Trial:
+    """One evaluated candidate (reference trial.py:34)."""
+
+    def __init__(self, space_values, trial_id=None):
+        self.values = dict(space_values)
+        self.id = trial_id
+        self.status = TrialStatus.RUNNING
+        self.cost: Cost = None
+        self.metrics = {}
+
+    def __repr__(self):
+        return f"Trial({self.values}, {self.cost}, {self.status})"
+
+
+def _factorizations(n, ways):
+    """All ordered tuples of `ways` ints >= 1 whose product is n."""
+    if ways == 1:
+        yield (n,)
+        return
+    for d in sorted({d for d in range(1, n + 1) if n % d == 0}):
+        for rest in _factorizations(n // d, ways - 1):
+            yield (d,) + rest
+
+
+class ParallelTuner:
+    """Search mesh axis degrees for a model on a cluster
+    (reference parallel_tuner.py, scored analytically).
+
+    ``tune()`` sweeps every (dp, mp, pp, sharding) factorization of the
+    slice x micro-batch/recompute choices, drops candidates that exceed
+    chip memory, and returns the fastest feasible trial.
+    """
+
+    def __init__(self, spec: ModelSpec, cluster: Cluster,
+                 global_batch=None, max_mp=8, max_pp=None,
+                 micro_batch_choices=(1, 2, 4, 8, 16),
+                 mem_headroom=0.9):
+        self.spec = spec
+        self.cluster = cluster
+        self.global_batch = global_batch or cluster.num_devices
+        self.max_mp = max_mp
+        self.max_pp = max_pp or spec.layers
+        self.micro_batch_choices = micro_batch_choices
+        self.mem_headroom = mem_headroom
+        self.trials = []
+
+    def _candidates(self):
+        n = self.cluster.num_devices
+        for dp, mp, pp, sh in _factorizations(n, 4):
+            if mp > self.max_mp or pp > self.max_pp:
+                continue
+            if self.spec.layers % pp:
+                continue
+            batch_per_dp = self.global_batch // max(dp * sh, 1)
+            if batch_per_dp < 1 or self.global_batch % max(dp * sh, 1):
+                continue
+            for mb in self.micro_batch_choices:
+                if batch_per_dp % mb or (pp > 1 and mb < pp):
+                    continue
+                for rc in (False, True):
+                    yield {"dp": dp, "mp": mp, "pp": pp,
+                           "sharding": sh, "micro_batches": mb,
+                           "global_batch": self.global_batch,
+                           "recompute": rc}
+
+    def tune(self, top_k=1):
+        est = CostEstimator(self.spec, self.cluster)
+        budget = self.cluster.hbm_bytes * self.mem_headroom
+        best = []
+        for i, cand in enumerate(self._candidates()):
+            t = Trial(cand, trial_id=i)
+            t.cost = est.estimate(cand)
+            t.status = (TrialStatus.COMPLETED
+                        if t.cost.memory_bytes <= budget
+                        else TrialStatus.INVALID)
+            self.trials.append(t)
+            if t.status == TrialStatus.COMPLETED:
+                best.append(t)
+        if not best:
+            raise RuntimeError(
+                "no feasible strategy fits chip memory; grow the slice "
+                "or enable more sharding/recompute")
+        best.sort(key=lambda t: t.cost.time_ms)
+        return best[0] if top_k == 1 else best[:top_k]
+
+
+class OptimizationTuner:
+    """Random search over a user TunableSpace with a user objective
+    (reference optimization_tuner.py:196 shape: trials + early stop),
+    for tuning pass configs the analytic model can't rank."""
+
+    def __init__(self, space_builder, objective, max_trials=20, seed=0):
+        self.space_builder = space_builder
+        self.objective = objective
+        self.max_trials = max_trials
+        self.rng = random.Random(seed)
+        self.trials = []
+
+    def tune(self):
+        space = TunableSpace()
+        self.space_builder(space)
+        seen = set()
+        best = None
+        for i in range(self.max_trials):
+            values = space.sample(self.rng)
+            key = tuple(sorted(values.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            t = Trial(values, trial_id=i)
+            try:
+                t.metrics["objective"] = float(self.objective(values))
+                t.status = TrialStatus.COMPLETED
+            except Exception:
+                t.status = TrialStatus.INVALID
+                self.trials.append(t)
+                continue
+            self.trials.append(t)
+            if best is None or (t.metrics["objective"]
+                                < best.metrics["objective"]):
+                best = t
+        if best is None:
+            raise RuntimeError("every trial failed")
+        return best

@@ -1,0 +1,8 @@
+from .data_generator import (  # noqa: F401
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]

@@ -1,0 +1,146 @@
+"""User-side ETL protocol for fleet datasets.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/data_generator/
+data_generator.py:20`` — a user subclass turns raw input lines into
+MultiSlot wire text that the dataset feed layer parses. The wire format
+is unchanged (``<n> v1 .. vn`` per slot, slots space-joined per sample)
+so pipe commands written for the reference work against the TPU build's
+datasets verbatim.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base ETL protocol: override ``generate_sample`` (and optionally
+    ``generate_batch``), then drive with ``run_from_stdin`` inside a
+    dataset ``pipe_command`` or ``run_from_memory`` for tests."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a callable yielding ``[(slot_name, [values...]), ...]``
+        samples parsed from one raw input ``line``."""
+        raise NotImplementedError(
+            "generate_sample() must be implemented by the subclass")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook: receives ``batch_size_`` samples,
+        yields (possibly transformed) samples."""
+
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    # -- drivers ------------------------------------------------------------
+    def _emit(self, samples, out):
+        for sample in self.generate_batch(samples)():
+            out.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        """Read raw lines from stdin, write MultiSlot wire text to stdout
+        (the reference's pipe_command entry point)."""
+        batch = []
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    self._emit(batch, sys.stdout)
+                    batch = []
+        if batch:
+            self._emit(batch, sys.stdout)
+
+    def run_from_memory(self):
+        """In-process variant of run_from_stdin: generate_sample(None)."""
+        batch = []
+        it = self.generate_sample(None)
+        for sample in it():
+            if sample is None:
+                continue
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                self._emit(batch, sys.stdout)
+                batch = []
+        if batch:
+            self._emit(batch, sys.stdout)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+def _check_sample(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample must be a list or tuple of "
+            "(name, values) pairs, e.g. [('words', [1926, 8, 17]), "
+            "('label', [1])]")
+    return line
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Values are emitted verbatim as strings (no numeric check)."""
+
+    def _gen_str(self, line):
+        line = _check_sample(line)
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots; records per-slot type like the reference proto_info
+    (ints promote to float if any float is ever seen for the slot)."""
+
+    def _gen_str(self, line):
+        line = _check_sample(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(f"slot name {name!r} must be str")
+                if not isinstance(elements, list) or not elements:
+                    raise ValueError(
+                        f"slot {name!r} must carry a non-empty list; pad "
+                        f"empty slots in generate_sample")
+                kind = ("float" if any(isinstance(e, float)
+                                       for e in elements) else "uint64")
+                self._proto_info.append((name, kind))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"sample has {len(line)} slots; earlier samples had "
+                    f"{len(self._proto_info)}")
+            for i, (name, elements) in enumerate(line):
+                if name != self._proto_info[i][0]:
+                    raise ValueError(
+                        f"slot {i} name changed from "
+                        f"{self._proto_info[i][0]!r} to {name!r}")
+                if self._proto_info[i][1] == "uint64" and any(
+                        isinstance(e, float) for e in elements):
+                    self._proto_info[i] = (name, "float")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(repr(e) if isinstance(e, float) else str(e)
+                         for e in elements)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,10 @@
+from .dataset import (  # noqa: F401
+    DatasetBase,
+    FileInstantDataset,
+    InMemoryDataset,
+    QueueDataset,
+)
+from .index_dataset import TreeIndex  # noqa: F401
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "FileInstantDataset", "TreeIndex"]

@@ -1,0 +1,216 @@
+"""Tree-index retrieval dataset (TDM) — TreeIndex parity.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/dataset/
+index_dataset.py:24 TreeIndex`` over the C++ index wrapper
+(``paddle/fluid/distributed/index_dataset/index_wrapper.cc``). The
+reference loads a protobuf tree file; the TPU build additionally offers
+``TreeIndex.from_leaves`` to build the complete ``branch``-ary tree
+in-process (the index is host-side metadata — nothing here touches the
+chip; layerwise_sample emits numpy batches that feed the compiled step).
+
+Code scheme (reference index_wrapper semantics): root code 0; the
+children of code ``c`` are ``c*branch + 1 .. c*branch + branch``; level
+of ``c`` is the depth from the root (root level 0). Leaf item ids map to
+leaf codes; embedding rows are indexed by code.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["Index", "TreeIndex"]
+
+Node = namedtuple("Node", ["id", "code", "is_leaf", "probability"])
+
+
+class Index:
+    def __init__(self, name):
+        self._name = name
+
+
+class TreeIndex(Index):
+    """name + either a saved .npz path (``TreeIndex(name, path)``, matching
+    the reference constructor) or ``from_leaves``."""
+
+    def __init__(self, name, path=None):
+        super().__init__(name)
+        self._nodes = {}          # code -> Node
+        self._id_code = {}        # leaf item id -> code
+        self._branch = 2
+        self._height = 0
+        self._sampler_layer_counts = None
+        self._sampler_start_layer = 1
+        self._sampler_rng = np.random.default_rng(0)
+        if path is not None:
+            self.load(path)
+
+    # -- construction / persistence -----------------------------------------
+    @classmethod
+    def from_leaves(cls, name, leaf_ids, branch=2):
+        """Build the complete branch-ary tree over ``leaf_ids`` (assigned
+        left-to-right on the deepest level)."""
+        self = cls(name)
+        self._branch = int(branch)
+        n = len(leaf_ids)
+        if n == 0:
+            raise ValueError("need at least one leaf id")
+        height = 0
+        while branch ** height < n:
+            height += 1
+        self._height = height
+        first = self._level_first(height)
+        for i, lid in enumerate(leaf_ids):
+            code = first + i
+            self._id_code[int(lid)] = code
+            self._nodes[code] = Node(int(lid), code, True, 1.0)
+        # internal nodes get synthetic ids above the max leaf id
+        next_id = max((int(i) for i in leaf_ids), default=0) + 1
+        for level in range(height - 1, -1, -1):
+            for code in range(self._level_first(level),
+                              self._level_first(level + 1)):
+                kids = [code * branch + k + 1 for k in range(branch)]
+                if any(k in self._nodes for k in kids):
+                    self._nodes[code] = Node(next_id, code, False, 1.0)
+                    next_id += 1
+        return self
+
+    def save(self, path):
+        codes = sorted(self._nodes)
+        np.savez(path,
+                 branch=self._branch, height=self._height,
+                 codes=np.array(codes, np.int64),
+                 ids=np.array([self._nodes[c].id for c in codes], np.int64),
+                 leaf=np.array([self._nodes[c].is_leaf for c in codes],
+                               bool))
+
+    def load(self, path):
+        with np.load(path if str(path).endswith(".npz")
+                     else str(path) + ".npz") as d:
+            self._branch = int(d["branch"])
+            self._height = int(d["height"])
+            self._nodes = {}
+            self._id_code = {}
+            for code, nid, leaf in zip(d["codes"], d["ids"], d["leaf"]):
+                node = Node(int(nid), int(code), bool(leaf), 1.0)
+                self._nodes[int(code)] = node
+                if leaf:
+                    self._id_code[int(nid)] = int(code)
+
+    # -- structure queries (reference surface) ------------------------------
+    def _level_first(self, level):
+        # first code on `level` of a complete branch-ary tree
+        b = self._branch
+        return (b ** level - 1) // (b - 1) if b > 1 else level
+
+    def _level_of(self, code):
+        level = 0
+        while code >= self._level_first(level + 1):
+            level += 1
+        return level
+
+    def height(self):
+        return self._height + 1  # reference counts levels, root inclusive
+
+    def branch(self):
+        return self._branch
+
+    def total_node_nums(self):
+        return len(self._nodes)
+
+    def emb_size(self):
+        """Embedding table size: one row per possible code (max code + 1)."""
+        return max(self._nodes) + 1 if self._nodes else 0
+
+    def get_all_leafs(self):
+        return [n for n in self._nodes.values() if n.is_leaf]
+
+    def get_nodes(self, codes):
+        return [self._nodes[int(c)] for c in codes]
+
+    def get_layer_codes(self, level):
+        lo, hi = self._level_first(level), self._level_first(level + 1)
+        return [c for c in range(lo, hi) if c in self._nodes]
+
+    def get_travel_codes(self, id, start_level=0):
+        """Leaf-to-ancestor path codes for item ``id``, stopping at
+        ``start_level`` (leaf first, reference order)."""
+        code = self._id_code[int(id)]
+        out = []
+        while self._level_of(code) >= start_level:
+            out.append(code)
+            if code == 0:
+                break
+            code = (code - 1) // self._branch
+        return out
+
+    def get_ancestor_codes(self, ids, level):
+        out = []
+        for i in ids:
+            code = self._id_code[int(i)]
+            while self._level_of(code) > level:
+                code = (code - 1) // self._branch
+            out.append(code)
+        return out
+
+    def get_children_codes(self, ancestor, level):
+        """All descendant codes of ``ancestor`` living on ``level``."""
+        frontier = [int(ancestor)]
+        cur = self._level_of(int(ancestor))
+        while cur < level:
+            frontier = [c * self._branch + k + 1 for c in frontier
+                        for k in range(self._branch)]
+            cur += 1
+        return [c for c in frontier if c in self._nodes]
+
+    def get_travel_path(self, child, ancestor):
+        """Codes strictly between child (inclusive) and ancestor
+        (exclusive), walking up."""
+        out = []
+        code = int(child)
+        while code != int(ancestor):
+            out.append(code)
+            code = (code - 1) // self._branch
+        return out
+
+    def get_pi_relation(self, ids, level):
+        return dict(zip([int(i) for i in ids],
+                        self.get_ancestor_codes(ids, level)))
+
+    # -- layerwise sampler (reference init_layerwise_sampler) ---------------
+    def init_layerwise_sampler(self, layer_sample_counts,
+                               start_sample_layer=1, seed=0):
+        expected = self._height + 1 - start_sample_layer
+        if len(layer_sample_counts) != expected:
+            raise ValueError(
+                f"layer_sample_counts must list {expected} layers "
+                f"(levels {start_sample_layer}..{self._height})")
+        self._sampler_layer_counts = list(layer_sample_counts)
+        self._sampler_start_layer = start_sample_layer
+        self._sampler_rng = np.random.default_rng(seed)
+
+    def layerwise_sample(self, user_input, index_input,
+                         with_hierarchy=False):
+        """Per (user features, positive leaf id) pair, emit one positive +
+        N sampled negatives per tree level:
+        ``[user..., travel_code, label]`` rows (reference semantics)."""
+        if self._sampler_layer_counts is None:
+            raise RuntimeError("call init_layerwise_sampler first")
+        out = []
+        for user, pos_id in zip(user_input, index_input):
+            user = list(user)
+            travel = self.get_travel_codes(int(pos_id),
+                                           self._sampler_start_layer)
+            for lvl_idx, pos_code in enumerate(reversed(travel)):
+                level = self._sampler_start_layer + lvl_idx
+                n_neg = self._sampler_layer_counts[lvl_idx]
+                layer = self.get_layer_codes(level)
+                cands = [c for c in layer if c != pos_code]
+                out.append(user + [pos_code, 1])
+                if cands:
+                    picks = self._sampler_rng.choice(
+                        len(cands), size=min(n_neg, len(cands)),
+                        replace=len(cands) < n_neg)
+                    for p in np.atleast_1d(picks):
+                        out.append(user + [cands[int(p)], 0])
+        return out

@@ -1,0 +1,10 @@
+from .fleet_executor import (  # noqa: F401
+    Carrier,
+    FleetExecutor,
+    Interceptor,
+    MessageBus,
+    TaskNode,
+)
+
+__all__ = ["FleetExecutor", "TaskNode", "Carrier", "Interceptor",
+           "MessageBus"]

@@ -145,6 +145,43 @@ class Executor:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training pass (reference executor.py
+        train_from_dataset over the C++ trainer loop): one epoch over the
+        fleet dataset, running the program per batch. ``fetch_list`` vars
+        are printed every ``print_period`` batches labeled by
+        ``fetch_info``."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        fetch_list = list(fetch_list or [])
+        names = list(fetch_info or [t.name or f"fetch{i}"
+                                    for i, t in enumerate(fetch_list)])
+        var_names = {name for name, _, _ in
+                     getattr(dataset, "use_var", [])} or None
+        for step, batch in enumerate(dataset):
+            # keep '<name>.lod' offsets of ragged slots alongside their
+            # value vectors — programs over lod data feed both
+            feed = {k: v for k, v in batch.items()
+                    if var_names is None or k in var_names
+                    or (k.endswith(".lod") and k[:-4] in var_names)}
+            outs = self.run(program, feed=feed, fetch_list=fetch_list)
+            if (debug or fetch_list) and (step + 1) % print_period == 0:
+                msg = ", ".join(f"{n}={np.asarray(o).mean():.6f}"
+                                for n, o in zip(names, outs))
+                print(f"[train_from_dataset] step {step + 1}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference pass over a dataset (the program carries no optimize
+        ops, so running it is side-effect-free — reference parity)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def _build(self, program: Program, fetch_list, feed_vals):
         nodes, params = _collect_graph(
             fetch_list + [loss for _, loss in program._optimize_ops])

@@ -1,0 +1,118 @@
+"""auto_parallel cost model + tuner (SURVEY §2.4 "auto-parallel tuner":
+CostEstimator / TunableSpace / Trial / ParallelTuner / OptimizationTuner
+— analytic roofline scoring instead of profile jobs)."""
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import (
+    Cluster, CostEstimator, ModelSpec, OptimizationTuner, ParallelTuner,
+    TrialStatus, TunableSpace)
+from paddle_tpu.distributed.auto_parallel.tuner import _factorizations
+
+
+def _gpt13b():
+    return ModelSpec(hidden=5120, layers=40, seq_len=2048,
+                     vocab_size=50304)
+
+
+def _gpt345m():
+    return ModelSpec(hidden=1024, layers=24, seq_len=1024,
+                     vocab_size=50304)
+
+
+def test_model_spec_params():
+    # 13B-class config lands in the 12-14B window
+    assert 12e9 < _gpt13b().n_params < 14.5e9
+    assert 0.3e9 < _gpt345m().n_params < 0.5e9
+
+
+def test_factorizations_cover_and_multiply():
+    facs = list(_factorizations(8, 4))
+    assert all(a * b * c * d == 8 for a, b, c, d in facs)
+    assert (8, 1, 1, 1) in facs and (1, 2, 2, 2) in facs
+    assert len(set(facs)) == len(facs)
+
+
+def test_cost_estimator_rejects_wrong_world():
+    est = CostEstimator(_gpt345m(), Cluster.v5e(8))
+    with pytest.raises(ValueError, match="devices"):
+        est.estimate({"dp": 4, "global_batch": 8})
+
+
+def test_memory_model_monotonic_in_sharding():
+    est = CostEstimator(_gpt13b(), Cluster.v5p(32))
+    base = {"dp": 1, "mp": 4, "pp": 4, "global_batch": 32,
+            "micro_batches": 8}
+    m1 = est.estimate({**base, "sharding": 2}).memory_bytes
+    m2 = est.estimate({**base, "dp": 2, "sharding": 1}).memory_bytes
+    assert m1 < m2  # ZeRO shards optimizer state; plain dp replicates
+
+
+def test_pipeline_bubble_shrinks_with_microbatches():
+    est = CostEstimator(_gpt13b(), Cluster.v5p(32))
+    st = {"dp": 2, "mp": 4, "pp": 4, "sharding": 1, "global_batch": 64}
+    t4 = est.estimate({**st, "micro_batches": 4}).time_ms
+    t16 = est.estimate({**st, "micro_batches": 16}).time_ms
+    assert t16 < t4
+
+
+def test_13b_pure_dp_does_not_fit_one_chip():
+    """13B Adam state alone (~150GB) exceeds a v5p chip: the tuner must
+    not pick dp-only."""
+    est = CostEstimator(_gpt13b(), Cluster.v5p(32))
+    dp_only = est.estimate({"dp": 32, "global_batch": 32})
+    assert dp_only.memory_bytes > Cluster.v5p(32).hbm_bytes
+
+
+def test_parallel_tuner_picks_feasible_hybrid_for_13b():
+    cluster = Cluster.v5p(32)
+    tuner = ParallelTuner(_gpt13b(), cluster, global_batch=64)
+    best = tuner.tune()
+    st = best.values
+    assert (st["dp"] * st["mp"] * st["pp"] * st["sharding"]
+            == cluster.num_devices)
+    assert best.cost.memory_bytes <= cluster.hbm_bytes * 0.9
+    # 13B on 32 chips demands model/pipeline/sharding help
+    assert st["mp"] * st["pp"] * st["sharding"] > 1
+    # every completed trial fits; every oversized one is INVALID
+    assert all(t.cost.memory_bytes <= cluster.hbm_bytes * 0.9
+               for t in tuner.trials
+               if t.status == TrialStatus.COMPLETED)
+    assert any(t.status == TrialStatus.INVALID for t in tuner.trials)
+
+
+def test_parallel_tuner_small_model_prefers_data_parallel():
+    """345M fits everywhere: the fastest plan should not waste chips on
+    mp/pp (comm/bubble cost with zero memory need)."""
+    best = ParallelTuner(_gpt345m(), Cluster.v5e(8),
+                         global_batch=64).tune()
+    assert best.values["mp"] == 1 and best.values["pp"] == 1
+
+
+def test_parallel_tuner_infeasible_raises():
+    tiny = Cluster(num_devices=1, peak_flops=197e12,
+                   hbm_bytes=1e9)  # 1GB chip: 13B can never fit
+    with pytest.raises(RuntimeError, match="feasible"):
+        ParallelTuner(_gpt13b(), tiny, global_batch=8).tune()
+
+
+def test_tunable_space_and_optimization_tuner():
+    space = TunableSpace()
+    assert space.fixed("stages", 2) == 2
+    assert space.boolean("fuse") is False
+    assert space.choice("mb", [1, 2, 4]) == 1
+    assert space.int_range("depth", 1, 8) == 1
+    space["mb"] = 4
+    assert space["mb"] == 4 and "mb" in space
+    with pytest.raises(KeyError):
+        space.set_value("nope", 1)
+
+    def build(s):
+        s.choice("x", [1, 2, 3, 4])
+        s.boolean("neg")
+
+    # objective minimized at x=4, neg=True -> -4
+    best = OptimizationTuner(
+        build, lambda v: -v["x"] if v["neg"] else v["x"],
+        max_trials=64, seed=0).tune()
+    assert best.metrics["objective"] == -4
+    assert best.values == {"x": 4, "neg": True}

@@ -1,0 +1,312 @@
+"""fleet.dataset / data_generator / TreeIndex (PS data pipeline parity —
+SURVEY §2.4 "PS data pipeline": InMemoryDataset/QueueDataset wrap the
+MultiSlot wire format; DataGenerator is the user ETL protocol; TreeIndex
+is the TDM retrieval index)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed import InMemoryDataset, QueueDataset
+from paddle_tpu.distributed.fleet import TreeIndex
+from paddle_tpu.distributed.fleet.data_generator import (
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _SlotVar:
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, shape, dtype
+
+
+def _write_slot_file(path, n, dim=3, seed=0):
+    """n lines of 'dim x... 1 label' MultiSlot text."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        xs = rng.standard_normal(dim)
+        label = int(rng.integers(0, 2))
+        lines.append(f"{dim} " + " ".join(f"{v:.6f}" for v in xs)
+                     + f" 1 {label}")
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _vars():
+    return [_SlotVar("x", [-1, 3], "float32"),
+            _SlotVar("label", [-1, 1], "int64")]
+
+
+def test_in_memory_dataset_batches(tmp_path):
+    f1, f2 = tmp_path / "a.txt", tmp_path / "b.txt"
+    _write_slot_file(f1, 5, seed=1)
+    _write_slot_file(f2, 6, seed=2)
+    ds = InMemoryDataset()
+    ds.init(batch_size=4, thread_num=2, use_var=_vars())
+    ds.set_filelist([str(f1), str(f2)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 11
+    batches = list(ds)
+    assert len(batches) == 2  # 11 samples -> two full batches of 4
+    assert batches[0]["x"].shape == (4, 3)
+    assert batches[0]["x"].dtype == np.float32
+    assert batches[0]["label"].shape == (4, 1)
+    assert batches[0]["label"].dtype == np.int64
+
+    before = [b["x"].copy() for b in batches]
+    ds.local_shuffle()
+    after = [b["x"] for b in ds]
+    assert not all(np.array_equal(a, b) for a, b in zip(before, after))
+    ds.release_memory()
+    with pytest.raises(RuntimeError, match="load_into_memory"):
+        next(iter(ds))
+
+
+def test_preload_and_global_shuffle_single_trainer(tmp_path):
+    f = tmp_path / "a.txt"
+    _write_slot_file(f, 8)
+    ds = InMemoryDataset()
+    ds.init(batch_size=2, use_var=_vars())
+    ds.set_filelist([str(f)])
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    ds.global_shuffle()  # world=1: plain shuffle, keeps all samples
+    assert ds.get_shuffle_data_size() == 8
+
+
+def test_queue_dataset_streams(tmp_path):
+    f = tmp_path / "a.txt"
+    _write_slot_file(f, 7)
+    ds = QueueDataset()
+    ds.init(batch_size=3, use_var=_vars())
+    ds.set_filelist([str(f)])
+    assert len(list(ds)) == 2  # 7 -> 2 full batches, tail dropped
+
+
+def test_ragged_slot_gets_lod(tmp_path):
+    f = tmp_path / "r.txt"
+    f.write_text("2 10 11 1 0\n3 20 21 22 1 1\n")
+    ds = QueueDataset()
+    ds.init(batch_size=2, use_var=[_SlotVar("ids", [-1], "int64"),
+                                   _SlotVar("label", [-1, 1], "int64")])
+    ds.set_filelist([str(f)])
+    (batch,) = list(ds)
+    np.testing.assert_array_equal(batch["ids"], [10, 11, 20, 21, 22])
+    np.testing.assert_array_equal(batch["ids.lod"], [0, 2, 5])
+
+
+def test_pipe_command_runs_data_generator(tmp_path):
+    """pipe_command parity: raw lines are transformed by a DataGenerator
+    subprocess exactly like the reference data_feed."""
+    raw = tmp_path / "raw.txt"
+    raw.write_text("1 2 3 0\n4 5 6 1\n")
+    gen = tmp_path / "gen.py"
+    gen.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        from paddle_tpu.distributed.fleet.data_generator import \\
+            MultiSlotDataGenerator
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    toks = [float(t) for t in line.split()]
+                    yield [("x", toks[:3]), ("label", [int(toks[3])])]
+                return it
+
+        G().run_from_stdin()
+    """))
+    ds = QueueDataset()
+    ds.init(batch_size=2, use_var=_vars(),
+            pipe_command=f"{sys.executable} {gen}")
+    ds.set_filelist([str(raw)])
+    (batch,) = list(ds)
+    np.testing.assert_allclose(batch["x"], [[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_array_equal(batch["label"], [[0], [1]])
+
+
+def test_data_generator_wire_format(capsys):
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", [19, 26, 8]), ("label", [1])]
+            return it
+
+    g = G()
+    g.set_batch(1)
+    import io
+    g._emit([[("words", [19, 26, 8]), ("label", [1])]], sys.stdout)
+    out = capsys.readouterr().out
+    assert out == "3 19 26 8 1 1\n"
+    # slot count / name drift is rejected
+    with pytest.raises(ValueError, match="slots"):
+        g._gen_str([("words", [1])])
+
+    s = MultiSlotStringDataGenerator()
+    assert s._gen_str([("q", ["a", "b"]), ("l", ["1"])]) == "2 a b 1 1\n"
+
+
+def test_train_from_dataset(tmp_path):
+    f = tmp_path / "train.txt"
+    _write_slot_file(f, 16, seed=3)
+    ds = InMemoryDataset()
+    ds.init(batch_size=4, use_var=_vars())
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+
+    static.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            y = static.data("label", [4, 1], "int64")
+            lin = paddle.nn.Linear(3, 2)
+            loss = paddle.nn.functional.cross_entropy(
+                lin(x), y.reshape([4]))
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        w0 = lin.weight.numpy().copy()
+        exe.train_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=2)
+        assert not np.allclose(lin.weight.numpy(), w0)  # params moved
+    finally:
+        static.disable_static()
+
+
+def test_global_shuffle_reshards_disjoint_filelists(tmp_path, monkeypatch):
+    """Two trainers with DISJOINT filelists exchange through the
+    TCPStore: after global_shuffle the union is preserved and split
+    evenly (the reference's gloo reshard — no sample may be dropped)."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    fa, fb = tmp_path / "a.txt", tmp_path / "b.txt"
+    _write_slot_file(fa, 6, seed=1)
+    _write_slot_file(fb, 4, seed=2)
+    port = _free_port_ds()
+    child = tmp_path / "gs_child.py"
+    child.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from paddle_tpu.distributed import InMemoryDataset
+
+        class V:
+            def __init__(s, n, sh, dt): s.name, s.shape, s.dtype = n, sh, dt
+
+        ds = InMemoryDataset()
+        ds.init(batch_size=1, use_var=[V("x", [-1, 3], "float32"),
+                                       V("label", [-1, 1], "int64")])
+        ds.set_filelist([{str(fb)!r}])  # trainer 1 sees ONLY file b
+        ds.load_into_memory()
+        ds.global_shuffle()
+        tot = sum(float(s[0].sum()) for s in ds._samples)
+        print("CHILD", len(ds._samples), round(tot, 4), flush=True)
+    """))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PADDLE_TRAINERS_NUM": "2", "PADDLE_TRAINER_ID": "1",
+           "PADDLE_MASTER_ENDPOINT": f"127.0.0.1:{port}"}
+    proc = subprocess.Popen([sys.executable, str(child)], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_MASTER_ENDPOINT", f"127.0.0.1:{port}")
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    try:
+        ds = InMemoryDataset()
+        ds.init(batch_size=1, use_var=_vars())
+        ds.set_filelist([str(fa)])  # trainer 0 sees ONLY file a
+        ds.load_into_memory()
+        ds.global_shuffle(store=store)
+        my_n = len(ds._samples)
+        my_tot = sum(float(s[0].sum()) for s in ds._samples)
+        out = proc.communicate(timeout=60)[0]
+        assert proc.returncode == 0, out
+        child_n, child_tot = None, None
+        for line in out.splitlines():
+            if line.startswith("CHILD "):
+                _, n, tot = line.split()
+                child_n, child_tot = int(n), float(tot)
+        assert child_n is not None, out
+        assert my_n + child_n == 10          # nothing dropped
+        assert my_n == 5 and child_n == 5    # evenly resharded
+        # checksum of the union survives the exchange
+        import numpy as _np
+        want = 0.0
+        for f in (fa, fb):
+            for line in f.read_text().splitlines():
+                want += float(_np.array(line.split()[1:4], float).sum())
+        np.testing.assert_allclose(my_tot + child_tot, want, atol=1e-3)
+    finally:
+        proc.kill()
+        store.close()
+
+
+def _free_port_ds():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# TreeIndex
+# ---------------------------------------------------------------------------
+
+def test_tree_index_structure():
+    t = TreeIndex.from_leaves("t", leaf_ids=[100, 101, 102, 103, 104],
+                              branch=2)
+    assert t.branch() == 2
+    assert t.height() == 4  # levels 0..3 (8 leaf slots for 5 leaves)
+    leafs = t.get_all_leafs()
+    assert sorted(n.id for n in leafs) == [100, 101, 102, 103, 104]
+    # travel path from a leaf reaches the root
+    codes = t.get_travel_codes(100)
+    assert codes[-1] == 0 and len(codes) == 4
+    # parent arithmetic is consistent
+    for child, parent in zip(codes, codes[1:]):
+        assert (child - 1) // 2 == parent
+    # ancestors at level 1 are one of the two level-1 codes
+    anc = t.get_ancestor_codes([100, 104], 1)
+    assert all(c in (1, 2) for c in anc)
+    # children of the root on the leaf level = all occupied leaf codes
+    kids = t.get_children_codes(0, 3)
+    assert len(kids) == 5
+    assert t.get_pi_relation([100], 1) == {100: anc[0]}
+    assert t.total_node_nums() == len(t.get_layer_codes(0)) + len(
+        t.get_layer_codes(1)) + len(t.get_layer_codes(2)) + 5
+    assert t.emb_size() > max(c for c in (n.code for n in leafs))
+
+
+def test_tree_index_save_load_roundtrip(tmp_path):
+    t = TreeIndex.from_leaves("t", leaf_ids=list(range(10, 19)), branch=3)
+    p = str(tmp_path / "tree")
+    t.save(p)
+    t2 = TreeIndex("t2", p)
+    assert t2.branch() == 3 and t2.height() == t.height()
+    assert sorted(n.id for n in t2.get_all_leafs()) == list(range(10, 19))
+    assert t2.get_travel_codes(10) == t.get_travel_codes(10)
+
+
+def test_tree_index_layerwise_sample():
+    t = TreeIndex.from_leaves("t", leaf_ids=list(range(8)), branch=2)
+    t.init_layerwise_sampler([1, 2, 3], start_sample_layer=1, seed=0)
+    rows = t.layerwise_sample([[7, 7], [9, 9]], [0, 5])
+    # per pair: 3 positives (one per level) + <=1+2+3 negatives
+    labels = [r[-1] for r in rows]
+    assert labels.count(1) == 6  # 2 pairs x 3 levels
+    assert all(len(r) == 4 for r in rows)  # user(2) + code + label
+    pos_rows = [r for r in rows if r[-1] == 1 and r[0] == 7]
+    # positive codes for item 0 lie on its travel path
+    travel = set(t.get_travel_codes(0, 1))
+    assert {r[2] for r in pos_rows} <= travel
+    with pytest.raises(ValueError, match="layers"):
+        t.init_layerwise_sampler([1, 1])
