@@ -74,7 +74,8 @@ class ModelSpec:
     @property
     def n_params(self):
         h = self.hidden
-        per_layer = 12 * h * h * self.ffn_mult / 4 + 13 * h
+        # attention qkv+out = 4h^2; ffn up+down = 2*ffn_mult*h^2
+        per_layer = (4 + 2 * self.ffn_mult) * h * h + 13 * h
         return int(self.layers * per_layer + self.vocab_size * h * 2)
 
     def step_flops(self, batch_tokens):

@@ -17,8 +17,8 @@ from .mpu import (  # noqa: F401
 from .train_step import ParallelTrainStep  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .dataset import (  # noqa: F401
-    DatasetBase, InMemoryDataset, QueueDataset, FileInstantDataset,
-    TreeIndex,
+    BoxPSDataset, DatasetBase, InMemoryDataset, QueueDataset,
+    FileInstantDataset, TreeIndex,
 )
 from . import data_generator  # noqa: F401
 from .sequence_parallel import (  # noqa: F401

@@ -108,8 +108,9 @@ class MultiSlotStringDataGenerator(DataGenerator):
 
 
 class MultiSlotDataGenerator(DataGenerator):
-    """Numeric slots; records per-slot type like the reference proto_info
-    (ints promote to float if any float is ever seen for the slot)."""
+    """Numeric slots; validates slot count/name stability across samples
+    (value typing comes from the dataset's declared var dtypes — the
+    reference's proto_info type promotion has no consumer here)."""
 
     def _gen_str(self, line):
         line = _check_sample(line)
@@ -122,22 +123,17 @@ class MultiSlotDataGenerator(DataGenerator):
                     raise ValueError(
                         f"slot {name!r} must carry a non-empty list; pad "
                         f"empty slots in generate_sample")
-                kind = ("float" if any(isinstance(e, float)
-                                       for e in elements) else "uint64")
-                self._proto_info.append((name, kind))
+                self._proto_info.append(name)
         else:
             if len(line) != len(self._proto_info):
                 raise ValueError(
                     f"sample has {len(line)} slots; earlier samples had "
                     f"{len(self._proto_info)}")
             for i, (name, elements) in enumerate(line):
-                if name != self._proto_info[i][0]:
+                if name != self._proto_info[i]:
                     raise ValueError(
                         f"slot {i} name changed from "
-                        f"{self._proto_info[i][0]!r} to {name!r}")
-                if self._proto_info[i][1] == "uint64" and any(
-                        isinstance(e, float) for e in elements):
-                    self._proto_info[i] = (name, "float")
+                        f"{self._proto_info[i]!r} to {name!r}")
         parts = []
         for name, elements in line:
             parts.append(str(len(elements)))

@@ -1,4 +1,5 @@
 from .dataset import (  # noqa: F401
+    BoxPSDataset,
     DatasetBase,
     FileInstantDataset,
     InMemoryDataset,
@@ -7,4 +8,4 @@ from .dataset import (  # noqa: F401
 from .index_dataset import TreeIndex  # noqa: F401
 
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
-           "FileInstantDataset", "TreeIndex"]
+           "FileInstantDataset", "BoxPSDataset", "TreeIndex"]

@@ -23,14 +23,16 @@ import threading
 import numpy as np
 
 __all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
-           "FileInstantDataset"]
+           "FileInstantDataset", "BoxPSDataset"]
 
 
 def _var_meta(v):
     """Accept static.data tensors (or anything with name/shape/dtype)."""
     name = getattr(v, "name", None) or str(v)
     shape = tuple(getattr(v, "shape", ()) or ())
-    dtype = np.dtype(str(getattr(v, "dtype", "float32")))
+    raw = str(getattr(v, "dtype", "float32"))
+    # framework dtypes print as 'paddle_tpu.float32'; numpy wants the tail
+    dtype = np.dtype(raw.rsplit(".", 1)[-1])
     return name, shape, dtype
 
 
@@ -130,20 +132,36 @@ class DatasetBase:
         return sample
 
     def _batch_dict(self, samples):
-        """Stack per-sample slot vectors into a feed dict. Uniform slots
-        become [B, *dims]; ragged slots flatten to values + '<name>.lod'
-        CSR offsets (LoDTensor parity)."""
+        """Stack per-sample slot vectors into a feed dict.
+
+        The dense/ragged decision is a property of the DECLARED var shape
+        (not of the batch at hand, which would make the feed structure
+        flip mid-epoch on coincidentally-uniform batches): a var with
+        fixed inner dims (e.g. [-1, 3]) is dense [B, *dims] and every
+        sample must carry prod(dims) values; a var with no fixed inner
+        dims (e.g. [-1]) is ragged and always batches as a flat value
+        vector + '<name>.lod' CSR offsets (LoDTensor parity)."""
         out = {}
         for i, (name, shape, dtype) in enumerate(self.use_var):
             cols = [s[i] for s in samples]
-            lens = {len(c) for c in cols}
-            if len(lens) == 1:
-                n = lens.pop()
-                arr = np.stack(cols).astype(dtype)
-                inner = [d for d in shape if d not in (-1, None)]
-                if inner and n == int(np.prod(inner)):
-                    arr = arr.reshape((len(cols), *inner))
-                out[name] = arr
+            # shape[0] is the batch dim by the use_var convention (either
+            # -1 or a concrete batch size) — only the dims AFTER it
+            # describe one sample
+            per_sample = shape[1:] if len(shape) else ()
+            ragged = not per_sample or any(d in (-1, None)
+                                           for d in per_sample)
+            inner = [] if ragged else [int(d) for d in per_sample]
+            if inner:
+                n = int(np.prod(inner))
+                bad = {len(c) for c in cols} - {n}
+                if bad:
+                    raise ValueError(
+                        f"slot {name!r} declares fixed shape {inner} "
+                        f"({n} values/sample) but samples carry "
+                        f"{sorted(bad)}; declare the var as [-1] for "
+                        f"ragged (lod) batching")
+                out[name] = np.stack(cols).astype(dtype).reshape(
+                    (len(cols), *inner))
             else:
                 out[name] = np.concatenate(cols).astype(dtype)
                 out[name + ".lod"] = np.cumsum(
@@ -251,8 +269,18 @@ class InMemoryDataset(DatasetBase):
             from ...store import TCPStore
             host, port = os.environ["PADDLE_MASTER_ENDPOINT"].rsplit(
                 ":", 1)
-            store = TCPStore(host, int(port), is_master=False,
-                             world_size=world)
+            if rank == 0:
+                # someone must host: rank 0 binds the server unless the
+                # launcher already did (then fall back to client)
+                try:
+                    store = TCPStore(host, int(port), is_master=True,
+                                     world_size=world)
+                except OSError:
+                    store = TCPStore(host, int(port), is_master=False,
+                                     world_size=world)
+            else:
+                store = TCPStore(host, int(port), is_master=False,
+                                 world_size=world)
         tag = f"fleet_ds/gs{self.shuffle_seed}"
         store.set(f"{tag}/{rank}", pickle.dumps(self._samples))
         store.wait([f"{tag}/{r}" for r in range(world)])
@@ -314,3 +342,14 @@ class QueueDataset(DatasetBase):
 
 class FileInstantDataset(QueueDataset):
     """Reference FileInstantDataset — same streaming semantics here."""
+
+
+class BoxPSDataset(InMemoryDataset):
+    """Reference BoxPSDataset (dataset.py:1343) — the BoxPS accelerator
+    cache rides HeterPs here; dataset behavior is InMemoryDataset's."""
+
+    def begin_pass(self):
+        pass
+
+    def end_pass(self, need_save_delta=False):
+        pass

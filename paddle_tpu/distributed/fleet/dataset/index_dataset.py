@@ -207,10 +207,14 @@ class TreeIndex(Index):
                 layer = self.get_layer_codes(level)
                 cands = [c for c in layer if c != pos_code]
                 out.append(user + [pos_code, 1])
-                if cands:
+                if not cands:
+                    continue
+                if len(cands) <= n_neg:
+                    # fewer candidates than requested: use each once
+                    picks = range(len(cands))
+                else:
                     picks = self._sampler_rng.choice(
-                        len(cands), size=min(n_neg, len(cands)),
-                        replace=len(cands) < n_neg)
-                    for p in np.atleast_1d(picks):
-                        out.append(user + [cands[int(p)], 0])
+                        len(cands), size=n_neg, replace=False)
+                for p in picks:
+                    out.append(user + [cands[int(p)], 0])
         return out

@@ -1,2 +1,5 @@
 """fleet.utils parity (reference: ``distributed/fleet/utils/``)."""
 from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from .hybrid_parallel_inference import (  # noqa: F401
+    HybridParallelInferenceHelper,
+)

@@ -54,7 +54,9 @@ class TaskNode:
     rank: int
     task_id: int = None
     node_type: str = "Compute"      # Compute | Source | Sink | Amplifier
-    max_run_times: int = 1          # num micro-batches
+    max_run_times: int = None       # per-node runs; None = executor's
+                                    # num_micro_batches (Amplifier nodes
+                                    # set their own multiple)
     run_fn: object = None
     program: object = None
     upstreams: list = field(default_factory=list)   # [(task_id, buff_size)]
@@ -135,6 +137,8 @@ class Interceptor(threading.Thread):
     def __init__(self, node: TaskNode, bus: MessageBus, results=None):
         super().__init__(daemon=True,
                          name=f"interceptor-{node.task_id}")
+        if node.max_run_times is None:  # direct Carrier use, no executor
+            node.max_run_times = 1
         self.node = node
         self.interceptor_id = node.task_id
         self.bus = bus
@@ -233,14 +237,22 @@ class Carrier:
             ic.start()
 
     def wait(self, timeout=None):
+        import time
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        for ic in self.interceptors:  # shared deadline, not per-node
+            ic.join(None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+        # surface a real failure first — a crashed node usually strands
+        # its peers on inbox.get, and the timeout alone would mask it
         for ic in self.interceptors:
-            ic.join(timeout)
             if ic.error is not None:
                 raise RuntimeError(
                     f"interceptor {ic.interceptor_id} failed") from ic.error
-            if ic.is_alive():
-                raise TimeoutError(
-                    f"interceptor {ic.interceptor_id} did not finish")
+        stuck = [ic.interceptor_id for ic in self.interceptors
+                 if ic.is_alive()]
+        if stuck:
+            raise TimeoutError(f"interceptors {stuck} did not finish")
 
     def release(self):
         _carriers.pop(self.carrier_id, None)
@@ -268,7 +280,8 @@ class FleetExecutor:
             if n.task_id is None:  # auto-ids start past explicit ones
                 n.task_id = next_id
                 next_id += 1
-            n.max_run_times = num_micro_batches
+            if n.max_run_times is None:  # explicit per-node counts kept
+                n.max_run_times = num_micro_batches
         ids = [n.task_id for n in task_nodes]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate task ids: {sorted(ids)}")

@@ -16,3 +16,4 @@ from .local_client import PsLocalClient  # noqa: F401
 from .the_one_ps import TheOnePs  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401
 from .service import PsRpcClient, run_server  # noqa: F401
+from .heter_ps import HeterPs  # noqa: F401

@@ -1,0 +1,141 @@
+"""Heterogeneous PS: HBM-cached embedding over the host table — heterPS
+parity.
+
+Parity: ``/root/reference/paddle/fluid/framework/fleet/heter_ps/``
+(PSGPUWrapper / HeterPs: GPU-resident hash tables caching the hot slice
+of a huge CPU/SSD sparse table, ``heter_ps.cu``'s pull/push through
+device hashmaps) and the CPU+accelerator mixed pipeline
+(``heter_client.cc`` / ``heter_server.cc``).
+
+TPU-native design: TPUs have no device hashmap, but the same economics
+hold — host RAM holds the unbounded feature table, a fixed-capacity HBM
+cache holds the hot rows as a dense [slots, dim] array, and lookups on
+cached ids are a pure device gather (MXU-adjacent, no host hop). The
+id→slot map and clock eviction run on host (they are O(batch) python
+against an O(tokens·dim) device gather); misses batch into ONE host
+pull + ONE device scatter per lookup, the same batching trick
+heter_ps.cu uses per pass. The host side is any PS client — local
+tables or the networked sharded service — so this is also the
+HeterClient analog (accelerator worker ↔ CPU table server).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["HeterPs"]
+
+
+class HeterPs:
+    """Fixed-capacity device cache in front of a PS sparse table.
+
+    ``client`` is a PsLocalClient or PsRpcClient that already holds
+    sparse ``table_id``; the host stays the source of truth (pushes land
+    in the host accessor, cached copies refresh), so ``flush`` is only
+    bookkeeping and eviction never loses updates.
+    """
+
+    def __init__(self, client, table_id, emb_dim, cache_slots=4096):
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.client = client
+        self.table_id = table_id
+        self.emb_dim = emb_dim
+        self.cache_slots = int(cache_slots)
+        self._cache = jnp.zeros((self.cache_slots, emb_dim), jnp.float32)
+        self._slot_of = {}                      # fid -> slot
+        self._fid_of = [None] * self.cache_slots
+        self._ref = np.zeros(self.cache_slots, bool)  # clock bits
+        self._hand = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- eviction (clock / second chance) -----------------------------------
+    def _grab_slot(self, pinned):
+        """Clock sweep skipping slots whose id is pinned (needed by the
+        in-flight pull — evicting a same-batch hit would break the final
+        gather). The caller guarantees len(pinned) <= cache_slots."""
+        while True:
+            s = self._hand
+            self._hand = (self._hand + 1) % self.cache_slots
+            old = self._fid_of[s]
+            if old is not None and old in pinned:
+                continue
+            if not self._ref[s]:
+                if old is not None:
+                    del self._slot_of[old]
+                return s
+            self._ref[s] = False
+
+    def _admit(self, fids, rows, pinned):
+        """Insert host rows for ``fids`` into cache slots (one device
+        scatter)."""
+        slots = []
+        for f in fids:
+            s = self._grab_slot(pinned)
+            self._slot_of[f] = s
+            self._fid_of[s] = f
+            slots.append(s)
+        idx = np.asarray(slots, np.int32)
+        self._cache = self._cache.at[idx].set(
+            self._jnp.asarray(rows, self._jnp.float32))
+        return slots
+
+    # -- pull/push ----------------------------------------------------------
+    def pull(self, ids):
+        """ids [...]-> device embeddings [..., emb_dim]; misses fetched
+        from the host in one batch."""
+        ids_np = np.asarray(ids).reshape(-1)
+        distinct = list(dict.fromkeys(ids_np.tolist()))
+        if len(distinct) > self.cache_slots:
+            # the gather needs every row resident at once; a batch whose
+            # vocabulary exceeds the cache can't be cached — serve it
+            # straight from the host (heterPS sizes its build pass the
+            # same way: cache >= pass vocabulary, else direct)
+            self.misses += len(ids_np)
+            rows = np.asarray(self.client.pull_sparse(
+                self.table_id, ids_np))
+            return self._jnp.asarray(rows, self._jnp.float32).reshape(
+                tuple(np.asarray(ids).shape) + (self.emb_dim,))
+        missing = [f for f in distinct if f not in self._slot_of]
+        self.hits += len(ids_np) - len(missing)
+        self.misses += len(missing)
+        if missing:
+            rows = np.asarray(self.client.pull_sparse(
+                self.table_id, np.asarray(missing, np.int64)))
+            self._admit(missing, rows, pinned=set(distinct))
+        slots = np.array([self._slot_of[f] for f in ids_np.tolist()],
+                         np.int32)
+        self._ref[slots] = True
+        out = self._cache[slots]
+        return out.reshape(tuple(np.asarray(ids).shape) + (self.emb_dim,))
+
+    def push(self, ids, grads):
+        """Apply grads through the host accessor (source of truth), then
+        refresh the cached copies of the touched rows."""
+        ids_np = np.asarray(ids).reshape(-1)
+        grads_np = np.asarray(grads).reshape(len(ids_np), self.emb_dim)
+        self.client.push_sparse_grad(self.table_id, ids_np, grads_np)
+        cached = [f for f in dict.fromkeys(ids_np.tolist())
+                  if f in self._slot_of]
+        if cached:
+            rows = np.asarray(self.client.pull_sparse(
+                self.table_id, np.asarray(cached, np.int64)))
+            idx = np.asarray([self._slot_of[f] for f in cached], np.int32)
+            self._cache = self._cache.at[idx].set(
+                self._jnp.asarray(rows, self._jnp.float32))
+
+    # -- stats / lifecycle --------------------------------------------------
+    @property
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def flush(self):
+        """Host already holds every update; drop the cache mapping."""
+        self._slot_of.clear()
+        self._fid_of = [None] * self.cache_slots
+        self._ref[:] = False
+
+    def end_pass(self):
+        """PSGPUWrapper::EndPass parity — writeback + release."""
+        self.flush()

@@ -534,7 +534,15 @@ class GPTHybridTrainStep:
 
             blk = lambda p, xx: gpt_block(p, xx, eps, mp_axis="mp",
                                           use_flash=use_flash)
-            if remat:
+            if remat == "dots":
+                # selective remat: save matmul outputs, recompute only the
+                # elementwise/norm glue — trades a little memory for much
+                # less recompute than full per-block checkpointing
+                blk = jax.checkpoint(
+                    blk, prevent_cse=False,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            elif remat:
                 # prevent_cse=False: inside lax.scan the loop structure
                 # already prevents the unwanted CSE; the default True makes
                 # XLA run the whole forward twice (loss value + residuals),

@@ -180,6 +180,29 @@ def test_train_from_dataset(tmp_path):
         static.disable_static()
 
 
+def test_use_var_accepts_real_static_data_vars(tmp_path):
+    """use_var must take the program's own static.data tensors (framework
+    dtype objects + concrete batch dims), not just duck-typed stubs."""
+    f = tmp_path / "a.txt"
+    _write_slot_file(f, 4)
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")   # concrete batch dim
+            y = static.data("label", [2, 1], "int64")
+    finally:
+        static.disable_static()
+    ds = QueueDataset()
+    ds.init(batch_size=2, use_var=[x, y])
+    ds.set_filelist([str(f)])
+    batches = list(ds)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (2, 3)
+    assert batches[0]["x"].dtype == np.float32
+    assert batches[0]["label"].dtype == np.int64
+
+
 def test_global_shuffle_reshards_disjoint_filelists(tmp_path, monkeypatch):
     """Two trainers with DISJOINT filelists exchange through the
     TCPStore: after global_shuffle the union is preserved and split
