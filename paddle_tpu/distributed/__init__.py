@@ -14,6 +14,7 @@ from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, all_gather_object, broadcast, reduce,
     scatter, all_to_all, send, recv, barrier, new_group, is_initialized,
     destroy_process_group, wait, prims,
+    P2POp, batch_isend_irecv, isend, irecv,
 )
 from .parallel import init_parallel_env, DataParallel, spawn  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -15,6 +15,8 @@ named mesh axis.
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -309,14 +311,167 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise RuntimeError(
-        "point-to-point eager send/recv has no single-controller analog; use "
-        "pipeline parallel (fleet.meta_parallel) whose schedule compiles "
-        "ppermute transfers, or batch_isend_irecv inside shard_map")
+    """Blocking p2p send (reference communication/send.py). Real across
+    processes when the rpc world is up; a lone send on one controller
+    has no receiver and raises with guidance."""
+    isend(tensor, dst, group).wait()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    send(tensor, src, group, sync_op)
+    irecv(tensor, src, group).wait()
+
+
+# -- p2p over the rpc agent (cross-process) or in-batch pairing ------------
+
+_p2p_lock = threading.Lock()
+_p2p_cv = threading.Condition(_p2p_lock)
+_p2p_mailbox = {}      # (src_rank, seq) -> np.ndarray
+_p2p_send_seq = {}     # dst_rank -> next seq
+_p2p_recv_seq = {}     # src_rank -> next seq
+
+
+def _p2p_deliver(src_rank, seq, arr):
+    """rpc handler: runs on the receiving process."""
+    with _p2p_cv:
+        _p2p_mailbox[(src_rank, seq)] = arr
+        _p2p_cv.notify_all()
+    return True
+
+
+def _p2p_reset():
+    """Drop mailbox + sequence state; called on rpc shutdown so a peer
+    that rejoins in a fresh world starts from seq 0 on both sides."""
+    with _p2p_cv:
+        _p2p_mailbox.clear()
+        _p2p_send_seq.clear()
+        _p2p_recv_seq.clear()
+
+
+def _rpc_world():
+    from .rpc import rpc as rpc_mod
+    agent = rpc_mod._agent
+    if agent is None:
+        return None, None
+    names = {i.rank: i.name for i in agent.infos}
+    return rpc_mod, names
+
+
+class _P2PTask:
+    def __init__(self, fn=None):
+        self._fn = fn
+        self._done = fn is None
+
+    def wait(self, timeout=120):
+        if not self._done:
+            self._fn(timeout)
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
+class P2POp:
+    """One batched p2p operation (reference batch_isend_irecv.py): ``op``
+    is ``paddle.distributed.isend`` or ``irecv``."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send. Cross-process: ships the value to rank ``dst``'s
+    mailbox through the rpc agent (ordered per src→dst by sequence
+    number). Single-process: only meaningful inside batch_isend_irecv,
+    where it pairs with a matching irecv."""
+    rpc_mod, names = _rpc_world()
+    if rpc_mod is None:
+        raise RuntimeError(
+            "eager p2p needs a peer: start the rpc world "
+            "(distributed.rpc.init_rpc) for cross-process send/recv, pair "
+            "sends with recvs in batch_isend_irecv, or use the compiled "
+            "pipeline schedules (ppermute) for on-mesh transfers")
+    me = rpc_mod.get_current_worker_info().rank
+    with _p2p_lock:
+        seq = _p2p_send_seq.get(dst, 0)
+        _p2p_send_seq[dst] = seq + 1
+    arr = np.asarray(unwrap(tensor))
+    fut = rpc_mod.rpc_async(names[dst], _p2p_deliver, args=(me, seq, arr))
+    return _P2PTask(lambda timeout: fut.result(timeout))
+
+
+def irecv(tensor, src=0, group=None):
+    """Async recv: resolves when rank ``src``'s matching isend lands in
+    the mailbox; the value is written into ``tensor`` in place."""
+    rpc_mod, names = _rpc_world()
+    if rpc_mod is None:
+        raise RuntimeError(
+            "eager p2p needs a peer: start the rpc world "
+            "(distributed.rpc.init_rpc) for cross-process send/recv, pair "
+            "sends with recvs in batch_isend_irecv, or use the compiled "
+            "pipeline schedules (ppermute) for on-mesh transfers")
+    with _p2p_lock:
+        seq = _p2p_recv_seq.get(src, 0)
+        _p2p_recv_seq[src] = seq + 1
+
+    def resolve(timeout):
+        import time
+        deadline = time.monotonic() + timeout
+        with _p2p_cv:
+            while (src, seq) not in _p2p_mailbox:
+                left = deadline - time.monotonic()
+                if left <= 0 or not _p2p_cv.wait(timeout=left):
+                    raise TimeoutError(
+                        f"irecv from rank {src} (seq {seq}) timed out")
+            arr = _p2p_mailbox.pop((src, seq))
+        tensor.set_value(jnp.asarray(arr))
+
+    return _P2PTask(resolve)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Launch a batch of p2p ops (reference batch_isend_irecv.py:73).
+
+    Cross-process (rpc world up): every op runs through the mailbox
+    protocol. Single-controller: sends and recvs are paired WITHIN the
+    batch (all ranks' ops are visible to the one controller), which is
+    exactly the pipeline-warmup pattern the reference API exists for.
+    """
+    if not p2p_op_list:
+        return []
+    rpc_mod, _ = _rpc_world()
+    if rpc_mod is not None:
+        return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
+    # single-controller pairing is POSITIONAL (i-th irecv takes the i-th
+    # isend); peers are advisory since one controller hosts every rank.
+    # Shape/dtype are validated so a mispairing fails loudly instead of
+    # propagating wrong data through a pipeline warmup.
+    sends = [op for op in p2p_op_list if op.op is isend]
+    tasks = []
+    for op in p2p_op_list:
+        if op.op is isend:
+            tasks.append(_P2PTask())
+        else:
+            if not sends:
+                raise RuntimeError(
+                    "irecv has no matching isend in this batch; on one "
+                    "controller batch_isend_irecv pairs them in order")
+            src = sends.pop(0)
+            sv, rv = unwrap(src.tensor), unwrap(op.tensor)
+            if tuple(sv.shape) != tuple(rv.shape) or sv.dtype != rv.dtype:
+                raise ValueError(
+                    f"paired isend {tuple(sv.shape)}/{sv.dtype} does not "
+                    f"match irecv buffer {tuple(rv.shape)}/{rv.dtype}; "
+                    f"single-controller pairing is positional — order the "
+                    f"batch so sends and recvs correspond")
+            op.tensor.set_value(jnp.asarray(sv))
+            tasks.append(_P2PTask())
+    return tasks
 
 
 def barrier(group=None):

@@ -275,7 +275,7 @@ class InMemoryDataset(DatasetBase):
                 try:
                     store = TCPStore(host, int(port), is_master=True,
                                      world_size=world)
-                except OSError:
+                except (OSError, RuntimeError):  # port already hosted
                     store = TCPStore(host, int(port), is_master=False,
                                      world_size=world)
             else:

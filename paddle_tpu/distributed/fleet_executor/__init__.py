@@ -1,3 +1,4 @@
+from .dist_model import DistModel, DistModelConfig  # noqa: F401
 from .fleet_executor import (  # noqa: F401
     Carrier,
     FleetExecutor,
@@ -7,4 +8,4 @@ from .fleet_executor import (  # noqa: F401
 )
 
 __all__ = ["FleetExecutor", "TaskNode", "Carrier", "Interceptor",
-           "MessageBus"]
+           "MessageBus", "DistModel", "DistModelConfig"]

@@ -237,22 +237,30 @@ class Carrier:
             ic.start()
 
     def wait(self, timeout=None):
+        """Wait for every interceptor under ONE shared deadline, polling
+        so a crashed node raises immediately (its peers are usually
+        stranded on inbox.get — joining them first would sit out the
+        whole timeout and mask the root cause)."""
         import time
         deadline = None if timeout is None else \
             time.monotonic() + timeout
-        for ic in self.interceptors:  # shared deadline, not per-node
-            ic.join(None if deadline is None
-                    else max(0.0, deadline - time.monotonic()))
-        # surface a real failure first — a crashed node usually strands
-        # its peers on inbox.get, and the timeout alone would mask it
-        for ic in self.interceptors:
-            if ic.error is not None:
-                raise RuntimeError(
-                    f"interceptor {ic.interceptor_id} failed") from ic.error
-        stuck = [ic.interceptor_id for ic in self.interceptors
-                 if ic.is_alive()]
-        if stuck:
-            raise TimeoutError(f"interceptors {stuck} did not finish")
+        pending = list(self.interceptors)
+        while pending:
+            still = []
+            for ic in pending:
+                ic.join(0.05)
+                if ic.error is not None:
+                    raise RuntimeError(
+                        f"interceptor {ic.interceptor_id} failed"
+                    ) from ic.error
+                if ic.is_alive():
+                    still.append(ic)
+            pending = still
+            if pending and deadline is not None \
+                    and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"interceptors {[ic.interceptor_id for ic in pending]}"
+                    f" did not finish")
 
     def release(self):
         _carriers.pop(self.carrier_id, None)

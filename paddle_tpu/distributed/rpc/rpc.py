@@ -258,6 +258,10 @@ def shutdown(graceful=True):
             _agent.store.barrier("rpc_shutdown")
         _agent.stop()
         _agent = None
+    # p2p mailbox/sequence state is world-scoped: clear it so a fresh
+    # init_rpc world restarts both sides at seq 0
+    from ..collective import _p2p_reset
+    _p2p_reset()
 
 
 def get_worker_info(name):

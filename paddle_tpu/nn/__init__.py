@@ -3,6 +3,7 @@
 Parity: reference python/paddle/nn/__init__.py export surface.
 """
 from .layer.layers import Layer, ParamAttr  # noqa: F401
+from . import utils  # noqa: F401
 from .layer.common import (  # noqa: F401
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
     Flatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, Pad1D, Pad2D,

@@ -1,0 +1,9 @@
+from .weight_norm_hook import remove_weight_norm, weight_norm  # noqa: F401
+from .spectral_norm_hook import spectral_norm  # noqa: F401
+from .transform_parameters import (  # noqa: F401
+    parameters_to_vector,
+    vector_to_parameters,
+)
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]

@@ -1,0 +1,84 @@
+"""Spectral normalization as a layer hook.
+
+Parity: ``/root/reference/python/paddle/nn/utils/spectral_norm_hook.py``
+— divide ``weight`` by its largest singular value, estimated by power
+iteration on persistent u/v buffers updated once per forward (training
+mode). The iteration is a pair of tiny matvecs that XLA fuses into the
+step; u/v live as layer buffers exactly like the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tape import apply
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unwrap
+
+__all__ = ["spectral_norm"]
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.iters = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        w = layer._parameters[self.name + "_orig"]
+        u = unwrap(layer._buffers[self.name + "_u"])
+        v = unwrap(layer._buffers[self.name + "_v"])
+        wm = jnp.moveaxis(unwrap(w), self.dim, 0)
+        wm = wm.reshape(wm.shape[0], -1)
+        if layer.training:
+            for _ in range(self.iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + self.eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + self.eps)
+            layer._buffers[self.name + "_u"] = Tensor(u)
+            layer._buffers[self.name + "_v"] = Tensor(v)
+        uc, vc = u, v
+
+        def f(wv):
+            m = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim],
+                                                      -1)
+            sigma = uc @ m @ vc
+            return wv / sigma
+
+        object.__setattr__(layer, self.name,
+                           apply(f, w, op_name="spectral_norm_hook"))
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to ``layer.<name>``; returns layer."""
+    if name + "_orig" in layer._parameters:
+        raise ValueError(f"spectral_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wv = unwrap(w)
+    if dim is None:
+        # Linear weights are [in, out] -> normalize over dim 1; convs and
+        # everything else over dim 0 (reference default heuristic)
+        dim = 1 if type(layer).__name__ in ("Linear", "LinearCompress") \
+            else 0
+    dim = dim if dim >= 0 else dim + wv.ndim
+    h = wv.shape[dim]
+    rest = int(np.prod(wv.shape)) // h
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(h).astype(np.float32)
+    v = rng.standard_normal(rest).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+    v /= np.linalg.norm(v) + eps
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", w)
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(u)))
+    layer.register_buffer(name + "_v", Tensor(jnp.asarray(v)))
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

@@ -1,0 +1,43 @@
+"""Flatten/unflatten parameter lists.
+
+Parity: ``/root/reference/python/paddle/nn/utils/transform_parameters.py``
+(parameters_to_vector :98 / vector_to_parameters :151).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tape import apply
+from ...ops._dispatch import unwrap
+
+__all__ = ["parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concatenate every parameter, flattened, into one 1-D tensor."""
+    parameters = list(parameters)
+    if not parameters:
+        raise ValueError("parameters is empty")
+
+    def f(*vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    return apply(f, *parameters, op_name="parameters_to_vector")
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write slices of ``vec`` back into each parameter in place."""
+    parameters = list(parameters)
+    v = unwrap(vec)
+    total = sum(int(jnp.size(unwrap(p))) for p in parameters)
+    if int(jnp.size(v)) != total:
+        raise ValueError(
+            f"vector has {int(jnp.size(v))} elements; parameters need "
+            f"{total}")
+    off = 0
+    for p in parameters:
+        pv = unwrap(p)
+        n = int(jnp.size(pv))
+        p.set_value(v[off:off + n].reshape(pv.shape).astype(pv.dtype))
+        off += n
+    return parameters

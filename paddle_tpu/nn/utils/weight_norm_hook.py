@@ -1,0 +1,119 @@
+"""Weight normalization as a layer hook.
+
+Parity: ``/root/reference/python/paddle/nn/utils/weight_norm_hook.py``
+(weight_norm/remove_weight_norm) — reparameterize ``weight`` as
+``g * v / ||v||`` so the optimizer trains ``weight_g``/``weight_v``; a
+forward-pre-hook rebuilds ``weight`` from them on every call (so the
+recomputation is traced into the compiled step and fuses with the
+consuming matmul — no eager materialization cost on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tape import apply
+from ...framework.tensor import Parameter, Tensor
+from ...ops._dispatch import unwrap
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_axes(ndim, dim):
+    return tuple(i for i in range(ndim) if i != dim)
+
+
+def _norm_except_dim(v, dim):
+    axes = _norm_axes(v.ndim, dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def _compute_weight(g, v, dim):
+    def f(gv, vv):
+        n = _norm_except_dim(vv, dim)
+        shape = [1] * vv.ndim
+        shape[dim] = -1
+        return vv * (gv.reshape(shape) / n)
+
+    return apply(f, g, v, op_name="weight_norm")
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = layer._parameters[self.name + "_g"]
+        v = layer._parameters[self.name + "_v"]
+        object.__setattr__(layer, self.name,
+                           _compute_weight(g, v, self.dim))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to ``layer.<name>``; returns the layer.
+
+    ``dim=None`` normalizes over the whole tensor (g is a scalar)."""
+    if name + "_g" in layer._parameters:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wv = unwrap(w)
+    eff_dim = 0 if dim is None else (dim if dim >= 0 else dim + wv.ndim)
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(wv * wv)).reshape(1)
+    else:
+        norm = _norm_except_dim(wv, eff_dim).reshape(-1)
+    g = Parameter(jnp.asarray(norm), name=f"{name}_g")
+    v = Parameter(jnp.asarray(wv), name=f"{name}_v")
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    hook = (_WholeTensorHook(name) if dim is None
+            else _WeightNormHook(name, eff_dim))
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (handle,
+                                      None if dim is None else eff_dim)
+    hook(layer, ())  # materialize layer.<name> for immediate access
+    return layer
+
+
+class _WholeTensorHook:
+    def __init__(self, name):
+        self.name = name
+
+    def __call__(self, layer, inputs):
+        g = layer._parameters[self.name + "_g"]
+        v = layer._parameters[self.name + "_v"]
+
+        def f(gv, vv):
+            return vv * (gv / jnp.sqrt(jnp.sum(vv * vv)))
+
+        object.__setattr__(layer, self.name,
+                           apply(f, g, v, op_name="weight_norm"))
+        return None
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g/v back into a plain ``weight`` parameter and drop the hook."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm on parameter {name!r}")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    # recompute the effective weight once, eagerly
+    gv, vv = unwrap(g), unwrap(v)
+    if dim is None:
+        w = vv * (gv / jnp.sqrt(jnp.sum(vv * vv)))
+    else:
+        shape = [1] * vv.ndim
+        shape[dim] = -1
+        w = vv * (gv.reshape(shape) / _norm_except_dim(vv, dim))
+    if name in layer.__dict__:
+        object.__delattr__(layer, name)
+    layer.add_parameter(name, Parameter(jnp.asarray(w), name=name))
+    return layer

@@ -161,3 +161,30 @@ def test_cross_process_pipeline(tmp_path):
     out = proc.communicate(timeout=60)[0]
     assert proc.returncode == 0, out
     assert "CHILD_RESULTS [1, 11, 21, 31]" in out
+
+
+def test_dist_model_pipelined_inference():
+    """DistModel runs a 3-stage host pipeline over micro-batch feeds and
+    returns last-stage outputs in order (dist_model.cc parity)."""
+    from paddle_tpu.distributed.fleet_executor import (
+        DistModel, DistModelConfig)
+
+    stages = [
+        lambda feed: np.asarray(feed) * 2.0,
+        lambda x: x + 1.0,
+        lambda x: float(x.sum()),
+    ]
+    cfg = DistModelConfig(stages=stages, num_micro_batches=3)
+    dm = DistModel(cfg)
+    feeds = [np.full((2, 2), i, np.float32) for i in range(3)]
+    out = dm.run(feeds)
+    assert out == [float((np.full((2, 2), i) * 2 + 1).sum())
+                   for i in range(3)]
+
+
+def test_dist_model_single_stage():
+    from paddle_tpu.distributed.fleet_executor import (
+        DistModel, DistModelConfig)
+
+    dm = DistModel(DistModelConfig(stages=[lambda f: f * 10]))
+    assert dm.run([1.0, 2.0]) == [10.0, 20.0]

@@ -1,0 +1,139 @@
+"""paddle.nn.utils (weight/spectral norm hooks, parameter vectors) and
+the p2p communication API (P2POp/batch_isend_irecv/isend/irecv)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.utils import (
+    parameters_to_vector, remove_weight_norm, spectral_norm,
+    vector_to_parameters, weight_norm)
+
+
+def test_weight_norm_reparameterizes_and_trains():
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    weight_norm(lin, name="weight", dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names
+    assert "weight" not in names
+    # effective weight unchanged by the reparameterization
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+    # forward works and grads flow to g and v
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 4)).astype(np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+
+
+def test_weight_norm_norm_semantics():
+    """||weight[i, :]|| == g[i] after re-scaling g (dim=0 rows)."""
+    lin = nn.Linear(5, 2)
+    weight_norm(lin, dim=0)
+    lin.weight_g.set_value(np.array([2.0, 3.0, 1.0, 0.5, 4.0],
+                                    np.float32))
+    lin(paddle.to_tensor(np.zeros((1, 5), np.float32)))  # refresh hook
+    norms = np.linalg.norm(lin.weight.numpy(), axis=1)
+    np.testing.assert_allclose(norms, [2.0, 3.0, 1.0, 0.5, 4.0],
+                               rtol=1e-5)
+
+
+def test_remove_weight_norm_restores_plain_param():
+    lin = nn.Linear(4, 3)
+    weight_norm(lin)
+    w_eff = lin.weight.numpy().copy()
+    remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin.weight.numpy(), w_eff, rtol=1e-5)
+    with pytest.raises(ValueError, match="no weight_norm"):
+        remove_weight_norm(lin)
+
+
+def test_weight_norm_dim_none_scalar_g():
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    weight_norm(lin, dim=None)
+    assert lin.weight_g.numpy().shape == (1,)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+
+def test_spectral_norm_unit_spectral_radius():
+    lin = nn.Linear(6, 4)
+    spectral_norm(lin, n_power_iterations=20)
+    x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+    lin.train()
+    lin(x)  # run power iteration
+    sigma = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+    names = dict(lin.named_parameters())
+    assert "weight_orig" in names and "weight" not in names
+
+
+def test_parameters_to_vector_roundtrip():
+    lin = nn.Linear(3, 2)
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.numpy().shape == (3 * 2 + 2,)
+    new = np.arange(8, dtype=np.float32)
+    vector_to_parameters(paddle.to_tensor(new), lin.parameters())
+    np.testing.assert_allclose(lin.weight.numpy().reshape(-1), new[:6])
+    np.testing.assert_allclose(lin.bias.numpy(), new[6:])
+    with pytest.raises(ValueError, match="elements"):
+        vector_to_parameters(paddle.to_tensor(new[:5]), lin.parameters())
+
+
+# ---------------------------------------------------------------------------
+# p2p
+# ---------------------------------------------------------------------------
+
+def test_batch_isend_irecv_pairs_in_controller():
+    import paddle_tpu.distributed as dist
+
+    src = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    dst = paddle.to_tensor(np.zeros(4, np.float32))
+    ops = [dist.P2POp(dist.isend, src, 1),
+           dist.P2POp(dist.irecv, dst, 0)]
+    tasks = dist.batch_isend_irecv(ops)
+    for t in tasks:
+        t.wait()
+    np.testing.assert_allclose(dst.numpy(), [0, 1, 2, 3])
+    with pytest.raises(RuntimeError, match="matching"):
+        dist.batch_isend_irecv([dist.P2POp(dist.irecv, dst, 0)])
+    with pytest.raises(ValueError, match="isend/irecv"):
+        dist.P2POp(dist.all_reduce, dst, 0)
+
+
+def test_isend_irecv_over_rpc_world():
+    """Self-world p2p through the rpc mailbox (the cross-process path,
+    exercised rank->self so one process covers both ends)."""
+    import socket
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import rpc
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    rpc.init_rpc("w0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        payload = paddle.to_tensor(np.full((3,), 7.0, np.float32))
+        out = paddle.to_tensor(np.zeros(3, np.float32))
+        t_send = dist.isend(payload, dst=0)
+        t_recv = dist.irecv(out, src=0)
+        t_send.wait()
+        t_recv.wait()
+        np.testing.assert_allclose(out.numpy(), 7.0)
+        # ordering: two sends arrive in sequence
+        a = paddle.to_tensor(np.array([1.0], np.float32))
+        b = paddle.to_tensor(np.array([2.0], np.float32))
+        dist.send(a, dst=0)
+        dist.send(b, dst=0)
+        r1 = paddle.to_tensor(np.zeros(1, np.float32))
+        r2 = paddle.to_tensor(np.zeros(1, np.float32))
+        dist.recv(r1, src=0)
+        dist.recv(r2, src=0)
+        assert r1.numpy()[0] == 1.0 and r2.numpy()[0] == 2.0
+    finally:
+        rpc.shutdown()
