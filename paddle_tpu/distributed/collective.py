@@ -397,6 +397,9 @@ def isend(tensor, dst=0, group=None):
             "sends with recvs in batch_isend_irecv, or use the compiled "
             "pipeline schedules (ppermute) for on-mesh transfers")
     me = rpc_mod.get_current_worker_info().rank
+    if dst not in names:
+        raise ValueError(f"isend dst rank {dst} not in the rpc world "
+                         f"(ranks {sorted(names)})")
     with _p2p_lock:
         seq = _p2p_send_seq.get(dst, 0)
         _p2p_send_seq[dst] = seq + 1
@@ -415,6 +418,9 @@ def irecv(tensor, src=0, group=None):
             "(distributed.rpc.init_rpc) for cross-process send/recv, pair "
             "sends with recvs in batch_isend_irecv, or use the compiled "
             "pipeline schedules (ppermute) for on-mesh transfers")
+    if src not in names:
+        raise ValueError(f"irecv src rank {src} not in the rpc world "
+                         f"(ranks {sorted(names)})")
     with _p2p_lock:
         seq = _p2p_recv_seq.get(src, 0)
         _p2p_recv_seq[src] = seq + 1
@@ -471,6 +477,11 @@ def batch_isend_irecv(p2p_op_list):
                     f"batch so sends and recvs correspond")
             op.tensor.set_value(jnp.asarray(sv))
             tasks.append(_P2PTask())
+    if sends:
+        raise RuntimeError(
+            f"{len(sends)} isend op(s) have no matching irecv in this "
+            f"batch; on one controller every send must pair with a recv "
+            f"or its data is lost — use the rpc world for true p2p")
     return tasks
 
 

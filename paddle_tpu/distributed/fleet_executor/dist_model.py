@@ -56,6 +56,11 @@ class DistModel:
         cfg = self.config
         feeds = list(feed_list)
         n_micro = len(feeds)
+        if cfg.num_micro_batches not in (None, 1, n_micro):
+            raise ValueError(
+                f"DistModelConfig.num_micro_batches={cfg.num_micro_batches}"
+                f" but run() received {n_micro} feeds; pass one feed per "
+                f"micro-batch")
         stages = cfg.stages
         n = len(stages)
 

@@ -159,7 +159,7 @@ _CE_CHUNK = 2048  # tokens per chunk: logits buffer ~= 2048*V*4B ≈ 400MB @50k
 
 
 def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
-                                 loss_mask=None):
+                                 loss_mask=None, bias=None):
     """LM head + softmax CE over an mp-sharded vocab (mp_layers.py:501 parity).
 
     h [B,S,H], wte_local [V_local,H], labels [B,S] global ids. Stable global
@@ -190,6 +190,8 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
         def per_chunk(args):
             hc, lc, mc = args
             lg = jnp.einsum("nh,vh->nv", hc, wte_local).astype(jnp.float32)
+            if bias is not None:
+                lg = lg + bias.astype(jnp.float32)
             mx = jax.lax.stop_gradient(jnp.max(lg, -1))
             lse = jnp.log(jnp.sum(jnp.exp(lg - mx[:, None]), -1)) + mx
             # out-of-range ids (e.g. -1 padding) contribute tgt=0, matching
@@ -210,6 +212,8 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
         return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)
 
     logits = jnp.einsum("bsh,vh->bsv", h, wte_local).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     v_local = logits.shape[-1]
     if mp_axis is not None:
         start = jax.lax.axis_index(mp_axis) * v_local

@@ -62,10 +62,15 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         raise ValueError(f"layer has no parameter {name!r}")
     wv = unwrap(w)
     if dim is None:
-        # Linear weights are [in, out] -> normalize over dim 1; convs and
-        # everything else over dim 0 (reference default heuristic)
-        dim = 1 if type(layer).__name__ in ("Linear", "LinearCompress") \
-            else 0
+        # Linear weights are [in, out] and conv-transpose kernels put the
+        # output channels on dim 1 -> normalize over dim 1 for both, like
+        # the reference isinstance heuristic; everything else dim 0
+        from .. import Linear
+        from ..layer import conv as _conv
+        transposed = tuple(getattr(_conv, n) for n in
+                           ("Conv1DTranspose", "Conv2DTranspose",
+                            "Conv3DTranspose") if hasattr(_conv, n))
+        dim = 1 if isinstance(layer, (Linear,) + transposed) else 0
     dim = dim if dim >= 0 else dim + wv.ndim
     h = wv.shape[dim]
     rest = int(np.prod(wv.shape)) // h

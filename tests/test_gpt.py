@@ -243,3 +243,33 @@ def test_generator_sampling_modes():
     g3 = GPTGenerator(model, temperature=1.0, top_k=8, seed=8)
     o3 = np.asarray(g3(prompt, max_new_tokens=8)._value)
     assert o3.shape == o1.shape  # different seed may differ; just runs
+
+
+def test_bert_fused_mlm_loss_matches_criterion():
+    """forward_with_mlm_loss == BertPretrainingCriterion(model(ids)) on
+    both CE paths (full logits AND the chunked gate at V>=16384)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        BertModel,
+                                        BertPretrainingCriterion)
+
+    for vocab, B, S in ((128, 2, 16), (16384, 5, 512)):
+        cfg = BertConfig(vocab_size=vocab, hidden_size=32,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         intermediate_size=64, max_position_embeddings=512,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        model = BertForPretraining(BertModel(cfg))
+        model.eval()
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, vocab, (B, S)).astype(np.int64))
+        labels_np = rng.integers(0, vocab, (B, S)).astype(np.int64)
+        labels_np[0, :3] = -100  # ignore_index positions
+        labels = paddle.to_tensor(labels_np)
+        logits, nsp = model(ids)
+        want = BertPretrainingCriterion(vocab)(logits, nsp, labels)
+        got = model.forward_with_mlm_loss(ids, labels)
+        np.testing.assert_allclose(float(got.numpy()),
+                                   float(want.numpy()), rtol=2e-4)
