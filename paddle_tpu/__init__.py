@@ -74,3 +74,14 @@ def __getattr__(name):
 
 # save/load + seed surface
 from .framework.io import save, load  # noqa: F401,E402
+
+# top-level parity aliases (reference python/paddle/__init__.py __all__)
+from .nn.layer.layers import ParamAttr  # noqa: E402,F401
+from .framework.place import TPUPlace as NPUPlace  # noqa: E402,F401
+from .framework.dtype import DType as dtype  # noqa: E402,F401
+from .framework.random import (  # noqa: E402,F401
+    get_rng_state as get_cuda_rng_state,
+    set_rng_state as set_cuda_rng_state,
+)
+from .static import enable_static, disable_static  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401

@@ -28,3 +28,12 @@ from . import ps  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
 from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
 from . import fleet_executor  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from .parity import (  # noqa: F401,E402
+    alltoall, alltoall_single, reduce_scatter, broadcast_object_list,
+    scatter_object_list, split, ParallelMode, get_backend, is_available,
+    gloo_init_parallel_env, gloo_barrier, gloo_release,
+    ProbabilityEntry, CountFilterEntry, ShowClickEntry,
+)
+from .collective import get_group  # noqa: F401,E402

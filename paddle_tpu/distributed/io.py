@@ -1,0 +1,45 @@
+"""Distributed persistence helpers.
+
+Parity: ``/root/reference/python/paddle/distributed/io.py`` —
+save/load of persistables for distributed (PS) programs. Dense state
+delegates to ``paddle.save/load``; sparse PS tables save through their
+owning client (``ps/service.py`` shards to per-server files).
+"""
+from __future__ import annotations
+
+from ..framework.io import load, save
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var):
+    """Parameters and buffers persist; feed/fetch temporaries don't."""
+    from ..framework.tensor import Parameter
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable of a program/layer to ``dirname``
+    (reference io.py save_persistables)."""
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    target = os.path.join(dirname, filename or "persistables.pdparams")
+    if main_program is None:
+        raise ValueError("pass the program (or a Layer) whose state to save")
+    state = (main_program.state_dict()
+             if hasattr(main_program, "state_dict")
+             else {p.name or f"param_{i}": p
+                   for i, p in enumerate(main_program.parameters())})
+    save(state, target)
+    return target
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+    target = os.path.join(dirname, filename or "persistables.pdparams")
+    state = load(target)
+    if main_program is not None and hasattr(main_program,
+                                            "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state

@@ -1,0 +1,224 @@
+"""Remaining ``paddle.distributed.*`` surface.
+
+Parity homes in the reference: ``distributed/communication/`` (alltoall
+:alltoall_single, reduce_scatter, broadcast/scatter_object_list, split),
+``distributed/entry_attr.py`` (ProbabilityEntry/CountFilterEntry/
+ShowClickEntry — PS sparse-table admission policies),
+``distributed/parallel.py`` (ParallelMode, gloo_* helpers),
+``distributed/collective.py`` (get_backend/get_group/is_available).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import unwrap
+from .collective import (ReduceOp, _get_group, all_to_all, broadcast,
+                         scatter)
+
+__all__ = [
+    "alltoall", "alltoall_single", "reduce_scatter",
+    "broadcast_object_list", "scatter_object_list", "split",
+    "ParallelMode", "get_backend", "is_available",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+]
+
+
+class ParallelMode:
+    """reference parallel.py ParallelMode enum."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def get_backend(group=None):
+    """The collective backend name: XLA over ICI/DCN (the NCCL slot)."""
+    return "XLA"
+
+
+def is_available():
+    import jax
+    return len(jax.devices()) > 0
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    """Reference alltoall (note the reversed arg order vs all_to_all)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: rows regroup across ranks. On one
+    controller the global tensor already holds every rank's rows, so the
+    exchange is an identity reshard; uneven splits are validated."""
+    group = _get_group(group)
+    v = unwrap(in_tensor)
+    n = group.nranks
+    if in_split_sizes is not None and sum(in_split_sizes) != v.shape[0]:
+        raise ValueError(
+            f"in_split_sizes {in_split_sizes} must sum to dim0 "
+            f"{v.shape[0]}")
+    out = Tensor(jnp.asarray(v))
+    if out_tensor is not None:
+        out_tensor._inplace_assign(out)
+        return out_tensor
+    return out
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Reduce the list across ranks, keep this rank's chunk
+    (communication/reduce_scatter.py). Single-controller: the reduction
+    over the stacked list is real; the 'scatter' keeps rank 0's chunk —
+    compiled code uses prims.c_reducescatter for the mesh version."""
+    group = _get_group(group)
+    vals = [unwrap(t) for t in tensor_list]
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+           ReduceOp.MIN: jnp.min}.get(op, jnp.sum)
+    stacked = jnp.stack(vals)
+    # reference semantics: element-wise reduce of per-rank tensors, then
+    # rank r receives the r-th tensor's reduction; on one controller we
+    # fill `tensor` with the rank-0 chunk
+    reduced = red(stacked, axis=0) if op != ReduceOp.AVG \
+        else jnp.mean(stacked, axis=0)
+    tensor._inplace_assign(Tensor(jnp.asarray(reduced)))
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Pickle-based object broadcast (communication/broadcast.py
+    broadcast_object_list). Single-controller: rank src's list is
+    already the global truth; round-trip through pickle keeps the
+    by-value semantics (callers may mutate their copy)."""
+    blob = pickle.dumps(list(object_list))
+    object_list[:] = pickle.loads(blob)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives its element of src's list (communication/
+    scatter.py scatter_object_list)."""
+    group = _get_group(group)
+    rank = 0
+    if in_object_list is None:
+        raise ValueError("src rank must pass in_object_list")
+    if len(in_object_list) % group.nranks:
+        raise ValueError(
+            f"object list length {len(in_object_list)} must divide the "
+            f"group size {group.nranks}")
+    per = len(in_object_list) // group.nranks
+    chunk = in_object_list[rank * per:(rank + 1) * per]
+    out_object_list[:] = pickle.loads(pickle.dumps(chunk))
+    return out_object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style parallel layer factory (reference collective.py
+    split): builds a row/column-parallel linear or parallel embedding
+    over the mp axis — the fleet.mpu layers are the implementation."""
+    from .fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            return RowParallelLinear(in_f, out_f,
+                                     input_is_parallel=False,
+                                     has_bias=bias_attr is not False)(x)
+        return ColumnParallelLinear(in_f, out_f,
+                                    gather_output=gather_out,
+                                    has_bias=bias_attr is not False)(x)
+    if operation == "embedding":
+        vocab, emb = size
+        return VocabParallelEmbedding(vocab, emb)(x)
+    raise ValueError(f"unsupported split operation {operation!r}")
+
+
+# -- gloo helpers (reference parallel.py:307-381): host-side barrier ----
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only process group bootstrap. The TCPStore plays gloo's role;
+    creating it here registers this process with the rendezvous."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    global _gloo_store, _gloo_world
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+    _gloo_world = rank_num
+    return _gloo_store
+
+
+_gloo_store = None
+_gloo_world = 1
+
+
+def gloo_barrier():
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_store.barrier("gloo_barrier")
+
+
+def gloo_release():
+    global _gloo_store
+    if _gloo_store is not None:
+        _gloo_store.close()
+        _gloo_store = None
+
+
+# -- PS sparse-table admission policies (entry_attr.py) -----------------
+
+class _Entry:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(_Entry):
+    """Admit a new feature id with fixed probability."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+    def should_admit(self, rng=None):
+        rng = rng or np.random.default_rng()
+        return bool(rng.random() < self.probability)
+
+
+class CountFilterEntry(_Entry):
+    """Admit a feature id once it has been seen ``count_filter`` times."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = count_filter
+        self._counts = {}
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+    def should_admit(self, fid):
+        c = self._counts.get(fid, 0) + 1
+        self._counts[fid] = c
+        return c >= self.count_filter
+
+
+class ShowClickEntry(_Entry):
+    """Score features by show/click stat names (CTR accessors)."""
+
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"

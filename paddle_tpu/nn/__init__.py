@@ -47,3 +47,10 @@ from .clip import (  # noqa: F401
 )
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from .layer.extras import (  # noqa: F401,E402
+    PairwiseDistance, SoftMarginLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, TripletMarginWithDistanceLoss, HSigmoidLoss,
+    Softmax2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, RNNTLoss, BiRNN,
+    BeamSearchDecoder, dynamic_decode,
+)
+from .layer.rnn import RNNCellBase  # noqa: F401,E402

@@ -10,3 +10,11 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .extras import (  # noqa: F401,E402
+    pairwise_distance, soft_margin_loss, multi_label_soft_margin_loss,
+    multi_margin_loss, triplet_margin_with_distance_loss, hsigmoid_loss,
+    diag_embed, sequence_mask, zeropad2d, temporal_shift, affine_grid,
+    grid_sample, gather_tree, max_unpool1d, max_unpool2d, max_unpool3d,
+    margin_cross_entropy, rnnt_loss, sparse_attention, elu_, softmax_,
+    tanh_,
+)

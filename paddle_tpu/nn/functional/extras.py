@@ -1,0 +1,470 @@
+"""Remaining ``paddle.nn.functional`` surface.
+
+Parity homes in the reference: ``nn/functional/loss.py``
+(soft_margin_loss :3622, multi_label_soft_margin_loss :3533,
+multi_margin_loss, triplet_margin_with_distance_loss :3244,
+hsigmoid_loss :896, margin_cross_entropy :1847, rnnt_loss),
+``nn/functional/distance.py`` (pairwise_distance),
+``nn/functional/common.py`` (zeropad2d, sequence_mask, diag_embed),
+``nn/functional/vision.py`` (affine_grid :29, grid_sample :245,
+temporal_shift), ``nn/functional/pooling.py`` (max_unpool1d/2d/3d),
+``incubate/sparse_attention``, and ``fluid/layers gather_tree``.
+
+All pure jnp/lax; the RNN-T loss runs its (T,U) lattice as a lax.scan
+over anti-diagonals so it compiles as one fused loop on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tape import apply
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unwrap
+
+__all__ = [
+    "pairwise_distance", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss", "diag_embed",
+    "sequence_mask", "zeropad2d", "temporal_shift", "affine_grid",
+    "grid_sample", "gather_tree", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "margin_cross_entropy", "rnnt_loss",
+    "sparse_attention", "elu_", "softmax_", "tanh_",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b) + epsilon
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(f, x, y, op_name="pairwise_distance")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)), label in {-1, 1}."""
+
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                       reduction)
+
+    return apply(f, input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(x, y, *w):
+        y = y.astype(x.dtype)
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args, op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class hinge (reference multi_margin_loss)."""
+
+    def f(x, y, *w):
+        n, c = x.shape
+        tgt = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - tgt + x) ** p
+        if w:
+            m = m * w[0][y][:, None]
+        mask = jnp.arange(c)[None, :] != y[:, None]
+        return _reduce(jnp.sum(m * mask, axis=1) / c, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args, op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+
+    def f(a, pos, neg):
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply(f, input, positive, negative,
+                 op_name="triplet_margin_with_distance_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference loss.py:896). Each class's path bits come from its binary
+    code over ``num_classes - 1`` internal nodes."""
+    # heap of 2n-1 nodes: internal 0..n-2, leaf of class c = c + n - 1.
+    # Path lengths vary when n is not a power of two; steps past the
+    # root are masked out, and every internal index is < n-1 by
+    # construction (no clipping/aliasing).
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))) + 1, 1)
+
+    def f(x, y, w, *b):
+        idx = y + (num_classes - 1)
+        loss = 0.0
+        for _ in range(depth):
+            active = idx > 0
+            parent = jnp.maximum((idx - 1) // 2, 0)
+            bit = idx % 2 == 1                 # left child -> bit 1
+            logit = jnp.sum(x * w[parent], axis=-1)
+            if b:
+                logit = logit + b[0][parent]
+            sign = jnp.where(bit, 1.0, -1.0)
+            loss = loss + jnp.log1p(jnp.exp(-sign * logit)) * active
+            idx = jnp.where(active, parent, 0)
+        return loss[:, None]
+
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args, op_name="hsigmoid_loss")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    from ...ops.manipulation import diag_embed as _ops_diag_embed
+    return _ops_diag_embed(input, offset, dim1, dim2)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(lengths):
+        m = maxlen or int(jnp.max(lengths))
+        return (jnp.arange(m)[None, :]
+                < lengths.reshape(-1, 1)).astype(dtype).reshape(
+                    tuple(lengths.shape) + (m,))
+
+    if maxlen is None and isinstance(x, Tensor):
+        from ...static.program import is_lazy
+        if is_lazy(x):
+            raise ValueError(
+                "sequence_mask(maxlen=None) needs a concrete lengths "
+                "tensor; pass maxlen explicitly under static capture / "
+                "jit (the mask shape must be static)")
+        m = int(np.max(np.asarray(unwrap(x))))
+        return sequence_mask(x, maxlen=m, dtype=dtype)
+    return apply(f, x, op_name="sequence_mask")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = padding
+
+    def f(v):
+        if data_format == "NCHW":
+            return jnp.pad(v, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(v, ((0, 0), (t, b), (l, r), (0, 0)))
+
+    return apply(f, x, op_name="zeropad2d")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift (reference vision.py temporal_shift): shift the first
+    C*ratio channels back one segment, the next C*ratio forward."""
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(f, x, op_name="temporal_shift")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference vision.py:29)."""
+    n, _c, h, w = (int(s) for s in out_shape)
+
+    def f(th):
+        def axis(sz):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, sz)
+            step = 2.0 / sz
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, sz)
+
+        ys, xs = jnp.meshgrid(axis(h), axis(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # H,W,3
+        grid = jnp.einsum("hwk,njk->nhwj", base, th)            # N,H,W,2
+        return grid.astype(th.dtype)
+
+    return apply(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling at normalized grid coords
+    (reference vision.py:245). x NCHW, grid N,H,W,2 in [-1, 1]."""
+
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            vals = v[jnp.arange(n)[:, None, None], :, iyc, ixc]
+            if padding_mode == "zeros":
+                vals = vals * inb[..., None]
+            return vals  # N,H,W,C
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            lx, ly = fx - x0, fy - y0
+            out = (sample(x0, y0) * ((1 - lx) * (1 - ly))[..., None]
+                   + sample(x0 + 1, y0) * (lx * (1 - ly))[..., None]
+                   + sample(x0, y0 + 1) * ((1 - lx) * ly)[..., None]
+                   + sample(x0 + 1, y0 + 1) * (lx * ly)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))  # back to NCHW
+
+    return apply(f, x, grid, op_name="grid_sample")
+
+
+def gather_tree(ids, parents):
+    """Beam-search back-trace (fluid/layers gather_tree): walk parent
+    pointers from the last step to recover full beams.
+    ids/parents [T, B, beam]."""
+
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam index per slot
+            tok = jnp.take_along_axis(idv[t], beams, axis=1)
+            beams = jnp.take_along_axis(par[t], beams, axis=1)
+            return beams, tok
+
+        init = jnp.tile(jnp.arange(idv.shape[2])[None, :],
+                        (idv.shape[1], 1))
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply(f, ids, parents, op_name="gather_tree")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                ndim, channels_last=False):
+    """Scatter pooled values back to pre-pool positions by flat index."""
+
+    def f(v, idx):
+        if channels_last:  # N...C -> NC...
+            perm = (0, ndim + 1) + tuple(range(1, ndim + 1))
+            v = jnp.transpose(v, perm)
+            idx = jnp.transpose(idx, perm)
+        spatial_in = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-ndim:])
+        else:
+            ks = (kernel_size,) * ndim if isinstance(kernel_size, int) \
+                else tuple(kernel_size)
+            st = tuple(ks) if stride is None else (
+                (stride,) * ndim if isinstance(stride, int)
+                else tuple(stride))
+            pd = (padding,) * ndim if isinstance(padding, int) \
+                else tuple(padding)
+            out_sp = tuple((s - 1) * st[i] - 2 * pd[i] + ks[i]
+                           for i, s in enumerate(spatial_in))
+        n, c = v.shape[:2]
+        flat_len = int(np.prod(out_sp))
+        vf = v.reshape(n, c, -1)
+        inf = idx.reshape(n, c, -1)
+        out = jnp.zeros((n, c, flat_len), v.dtype)
+        out = out.at[jnp.arange(n)[:, None, None],
+                     jnp.arange(c)[None, :, None], inf].set(vf)
+        out = out.reshape((n, c) + out_sp)
+        if channels_last:  # NC... -> N...C
+            out = jnp.transpose(out, (0,) + tuple(range(2, ndim + 2))
+                                + (1,))
+        return out
+
+    return apply(f, x, indices, op_name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1, channels_last=data_format == "NLC")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2,
+                       channels_last=data_format == "NHWC")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3,
+                       channels_last=data_format == "NDHWC")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference loss.py:1847):
+    cos(m1*theta + m2) - m3 on the target logit, then scaled CE."""
+
+    def f(lg, y):
+        theta = jnp.arccos(jnp.clip(
+            jnp.take_along_axis(lg, y[:, None], axis=1)[:, 0],
+            -1.0 + 1e-7, 1.0 - 1e-7))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = lg.at[jnp.arange(lg.shape[0]), y].set(tgt) * scale
+        lse = jax.scipy.special.logsumexp(adj, axis=1)
+        loss = lse - jnp.take_along_axis(adj, y[:, None], axis=1)[:, 0]
+        out_loss = _reduce(loss, reduction)
+        if return_softmax:
+            return out_loss, jax.nn.softmax(adj, axis=1)
+        return out_loss
+
+    return apply(f, logits, label, op_name="margin_cross_entropy")
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN transducer loss via the log-space forward algorithm
+    (reference rnnt_loss over warp-transducer). logits [B,T,U+1,V],
+    labels [B,U]."""
+
+    def f(lg, lab, t_len, u_len):
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, _V = lp.shape
+        U = U1 - 1
+        blank_lp = lp[..., blank]                      # [B,T,U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None], axis=3)[..., 0]
+        neg_inf = jnp.float32(-1e30)
+
+        # alpha over the (T, U+1) lattice, row by row in t
+        def t_step(alpha_prev, t):
+            # emit from the previous time step (blank transition)
+            from_top = alpha_prev + blank_lp[:, t - 1, :]
+
+            def u_scan(carry, u):
+                # label transition within the row
+                left = jnp.where(u > 0,
+                                 carry + lab_lp[:, t, u - 1], neg_inf)
+                cur = jnp.logaddexp(from_top[:, u], left) \
+                    .astype(jnp.float32)
+                return cur, cur
+
+            _, row = jax.lax.scan(u_scan, jnp.full((B,), neg_inf),
+                                  jnp.arange(U1))
+            return row.T, None
+
+        # t = 0 row: only label transitions
+        def u0_scan(carry, u):
+            nxt = jnp.where(u > 0, carry + lab_lp[:, 0, u - 1],
+                            jnp.float32(0.0))
+            return nxt.astype(jnp.float32), nxt.astype(jnp.float32)
+
+        _, row0 = jax.lax.scan(u0_scan, jnp.zeros((B,), jnp.float32),
+                               jnp.arange(U1))
+        alpha0 = row0.T
+
+        def scan_t(alpha, t):
+            new = t_step(alpha, t)[0]
+            return new, new
+
+        _, rows = jax.lax.scan(scan_t, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], axis=0)  # T,B,U1
+        # final: alpha[t_len-1, u_len] + blank at (t_len-1, u_len)
+        bidx = jnp.arange(B)
+        final = all_rows[t_len - 1, bidx, u_len] \
+            + blank_lp[bidx, t_len - 1, u_len]
+        loss = -final
+        return _reduce(loss, reduction)
+
+    return apply(f, logits, labels, logit_lengths, label_lengths,
+                 op_name="rnnt_loss")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention (reference incubate sparse_attention CUDA
+    op). TPU-native: the CSR pattern densifies into an additive mask and
+    runs through the XLA-fused sdpa — on TPU the MXU prefers the dense
+    masked form over gather-based sparsity at these block sizes."""
+
+    def f(q, k, v, off, cols):
+        B, H, S, D = q.shape
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(D)
+
+        def one_mask(o, c):
+            # nonzero k belongs to row r iff o[r] <= k < o[r+1]
+            rows = jnp.searchsorted(o, jnp.arange(c.shape[0]),
+                                    side="right") - 1
+            rows = jnp.clip(rows, 0, S - 1)
+            return jnp.zeros((S, S), bool).at[rows, c].set(True)
+
+        mask = jax.vmap(jax.vmap(one_mask))(off, cols)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+    return apply(f, query, key, value, sparse_csr_offset,
+                 sparse_csr_columns, op_name="sparse_attention")
+
+
+# -- in-place activation variants ------------------------------------------
+
+def elu_(x, alpha=1.0, name=None):
+    out = apply(lambda v: jnp.where(v > 0, v, alpha * jnp.expm1(v)), x,
+                op_name="elu_")
+    x._inplace_assign(out)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = apply(lambda v: jax.nn.softmax(
+        v.astype(dtype) if dtype else v, axis=axis), x, op_name="softmax_")
+    x._inplace_assign(out)
+    return x
+
+
+from ...ops.extras import tanh_  # noqa: E402  (one in-place impl)

@@ -12,6 +12,7 @@ from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from . import math as _math
 from . import manipulation as _manip

@@ -1,0 +1,248 @@
+"""Top-level API stragglers — the tail of ``paddle.*`` names.
+
+Parity: assorted reference homes — ``python/paddle/tensor/math.py``
+(neg :431, quantile/nanquantile :4874, frexp :5188, renorm :2018,
+sgn :4498, take :5288), ``tensor/manipulation.py`` (reverse=flip,
+vsplit, index_add_, tanh_), ``tensor/attribute.py`` (shape,
+is_complex/is_floating_point/is_integer, iinfo), ``framework``
+(broadcast_shape, set_printoptions), ``fluid/layers`` (create_parameter),
+``reader.py`` (batch), ``fluid/framework.py`` (in_dynamic_mode,
+LazyGuard). All pure jnp/host-side — nothing here touches the hot path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tape import apply
+from ..framework.tensor import Tensor
+from ._dispatch import unwrap
+
+__all__ = [
+    "neg", "floor_mod", "quantile", "nanquantile", "frexp", "renorm",
+    "sgn", "take", "reverse", "vsplit", "index_add_", "tanh_", "shape",
+    "is_complex", "is_floating_point", "is_integer", "iinfo",
+    "broadcast_shape", "set_printoptions", "create_parameter", "batch",
+    "in_dynamic_mode", "LazyGuard", "check_shape",
+    "disable_signal_handler",
+]
+
+
+def neg(x, name=None):
+    return apply(lambda v: -v, x, op_name="neg")
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+def _quantile(x, q, axis, keepdim, nan_aware):
+    fn = jnp.nanquantile if nan_aware else jnp.quantile
+
+    def f(v):
+        qv = jnp.asarray(q, jnp.float64 if v.dtype == jnp.float64
+                         else jnp.float32)
+        out = fn(v.astype(qv.dtype), qv, axis=axis, keepdims=keepdim)
+        return out
+
+    return apply(f, x, op_name="quantile")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(x, q, axis, keepdim, nan_aware=False)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(x, q, axis, keepdim, nan_aware=True)
+
+
+def frexp(x, name=None):
+    """mantissa in [0.5, 1) and integer exponent with x = m * 2**e."""
+
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+
+    return apply(f, x, op_name="frexp")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale slices along ``axis`` whose p-norm exceeds max_norm down to
+    it (reference math.py renorm)."""
+
+    def f(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return apply(f, x, op_name="renorm")
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (reference math.py:4498)."""
+
+    def f(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return apply(f, x, op_name="sgn")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened gather (reference math.py:5288): index into x.ravel().
+    ``mode``: 'raise' clips like paddle's checked path (XLA cannot raise
+    data-dependently), 'wrap' wraps, 'clip' clips."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"unsupported take mode {mode!r}")
+
+    def f(v, i):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        i = i.astype(jnp.int64) if i.dtype not in (jnp.int32, jnp.int64) \
+            else i
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+
+    return apply(f, x, index, op_name="take")
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    from .manipulation import split
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_sections=num_or_indices, axis=0)
+    # indices form: split points -> section sizes
+    idx = list(num_or_indices)
+    n = x.shape[0]
+    bounds = [0] + idx + [n]
+    sections = [b - a for a, b in zip(bounds, bounds[1:])]
+    return split(x, num_or_sections=sections, axis=0)
+
+
+def index_add_(x, index, axis, value, name=None):
+    """In-place index_add (reference manipulation.py index_add_)."""
+    from .manipulation import index_add
+    out = index_add(x, index, axis, value)
+    x._inplace_assign(out)
+    return x
+
+
+def tanh_(x, name=None):
+    out = apply(jnp.tanh, x, op_name="tanh_")
+    x._inplace_assign(out)
+    return x
+
+
+def shape(input):
+    """Runtime shape as an int32 tensor (reference attribute.py:shape)."""
+    return Tensor(jnp.asarray(np.asarray(unwrap(input).shape), jnp.int32))
+
+
+def is_complex(x):
+    return jnp.iscomplexobj(unwrap(x))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def iinfo(dtype):
+    from ..framework.dtype import to_jax_dtype
+    return np.iinfo(np.dtype(to_jax_dtype(dtype)))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr options (host-side numpy printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (reference layers/create_parameter)."""
+    from ..nn.initializer import Constant, XavierNormal
+    from ..framework.tensor import Parameter
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    val = init(tuple(shape), dtype)
+    return Parameter(jnp.asarray(val), name=name)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference
+    fluid/reader batch)."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def in_dynamic_mode():
+    from ..static.program import in_static_mode
+    return not in_static_mode()
+
+
+class LazyGuard:
+    """Reference LazyGuard defers parameter materialization to first use;
+    XLA initializes lazily by construction, so this guard is a no-op
+    context for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def check_shape(shape):
+    """Static-graph shape sanity check (reference utils check_shape)."""
+    for d in tuple(shape):
+        if d is not None and not isinstance(d, int):
+            raise TypeError(f"shape entries must be int/None, got {d!r}")
+    return True
+
+
+def disable_signal_handler():
+    """The reference unhooks its C++ crash handlers; the TPU build
+    installs none, so this is a documented no-op."""

