@@ -1,4 +1,4 @@
 """paddle.autograd parity (reference: ``python/paddle/autograd/``)."""
 from ..framework.tape import backward, grad, no_grad, enable_grad  # noqa: F401
 from ..framework.tape import is_grad_enabled, set_grad_enabled  # noqa: F401
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks  # noqa: F401

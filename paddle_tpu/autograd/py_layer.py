@@ -25,9 +25,18 @@ class PyLayerContext:
         self._materialize_grads = True
 
     def save_for_backward(self, *tensors):
+        hooks = _current_hooks()
+        # capture the pair NOW: backward usually runs after the context
+        # exited, so the ambient stack is the wrong place to look then
+        self._hooks_pair = hooks
+        if hooks is not None:
+            tensors = tuple(hooks[0](t) for t in tensors)
         self.container = tensors
 
     def saved_tensor(self):
+        hooks = getattr(self, "_hooks_pair", None)
+        if hooks is not None:
+            return tuple(hooks[1](t) for t in self.container)
         return self.container
 
     def mark_non_differentiable(self, *tensors):
@@ -115,3 +124,31 @@ class PyLayer(metaclass=PyLayerMeta):
                    _out_index=i)
             for i, o in enumerate(outs))
         return wrapped if multi else wrapped[0]
+
+
+# -- saved_tensors_hooks (reference autograd/saved_tensors_hooks.py) -------
+
+_hooks_stack = []
+
+
+def _current_hooks():
+    return _hooks_stack[-1] if _hooks_stack else None
+
+
+class saved_tensors_hooks:
+    """Context manager intercepting PyLayer save_for_backward /
+    saved_tensor with (pack, unpack) hooks — e.g. offload residuals to
+    host numpy on save and restore on use. Only PyLayer saves route
+    through these; XLA-traced residuals are managed by the compiler
+    (use jax.checkpoint / remat policies for those)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _hooks_stack.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_stack.pop()
+        return False

@@ -27,10 +27,15 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
     target = os.path.join(dirname, filename or "persistables.pdparams")
     if main_program is None:
         raise ValueError("pass the program (or a Layer) whose state to save")
-    state = (main_program.state_dict()
-             if hasattr(main_program, "state_dict")
-             else {p.name or f"param_{i}": p
-                   for i, p in enumerate(main_program.parameters())})
+    if hasattr(main_program, "state_dict"):       # Layer
+        state = main_program.state_dict()
+    elif hasattr(main_program, "_nodes"):         # static Program
+        from ..static.parity import _program_params
+        params = _program_params(main_program)
+        state = {p.name or f"param_{i}": p for i, p in enumerate(params)}
+    else:
+        state = {p.name or f"param_{i}": p
+                 for i, p in enumerate(main_program.parameters())}
     save(state, target)
     return target
 
@@ -39,7 +44,15 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
     import os
     target = os.path.join(dirname, filename or "persistables.pdparams")
     state = load(target)
-    if main_program is not None and hasattr(main_program,
-                                            "set_state_dict"):
+    if main_program is None:
+        return state
+    if hasattr(main_program, "set_state_dict"):   # Layer
         main_program.set_state_dict(state)
+    elif hasattr(main_program, "_nodes"):         # static Program
+        from ..static.parity import set_program_state
+        import numpy as _np
+        from ..ops._dispatch import unwrap as _unwrap
+        set_program_state(main_program,
+                          {k: _np.asarray(_unwrap(v)) if hasattr(v, "_value")
+                           else _np.asarray(v) for k, v in state.items()})
     return state

@@ -6,3 +6,9 @@ from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import autotune  # noqa: F401
 from . import autograd  # noqa: F401
+from .extras import (  # noqa: F401
+    LookAhead, ModelAverage, identity_loss, segment_sum, segment_mean,
+    segment_min, segment_max, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle, graph_send_recv,
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+)

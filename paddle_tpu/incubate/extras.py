@@ -1,0 +1,244 @@
+"""Remaining ``paddle.incubate`` surface.
+
+Parity homes in the reference: ``incubate/optimizer/lookahead.py``
+(LookAhead :30), ``incubate/optimizer/modelaverage.py`` (ModelAverage),
+``incubate/tensor/math.py`` (segment_sum/mean/min/max — delegating to
+the geometric kernels like the reference does),
+``incubate/operators/graph_khop_sampler.py`` / ``graph_reindex.py`` /
+``graph_sample_neighbors.py`` / ``graph_send_recv.py``,
+``incubate/operators/softmax_mask_fuse.py`` (+_upper_triangle), and
+``identity_loss``. Graph sampling is host-side (it is data prep, not
+chip work — the reference's CUDA samplers exist to keep GPU graphs
+resident, which the PS/host tables own here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tape import apply
+from ..framework.tensor import Tensor
+from ..geometric.math import (  # noqa: F401  (reference re-exports these)
+    segment_max, segment_mean, segment_min, segment_sum)
+from ..geometric.message_passing import send_u_recv
+from ..ops._dispatch import unwrap
+
+__all__ = [
+    "LookAhead", "ModelAverage", "identity_loss", "segment_sum",
+    "segment_mean", "segment_min", "segment_max", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+    "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+]
+
+
+class LookAhead:
+    """k-step lookahead wrapper: slow weights interpolate toward the
+    fast optimizer every k steps (incubate/optimizer/lookahead.py:30)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        params = self.inner_optimizer._parameter_list
+        if self._step % self.k:
+            return
+        for p in params:
+            fast = unwrap(p)
+            slow = self._slow.get(id(p), fast)
+            new_slow = slow + self.alpha * (fast - slow)
+            self._slow[id(p)] = new_slow
+            p.set_value(new_slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self.inner_optimizer.minimize(loss)
+        self._step += 1
+        return out
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step
+        return sd
+
+
+class ModelAverage:
+    """Running parameter average with apply/restore guards
+    (incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {id(p): np.zeros_like(np.asarray(unwrap(p)))
+                     for p in self._params}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + np.asarray(unwrap(p))
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            for p in self._params:
+                self._backup[id(p)] = unwrap(p)
+                if self._count:
+                    p.set_value(jnp.asarray(self._sum[id(p)]
+                                            / self._count))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.set_value(self._backup.pop(id(p)))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss without changing it (reference
+    incubate identity_loss — the IPU pipeline marker); reductions kept."""
+    from ..nn.functional.extras import _reduce
+    return apply(lambda v: _reduce(v, reduction), x,
+                 op_name="identity_loss")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference fused_softmax_mask_op.cu).
+    One jnp expression — XLA fuses the add into the softmax on TPU."""
+    return apply(lambda v, m: jax.nn.softmax(v + m, axis=-1), x, mask,
+                 op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangle) mask fused in
+    (reference fused_softmax_mask_upper_triangle_op.cu)."""
+
+    def f(v):
+        S = v.shape[-1]
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        return jax.nn.softmax(jnp.where(causal, v, -1e30), axis=-1)
+
+    return apply(f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy name for geometric send_u_recv (reference
+    graph_send_recv.py delegates the same way)."""
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def _csr(row, colptr_len):
+    return row
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    graph_sample_neighbors.py). Host-side numpy: returns
+    (out_neighbors, out_count[, out_eids])."""
+    rng = np.random.default_rng(0)
+    row_np = np.asarray(unwrap(row)).reshape(-1)
+    colptr_np = np.asarray(unwrap(colptr)).reshape(-1)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    eids_np = np.asarray(unwrap(eids)).reshape(-1) if eids is not None \
+        else None
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        neigh = row_np[lo:hi]
+        idx = np.arange(lo, hi)
+        if sample_size > 0 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eids_np is not None:
+            out_e.append(eids_np[idx])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, row_np.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int64)))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True needs eids")
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Compact node ids to a contiguous range (reference
+    graph_reindex.py): returns (reindexed_src, reindexed_dst,
+    out_nodes)."""
+    x_np = np.asarray(unwrap(x)).reshape(-1)
+    nb = np.asarray(unwrap(neighbors)).reshape(-1)
+    cnt = np.asarray(unwrap(count)).reshape(-1)
+    order = {}
+    for n in list(x_np) + list(nb):
+        if int(n) not in order:
+            order[int(n)] = len(order)
+    src = np.asarray([order[int(n)] for n in nb], np.int64)
+    dst = np.repeat(np.asarray([order[int(n)] for n in x_np], np.int64),
+                    cnt)
+    out_nodes = np.asarray(list(order), np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + reindex (reference graph_khop_sampler.py):
+    returns (edge_src, edge_dst, sample_index, reindex_x)."""
+    cur = input_nodes
+    all_src, all_dst_nodes, all_counts = [], [], []
+    for size in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, cur,
+                                         sample_size=size)
+        all_src.append(np.asarray(unwrap(nb)))
+        all_dst_nodes.append(np.asarray(unwrap(cur)).reshape(-1))
+        all_counts.append(np.asarray(unwrap(cnt)))
+        cur = nb
+    nb_cat = np.concatenate(all_src)
+    dst_rep = np.concatenate([np.repeat(d, c) for d, c in
+                              zip(all_dst_nodes, all_counts)])
+    order = {}
+    for n in list(np.asarray(unwrap(input_nodes)).reshape(-1)) + \
+            list(dst_rep) + list(nb_cat):
+        if int(n) not in order:
+            order[int(n)] = len(order)
+    src = np.asarray([order[int(n)] for n in nb_cat], np.int64)
+    dst = np.asarray([order[int(n)] for n in dst_rep], np.int64)
+    sample_index = np.asarray(list(order), np.int64)
+    reindex_x = np.asarray(
+        [order[int(n)] for n in
+         np.asarray(unwrap(input_nodes)).reshape(-1)], np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(reindex_x)))

@@ -16,3 +16,4 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from ._mp_loader import get_worker_info, WorkerInfo  # noqa: F401

@@ -72,8 +72,27 @@ def wrap_np_tree(item):
     return item
 
 
+_worker_info = None
+
+
+class WorkerInfo:
+    """reference fluid/dataloader/worker.py WorkerInfo: visible only
+    inside a fork worker via io.get_worker_info()."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn,
-                 worker_init_fn, worker_id):
+                 worker_init_fn, worker_id, num_workers=0):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -103,7 +122,7 @@ class MultiprocessIterator:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(dataset, self._index_queues[wid], self._data_queue,
-                      collate_fn, worker_init_fn, wid),
+                      collate_fn, worker_init_fn, wid, num_workers),
                 daemon=True)
             w.start()
             self._workers.append(w)

@@ -16,3 +16,6 @@ from .api import to_static, not_to_static, ignore_module, functional_call, Trace
 from .save_load import save, load, TranslatedLayer  # noqa: F401
 
 from .save_load import InputSpec  # noqa: F401
+from .translator import (  # noqa: F401
+    ProgramTranslator, set_code_level, set_verbosity,
+)

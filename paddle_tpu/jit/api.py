@@ -137,6 +137,16 @@ class TracedProgram:
         return out
 
 
+_to_static_enabled = True
+
+
+def _set_to_static_enabled(flag):
+    """ProgramTranslator.enable(False) parity: @to_static functions run
+    their original eager body until re-enabled."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
 class StaticFunction:
     """@to_static wrapper with per-signature program cache.
 
@@ -183,6 +193,8 @@ class StaticFunction:
         return tuple(parts)
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:  # ProgramTranslator.enable(False)
+            return self._fn(*args, **kwargs)
         # Tensor kwargs become trailing positional inputs of the traced
         # program — real traced inputs (fresh values each call, grads flow
         # when stop_gradient=False) instead of baked trace constants.

@@ -1,0 +1,55 @@
+"""ProgramTranslator facade + dy2static logging knobs.
+
+Parity: ``/root/reference/python/paddle/jit/dy2static/program_translator.py
+:1111 ProgramTranslator`` (singleton switching dy2static on/off, cache
+introspection) and ``dy2static/logging_utils.py`` (set_code_level /
+set_verbosity). The transform pipeline itself lives in
+``jit/dy2static``; the AST-vs-trace decision per function is made by
+``jit.api.to_static``.
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["ProgramTranslator", "set_code_level", "set_verbosity"]
+
+_logger = logging.getLogger("paddle_tpu.dy2static")
+_code_level = 0
+
+
+class ProgramTranslator:
+    """Singleton controlling whether @to_static functions compile or run
+    eagerly (reference program_translator.py:1111)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static):
+        self.enable_to_static = bool(enable_to_static)
+        from .api import _set_to_static_enabled
+        _set_to_static_enabled(self.enable_to_static)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static log verbosity (reference logging_utils.set_verbosity)."""
+    _logger.setLevel(logging.DEBUG if level >= 3
+                     else logging.INFO if level >= 1 else logging.WARNING)
+    if also_to_stdout and not _logger.handlers:
+        _logger.addHandler(logging.StreamHandler())
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """How much transformed code to log (reference set_code_level)."""
+    global _code_level
+    _code_level = level
+    if also_to_stdout:
+        set_verbosity(3, True)

@@ -117,6 +117,25 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     """Hierarchical sigmoid over the default complete binary tree
     (reference loss.py:896). Each class's path bits come from its binary
     code over ``num_classes - 1`` internal nodes."""
+    if (path_table is None) != (path_code is None):
+        raise ValueError("pass path_table and path_code together")
+    if path_table is not None:
+        # custom (Huffman) tree: per-sample node ids + bits, -1 padded
+        def fc(x, pt, pc, w, *b):
+            valid = pt >= 0
+            node = jnp.maximum(pt, 0)
+            logit = jnp.einsum("nf,nlf->nl", x, w[node])
+            if b:
+                logit = logit + b[0][node]
+            sign = jnp.where(pc == 1, 1.0, -1.0)
+            loss = jnp.sum(jnp.log1p(jnp.exp(-sign * logit)) * valid,
+                           axis=1)
+            return loss[:, None]
+
+        args = (input, path_table, path_code, weight) + (
+            (bias,) if bias is not None else ())
+        return apply(fc, *args, op_name="hsigmoid_loss")
+
     # heap of 2n-1 nodes: internal 0..n-2, leaf of class c = c + n - 1.
     # Path lengths vary when n is not a power of two; steps past the
     # root are masked out, and every internal index is < n-1 by
@@ -225,7 +244,15 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
     """Bilinear/nearest sampling at normalized grid coords
-    (reference vision.py:245). x NCHW, grid N,H,W,2 in [-1, 1]."""
+    (reference vision.py:245). x NCHW, grid N,H,W,2 in [-1, 1].
+    padding_mode: 'zeros' or 'border' ('reflection' is not implemented).
+    """
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"padding_mode {padding_mode!r} is not implemented; use "
+            f"'zeros' or 'border'")
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unknown mode {mode!r}")
 
     def f(v, g):
         n, c, h, w = v.shape
@@ -366,6 +393,10 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 
 def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
               fastemit_lambda=0.0, reduction="mean", name=None):
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda regularization is not implemented; pass 0 "
+            "(the plain transducer loss)")
     """RNN transducer loss via the log-space forward algorithm
     (reference rnnt_loss over warp-transducer). logits [B,T,U+1,V],
     labels [B,U]."""

@@ -174,24 +174,66 @@ class BiRNN(Layer):
 
         return apply(f, x, lengths, op_name="seq_reverse")
 
+    @staticmethod
+    def _masked_forward(cell, inputs, lengths, init_states):
+        """Step the cell over time, freezing each sample's state (and
+        zeroing its outputs) once t >= its length — so final states are
+        the state at the TRUE last step, untouched by padding."""
+        from ... import ops
+        from ...framework.tape import apply
+        from ...ops import manipulation as M
+        import jax.numpy as jnp
+
+        T = inputs.shape[1]
+        states = init_states
+        outs = []
+        for t in range(T):
+            x_t = M.squeeze(M.slice(inputs, [1], [t], [t + 1]), [1])
+            out, new_states = cell(x_t, states)
+
+            def keep(new, old, _t=t):
+                if old is None:
+                    return new  # first step defines the state structure
+                return apply(
+                    lambda n, o, ln: jnp.where(
+                        (ln > _t).reshape((-1,) + (1,) * (n.ndim - 1)),
+                        n, o),
+                    new, old, lengths, op_name="masked_state")
+
+            if isinstance(new_states, (tuple, list)):
+                old = (states if isinstance(states, (tuple, list))
+                       else (None,) * len(new_states))
+                states = type(new_states)(
+                    keep(n, o) for n, o in zip(new_states, old))
+            else:
+                states = keep(new_states, states)
+            out = apply(
+                lambda o, ln, _t=t: jnp.where(
+                    (ln > _t).reshape((-1,) + (1,) * (o.ndim - 1)),
+                    o, jnp.zeros_like(o)),
+                out, lengths, op_name="masked_out")
+            outs.append(out)
+        return M.stack(outs, axis=1), states
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         st_fw, st_bw = (initial_states if initial_states is not None
                         else (None, None))
-        out_fw, fin_fw = self.rnn_fw(inputs, st_fw)
         if sequence_length is None:
+            out_fw, fin_fw = self.rnn_fw(inputs, st_fw)
             out_bw, fin_bw = self.rnn_bw(inputs, st_bw)
         else:
-            # padded batch: reverse each sample within its own length,
-            # run FORWARD, and un-reverse — the reference's masked
-            # backward pass (a plain is_reverse sweep would consume the
-            # padding first)
-            if self.rnn_bw.time_major:
+            # padded batch (reference masked BiRNN): forward direction
+            # freezes per-sample state past its length; backward runs
+            # forward over the length-reversed prefix (same masking) and
+            # un-reverses its outputs
+            if self.rnn_fw.time_major:
                 raise NotImplementedError(
                     "sequence_length with time_major BiRNN")
+            out_fw, fin_fw = self._masked_forward(
+                self.cell_fw, inputs, sequence_length, st_fw)
             rev = self._reverse_by_length(inputs, sequence_length)
-            out_rev, fin_bw = self.rnn_fw.__class__(
-                self.cell_bw, is_reverse=False,
-                time_major=False)(rev, st_bw)
+            out_rev, fin_bw = self._masked_forward(
+                self.cell_bw, rev, sequence_length, st_bw)
             out_bw = self._reverse_by_length(out_rev, sequence_length)
         from ... import ops
         return ops.concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)

@@ -449,6 +449,7 @@ class ExponentialMovingAverage:
     def __init__(self, decay=0.999, thres_steps=None, name=None,
                  parameter_list=None):
         self._decay = decay
+        self._thres_steps = thres_steps
         self._params = list(parameter_list or [])
         self._shadow = {}
         self._backup = {}
@@ -463,8 +464,10 @@ class ExponentialMovingAverage:
     def update(self):
         self._ensure_params()
         self._step += 1
-        d = min(self._decay, (1 + self._step) / (10 + self._step)) \
-            if self._step else self._decay
+        # constant decay by default; the TF-style warmup ramp only when
+        # thres_steps is requested (reference semantics)
+        d = self._decay if self._thres_steps is None else min(
+            self._decay, (1 + self._step) / (10 + self._step))
         for p in self._params:
             v = unwrap(p)
             s = self._shadow.get(id(p))

@@ -1,0 +1,45 @@
+"""Image IO backend selection.
+
+Parity: ``/root/reference/python/paddle/vision/image.py``
+(set_image_backend/get_image_backend/image_load) — PIL is the default
+backend; 'cv2' is accepted when opencv is importable (not in this
+image, so it raises with guidance); tensor backend returns HWC arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be pil/cv2/tensor, got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ValueError(
+                "cv2 backend requested but opencv is not installed; "
+                "use the default 'pil' backend") from e
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend
+
+
+def image_load(path, backend=None):
+    backend = backend or _backend
+    if backend == "cv2":
+        import cv2
+        return cv2.imread(path)
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        return np.asarray(img)
+    return img

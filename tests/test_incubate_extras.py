@@ -1,0 +1,207 @@
+"""incubate/io/vision/jit/autograd round-3 tail parity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import incubate
+
+rng = np.random.default_rng(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_lookahead_interpolates_slow_weights():
+    lin = nn.Linear(2, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=lin.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    w0 = lin.weight.numpy().copy()
+    x = _t(np.ones((4, 2), np.float32))
+    for step in range(2):
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k steps the weights were pulled halfway back toward w0's
+    # trajectory: fast-only would differ
+    w_look = lin.weight.numpy().copy()
+    lin2 = nn.Linear(2, 1)
+    lin2.weight.set_value(w0)
+    lin2.bias.set_value(np.zeros_like(lin2.bias.numpy()))
+    assert opt.state_dict()["lookahead_step"] == 2
+    assert not np.allclose(w_look, w0)
+
+
+def test_model_average_applies_mean():
+    lin = nn.Linear(2, 1)
+    ma = incubate.ModelAverage(parameters=lin.parameters())
+    vals = []
+    for v in (1.0, 3.0):
+        lin.weight.set_value(np.full((2, 1), v, np.float32))
+        ma.step()
+        vals.append(v)
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(), np.mean(vals))
+    np.testing.assert_allclose(lin.weight.numpy(), 3.0)  # restored
+
+
+def test_segment_ops_and_identity_loss():
+    data = _t(np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32))
+    seg = _t(np.array([0, 0, 1]))
+    np.testing.assert_allclose(
+        incubate.segment_sum(data, seg).numpy(), [[4, 6], [5, 6]])
+    np.testing.assert_allclose(
+        incubate.segment_mean(data, seg).numpy(), [[2, 3], [5, 6]])
+    out = incubate.identity_loss(data, reduction="sum")
+    np.testing.assert_allclose(out.numpy(), 21.0)
+
+
+def test_softmax_mask_fuse_variants():
+    x = rng.standard_normal((2, 1, 4, 4)).astype(np.float32)
+    mask = np.where(rng.random((2, 1, 4, 4)) > 0.5, 0.0, -1e30) \
+        .astype(np.float32)
+    got = incubate.softmax_mask_fuse(_t(x), _t(mask)).numpy()
+    import scipy.special as sp
+    want = sp.softmax(np.where(mask < -1e20, -np.inf, x + mask), axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    got = incubate.softmax_mask_fuse_upper_triangle(_t(x)).numpy()
+    tri = np.tril(np.ones((4, 4), bool))
+    want = sp.softmax(np.where(tri, x, -np.inf), axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _toy_graph():
+    """CSC: node v's in-neighbors are row[colptr[v]:colptr[v+1]]."""
+    # 0 <- 1,2 ; 1 <- 2 ; 2 <- 0
+    row = _t(np.array([1, 2, 2, 0], np.int64))
+    colptr = _t(np.array([0, 2, 3, 4], np.int64))
+    return row, colptr
+
+
+def test_graph_sample_neighbors_full_and_capped():
+    row, colptr = _toy_graph()
+    nb, cnt = incubate.graph_sample_neighbors(
+        row, colptr, _t(np.array([0, 2], np.int64)))
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1])
+    np.testing.assert_array_equal(nb.numpy(), [1, 2, 0])
+    nb, cnt = incubate.graph_sample_neighbors(
+        row, colptr, _t(np.array([0], np.int64)), sample_size=1)
+    assert cnt.numpy()[0] == 1 and nb.numpy()[0] in (1, 2)
+
+
+def test_graph_reindex_compacts_ids():
+    x = _t(np.array([10, 30], np.int64))
+    neighbors = _t(np.array([30, 20, 10], np.int64))
+    count = _t(np.array([2, 1], np.int64))
+    src, dst, nodes = incubate.graph_reindex(x, neighbors, count)
+    assert nodes.numpy()[0] == 10 and len(nodes.numpy()) == 3
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+    assert src.numpy()[2] == 0  # neighbor 10 reuses x's id slot
+
+
+def test_graph_khop_sampler_shapes():
+    row, colptr = _toy_graph()
+    src, dst, sample_idx, reindex_x = incubate.graph_khop_sampler(
+        row, colptr, _t(np.array([0], np.int64)), [2, 2])
+    assert len(src.numpy()) == len(dst.numpy()) >= 2
+    assert set(reindex_x.numpy()) <= set(range(len(sample_idx.numpy())))
+
+
+def test_io_get_worker_info_in_worker():
+    import paddle_tpu.io as io
+
+    assert io.get_worker_info() is None  # main process
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            wi = io.get_worker_info()
+            assert wi is not None and wi.num_workers == 2
+            return np.array([wi.id], np.int64)
+
+    dl = io.DataLoader(DS(), batch_size=2, num_workers=2)
+    ids = np.concatenate([np.asarray(b[0] if isinstance(b, (list, tuple))
+                                     else b).reshape(-1) for b in dl])
+    assert set(ids.tolist()) <= {0, 1}
+
+
+def test_vision_image_backend(tmp_path):
+    from paddle_tpu import vision
+
+    assert vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError, match="pil/cv2/tensor"):
+        vision.set_image_backend("nope")
+    from PIL import Image
+    p = str(tmp_path / "img.png")
+    Image.fromarray(np.zeros((4, 5, 3), np.uint8)).save(p)
+    img = vision.image_load(p)
+    assert img.size == (5, 4)
+    vision.set_image_backend("tensor")
+    try:
+        arr = vision.image_load(p)
+        assert arr.shape == (4, 5, 3)
+    finally:
+        vision.set_image_backend("pil")
+
+
+def test_program_translator_toggle():
+    from paddle_tpu import jit
+
+    calls = []
+
+    @jit.to_static
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    pt = jit.ProgramTranslator()
+    assert pt is jit.ProgramTranslator.get_instance()
+    pt.enable(False)
+    try:
+        out = f(_t(np.array([3.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), 6.0)
+    finally:
+        pt.enable(True)
+    out = f(_t(np.array([4.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), 8.0)
+    jit.set_verbosity(1)
+    jit.set_code_level(50)
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+    packed, unpacked = [], []
+
+    def pack(t):
+        packed.append(1)
+        return np.asarray(t.numpy())  # offload to host
+
+    def unpack(a):
+        unpacked.append(1)
+        return paddle.to_tensor(a)
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2 * x
+
+    with saved_tensors_hooks(pack, unpack):
+        x = _t(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        y = Square.apply(x)
+        y.backward()
+    assert packed and unpacked
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)

@@ -242,11 +242,19 @@ def test_birnn_sequence_length_masks_padding():
     x_short = rng.standard_normal((1, 2, 3)).astype(np.float32)
     x_padded = np.concatenate(
         [x_short, np.zeros((1, 3, 3), np.float32)], axis=1)
-    out_pad, _ = rnn(_t(x_padded),
-                     sequence_length=_t(np.array([2], np.int64)))
-    out_ref, _ = rnn(_t(x_short))
+    out_pad, (fw_pad, bw_pad) = rnn(
+        _t(x_padded), sequence_length=_t(np.array([2], np.int64)))
+    out_ref, (fw_ref, bw_ref) = rnn(_t(x_short))
     np.testing.assert_allclose(out_pad.numpy()[:, :2], out_ref.numpy(),
                                rtol=1e-5, atol=1e-6)
+    # final states must be padding-free too (review finding): the state
+    # freezes at each sample's true last step
+    np.testing.assert_allclose(fw_pad.numpy(), fw_ref.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(bw_pad.numpy(), bw_ref.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    # padded region of outputs is zeroed
+    np.testing.assert_allclose(out_pad.numpy()[:, 2:], 0.0)
 
 
 def test_max_unpool2d_nhwc():
@@ -288,3 +296,27 @@ def test_sparse_attention_matches_dense_and_traces():
         np.testing.assert_allclose(res, want, rtol=1e-4, atol=1e-5)
     finally:
         static.disable_static()
+
+
+
+def test_hsigmoid_custom_tree_and_rnnt_fastemit_guard():
+    # custom path tables: single internal node, classes split on bit
+    x = _t(rng.standard_normal((4, 3)).astype(np.float32))
+    w = _t(rng.standard_normal((1, 3)).astype(np.float32))
+    pt = _t(np.array([[0], [0], [0], [0]], np.int64))
+    pc = _t(np.array([[1], [1], [0], [0]], np.int64))
+    loss = F.hsigmoid_loss(x, None, 2, w, path_table=pt, path_code=pc)
+    logits = x.numpy() @ w.numpy().T
+    want = np.log1p(np.exp(-np.array([1, 1, -1, -1])[:, None] * logits))
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-5)
+    with pytest.raises(ValueError, match="together"):
+        F.hsigmoid_loss(x, None, 2, w, path_table=pt)
+    with pytest.raises(NotImplementedError, match="fastemit"):
+        F.rnnt_loss(_t(np.zeros((1, 2, 2, 3), np.float32)),
+                    _t(np.zeros((1, 1), np.int64)),
+                    _t(np.array([2], np.int64)),
+                    _t(np.array([1], np.int64)), fastemit_lambda=0.1)
+    with pytest.raises(NotImplementedError, match="reflection"):
+        F.grid_sample(_t(np.zeros((1, 1, 2, 2), np.float32)),
+                      _t(np.zeros((1, 2, 2, 2), np.float32)),
+                      padding_mode="reflection")
