@@ -155,23 +155,15 @@ class BertForPretraining(nn.Layer):
         through the same remat'ed chunked CE the GPT head uses
         (gpt.vocab_parallel_cross_entropy), with the decoder bias folded
         in. ignore_index=-100 semantics via the loss mask."""
-        from ..framework.tape import apply
-        from .gpt import vocab_parallel_cross_entropy
-        import jax.numpy as jnp
+        from .gpt import fused_mlm_cross_entropy
 
         seq, _pooled = self.bert(input_ids, token_type_ids,
                                  attention_mask)
         cls = self.cls
         h = cls.layer_norm(cls.activation(cls.transform(seq)))
-
-        def f(hv, wv, bv, lv):
-            mask = (lv != -100).astype(jnp.float32)
-            return vocab_parallel_cross_entropy(
-                hv, wv.astype(hv.dtype), jnp.where(lv == -100, -1, lv),
-                loss_mask=mask, bias=bv)
-
-        return apply(f, h, cls.decoder_weight, cls.decoder_bias,
-                     masked_lm_labels, op_name="fused_mlm_loss")
+        return fused_mlm_cross_entropy(h, cls.decoder_weight,
+                                       cls.decoder_bias,
+                                       masked_lm_labels)
 
 
 class BertPretrainingCriterion(nn.Layer):

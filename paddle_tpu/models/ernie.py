@@ -137,3 +137,16 @@ class ErnieMoeForPretraining(nn.Layer):
         h = self.layer_norm(nn.functional.gelu(self.transform(h)))
         return ops.matmul(h, self.decoder_weight, transpose_y=True) \
             + self.decoder_bias
+
+    def forward_with_mlm_loss(self, input_ids, masked_lm_labels,
+                              token_type_ids=None, attention_mask=None):
+        """Fused MLM head + chunked CE (same design as
+        bert.py forward_with_mlm_loss): the [B*S, V] fp32 logits buffer
+        never materializes; ignore_index=-100 via the loss mask."""
+        from .gpt import fused_mlm_cross_entropy
+
+        h = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(nn.functional.gelu(self.transform(h)))
+        return fused_mlm_cross_entropy(h, self.decoder_weight,
+                                       self.decoder_bias,
+                                       masked_lm_labels)

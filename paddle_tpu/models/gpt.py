@@ -240,6 +240,23 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
     return jnp.mean(loss)
 
 
+def fused_mlm_cross_entropy(h, weight, bias, labels):
+    """Shared fused MLM head + chunked CE for encoder pretraining heads
+    (BERT/ERNIE): ignore_index=-100 via loss mask, labels remapped to -1
+    so the chunked path's out-of-range handling zeroes their target
+    term. ``h`` is the transformed hidden state Tensor; weight [V, H]
+    tied embeddings; bias [V]."""
+    from ..framework.tape import apply
+
+    def f(hv, wv, bv, lv):
+        mask = (lv != -100).astype(jnp.float32)
+        return vocab_parallel_cross_entropy(
+            hv, wv.astype(hv.dtype), jnp.where(lv == -100, -1, lv),
+            loss_mask=mask, bias=bv)
+
+    return apply(f, h, weight, bias, labels, op_name="fused_mlm_loss")
+
+
 # ---------------------------------------------------------------------------
 # nn.Layer (eager / GSPMD) path
 # ---------------------------------------------------------------------------

@@ -112,3 +112,28 @@ def test_static_amp_capture_trains():
         assert ls[-1] < ls[0], ls
     finally:
         static.disable_static()
+
+
+def test_ernie_fused_mlm_loss_matches_unfused():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (ErnieMoeForPretraining, ErnieMoeModel,
+                                   ernie_moe_tiny_config)
+
+    cfg = ernie_moe_tiny_config()
+    model = ErnieMoeForPretraining(ErnieMoeModel(cfg))
+    model.eval()
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int64))
+    labels_np = rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int64)
+    labels_np[0, :2] = -100
+    labels = paddle.to_tensor(labels_np)
+    logits = model(ids)
+    want = paddle.nn.functional.cross_entropy(
+        paddle.reshape(logits, [-1, cfg.vocab_size]),
+        paddle.to_tensor(labels_np.reshape(-1)),
+        ignore_index=-100)
+    got = model.forward_with_mlm_loss(ids, labels)
+    np.testing.assert_allclose(float(got.numpy()), float(want.numpy()),
+                               rtol=2e-4)
