@@ -87,8 +87,11 @@ class DistModel:
             nodes[i].add_downstream_task(i + 1, buff_size=2)
             nodes[i + 1].add_upstream_task(i, buff_size=2)
 
+        # one carrier id SHARED by all ranks of this pipeline: remote
+        # delivery routes by (carrier_id, task_id), so every rank must
+        # register under the same id (reference: carrier ids are global)
         fe = FleetExecutor().init(
-            f"dist_model_r{cfg.rank}", nodes, rank=cfg.rank,
+            "dist_model", nodes, rank=cfg.rank,
             num_micro_batches=n_micro, rank_to_name=cfg.rank_to_name)
         try:
             return fe.run(timeout=timeout)

@@ -77,9 +77,11 @@ class MessageBus:
     from the task-node map every rank shares).
     """
 
-    def __init__(self, rank=0, rank_to_name=None):
+    def __init__(self, rank=0, rank_to_name=None, carrier_id=None):
         self.rank = rank
         self.rank_to_name = rank_to_name or {}
+        self.carrier_id = carrier_id  # routes remote sends to the peer
+                                      # carrier of the SAME pipeline
         self._local = {}          # task_id -> Interceptor
         self._rank_of = {}        # task_id -> rank
 
@@ -99,8 +101,8 @@ class MessageBus:
             raise ValueError(f"unknown interceptor {msg.dst_id}")
         from .. import rpc
         rpc.rpc_sync(self.rank_to_name[rank], _deliver_remote,
-                     args=(msg.dst_id, msg.src_id, msg.message_type,
-                           msg.scope_idx, msg.payload))
+                     args=(self.carrier_id, msg.dst_id, msg.src_id,
+                           msg.message_type, msg.scope_idx, msg.payload))
         return True
 
 
@@ -108,19 +110,29 @@ class MessageBus:
 _carriers = {}
 
 
-def _deliver_remote(dst_id, src_id, message_type, scope_idx, payload):
+def _deliver_remote(carrier_id, dst_id, src_id, message_type, scope_idx,
+                    payload):
+    """Deliver into the carrier with the SAME carrier_id on this rank —
+    routing by (carrier_id, task_id), so two concurrently running
+    pipelines whose task ids both start at 0 cannot receive each other's
+    credit/data messages."""
     import time
     deadline = time.monotonic() + 30
     while True:  # the peer may still be building its carrier
-        for carrier in list(_carriers.values()):
-            ic = carrier.bus._local.get(dst_id)
-            if ic is not None:
-                ic.enqueue(Message(src_id, dst_id, message_type,
-                                   scope_idx, payload))
-                return True
+        if carrier_id is not None:
+            carrier = _carriers.get(carrier_id)
+            ic = carrier.bus._local.get(dst_id) if carrier else None
+        else:  # legacy direct-Carrier use without an executor id
+            ic = next((c.bus._local[dst_id] for c in list(_carriers.values())
+                       if dst_id in c.bus._local), None)
+        if ic is not None:
+            ic.enqueue(Message(src_id, dst_id, message_type,
+                               scope_idx, payload))
+            return True
         if time.monotonic() > deadline:
             raise ValueError(
-                f"no local interceptor {dst_id} on this rank")
+                f"no local interceptor {dst_id} in carrier "
+                f"{carrier_id!r} on this rank")
         time.sleep(0.02)
 
 
@@ -293,7 +305,7 @@ class FleetExecutor:
         ids = [n.task_id for n in task_nodes]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate task ids: {sorted(ids)}")
-        bus = MessageBus(rank, rank_to_name or {})
+        bus = MessageBus(rank, rank_to_name or {}, carrier_id=carrier_id)
         bus.set_task_ranks({n.task_id: n.rank for n in task_nodes})
         self.carrier = Carrier(carrier_id, bus)
         self._task_nodes = task_nodes

@@ -74,19 +74,26 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Reduce the list across ranks, keep this rank's chunk
-    (communication/reduce_scatter.py). Single-controller: the reduction
-    over the stacked list is real; the 'scatter' keeps rank 0's chunk —
-    compiled code uses prims.c_reducescatter for the mesh version."""
+    (communication/reduce_scatter.py). Every rank holds a tensor_list;
+    the lists are reduced element-wise across ranks and rank r receives
+    reduced list[r]. Single-controller: all ranks share this process's
+    tensor_list, so the cross-rank reduction of entry r is nranks×list[r]
+    (SUM) / list[r] (MAX/MIN) / list[r] (AVG); this rank keeps the entry
+    indexed by its group rank — compiled code uses prims.c_reducescatter
+    for the mesh version."""
     group = _get_group(group)
-    vals = [unwrap(t) for t in tensor_list]
-    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
-           ReduceOp.MIN: jnp.min}.get(op, jnp.sum)
-    stacked = jnp.stack(vals)
-    # reference semantics: element-wise reduce of per-rank tensors, then
-    # rank r receives the r-th tensor's reduction; on one controller we
-    # fill `tensor` with the rank-0 chunk
-    reduced = red(stacked, axis=0) if op != ReduceOp.AVG \
-        else jnp.mean(stacked, axis=0)
+    from . import env as env_mod
+    r = group.get_group_rank(env_mod.get_rank())
+    if r < 0:
+        return tensor  # this process is not a member of the group
+    v = unwrap(tensor_list[r])
+    n = group.nranks
+    if op in (ReduceOp.MAX, ReduceOp.MIN, ReduceOp.AVG):
+        reduced = v  # all ranks contribute the same value
+    elif op == ReduceOp.PROD:
+        reduced = v ** n
+    else:  # SUM
+        reduced = v * n
     tensor._inplace_assign(Tensor(jnp.asarray(reduced)))
     return tensor
 
@@ -106,7 +113,10 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     """Each rank receives its element of src's list (communication/
     scatter.py scatter_object_list)."""
     group = _get_group(group)
-    rank = 0
+    from . import env as env_mod
+    rank = group.get_group_rank(env_mod.get_rank())
+    if rank < 0:
+        return out_object_list  # this process is not a member of the group
     if in_object_list is None:
         raise ValueError("src rank must pass in_object_list")
     if len(in_object_list) % group.nranks:

@@ -48,14 +48,28 @@ class LookAhead:
         return self.inner_optimizer._parameter_list
 
     def step(self):
+        self._capture_slow_init()
         self.inner_optimizer.step()
+        self._after_inner_step()
+
+    def _capture_slow_init(self):
+        """Slow weights start from the params' pre-training values
+        (reference: slow_var initialized from param in the startup
+        program), not from the fast value at first interpolation."""
+        for p in self.inner_optimizer._parameter_list:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = unwrap(p)
+
+    def _after_inner_step(self):
+        """Every k fast steps, pull the slow weights toward the fast ones
+        and reset the fast weights to the interpolation (lookahead.py:30
+        _append_optimize_op)."""
         self._step += 1
-        params = self.inner_optimizer._parameter_list
         if self._step % self.k:
             return
-        for p in params:
+        for p in self.inner_optimizer._parameter_list:
             fast = unwrap(p)
-            slow = self._slow.get(id(p), fast)
+            slow = self._slow[id(p)]
             new_slow = slow + self.alpha * (fast - slow)
             self._slow[id(p)] = new_slow
             p.set_value(new_slow)
@@ -65,8 +79,9 @@ class LookAhead:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        self._capture_slow_init()
         out = self.inner_optimizer.minimize(loss)
-        self._step += 1
+        self._after_inner_step()
         return out
 
     def state_dict(self):

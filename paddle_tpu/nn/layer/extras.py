@@ -264,7 +264,14 @@ class BeamSearchDecoder:
 
 def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
     """Greedy-beam decode loop (reference rnn.py dynamic_decode),
-    returning (token ids [B, T, beam], final states)."""
+    returning (token ids [B, T, beam], final states).
+
+    .. note:: This is a **greedy approximation** of the reference's beam
+       search: a single live stream follows the argmax token and each
+       step's top-k is recorded into the beam slots. There is no score
+       accumulation or per-beam state tracking, so outputs differ from
+       true beam search whenever a non-argmax prefix would win overall.
+    """
     import jax.numpy as jnp
     import paddle_tpu as paddle
 

@@ -197,10 +197,12 @@ def test_distributed_parity_helpers():
     world = dist.get_group().nranks
     dist.scatter_object_list(out, in_object_list=list(range(world)))
     assert out == [0]  # rank 0's chunk on the controller
+    # rank r receives the cross-rank reduction of tensor_list[r]; on one
+    # controller every rank shares this list, so SUM gives nranks*list[0]
     t = paddle.to_tensor(np.zeros(2, np.float32))
     dist.reduce_scatter(t, [paddle.to_tensor(np.ones(2, np.float32)),
                             paddle.to_tensor(np.ones(2, np.float32) * 2)])
-    np.testing.assert_allclose(t.numpy(), 3.0)
+    np.testing.assert_allclose(t.numpy(), float(world))
     single = dist.alltoall_single(paddle.to_tensor(np.arange(4.0)))
     np.testing.assert_allclose(single.numpy(), np.arange(4.0))
     with pytest.raises(ValueError, match="sum to dim0"):

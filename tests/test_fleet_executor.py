@@ -118,7 +118,7 @@ def test_cross_process_pipeline(tmp_path):
         ]
         nodes[0].add_downstream_task(1, 2)
         nodes[1].add_upstream_task(0, 2)
-        fe = FleetExecutor().init("child", nodes, rank=1,
+        fe = FleetExecutor().init("pipe0", nodes, rank=1,
                                   num_micro_batches=4,
                                   rank_to_name={{0: "w0", 1: "w1"}})
         out = fe.run(timeout=60)
@@ -147,7 +147,7 @@ def test_cross_process_pipeline(tmp_path):
         ]
         nodes[0].add_downstream_task(1, 2)
         nodes[1].add_upstream_task(0, 2)
-        fe = FleetExecutor().init("parent", nodes, rank=0,
+        fe = FleetExecutor().init("pipe0", nodes, rank=0,
                                   num_micro_batches=4,
                                   rank_to_name={0: "w0", 1: "w1"})
         fe.run(timeout=60)
