@@ -117,7 +117,8 @@ class PyLayer(metaclass=PyLayerMeta):
                                 else jnp.asarray(g))
             return tuple(vals)
 
-        node = TapeNode(vjp_fn, diff_tensors, out_avals, cls.__name__)
+        node = TapeNode(vjp_fn, diff_tensors, out_avals, cls.__name__,
+                        multi_out=multi)
         wrapped = tuple(
             Tensor(o._value, stop_gradient=id(o) in non_diff_ids,
                    _node=None if id(o) in non_diff_ids else node,

@@ -184,14 +184,26 @@ class TapeNode:
     """One recorded differentiable op: the vjp pullback plus links to the input
     tensors whose gradients it produces (analog of GradNodeBase + TensorWrapper)."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "freed")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "freed", "fwd_fn",
+                 "multi_out", "has_aux", "amp_cast")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name):
+    def __init__(self, vjp_fn, inputs, out_avals, name, fwd_fn=None,
+                 multi_out=False, has_aux=False, amp_cast=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # tuple[Tensor] — diff inputs, order matches vjp outputs
         self.out_avals = out_avals  # list[(shape, jnp dtype)] per diff output
         self.name = name
         self.freed = False
+        # the closed primal fn over the diff input values — lets
+        # create_graph re-derive the vjp as a TAPED op of (cotangents,
+        # primals), which is how gradient-of-gradient reaches the primals
+        self.fwd_fn = fwd_fn
+        # True when the primal returned a tuple/list (even of length 1):
+        # the cotangent handed to vjp_fn must match that pytree structure
+        self.multi_out = multi_out
+        self.has_aux = has_aux      # fwd_fn returns (out, aux)
+        self.amp_cast = amp_cast    # value-cast applied to diff inputs
+                                    # before the primal ran (AMP lists)
 
 
 def _is_diff_dtype(dtype) -> bool:
@@ -261,8 +273,16 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
     diff_tensors = tuple(args[i] for i in diff_idx)
     diff_vals = tuple(vals[i] for i in diff_idx)
 
+    # capture only the NON-diff values: diff positions are overwritten per
+    # call, so nulling them keeps the closure from pinning the AMP-cast
+    # copies of the diff arrays (the uncast originals live in node.inputs;
+    # create_graph re-applies the cast from node.amp_cast)
+    static_full = list(vals)
+    for i in diff_idx:
+        static_full[i] = None
+
     def closed(*dvals):
-        full = list(vals)
+        full = list(static_full)
         for i, dv in zip(diff_idx, dvals):
             full[i] = dv
         return fn(*full, **kwargs)
@@ -277,7 +297,8 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
     outs = tuple(out_val) if multi else (out_val,)
     _maybe_check_nan_inf(name, outs)
     out_avals = [(o.shape, o.dtype) for o in outs]
-    node = TapeNode(vjp_fn, diff_tensors, out_avals, name)
+    node = TapeNode(vjp_fn, diff_tensors, out_avals, name, fwd_fn=closed,
+                    multi_out=multi, has_aux=has_aux, amp_cast=amp_cast)
 
     wrapped = tuple(
         Tensor(o, stop_gradient=False, _node=node, _out_index=i)
@@ -376,8 +397,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             g if g is not None else jnp.zeros(shape, dtype)
             for g, (shape, dtype) in zip(grads, node.out_avals)
         )
-        multi = len(cots) > 1
-        in_grads = node.vjp_fn(cots if multi else cots[0])
+        in_grads = node.vjp_fn(cots if node.multi_out else cots[0])
         for t, g in zip(node.inputs, in_grads):
             if t._node is not None:
                 slot = node_grads.setdefault(
@@ -396,6 +416,113 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
         if not retain_graph:
             node.freed = True
             node.vjp_fn = None
+            node.fwd_fn = None
+
+
+def _backward_taped(tensors, grad_tensors, leaf_ids):
+    """Reverse accumulation where every vjp evaluation is itself RECORDED
+    on the tape (``paddle.grad(create_graph=True)`` — reference
+    eager/backward.cc:105 with ``create_graph``, general_grad.h).
+
+    Each node's pullback is re-derived from the stored primal closure and
+    dispatched through :func:`apply` as one op over (cotangents, primal
+    inputs) — so the returned gradients are taped Tensors whose own
+    backward reaches the primal leaves (hessian-vector products, WGAN-GP
+    gradient penalties). Never frees nodes (create_graph implies
+    retain_graph). Returns {id(leaf): taped grad Tensor}.
+    """
+    from .tensor import Tensor
+
+    def tadd(a, b):
+        return apply(jnp.add, a, b, op_name="grad_accumulate")
+
+    node_grads: dict[int, list] = {}
+    leaf_grads: dict[int, Any] = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            seed = Tensor(jnp.ones(t.shape, t._value.dtype))
+        elif isinstance(g, Tensor):
+            seed = g
+        else:
+            seed = Tensor(jnp.asarray(g))
+        if t._node is None:
+            if not t.stop_gradient and id(t) in leaf_ids:
+                prev = leaf_grads.get(id(t))
+                leaf_grads[id(t)] = seed if prev is None else tadd(prev, seed)
+            continue
+        if t._node.freed:
+            raise RuntimeError(
+                f"create_graph backward through op '{t._node.name}', but the "
+                "tape was freed. Pass retain_graph=True to the first backward()."
+            )
+        if t._node.fwd_fn is None:
+            raise RuntimeError(
+                f"create_graph is not supported through op '{t._node.name}': "
+                "it has no jax-traceable primal closure (custom PyLayer vjps "
+                "are opaque to double backward)."
+            )
+        slot = node_grads.setdefault(id(t._node), [None] * len(t._node.out_avals))
+        i = t._out_index
+        slot[i] = seed if slot[i] is None else tadd(slot[i], seed)
+        roots.append(t._node)
+
+    order = _toposort(roots)
+    for node in reversed(order):
+        grads = node_grads.pop(id(node), None)
+        if grads is None:
+            continue  # unreachable from roots
+        if node.fwd_fn is None:
+            raise RuntimeError(
+                f"create_graph is not supported through op '{node.name}': "
+                "it has no jax-traceable primal closure (custom PyLayer vjps "
+                "are opaque to double backward)." if not node.freed else
+                f"create_graph backward through op '{node.name}', but the "
+                "tape was freed. Pass retain_graph=True to the first "
+                "backward().")
+        cot_tensors = tuple(
+            g if g is not None else Tensor(jnp.zeros(shape, dtype))
+            for g, (shape, dtype) in zip(grads, node.out_avals)
+        )
+        n_out = len(node.out_avals)
+        multi_out = node.multi_out
+        fwd = node.fwd_fn
+
+        def pullback(*flat, _fwd=fwd, _n=n_out, _multi=multi_out,
+                     _aux=node.has_aux, _cast=node.amp_cast):
+            cots, dvals = flat[:_n], flat[_n:]
+            if _cast is not None:
+                # node.inputs holds the UNCAST originals; re-apply the AMP
+                # cast inside the traced fn so the re-derived output dtype
+                # matches out_avals and grads flow back to the uncast leaves
+                dvals = tuple(
+                    _cast(v) if hasattr(v, "dtype")
+                    and jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for v in dvals)
+            if _aux:
+                _, vjp_fn, _ = jax.vjp(_fwd, *dvals, has_aux=True)
+            else:
+                _, vjp_fn = jax.vjp(_fwd, *dvals)
+            return vjp_fn(tuple(cots) if _multi else cots[0])
+
+        in_grads = apply(pullback, *cot_tensors, *node.inputs,
+                         op_name=f"grad_{node.name}")
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for t, g in zip(node.inputs, in_grads):
+            if t._node is not None:
+                want = t._node.out_avals[t._out_index][1]
+                if g._value.dtype != want:  # AMP boundary (see backward())
+                    g = apply(lambda v, _d=want: v.astype(_d), g,
+                              op_name="grad_cast")
+                slot = node_grads.setdefault(
+                    id(t._node), [None] * len(t._node.out_avals))
+                i = t._out_index
+                slot[i] = g if slot[i] is None else tadd(slot[i], g)
+            elif id(t) in leaf_ids:
+                prev = leaf_grads.get(id(t))
+                leaf_grads[id(t)] = g if prev is None else tadd(prev, g)
+    return leaf_grads
 
 
 def grad(
@@ -409,8 +536,9 @@ def grad(
     """paddle.grad parity (reference: eager/general_grad.h GeneralGrad).
 
     Computes d(outputs)/d(inputs) without touching ``.grad`` of other leaves.
-    create_graph is currently handled by re-tracing (the vjp calls are jax-traceable);
-    double-backward through `grad` returns non-taped results for now.
+    With ``create_graph=True`` the vjp evaluations are themselves recorded on
+    the tape (via the stored primal closures), so the returned gradients are
+    differentiable — double backward / gradient penalties work.
     """
     from .tensor import Tensor
 
@@ -421,6 +549,27 @@ def grad(
         inputs = [inputs]
     if retain_graph is None:
         retain_graph = create_graph
+
+    if create_graph:
+        if grad_outputs is None:
+            grad_outputs = [None] * len(outputs)
+        elif isinstance(grad_outputs, Tensor):
+            grad_outputs = [grad_outputs]
+        with enable_grad():
+            leaf_grads = _backward_taped(outputs, grad_outputs,
+                                         {id(t) for t in inputs})
+        results = []
+        for t in inputs:
+            g = leaf_grads.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; pass "
+                        "allow_unused=True to return None for it")
+                results.append(None)
+            else:
+                results.append(g)
+        return results[0] if single else results
 
     # Stash and clear leaf grads of the requested inputs; the leaf filter keeps
     # backward from touching .grad of any other leaf (only_inputs semantics).

@@ -1,0 +1,147 @@
+"""Double backward: paddle.grad(create_graph=True) records the vjp on the tape.
+
+Parity model: reference eager/general_grad.h GeneralGrad + eager/backward.cc:105
+RunBackward(create_graph) — higher-order autograd (hessian-vector products,
+WGAN-GP gradient penalty). Oracles are jax.grad/jax.hessian on the same pure fn.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_second_derivative_scalar():
+    # y = x^3 -> dy/dx = 3x^2 -> d2y/dx2 = 6x
+    x = paddle.to_tensor([2.0, -1.5], stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    assert not g.stop_gradient  # taped result
+    g2 = paddle.grad(g.sum(), [x])[0]
+    np.testing.assert_allclose(g2.numpy(), 6 * x.numpy(), rtol=1e-5)
+
+
+def test_third_derivative():
+    x = paddle.to_tensor([1.3], stop_gradient=False)
+    y = (x ** 4).sum()
+    g1 = paddle.grad(y, [x], create_graph=True)[0]
+    g2 = paddle.grad(g1.sum(), [x], create_graph=True)[0]
+    g3 = paddle.grad(g2.sum(), [x])[0]
+    np.testing.assert_allclose(g3.numpy(), 24 * x.numpy(), rtol=1e-5)
+
+
+def test_hessian_vector_vs_jax_oracle():
+    def f(x):
+        return jnp.sum(jnp.tanh(x) ** 2) + 0.5 * x[0] * x[1]
+
+    x0 = np.array([0.3, -0.7, 1.1], np.float32)
+    v0 = np.array([1.0, 2.0, -0.5], np.float32)
+
+    x = paddle.to_tensor(x0, stop_gradient=False)
+    v = paddle.to_tensor(v0)
+    y = (paddle.tanh(x) ** 2).sum() + 0.5 * x[0] * x[1]
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    hvp = paddle.grad((g * v).sum(), [x])[0]
+
+    oracle = jax.hessian(f)(jnp.asarray(x0)) @ jnp.asarray(v0)
+    np.testing.assert_allclose(hvp.numpy(), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grad_penalty_reaches_params():
+    """WGAN-GP shape: penalty = (||d critic/d x|| - 1)^2 must produce
+    nonzero, oracle-matched gradients for the critic's weights."""
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal((4, 1)).astype(np.float32)
+    x0 = rng.standard_normal((3, 4)).astype(np.float32)
+
+    w = paddle.to_tensor(w0, stop_gradient=False)
+    x = paddle.to_tensor(x0, stop_gradient=False)
+    score = paddle.matmul(paddle.tanh(x), w).sum()
+    (gx,) = paddle.grad(score, [x], create_graph=True)
+    penalty = ((gx * gx).sum(axis=1).sqrt() - 1.0).pow(2).mean()
+    (gw,) = paddle.grad(penalty, [w])
+
+    def penalty_fn(wv):
+        def critic(xv):
+            return jnp.sum(jnp.tanh(xv) @ wv)
+        gxv = jax.grad(critic)(jnp.asarray(x0))
+        return jnp.mean((jnp.sqrt(jnp.sum(gxv * gxv, axis=1)) - 1.0) ** 2)
+
+    oracle = jax.grad(penalty_fn)(jnp.asarray(w0))
+    assert float(np.abs(gw.numpy()).max()) > 0
+    np.testing.assert_allclose(gw.numpy(), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_create_graph_backward_into_dot_grad():
+    """backward() after a create_graph grad accumulates into .grad."""
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 2).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    loss2 = (g * g).sum()          # = 4 * sum(x^2)
+    loss2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8 * x.numpy(), rtol=1e-5)
+
+
+def test_multi_output_node_create_graph():
+    x = paddle.to_tensor([0.5, 1.5, -2.0, 3.0], stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    y = (a * b).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    g2 = paddle.grad((g ** 2).sum(), [x])[0]
+
+    def f(xv):
+        av, bv = jnp.split(xv, 2)
+        return jnp.sum(av * bv)
+
+    def f2(xv):
+        return jnp.sum(jax.grad(f)(xv) ** 2)
+
+    oracle = jax.grad(f2)(jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(g2.numpy(), np.asarray(oracle), rtol=1e-5)
+
+
+def test_create_graph_requires_unfreed_tape():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x ** 2).sum()
+    y.backward()  # frees the tape
+    try:
+        paddle.grad(y, [x], create_graph=True)
+    except RuntimeError as e:
+        assert "freed" in str(e)
+    else:
+        raise AssertionError("expected RuntimeError on freed tape")
+
+
+def test_create_graph_through_has_aux_op():
+    """topk is recorded with has_aux (indices); create_graph must re-derive
+    its vjp with has_aux=True."""
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, _idx = paddle.topk(x, k=2)
+    y = (vals ** 2).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    g2 = paddle.grad((g ** 2).sum(), [x])[0]
+    # d/dx of sum(g^2) where g = [2*3, 0, 2*2] -> 2*g*dg/dx = [24, 0, 16]... dg/dx diag = 2 on topk slots
+    np.testing.assert_allclose(g2.numpy(), [24.0, 0.0, 16.0], rtol=1e-5)
+
+
+def test_create_graph_under_amp():
+    from paddle_tpu import amp
+    x = paddle.to_tensor(np.ones((4, 4), np.float32) * 0.5,
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.eye(4, dtype=np.float32) * 2.0,
+                         stop_gradient=False)
+    with amp.auto_cast(True, level="O1"):
+        y = paddle.matmul(x, w).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    gw = paddle.grad((g * g).sum(), [w], allow_unused=True)[0]
+    assert gw is not None
+
+    def f(wv):
+        gv = jax.grad(lambda xv: jnp.sum(xv @ wv))(
+            jnp.ones((4, 4), jnp.float32) * 0.5)
+        return jnp.sum(gv * gv)
+
+    oracle = jax.grad(f)(np.eye(4, dtype=np.float32) * 2.0)
+    np.testing.assert_allclose(gw.numpy(), np.asarray(oracle), rtol=1e-2)

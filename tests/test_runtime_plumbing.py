@@ -268,3 +268,42 @@ def test_mp_dataloader_worker_init_fn():
     loader = DataLoader(ds, batch_size=4, num_workers=2,
                         worker_init_fn=lambda wid: None)
     assert len(list(loader)) == 2
+
+
+def test_pylayer_multi_output_backward():
+    """Multi-output PyLayer: backward receives one cotangent per output
+    (regression: TapeNode.multi_out must be set for PyLayer nodes)."""
+    class TwoOut(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0, x * x
+
+        @staticmethod
+        def backward(ctx, ga, gb):
+            (x,) = ctx.saved_tensor()
+            return ga * 2.0 + gb * 2.0 * x
+
+    x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    x.stop_gradient = False
+    a, b = TwoOut.apply(x)
+    (ops.sum(a) + ops.sum(b)).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               2.0 + 2.0 * np.array([1.0, -2.0]), rtol=1e-6)
+
+
+def test_pylayer_create_graph_clear_error():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2.0
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    x.stop_gradient = False
+    y = ops.sum(Double.apply(x))
+    with pytest.raises(RuntimeError, match="not supported through op"):
+        paddle.grad(y, [x], create_graph=True)
