@@ -262,3 +262,148 @@ def gpipe_spmd(block_fn, stacked_params, x_micro, mesh, n_micro,
         out_specs=P(),
         check_vma=False,
     )(stacked_params, x_micro, labels_micro)
+
+
+# ---------------------------------------------------------------------------
+# the compiled 1F1B schedule — O(pp) live activations, manual in-loop backward
+# ---------------------------------------------------------------------------
+
+def _onef1b_tick_loop(block_apply, head_apply, blocks_local, head_params,
+                      xs, labs, pp, n_micro, seed_scale=1.0):
+    """Lockstep 1F1B tick loop — runs INSIDE a shard_map over the ``pp`` axis.
+
+    Parity: ``pipeline_parallel.py:119`` forward_backward_pipeline's
+    steady-state 1F1B. TPU-native form: one compiled loop where every tick
+    does one forward AND one backward per stage —
+      forward  wavefront: stage s runs micro m at tick  t = m + s
+      backward wavefront: stage s runs micro m at tick  t = m + 2(pp-1) - s
+    (the last stage backwards a micro in the tick it forwards it). Stage-input
+    activations live in a ``min(n_micro, 2pp-1)``-slot ring buffer, so live
+    activation memory is **O(pp), not O(n_micro)** — the property GPipe
+    fill-drain lacks. Each backward re-derives its stage's vjp from the saved
+    input (recompute-in-backward; residuals are transient within the tick).
+    The backward is MANUAL (jax.vjp per tick), so this function returns
+    gradients directly instead of relying on jax.grad over the schedule.
+
+    block_apply(blocks_local, x) -> y applies this stage's whole sub-stack.
+    head_apply(head_params, y, lab) -> scalar loss (f32) for the last stage.
+    seed_scale scales the loss cotangent (fold 1/n_micro and any axis-mean
+    normalizations here). Returns per-rank UNREDUCED
+    ``(loss_sum_f32, dblocks_f32, dhead_f32, dxs)``: loss/dhead are nonzero
+    only on the last stage, dxs only on stage 0; callers psum/mask over
+    ``pp`` (and any model-parallel axes) as their sharding requires.
+    """
+    stage = jax.lax.axis_index("pp")
+    K = min(n_micro, 2 * pp - 1)
+    T = n_micro + 2 * (pp - 1)
+    rot_f = [(i, (i + 1) % pp) for i in range(pp)]
+    rot_b = [(i, (i - 1) % pp) for i in range(pp)]
+    f32 = jnp.float32
+    to_f32 = lambda tree: jax.tree.map(lambda v: v.astype(f32), tree)
+    zeros_f32 = lambda tree: jax.tree.map(
+        lambda v: jnp.zeros(v.shape, f32), tree)
+
+    def tick(carry, t):
+        fstate, bstate, ring, gb, gh, dxs, loss_acc = carry
+
+        # ---- forward wavefront: micro m_f enters this stage ----
+        m_f = t - stage
+        valid_f = (m_f >= 0) & (m_f < n_micro)
+        x_in = jnp.where(stage == 0,
+                         jnp.take(xs, jnp.clip(m_f, 0, n_micro - 1), axis=0),
+                         fstate)
+        slot_f = jnp.where(valid_f, m_f % K, 0)
+        old = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+        ring = jax.lax.dynamic_update_index_in_dim(
+            ring, jnp.where(valid_f, x_in, old), slot_f, 0)
+        y = block_apply(blocks_local, x_in)
+
+        # ---- backward wavefront: micro m_b leaves this stage ----
+        m_b = t - 2 * (pp - 1) + stage
+        valid_b = (m_b >= 0) & (m_b < n_micro)
+        slot_b = jnp.where(valid_b, m_b % K, 0)
+        x_s = jax.lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
+        lab = jnp.take(labs, jnp.clip(m_b, 0, n_micro - 1), axis=0)
+
+        def last_branch(x_s, lab, _cot):
+            # forward + head, loss cotangent seeds the vjp; masking the
+            # seed (not the grads) zeroes invalid ticks for free
+            def f(bl, hp, xx):
+                return head_apply(hp, block_apply(bl, xx), lab)
+            lv, vjp = jax.vjp(f, blocks_local, head_params, x_s)
+            seed = jnp.where(valid_b, seed_scale, 0.0).astype(lv.dtype)
+            db, dh, dx = vjp(seed)
+            return (jnp.where(valid_b, lv, 0.0).astype(f32),
+                    to_f32(db), to_f32(dh), dx)
+
+        def mid_branch(x_s, _lab, cot):
+            def f(bl, xx):
+                return block_apply(bl, xx)
+            _y, vjp = jax.vjp(f, blocks_local, x_s)
+            db, dx = vjp(jnp.where(valid_b, cot, jnp.zeros_like(cot)))
+            return (jnp.zeros((), f32), to_f32(db),
+                    zeros_f32(head_params), dx)
+
+        # stage is uniform within every mp/dp group, so the collectives
+        # inside each branch stay collective-safe (same gate as gpipe head)
+        lv, db, dh, dx = jax.lax.cond(stage == pp - 1, last_branch,
+                                      mid_branch, x_s, lab, bstate)
+
+        gb = jax.tree.map(jnp.add, gb, db)
+        gh = jax.tree.map(jnp.add, gh, dh)
+        loss_acc = loss_acc + lv
+        slot_x = jnp.clip(m_b, 0, n_micro - 1)
+        old_dx = jax.lax.dynamic_index_in_dim(dxs, slot_x, 0, keepdims=False)
+        dxs = jax.lax.dynamic_update_index_in_dim(
+            dxs, jnp.where(valid_b & (stage == 0), dx, old_dx), slot_x, 0)
+
+        fstate = jax.lax.ppermute(y, "pp", rot_f)
+        bstate = jax.lax.ppermute(dx, "pp", rot_b)
+        return (fstate, bstate, ring, gb, gh, dxs, loss_acc), None
+
+    init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]),
+            jnp.zeros((K,) + xs.shape[1:], xs.dtype),
+            zeros_f32(blocks_local), zeros_f32(head_params),
+            jnp.zeros_like(xs), jnp.zeros((), f32))
+    (_, _, _, gb, gh, dxs, loss_acc), _ = jax.lax.scan(
+        tick, init, jnp.arange(T))
+    return loss_acc, gb, gh, dxs
+
+
+def onef1b_spmd(block_fn, stacked_params, x_micro, mesh, n_micro,
+                head_fn=None, labels_micro=None):
+    """1F1B counterpart of :func:`gpipe_spmd` — same layout contract, but
+    returns ``(loss, dparams, dxs)`` with gradients computed by the manual
+    in-schedule backward (so activation memory is O(pp), not O(n_micro)).
+
+    stacked_params: pytree of [pp * layers_per_stage, ...] arrays (dim0
+    sharded over pp). x_micro: [n_micro, mb, ...]. head_fn(y, lab) -> scalar.
+    """
+    pp = mesh.shape["pp"]
+
+    def stage_prog(params_local, xs, labs):
+        def block_apply(bl, x):
+            out, _ = jax.lax.scan(lambda h, p: (block_fn(p, h), None), x, bl)
+            return out
+
+        def head_apply(_hp, y, lab):
+            return head_fn(y, lab)
+
+        loss_sum, db, _dh, dxs = _onef1b_tick_loop(
+            block_apply, head_apply, params_local, {}, xs, labs, pp,
+            n_micro, seed_scale=1.0 / n_micro)
+        stage = jax.lax.axis_index("pp")
+        loss = jax.lax.psum(loss_sum, "pp") / n_micro
+        dxs = jax.lax.psum(
+            jnp.where(stage == 0, dxs, jnp.zeros_like(dxs)), "pp")
+        return loss, db, dxs
+
+    return shard_map(
+        stage_prog, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                   P()),
+        check_vma=False,
+    )(stacked_params, x_micro, labels_micro)

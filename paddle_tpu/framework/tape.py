@@ -411,6 +411,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 if g.dtype != want:
                     g = g.astype(want)
                 slot[i] = g if slot[i] is None else slot[i] + g
+                if _leaf_filter is not None and id(t) in _leaf_filter:
+                    # paddle.grad supports intermediate (non-leaf) inputs:
+                    # record the consumer contribution AND keep propagating
+                    t._accumulate_grad(g)
             elif _leaf_filter is None or id(t) in _leaf_filter:
                 t._accumulate_grad(g)
         if not retain_graph:
@@ -519,6 +523,11 @@ def _backward_taped(tensors, grad_tensors, leaf_ids):
                     id(t._node), [None] * len(t._node.out_avals))
                 i = t._out_index
                 slot[i] = g if slot[i] is None else tadd(slot[i], g)
+                if id(t) in leaf_ids:
+                    # intermediate (non-leaf) requested input: record the
+                    # consumer contribution AND keep propagating
+                    prev = leaf_grads.get(id(t))
+                    leaf_grads[id(t)] = g if prev is None else tadd(prev, g)
             elif id(t) in leaf_ids:
                 prev = leaf_grads.get(id(t))
                 leaf_grads[id(t)] = g if prev is None else tadd(prev, g)

@@ -424,7 +424,8 @@ class GPTHybridTrainStep:
     def __init__(self, model, config: GPTConfig, hcg, n_micro=None, lr=1e-4,
                  beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.01,
                  grad_clip_norm=1.0, remat=True, compute_dtype=None,
-                 use_flash=None, virtual_pp_degree=1):
+                 use_flash=None, virtual_pp_degree=1,
+                 pipeline_schedule="gpipe"):
         gpt = model.gpt if isinstance(model, GPTForPretraining) else model
         self.model = model
         self.gpt = gpt
@@ -440,6 +441,16 @@ class GPTHybridTrainStep:
         assert config.vocab_size % mp == 0, "vocab must divide mp"
         self.n_micro = n_micro or max(pp, 1)
         self.vpp = vpp
+        # "gpipe": fill-drain forward, backward via jax.grad over the
+        # schedule (activations O(n_micro)). "1f1b": manual in-schedule
+        # backward, live activations O(pp) (pipeline_parallel.py:119).
+        if pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
+        if pipeline_schedule == "1f1b" and vpp > 1:
+            raise NotImplementedError(
+                "interleaved 1F1B (virtual_pp_degree>1) not implemented; "
+                "use the breadth-first virtual-pp gpipe schedule")
+        self.pipeline_schedule = pipeline_schedule
         self.hyper = (lr, beta1, beta2, eps, weight_decay, grad_clip_norm)
         self.remat = remat
         # AMP-O2 style: master params stay f32, forward runs in compute_dtype
@@ -511,6 +522,34 @@ class GPTHybridTrainStep:
         return P(*parts)
 
     # ------------------------------------------------------------------
+    def _cast_params(self, params):
+        """AMP-O2 master->compute cast (bf16 keeps matmuls on the MXU)."""
+        if self.compute_dtype is None:
+            return params
+        cast = lambda v: v.astype(self.compute_dtype)
+        return dict(params, blocks=jax.tree.map(cast, params["blocks"]),
+                    wte=cast(params["wte"]), wpe=cast(params["wpe"]))
+
+    def _check_seq(self, S):
+        if S > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {S} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings}")
+
+    def _use_flash(self, S):
+        """ONE flash-attention gate for every schedule (tuning-sensitive:
+        retunes must apply to gpipe and 1f1b alike). auto: flash beats
+        XLA's fused attention from S>=512 even at d=64 (measured +9%
+        tokens/s on GPT-345M @1024 on v5e — the lane padding is outweighed
+        by skipping the materialized probs matrix); off on the CPU mesh
+        (interpret mode inside shard_map is slow)."""
+        if self.use_flash is None:
+            use_flash = (jax.default_backend() == "tpu" and S >= 512)
+        else:
+            use_flash = self.use_flash
+        return use_flash and S % 128 == 0 and S >= 128 \
+            and self.config.head_dim <= 128
+
     def _loss_fn(self, params, ids, labels):
         """Full forward: embed (GSPMD) -> GPipe decoder shard_map -> loss."""
         cfg = self.config
@@ -523,15 +562,8 @@ class GPTHybridTrainStep:
         assert B % n_micro == 0, "batch must divide micro-batches"
         mb = B // n_micro
 
-        if self.compute_dtype is not None:
-            cast = lambda v: v.astype(self.compute_dtype)
-            params = dict(params, blocks=jax.tree.map(cast, params["blocks"]),
-                          wte=cast(params["wte"]), wpe=cast(params["wpe"]))
-
-        if S > cfg.max_position_embeddings:
-            raise ValueError(
-                f"sequence length {S} exceeds max_position_embeddings "
-                f"{cfg.max_position_embeddings}")
+        params = self._cast_params(params)
+        self._check_seq(S)
         pos = jnp.arange(S)
         h = params["wte"][ids] + params["wpe"][pos]
         xs = h.reshape(n_micro, mb, S, cfg.hidden_size)
@@ -539,16 +571,7 @@ class GPTHybridTrainStep:
 
         eps = cfg.layer_norm_epsilon
         remat = self.remat
-        # auto: flash beats XLA's fused attention from S>=512 even at d=64
-        # (measured +9% tokens/s on GPT-345M @1024 on v5e — the lane padding
-        # is outweighed by skipping the materialized probs matrix); off on
-        # the CPU mesh (interpret mode inside shard_map is slow)
-        if self.use_flash is None:
-            use_flash = (jax.default_backend() == "tpu" and S >= 512)
-        else:
-            use_flash = self.use_flash
-        use_flash = use_flash and S % 128 == 0 and S >= 128 \
-            and cfg.head_dim <= 128
+        use_flash = self._use_flash(S)
 
         def stage_prog(blocks_local, wte_local, lnf_w, lnf_b, xs, labs):
             stage = jax.lax.axis_index("pp")
@@ -739,6 +762,116 @@ class GPTHybridTrainStep:
           xs, labs)
         return loss
 
+    def _loss_and_grads_1f1b(self, params, ids, labels):
+        """Forward AND backward via the compiled 1F1B schedule
+        (pipeline_parallel.py:119 steady-state parity).
+
+        Unlike :meth:`_loss_fn` + jax.grad (GPipe: every micro-batch's
+        activations are live until the backward pass), the 1F1B tick loop
+        in ``fleet/pipeline.py`` interleaves each micro-batch's backward
+        with the next ones' forwards, bounding live activations to O(pp)
+        stage inputs. Gradients come out of the shard_map directly; the
+        embedding backward closes the loop through the collected input
+        cotangents.
+
+        Collective-calibration (manual vjp inside shard_map, psumᵀ=psum):
+        the loss is replicated over mp after the CE's internal psums, so
+        every mp rank's vjp seed carries 1/mp; grads of mp-replicated
+        params then need a psum over mp, mp-sharded params are exact
+        locally, and stage-boundary cotangents are partial (they sum to
+        the true cotangent — the next stage's psum transpose restores
+        them). dp/sharding shards each carry 1/(dp·sharding) in the seed
+        and psum at the end (= the pmean the GPipe path gets from
+        shard_map's own transpose).
+        """
+        cfg = self.config
+        mesh = self.mesh
+        pp = mesh.shape["pp"]
+        mp = mesh.shape["mp"]
+        dpsh = mesh.shape["dp"] * mesh.shape["sharding"]
+        n_micro = self.n_micro
+        B, S = ids.shape
+        assert B % n_micro == 0, "batch must divide micro-batches"
+        mb = B // n_micro
+
+        params = self._cast_params(params)
+        self._check_seq(S)
+        pos = jnp.arange(S)
+
+        def embed(wte, wpe):
+            return wte[ids] + wpe[pos]
+
+        h, embed_vjp = jax.vjp(embed, params["wte"], params["wpe"])
+        xs = h.reshape(n_micro, mb, S, cfg.hidden_size)
+        labs = labels.reshape(n_micro, mb, S)
+
+        eps = cfg.layer_norm_epsilon
+        use_flash = self._use_flash(S)
+
+        from ..distributed.fleet.pipeline import _onef1b_tick_loop
+
+        def stage_prog(blocks_local, wte_local, lnf_w, lnf_b, xs, labs):
+            stage = jax.lax.axis_index("pp")
+            blk = lambda p, xx: gpt_block(p, xx, eps, mp_axis="mp",
+                                          use_flash=use_flash)
+            # no remat wrapper: 1F1B's per-tick vjp residuals are consumed
+            # in the same tick, so there is nothing to trade FLOPs for
+
+            def block_apply(bl, x):
+                out, _ = jax.lax.scan(lambda h_, p: (blk(p, h_), None), x, bl)
+                return out
+
+            def head_apply(hp, y, lab):
+                x = _ln(y, hp["lnf_w"], hp["lnf_b"], eps).astype(
+                    hp["wte"].dtype)
+                return vocab_parallel_cross_entropy(x, hp["wte"], lab,
+                                                    mp_axis="mp")
+
+            head_params = {"wte": wte_local, "lnf_w": lnf_w, "lnf_b": lnf_b}
+            seed = 1.0 / (n_micro * mp * dpsh)
+            loss_sum, gb, gh, dxs = _onef1b_tick_loop(
+                block_apply, head_apply, blocks_local, head_params,
+                xs, labs, pp, n_micro, seed_scale=seed)
+
+            # ---- reductions (see docstring) ----
+            loss = jax.lax.psum(loss_sum, "pp") / n_micro
+            loss = jax.lax.pmean(loss, ("dp", "sharding"))
+            gb = {k: jax.lax.psum(v, ("dp", "sharding"))
+                  for k, v in gb.items()}
+            gb = {k: v if any(ax == "mp" or (isinstance(ax, tuple)
+                                             and "mp" in ax)
+                              for ax in _STACK_SPECS[k])
+                  else jax.lax.psum(v, "mp") for k, v in gb.items()}
+            gh = jax.tree.map(lambda v: jax.lax.psum(v, ("pp", "dp",
+                                                         "sharding")), gh)
+            gh["lnf_w"] = jax.lax.psum(gh["lnf_w"], "mp")
+            gh["lnf_b"] = jax.lax.psum(gh["lnf_b"], "mp")
+            dxs = jnp.where(stage == 0, dxs, jnp.zeros_like(dxs))
+            dxs = jax.lax.psum(dxs, ("pp", "mp"))
+            return loss, gb, gh["wte"], gh["lnf_w"], gh["lnf_b"], dxs
+
+        data_spec = P(None, ("dp", "sharding"), None)
+        xs_spec = P(None, ("dp", "sharding"), None, None)
+        loss, gb, gwte_h, glnf_w, glnf_b, dxs = shard_map(
+            stage_prog, mesh=mesh,
+            in_specs=(dict(_STACK_SPECS), P("mp", None), P(), P(),
+                      xs_spec, data_spec),
+            out_specs=(P(), dict(_STACK_SPECS), P("mp", None), P(), P(),
+                       xs_spec),
+            check_vma=False,
+        )(params["blocks"], params["wte"], params["lnf_w"], params["lnf_b"],
+          xs, labs)
+
+        dwte_e, dwpe = embed_vjp(dxs.reshape(B, S, cfg.hidden_size))
+        grads = {
+            "blocks": gb,
+            "wte": gwte_h + dwte_e.astype(jnp.float32),
+            "wpe": dwpe.astype(jnp.float32),
+            "lnf_w": glnf_w,
+            "lnf_b": glnf_b,
+        }
+        return loss, grads
+
     def _decay_mask(self):
         """Reference GPT recipe: weight decay on matmul weights + embeddings,
         never on LayerNorm scales or biases."""
@@ -756,8 +889,12 @@ class GPTHybridTrainStep:
 
         def step(params, opt_state, ids, labels, lr, t):
             _, b1, b2, eps_o, wd, clip = self.hyper
-            loss, grads = jax.value_and_grad(self._loss_fn)(params, ids,
-                                                            labels)
+            if self.pipeline_schedule == "1f1b" \
+                    and self.mesh.shape["pp"] > 1:
+                loss, grads = self._loss_and_grads_1f1b(params, ids, labels)
+            else:
+                loss, grads = jax.value_and_grad(self._loss_fn)(params, ids,
+                                                                labels)
             if clip is not None and clip > 0:
                 gnorm = jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))

@@ -145,3 +145,18 @@ def test_create_graph_under_amp():
 
     oracle = jax.grad(f)(np.eye(4, dtype=np.float32) * 2.0)
     np.testing.assert_allclose(gw.numpy(), np.asarray(oracle), rtol=1e-2)
+
+
+def test_grad_wrt_intermediate_tensor():
+    """paddle.grad supports non-leaf inputs (reference GeneralGrad)."""
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * 2.0
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, [h], create_graph=True)
+    np.testing.assert_allclose(gh.numpy(), 2 * h.numpy(), rtol=1e-6)
+    # and through the plain path too
+    x2 = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h2 = x2 * 2.0
+    y2 = (h2 * h2).sum()
+    (gh2,) = paddle.grad(y2, [h2])
+    np.testing.assert_allclose(gh2.numpy(), 2 * h2.numpy(), rtol=1e-6)

@@ -247,3 +247,49 @@ def test_zero_stages_shard_state_and_match_oracle(stage):
         sharded_params = [
             s for s in step._param_specs if "sharding" in [a for a in s if a]]
         assert sharded_params, "stage 3 must shard parameters"
+
+
+def test_1f1b_matches_sequential_pp4():
+    """1F1B compiled schedule: loss AND manual grads match the sequential
+    stack oracle at pp=4, n_micro=8 (VERDICT r3 done-criterion)."""
+    from paddle_tpu.distributed.fleet.pipeline import onef1b_spmd
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=4)
+    mesh = hcg.mesh
+    pp, layers_per, n_micro = 4, 2, 8
+    mb, s, h = 2, 3, 8
+    rng = np.random.default_rng(7)
+    params = {"w": jnp.asarray(
+        rng.standard_normal((pp * layers_per, h, h)).astype(np.float32) * 0.3),
+        "b": jnp.asarray(
+        rng.standard_normal((pp * layers_per, h)).astype(np.float32) * 0.1)}
+    xs = jnp.asarray(rng.standard_normal((n_micro, mb, s, h)).astype(np.float32))
+    labels = jnp.asarray(
+        rng.standard_normal((n_micro, mb, s, h)).astype(np.float32))
+
+    def block_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def head_fn(x, lab):
+        return jnp.mean((x - lab) ** 2)
+
+    loss, grads, dxs = onef1b_spmd(block_fn, params, xs, mesh, n_micro,
+                                   head_fn=head_fn, labels_micro=labels)
+
+    def seq_loss(pr, xv):
+        tot = 0.0
+        for m in range(n_micro):
+            x = xv[m]
+            for i in range(pp * layers_per):
+                x = block_fn(jax.tree.map(lambda a: a[i], pr), x)
+            tot = tot + head_fn(x, labels[m])
+        return tot / n_micro
+
+    ref_loss = seq_loss(params, xs)
+    g_ref, dxs_ref = jax.grad(seq_loss, argnums=(0, 1))(params, xs)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dxs), np.asarray(dxs_ref),
+                               rtol=1e-4, atol=1e-6)

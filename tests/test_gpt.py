@@ -273,3 +273,40 @@ def test_bert_fused_mlm_loss_matches_criterion():
         got = model.forward_with_mlm_loss(ids, labels)
         np.testing.assert_allclose(float(got.numpy()),
                                    float(want.numpy()), rtol=2e-4)
+
+
+def test_gpt_1f1b_matches_gpipe_oracle():
+    """1F1B hybrid step (pp2 x mp2 x dp2, manual in-schedule backward)
+    tracks the GPipe step exactly: same per-step losses over 4 steps means
+    identical gradients through the optimizer (pipeline_parallel.py:119)."""
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=2, pp_degree=2)
+    oracle = GPTHybridTrainStep(model, cfg, hcg, n_micro=4, lr=1e-3,
+                                remat=False)
+
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    model2 = GPTForPretraining(GPTModel(cfg))
+    # same init
+    for l1, l2 in zip(model.gpt.layers, model2.gpt.layers):
+        for k in ("ln1_w", "ln1_b", "wqkv", "bqkv", "wo", "bo",
+                  "ln2_w", "ln2_b", "w1", "b1", "w2", "b2"):
+            getattr(l2, k).set_value(np.asarray(getattr(l1, k)._value))
+    g1, g2 = model.gpt, model2.gpt
+    g2.embeddings.word_embeddings.set_value(
+        np.asarray(g1.embeddings.word_embeddings._value))
+    g2.embeddings.position_embeddings.set_value(
+        np.asarray(g1.embeddings.position_embeddings._value))
+    g2.lnf_w.set_value(np.asarray(g1.lnf_w._value))
+    g2.lnf_b.set_value(np.asarray(g1.lnf_b._value))
+    hcg2 = HybridCommunicateGroup(dp_degree=2, mp_degree=2, pp_degree=2)
+    step = GPTHybridTrainStep(model2, cfg, hcg2, n_micro=4, lr=1e-3,
+                              remat=False, pipeline_schedule="1f1b")
+
+    ids, labels = _batch(cfg, 8, 16, seed=11)
+    for i in range(4):
+        ref = float(oracle(ids, labels).numpy())
+        got = float(step(ids, labels).numpy())
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"step {i}")
