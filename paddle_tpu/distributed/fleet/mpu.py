@@ -57,22 +57,26 @@ class RNGStatesTracker:
         if name not in self.states_:
             self.states_[name] = jax.random.key(0)
         key = self.states_[name]
-        try:
-            # fold in mp coordinate when tracing inside an mp shard_map region
-            axis_env = None
-            try:
-                idx = jax.lax.axis_index("mp")
-                key = jax.random.fold_in(key, idx)
-            except NameError:
-                pass
-            except Exception:
-                pass
-            key, sub = jax.random.split(key)
-            self.states_[name] = key
-            with random_mod.rng_guard(sub):
-                yield
-        finally:
-            pass
+        idx = _mp_axis_index_or_none()
+        if idx is not None:
+            # inside an mp shard_map region: fold the mp coordinate in so
+            # each rank draws a distinct stream (mpu/random.py:35 — the
+            # per-device model-parallel seed offset)
+            key = jax.random.fold_in(key, idx)
+        key, sub = jax.random.split(key)
+        self.states_[name] = key
+        with random_mod.rng_guard(sub):
+            yield
+
+
+def _mp_axis_index_or_none():
+    """axis_index("mp") when tracing inside an mp shard_map region, else
+    None. NameError is jax's documented unbound-axis error ("Found an
+    unbound axis name"); nothing else is swallowed."""
+    try:
+        return jax.lax.axis_index("mp")
+    except NameError:
+        return None
 
 
 _RNG_STATE_TRACKER = RNGStatesTracker()
@@ -163,18 +167,80 @@ class RowParallelLinear(nn.Layer):
         return out
 
 
+def parallel_cross_entropy(logits, labels, ignore_index=-100, mp_axis=None):
+    """Per-token softmax CE over a class dim sharded on ``mp_axis``
+    (mp_layers.py:501 CSoftmaxWithCrossEntropy semantics). Pure jax.
+
+    logits ``[..., V_local]`` — the LOCAL vocab shard when called inside a
+    shard_map region with ``mp_axis`` set; the full logits otherwise.
+    labels ``[...]`` GLOBAL class ids. Stable global logsumexp via
+    pmax/psum over mp; the target logit is picked on the rank owning the
+    id and psum'ed — the same math as the GPT head's
+    ``vocab_parallel_cross_entropy``, at the logits level.
+    """
+    lg = logits.astype(jnp.float32)
+    if labels.ndim == lg.ndim and labels.shape[-1] == 1:
+        # paddle's standard [..., 1] label convention
+        # (_c_softmax_with_cross_entropy accepts input_dims == label_dims)
+        labels = labels[..., 0]
+    v_local = lg.shape[-1]
+    start = jax.lax.axis_index(mp_axis) * v_local if mp_axis else 0
+    m_loc = jax.lax.stop_gradient(jnp.max(lg, -1))
+    m = jax.lax.pmax(m_loc, mp_axis) if mp_axis else m_loc
+    sumexp = jnp.sum(jnp.exp(lg - m[..., None]), -1)
+    if mp_axis:
+        sumexp = jax.lax.psum(sumexp, mp_axis)
+    lse = jnp.log(sumexp) + m
+    local_idx = labels - start
+    in_range = (local_idx >= 0) & (local_idx < v_local)
+    picked = jnp.take_along_axis(
+        lg, jnp.clip(local_idx, 0, v_local - 1)[..., None], -1)[..., 0]
+    tgt = jnp.where(in_range, picked, 0.0)
+    if mp_axis:
+        tgt = jax.lax.psum(tgt, mp_axis)
+    loss = lse - tgt
+    if ignore_index is not None:
+        loss = jnp.where(labels == ignore_index, 0.0, loss)
+    return loss
+
+
 class ParallelCrossEntropy(nn.Layer):
-    """Cross entropy over mp-sharded logits (mp_layers.py:501). GSPMD computes
-    the softmax reduction over the sharded class dim with an mp psum."""
+    """Cross entropy over mp-sharded logits (mp_layers.py:501).
+
+    With an mp>1 mesh the forward runs :func:`parallel_cross_entropy`
+    inside a shard_map over the mp axis — the real vocab-parallel
+    pmax/psum math, logits consumed as local shards. Without one it runs
+    the identical math with mp_axis=None (same numerics, one shard).
+    """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        loss = F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
         from ...ops.manipulation import unsqueeze
+        ii = self.ignore_index
+        hcg = get_hybrid_communicate_group()
+        mp = hcg.get_model_parallel_world_size() if hcg else 1
+        if mp > 1:
+            from ..mesh import get_global_mesh
+            mesh = get_global_mesh()
+            nd = unwrap(input).ndim
+            in_spec = P(*([None] * (nd - 1)), "mp")
+            from jax import shard_map
+
+            def f(lg, lab):
+                return shard_map(
+                    lambda l_, la_: parallel_cross_entropy(l_, la_, ii,
+                                                           mp_axis="mp"),
+                    mesh=mesh, in_specs=(in_spec, P()), out_specs=P(),
+                    check_vma=False)(lg, lab)
+
+            loss = apply(f, input, label, op_name="parallel_cross_entropy")
+        else:
+            loss = apply(
+                lambda lg, lab: parallel_cross_entropy(lg, lab, ii),
+                input, label, op_name="parallel_cross_entropy")
         return unsqueeze(loss, -1)
 
 

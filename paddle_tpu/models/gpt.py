@@ -211,30 +211,14 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
             jax.checkpoint(per_chunk, prevent_cse=False), chunks)
         return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1.0)
 
+    # the logits-level vocab-parallel math is shared with
+    # mpu.ParallelCrossEntropy (mp_layers.py:501) — ONE implementation
+    from ..distributed.fleet.mpu import parallel_cross_entropy
     logits = jnp.einsum("bsh,vh->bsv", h, wte_local).astype(jnp.float32)
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
-    v_local = logits.shape[-1]
-    if mp_axis is not None:
-        start = jax.lax.axis_index(mp_axis) * v_local
-    else:
-        start = 0
-    # the max shift is for numerical stability only — constant w.r.t. AD
-    # (pmax has no VJP rule, and none is needed)
-    m_loc = jax.lax.stop_gradient(jnp.max(logits, -1))
-    m = jax.lax.pmax(m_loc, mp_axis) if mp_axis is not None else m_loc
-    sumexp = jnp.sum(jnp.exp(logits - m[..., None]), -1)
-    if mp_axis is not None:
-        sumexp = jax.lax.psum(sumexp, mp_axis)
-    lse = jnp.log(sumexp) + m
-    local_idx = labels - start
-    in_range = (local_idx >= 0) & (local_idx < v_local)
-    picked = jnp.take_along_axis(
-        logits, jnp.clip(local_idx, 0, v_local - 1)[..., None], -1)[..., 0]
-    tgt = jnp.where(in_range, picked, 0.0)
-    if mp_axis is not None:
-        tgt = jax.lax.psum(tgt, mp_axis)
-    loss = lse - tgt
+    loss = parallel_cross_entropy(logits, labels, ignore_index=None,
+                                  mp_axis=mp_axis)
     if loss_mask is not None:
         return jnp.sum(loss * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
     return jnp.mean(loss)
@@ -939,7 +923,18 @@ class GPTHybridTrainStep:
         if self._compiled is None:
             self._build()
         self._t += 1
-        lr = jnp.asarray(self.hyper[0], jnp.float32)
+        # lr is a traced jit input, so a live LR schedule is free: pass an
+        # optimizer.lr.LRScheduler (or any callable) as ``lr`` and each
+        # step feeds its current value then advances it (reference:
+        # HybridParallelOptimizer consuming lr_scheduler.get_lr())
+        lr_src = self.hyper[0]
+        if callable(lr_src):
+            lr_val = float(lr_src())
+            if hasattr(lr_src, "step"):
+                lr_src.step()
+        else:
+            lr_val = lr_src
+        lr = jnp.asarray(lr_val, jnp.float32)
         t = jnp.asarray(self._t, jnp.float32)
         loss, self.params, self.opt_state = self._compiled(
             self.params, self.opt_state, ids, labs, lr, t)
