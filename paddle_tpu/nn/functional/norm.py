@@ -7,7 +7,6 @@ import jax.numpy as jnp
 from ...ops._dispatch import apply, unwrap
 from ...framework.tensor import Tensor
 
-_warned_static_bn_stats = False
 
 __all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "normalize",
            "local_response_norm", "rms_norm"]
@@ -61,27 +60,26 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     args = [a for a in (weight, bias) if a is not None]
     out, (bm, bv) = apply(f, x, *args, op_name="batch_norm", has_aux=True)
     # update running stats (momentum convention: new = m*old + (1-m)*batch).
-    # LIMITATION under lazy program capture: batch stats are symbolic and
-    # the static Executor has no buffer write-back channel, so running
-    # stats keep their INIT values — inference-mode BN after static-mode
-    # training normalizes with mean 0 / var 1 unless stats are restored
-    # from an eager run or a checkpoint. (The reference's static BN
-    # updates them via in-program ops.) Warn once so this isn't silent.
-    from ...static.program import is_lazy
+    # Under lazy program capture the batch stats are symbolic: register an
+    # in-program buffer update instead — the Executor feeds the running
+    # stats per run and writes the evaluated update back (the reference's
+    # in-place mean/var update of batch_norm_kernel.cu).
+    from ...static.program import (is_lazy, latest_buffer_value,
+                                   record_buffer_update)
     if isinstance(running_mean, Tensor):
         if not is_lazy(bm):
             running_mean._value = momentum * rm + (1.0 - momentum) * bm._value
             running_var._value = momentum * rv + (1.0 - momentum) * bv._value
         else:
-            global _warned_static_bn_stats
-            if not _warned_static_bn_stats:
-                _warned_static_bn_stats = True
-                import warnings
-                warnings.warn(
-                    "batch_norm under static program capture does not "
-                    "update running_mean/running_var; inference-mode BN "
-                    "will use their current (init) values. Train in "
-                    "dygraph or load stats from a checkpoint for eval.")
+            upd = lambda b, r: momentum * r + (1.0 - momentum) * b
+            # chain off any earlier update of the same buffer in this
+            # program so repeated captures compound within one run
+            record_buffer_update(
+                running_mean, apply(upd, bm, latest_buffer_value(running_mean),
+                                    op_name="bn_stats_update"))
+            record_buffer_update(
+                running_var, apply(upd, bv, latest_buffer_value(running_var),
+                                   op_name="bn_stats_update"))
     return out
 
 

@@ -118,18 +118,22 @@ class Executor:
             (k, tuple(np.shape(v)), str(np.asarray(v).dtype) if not hasattr(
                 v, "dtype") else str(v.dtype)) for k, v in feed_vals.items()))
         key = (id(program), sig_items, tuple(id(t) for t in fetch_list),
-               len(program._optimize_ops), len(program._nodes))
+               len(program._optimize_ops), len(program._nodes),
+               len(getattr(program, "_buffer_updates", [])))
 
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(program, fetch_list, feed_vals)
             self._cache[key] = entry
-        jitted, params, opt = entry
+        jitted, params, opt, targets = entry
 
         param_vals = [p._value for p in params]
+        # program state buffers (BN running stats): fed per run, written
+        # back after — updates compound across runs
+        buffer_vals = [t._value for t in targets]
         rng = random_mod.next_key()
         if opt is None:
-            outs = jitted(feed_vals, param_vals, rng)
+            outs, new_bufs = jitted(feed_vals, param_vals, buffer_vals, rng)
         else:
             # optimizer accumulators/LR are jit INPUTS carried across runs (the
             # ADVICE r1 fix: without this, Momentum velocity / Adam moments /
@@ -138,11 +142,13 @@ class Executor:
             state_vals = [opt_obj._accumulators[n][k]._value
                           for n, k in opt_obj._jit_state_keys]
             lr = jnp.asarray(opt_obj.get_lr(), jnp.float32)
-            outs, new_param_vals, new_state = jitted(
-                feed_vals, param_vals, state_vals, rng, lr)
+            outs, new_param_vals, new_state, new_bufs = jitted(
+                feed_vals, param_vals, buffer_vals, state_vals, rng, lr)
             for p, nv in zip(params, new_param_vals):
                 p._value = nv
             opt_obj._restore_jit_state(new_state)
+        for t, nv in zip(targets, new_bufs):
+            t._value = nv
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
@@ -185,33 +191,49 @@ class Executor:
                                        print_period)
 
     def _build(self, program: Program, fetch_list, feed_vals):
+        bupds = getattr(program, "_buffer_updates", [])
+        targets = [t for t, _ in bupds]
+        upd_exprs = [v for _, v in bupds]
+        target_ids = {id(t) for t in targets}
         nodes, params = _collect_graph(
-            fetch_list + [loss for _, loss in program._optimize_ops])
+            fetch_list + upd_exprs
+            + [loss for _, loss in program._optimize_ops])
+        # buffer targets are fed through their own channel, never as
+        # optimizer-visible params
+        params = [p for p in params if id(p) not in target_ids]
         opt = program._optimize_ops[-1] if program._optimize_ops else None
+        n_fetch = len(fetch_list)
+
+        def _pm(param_vals, buffer_vals):
+            pm = {id(p): v for p, v in zip(params, param_vals)}
+            pm.update({id(t): v for t, v in zip(targets, buffer_vals)})
+            return pm
 
         if opt is None:
-            def run_fn(feed_vals, param_vals, rng):
-                pm = {id(p): v for p, v in zip(params, param_vals)}
+            def run_fn(feed_vals, param_vals, buffer_vals, rng):
                 with random_mod.rng_guard(rng):
-                    return _eval_graph(fetch_list, feed_vals, pm)
-            return jax.jit(run_fn), params, None
+                    outs = _eval_graph(fetch_list + upd_exprs, feed_vals,
+                                       _pm(param_vals, buffer_vals))
+                return outs[:n_fetch], outs[n_fetch:]
+            return jax.jit(run_fn), params, None, targets
 
         optimizer, loss_var = opt
 
-        def loss_fn(param_vals, feed_vals, rng):
-            pm = {id(p): v for p, v in zip(params, param_vals)}
+        def loss_fn(param_vals, buffer_vals, feed_vals, rng):
             with random_mod.rng_guard(rng):
-                outs = _eval_graph(fetch_list + [loss_var], feed_vals, pm)
-            return outs[-1].sum(), outs[:-1]
+                outs = _eval_graph(fetch_list + upd_exprs + [loss_var],
+                                   feed_vals, _pm(param_vals, buffer_vals))
+            return outs[-1].sum(), (outs[:n_fetch], outs[n_fetch:-1])
 
-        def step_fn(feed_vals, param_vals, state_vals, rng, lr):
-            (loss_val, outs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(param_vals, feed_vals, rng)
+        def step_fn(feed_vals, param_vals, buffer_vals, state_vals, rng, lr):
+            (loss_val, (outs, new_bufs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(param_vals, buffer_vals, feed_vals,
+                                       rng)
             if state_vals is not None:
                 optimizer._restore_jit_state(state_vals)
             new_vals, new_state = optimizer._jit_apply(
                 params, param_vals, grads, lr=lr)
-            return outs, new_vals, new_state
+            return outs, new_vals, new_state, new_bufs
 
         # abstract trace with state=None discovers the accumulator structure
         # (fills optimizer._jit_state_keys); live/restored state is snapshotted
@@ -219,14 +241,15 @@ class Executor:
         # never-stepped accumulators materialize from their init factories
         snapshot = optimizer._concrete_state_snapshot()
         param_vals = [p._value for p in params]
+        buffer_vals0 = [t._value for t in targets]
         rng0 = random_mod.next_key()
         lr0 = jnp.asarray(optimizer.get_lr(), jnp.float32)
         jax.eval_shape(
-            lambda fv, pv, rng, lr: step_fn(fv, pv, None, rng, lr),
-            feed_vals, param_vals, rng0, lr0)
+            lambda fv, pv, bv, rng, lr: step_fn(fv, pv, bv, None, rng, lr),
+            feed_vals, param_vals, buffer_vals0, rng0, lr0)
         optimizer._materialize_jit_state(snapshot)
 
-        return jax.jit(step_fn), params, (optimizer,)
+        return jax.jit(step_fn), params, (optimizer,), targets
 
 
 def default_startup_sentinel():

@@ -98,6 +98,25 @@ def is_lazy(t) -> bool:
     return isinstance(t, Tensor) and getattr(t, "_lazy", None) is not None
 
 
+def record_buffer_update(target: Tensor, lazy_value: Tensor):
+    """Register an in-program state write: after each Executor run,
+    ``target._value`` becomes the evaluated ``lazy_value``. The target is
+    fed as a per-run input (never baked), so updates compound across runs."""
+    default_main_program()._buffer_updates.append((target, lazy_value))
+
+
+def latest_buffer_value(target: Tensor):
+    """The most recently recorded update value for ``target`` in the
+    current program, or ``target`` itself. Ops that update the same buffer
+    twice in one program (a BN layer captured on two inputs) must chain
+    off this so the updates compound within the run, like the reference's
+    sequential in-place batch_norm ops."""
+    for t, v in reversed(default_main_program()._buffer_updates):
+        if t is target:
+            return v
+    return target
+
+
 class Program:
     """Recorded lazy DAG + feed/fetch bookkeeping (ProgramDesc parity shell)."""
 
@@ -105,6 +124,10 @@ class Program:
         self._nodes: list[LazyNode] = []
         self._feeds: dict[str, Tensor] = {}
         self._optimize_ops = []  # (optimizer, loss_tensor)
+        # (target eager Tensor, lazy update value): in-program state writes
+        # the Executor applies after each run — the reference's in-place
+        # buffer ops (BN running mean/var, batch_norm_kernel.cu)
+        self._buffer_updates = []
         self.random_seed = 0
 
     def global_block(self):
@@ -115,6 +138,9 @@ class Program:
         p = Program()
         p._nodes = list(self._nodes)
         p._feeds = dict(self._feeds)
+        # eval clones never mutate state (reference clone(for_test=True)
+        # strips the training-only in-place ops)
+        p._buffer_updates = [] if for_test else list(self._buffer_updates)
         return p
 
     def __repr__(self):

@@ -310,3 +310,21 @@ def test_gpt_1f1b_matches_gpipe_oracle():
         got = float(step(ids, labels).numpy())
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4,
                                    err_msg=f"step {i}")
+
+
+def test_gpt_hybrid_step_live_lr_schedule():
+    """lr accepts an LRScheduler: each compiled step consumes the live
+    value (traced input, no recompile) and advances the schedule."""
+    from paddle_tpu.optimizer import lr as lr_mod
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=2, pp_degree=2)
+    sched = lr_mod.StepDecay(learning_rate=1e-2, step_size=2, gamma=0.1)
+    step = GPTHybridTrainStep(model, cfg, hcg, n_micro=2, lr=sched)
+    ids, labels = _batch(cfg, 4, 16, seed=3)
+    step(ids, labels)
+    step(ids, labels)
+    assert abs(sched() - 1e-3) < 1e-9  # decayed after 2 steps
+    step(ids, labels)
+    assert step._compiled is not None  # no rebuild across lr changes

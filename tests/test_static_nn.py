@@ -192,3 +192,67 @@ def test_static_nn_params_train():
         assert losses[-1] < losses[0]
     finally:
         static.disable_static()
+
+
+def test_static_bn_updates_running_stats():
+    """Static-capture BN threads running mean/var through the Executor's
+    buffer channel: stats update per run (reference in-place update of
+    batch_norm_kernel.cu), compounding across runs."""
+    rng = np.random.default_rng(3)
+    x_np = (rng.standard_normal((4, 3, 5, 5)) * 2 + 1).astype(np.float32)
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 3, 5, 5], "float32")
+            bn = paddle.nn.BatchNorm2D(3)
+            out_v = bn(x)
+        assert len(main._buffer_updates) == 2
+        exe = static.Executor()
+        rm0 = np.array(bn._mean.numpy())
+        exe.run(main, feed={"x": x_np}, fetch_list=[out_v])
+        rm1 = np.array(bn._mean.numpy())
+        rv1 = np.array(bn._variance.numpy())
+        assert not np.allclose(rm0, rm1), "running mean did not update"
+        batch_mean = x_np.mean(axis=(0, 2, 3))
+        batch_var = x_np.var(axis=(0, 2, 3))
+        np.testing.assert_allclose(rm1, 0.9 * rm0 + 0.1 * batch_mean,
+                                   rtol=1e-5, atol=1e-6)
+        # second run compounds on the first (not recomputed from init)
+        exe.run(main, feed={"x": x_np}, fetch_list=[out_v])
+        rm2 = np.array(bn._mean.numpy())
+        np.testing.assert_allclose(rm2, 0.9 * rm1 + 0.1 * batch_mean,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.array(bn._variance.numpy()),
+            0.9 * rv1 + 0.1 * batch_var, rtol=1e-5, atol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_static_bn_double_capture_compounds():
+    """A BN layer captured TWICE in one program chains its updates so a
+    single run compounds both (reference sequential in-place ops)."""
+    rng = np.random.default_rng(4)
+    x1_np = (rng.standard_normal((4, 3, 5, 5)) + 2).astype(np.float32)
+    x2_np = (rng.standard_normal((4, 3, 5, 5)) - 1).astype(np.float32)
+
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x1 = static.data("x1", [4, 3, 5, 5], "float32")
+            x2 = static.data("x2", [4, 3, 5, 5], "float32")
+            bn = paddle.nn.BatchNorm2D(3)
+            o = bn(x1) + bn(x2)
+        exe = static.Executor()
+        rm0 = np.array(bn._mean.numpy())
+        exe.run(main, feed={"x1": x1_np, "x2": x2_np}, fetch_list=[o])
+        rm1 = np.array(bn._mean.numpy())
+        m1 = x1_np.mean(axis=(0, 2, 3))
+        m2 = x2_np.mean(axis=(0, 2, 3))
+        want = 0.9 * (0.9 * rm0 + 0.1 * m1) + 0.1 * m2
+        np.testing.assert_allclose(rm1, want, rtol=1e-5, atol=1e-6)
+    finally:
+        static.disable_static()
