@@ -47,20 +47,35 @@ def _pick_block(s_len):
     raise ValueError(f"seq {s_len} not a multiple of {MIN_BLOCK}")
 
 
-def supported(q_shape, k_shape=None, v_shape=None) -> bool:
+def supported(q_shape, k_shape=None, v_shape=None, causal=False) -> bool:
     """Gate used by nn.functional.attention: [B, S, N, D] TPU-friendly?
 
-    The kernel is self-attention-shaped: k/v must match q exactly. Cross
-    attention (sk != sq) and MQA/GQA head broadcasting route to the XLA
-    reference path.
+    Handles self-attention, cross-attention (sk != sq, non-causal), and
+    MQA/GQA (num_kv_heads dividing num_heads — the generality of the
+    reference's fused_attention_op.cu). Requires both sequence lengths to
+    be MIN_BLOCK multiples and head_dim <= the 128-lane width.
     """
     if len(q_shape) != 4:
         return False
-    b, s, n, d = q_shape
-    if not (s >= MIN_BLOCK and s % MIN_BLOCK == 0 and 0 < d <= _LANE):
+    b, sq, n, d = q_shape
+    if not (sq >= MIN_BLOCK and sq % MIN_BLOCK == 0 and 0 < d <= _LANE):
         return False
-    return all(other is None or tuple(other) == tuple(q_shape)
-               for other in (k_shape, v_shape))
+    for other in (k_shape, v_shape):
+        if other is None:
+            continue
+        if len(other) != 4:
+            return False
+        bk, sk, nkv, dk = other
+        if (bk, dk) != (b, d) or nkv <= 0 or n % nkv:
+            return False
+        if not (sk >= MIN_BLOCK and sk % MIN_BLOCK == 0):
+            return False
+        if causal and sk != sq:
+            return False  # causal offsets for cached decode not implemented
+    if k_shape is not None and v_shape is not None \
+            and tuple(k_shape) != tuple(v_shape):
+        return False
+    return True
 
 
 def _interpret() -> bool:
@@ -133,25 +148,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 @_no_x64
-def _fwd(q, k, v, causal, scale):
-    bn, s_len, d = q.shape
-    bq = bk = _pick_block(s_len)
-    nq, nk = s_len // bq, s_len // bk
+def _fwd(q, k, v, causal, scale, g=1):
+    """g: query heads per KV head (MQA/GQA) — q is [bn, sq, d], k/v are
+    [bn // g, sk, d]; the KV block index maps divide the head index."""
+    bn, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    nq, nk = sq // bq, sk // bk
     return pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale),
         grid=(bn, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
-            jax.ShapeDtypeStruct((bn, s_len, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bn, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -209,20 +227,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale, nq):
+    """Innermost grid dim walks ALL g*nq query blocks of this KV head's
+    group (GQA: a KV head accumulates dk/dv over its g query heads);
+    ``j // nq`` selects the group-local query head, ``j % nq`` its block."""
     ki = pl.program_id(1)
     j = pl.program_id(2)
-    nq = pl.num_programs(2)
+    gnq = pl.num_programs(2)
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
+    qb = j % np.int32(nq)
 
     @pl.when(j == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # causal: q block j contributes only if its last row >= k block first row
-    run = (j * np.int32(bq) + np.int32(bq - 1) >= ki * np.int32(bk)) \
+    # causal: q block contributes only if its last row >= k block first row
+    run = (qb * np.int32(bq) + np.int32(bq - 1) >= ki * np.int32(bk)) \
         if causal else (j >= 0)
 
     @pl.when(run)
@@ -235,7 +257,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, j, ki, bq, bk)
+            s = _causal_mask(s, qb, ki, bq, bk)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         dv_scr[:] = dv_scr[:] + jnp.dot(p.astype(do.dtype).T, do,
                                         preferred_element_type=jnp.float32)
@@ -244,57 +266,65 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = dk_scr[:] + jnp.dot(ds.T, q,
                                         preferred_element_type=jnp.float32)
 
-    @pl.when(j == nq - 1)
+    @pl.when(j == gnq - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 @_no_x64
-def _bwd(causal, scale, residuals, do):
+def _bwd(causal, scale, g, residuals, do):
     q, k, v, o, lse = residuals
-    bn, s_len, d = q.shape
+    bn, sq, d = q.shape
+    bnk, sk, _ = k.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    bq = bk = _pick_block(s_len)
-    nq, nk = s_len // bq, s_len // bk
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    nq, nk = sq // bq, sk // bk
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
         grid=(bn, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bn, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_ARB,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
+    # dk/dv: one program per KV head; the innermost dim walks the g*nq
+    # query blocks of the whole GQA group so grouped heads accumulate
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
-        grid=(bn, nk, nq),
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          nq=nq),
+        grid=(bnk, nk, g * nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, i, j: (b * g + j // nq, j % nq, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, i, j: (b * g + j // nq, j % nq, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, i, j: (b * g + j // nq, j % nq, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, i, j: (b * g + j // nq, j % nq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
-            jax.ShapeDtypeStruct((bn, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bnk, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((bnk, sk, d), q.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -310,14 +340,14 @@ def _bwd(causal, scale, residuals, do):
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    o, _ = _fwd(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, g):
+    o, _ = _fwd(q, k, v, causal, scale, g)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    o, lse = _fwd(q, k, v, causal, scale)
+def _flash_fwd(q, k, v, causal, scale, g):
+    o, lse = _fwd(q, k, v, causal, scale, g)
     return o, (q, k, v, o, lse)
 
 
@@ -325,20 +355,31 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None):
-    """q/k/v: [BN, S, D] (head-major). Returns [BN, S, D]."""
+    """q: [BN, Sq, D] (head-major); k/v: [BN // g, Sk, D] where g is the
+    MQA/GQA group size (1 = standard attention). Returns [BN, Sq, D]."""
     d = q.shape[-1]
+    if q.shape[0] % k.shape[0]:
+        raise ValueError(
+            f"query heads {q.shape[0]} must be a multiple of kv heads "
+            f"{k.shape[0]}")
+    g = q.shape[0] // k.shape[0]
+    if causal and k.shape[1] != q.shape[1]:
+        raise ValueError("causal flash attention requires equal q/k lengths")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if d < _LANE:
         pad = [(0, 0), (0, 0), (0, _LANE - d)]
         q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
-    out = _flash(q, k, v, causal, scale)
+    out = _flash(q, k, v, causal, scale, g)
     return out[..., :d] if d < _LANE else out
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """paddle sdpa layout [B, S, N, D] -> [B, S, N, D]."""
-    b, s, n, d = q.shape
-    to3 = lambda t: t.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+    """paddle sdpa layout [B, Sq, N, D] (k/v: [B, Sk, Nkv, D]) ->
+    [B, Sq, N, D]. Nkv may divide N (MQA/GQA); Sk may differ from Sq
+    (cross attention, non-causal)."""
+    b, sq, n, d = q.shape
+    to3 = lambda t: t.transpose(0, 2, 1, 3).reshape(
+        t.shape[0] * t.shape[2], t.shape[1], t.shape[3])
     out = flash_attention(to3(q), to3(k), to3(v), causal=causal, scale=scale)
-    return out.reshape(b, n, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, n, sq, d).transpose(0, 2, 1, 3)

@@ -23,6 +23,10 @@ def _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale, training, key=None):
     qt = jnp.einsum("bshd->bhsd", q)
     kt = jnp.einsum("bshd->bhsd", k)
     vt = jnp.einsum("bshd->bhsd", v)
+    if kt.shape[1] != qt.shape[1]:  # MQA/GQA: broadcast kv heads
+        g = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, g, axis=1)
+        vt = jnp.repeat(vt, g, axis=1)
     logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
@@ -58,7 +62,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         try:
             from ...kernels.flash_attention import flash_attention_bshd, supported
             q = unwrap(query)
-            if supported(q.shape, unwrap(key).shape, unwrap(value).shape):
+            if supported(q.shape, unwrap(key).shape, unwrap(value).shape,
+                         causal=is_causal):
                 def ff(qv, kv, vv):
                     return flash_attention_bshd(qv, kv, vv, causal=is_causal,
                                                 scale=scale)

@@ -68,3 +68,80 @@ def test_sdpa_routes_to_flash():
     ref = _sdpa_ref(q, k, v, None, 0.0, True, None, False)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
                                atol=2e-4)
+
+
+@pytest.mark.parametrize("nkv,causal", [(1, False), (1, True),
+                                        (2, False), (2, True)])
+def test_flash_gqa_forward_and_backward(nkv, causal):
+    """MQA (nkv=1) / GQA (nkv=2 of n=4): values AND all three grads match
+    the head-broadcast reference — dk/dv accumulate over the group."""
+    b, s, n, d = 2, 256, 4, 64
+    q = _rand((b, s, n, d), seed=20)
+    k = _rand((b, s, nkv, d), seed=21)
+    v = _rand((b, s, nkv, d), seed=22)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention_bshd(q, k, v,
+                                                       causal=causal)))
+
+    def loss_ref(q, k, v):
+        out = _sdpa_ref(q, k, v, None, 0.0, causal, None, False)
+        return jnp.sum(jnp.square(out))
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention_bshd(q, k, v, causal=causal)),
+        np.asarray(_sdpa_ref(q, k, v, None, 0.0, causal, None, False)),
+        rtol=2e-4, atol=2e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch (nkv={nkv})")
+
+
+def test_flash_cross_attention():
+    """Cross attention: sk != sq (encoder-decoder / prefill shapes)."""
+    b, sq, sk, n, d = 2, 128, 384, 2, 64
+    q = _rand((b, sq, n, d), seed=30)
+    k = _rand((b, sk, n, d), seed=31)
+    v = _rand((b, sk, n, d), seed=32)
+    ref = _sdpa_ref(q, k, v, None, 0.0, False, None, False)
+    out = flash_attention_bshd(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention_bshd(q, k, v)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_sdpa_ref(q, k, v, None, 0.0, False,
+                                            None, False)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_gqa_cross_combined():
+    """GQA + cross attention at once."""
+    b, sq, sk, n, nkv, d = 1, 128, 256, 4, 2, 64
+    q = _rand((b, sq, n, d), seed=40)
+    k = _rand((b, sk, nkv, d), seed=41)
+    v = _rand((b, sk, nkv, d), seed=42)
+    ref = _sdpa_ref(q, k, v, None, 0.0, False, None, False)
+    out = flash_attention_bshd(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_supported_gate_gqa_cross():
+    assert supported((2, 256, 4, 64), (2, 256, 2, 64), (2, 256, 2, 64))
+    assert supported((2, 256, 4, 64), (2, 512, 4, 64), (2, 512, 4, 64))
+    assert not supported((2, 256, 4, 64), (2, 512, 4, 64),
+                         (2, 512, 4, 64), causal=True)
+    assert not supported((2, 256, 4, 64), (2, 256, 3, 64), (2, 256, 3, 64))
+    assert not supported((2, 256, 4, 64), (2, 200, 4, 64), (2, 200, 4, 64))
