@@ -8,7 +8,27 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
+
+# Multi-process launch contract (python -m paddle_tpu.distributed.launch):
+# jax.distributed.initialize MUST run before anything touches the XLA
+# backend, and importing this package is the first thing every worker
+# does — so the bootstrap lives here. endpoints[0] hosts the coordination
+# service (the reference's TCPStore-rendezvous slot, parallel.py:108).
+if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
+        and _os.environ.get("PADDLE_TRAINER_ENDPOINTS") \
+        and "PADDLE_LOCAL_RANK" in _os.environ \
+        and not _jax.distributed.is_initialized():
+    # PADDLE_LOCAL_RANK marks a launcher-SPAWNED worker: stale shell
+    # exports of the other contract vars must not hijack an unrelated
+    # process (e.g. the launcher itself) into the coordination service
+    _jax.distributed.initialize(
+        coordinator_address=_os.environ["PADDLE_TRAINER_ENDPOINTS"]
+        .split(",")[0],
+        num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+        process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
 
 # Paddle dtype semantics need real int64/float64 (python ints -> int64 tensors).
 # Weak typing keeps python scalars from promoting compute dtypes, and all perf-path

@@ -39,6 +39,21 @@ def _free_ports(n, host="127.0.0.1"):
     return ports
 
 
+def _node_ip(master_host):
+    """This node's IP on the route toward the master (endpoint the other
+    nodes can reach). PADDLE_NODE_IP overrides."""
+    if os.environ.get("PADDLE_NODE_IP"):
+        return os.environ["PADDLE_NODE_IP"]
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((master_host, 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
 def _parse_args(argv=None):
     p = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
@@ -65,6 +80,13 @@ def launch(argv=None):
     """Spawn the worker pod; returns the list of exit codes."""
     args = _parse_args(argv)
 
+    # stale contract vars from an outer launch must not leak into this
+    # pod's workers (they would override the fresh contract below)
+    for var in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                "PADDLE_LOCAL_RANK", "PADDLE_CURRENT_ENDPOINT",
+                "PADDLE_TRAINER_ENDPOINTS", "PADDLE_STORE_ENDPOINT"):
+        os.environ.pop(var, None)
+
     if args.nproc_per_node is not None:
         nproc = args.nproc_per_node
     elif args.devices:
@@ -75,14 +97,44 @@ def launch(argv=None):
     world = nproc * nnodes
 
     host = "127.0.0.1"
+    store = None
+    store_ep = None
     if args.master:
+        # multi-node: node 0's launcher hosts the native TCPStore at
+        # --master; every node publishes its workers' endpoints and reads
+        # the full sorted list back (controllers/master.py endpoint
+        # exchange). The same store stays alive for the workers' host-side
+        # object collectives (PADDLE_STORE_ENDPOINT).
+        from ..store import TCPStore
+        mhost, mport = args.master.rsplit(":", 1)
+        store = TCPStore(mhost, int(mport),
+                         is_master=(args.node_rank == 0),
+                         world_size=nnodes)
+        my_host = _node_ip(mhost) if nnodes > 1 else host
+        ports = _free_ports(nproc, host=my_host)
+        local_eps = [f"{my_host}:{p}" for p in ports]
+        store.set(f"launch/{args.job_id}/eps/{args.node_rank}",
+                  ",".join(local_eps))
+        endpoints = []
+        for nr in range(nnodes):
+            endpoints.extend(
+                store.get(f"launch/{args.job_id}/eps/{nr}")
+                .decode().split(","))
         master_ep = args.master
-        ports = _free_ports(nproc)
-        endpoints = None  # filled by master in a real multi-node deployment
+        store_ep = args.master
     else:
-        ports = _free_ports(nproc)
-        endpoints = [f"{host}:{p}" for p in ports]
+        ports = _free_ports(nproc + 1)
+        endpoints = [f"{host}:{p}" for p in ports[:nproc]]
         master_ep = endpoints[0]
+        # host a store for the workers' object collectives; optional on a
+        # single node (everything else works without it)
+        try:
+            from ..store import TCPStore
+            store = TCPStore(host, ports[nproc], is_master=True,
+                             world_size=world)
+            store_ep = f"{host}:{store.port}"
+        except Exception:
+            store = None
 
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
@@ -95,14 +147,13 @@ def launch(argv=None):
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_LOCAL_RANK": str(local_rank),
-            "PADDLE_CURRENT_ENDPOINT":
-                endpoints[local_rank] if endpoints else
-                f"{host}:{ports[local_rank]}",
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
             "PADDLE_MASTER": master_ep,
             "PADDLE_JOB_ID": args.job_id,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
         })
-        if endpoints:
-            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        if store_ep:
+            env["PADDLE_STORE_ENDPOINT"] = store_ep
         cmd = [sys.executable, args.training_script] + \
             list(args.training_script_args)
         if args.log_dir:
@@ -133,6 +184,16 @@ def launch(argv=None):
                 proc.kill()
             if log:
                 log.close()
+        if store is not None:
+            if args.master and nnodes > 1 and all(c == 0 for c in codes):
+                # multi-node: node 0 hosts the store every node's workers
+                # use — sync launchers before the master tears it down
+                # (skipped on failure so a dead node cannot hang teardown)
+                try:
+                    store.barrier(f"launch/{args.job_id}/done")
+                except Exception:
+                    pass
+            store.close()
     return codes
 
 

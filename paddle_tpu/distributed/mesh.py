@@ -58,6 +58,25 @@ def _set_hcg(hcg):
     _hcg = hcg
 
 
+def _process_axis_rank(mesh, axis_name):
+    """This process's coordinate along ``axis_name`` (str or tuple) in the
+    mesh, taken from its first locally-owned device — the multi-process
+    analog of the reference's per-rank topology coordinate."""
+    import jax
+    pid = jax.process_index()
+    devs = mesh.devices
+    flat = devs.ravel()
+    first = next((i for i, d in enumerate(flat)
+                  if getattr(d, "process_index", 0) == pid), 0)
+    coords = np.unravel_index(first, devs.shape)
+    names = list(mesh.axis_names)
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    r = 0
+    for a in axes:
+        r = r * mesh.shape[a] + int(coords[names.index(a)])
+    return r
+
+
 class Group:
     """A communication group = one (or more) mesh axis.
 
@@ -93,6 +112,11 @@ class Group:
 
     @property
     def rank(self):
+        import jax
+        if self.mesh is not None and jax.process_count() > 1:
+            # multi-process: this process's coordinate along the group's
+            # axes, from its first locally-owned mesh device
+            return _process_axis_rank(self.mesh, self.axis_name)
         from . import env
         return env.get_rank()
 
@@ -217,19 +241,29 @@ class HybridCommunicateGroup:
     def get_global_group(self):
         return Group(tuple(AXIS_ORDER), self.mesh)
 
-    # --- ranks: single-controller SPMD has no per-process rank for mesh axes;
-    # these exist for API parity and multi-process launches ---
-    def get_data_parallel_rank(self):
+    # --- ranks: 0 under single-controller SPMD (one process sees every
+    # mesh coordinate); under a multi-process launch they are the
+    # process's real axis coordinates (topology.py get_coord parity) ---
+    def _axis_rank(self, axis):
+        import jax
+        if jax.process_count() > 1:
+            return _process_axis_rank(self.mesh, axis)
         return 0
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
 
     def get_model_parallel_rank(self):
-        return 0
+        return self._axis_rank("mp")
 
     def get_stage_id(self):
-        return 0
+        return self._axis_rank("pp")
 
     def get_sharding_parallel_rank(self):
-        return 0
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
 
     def get_rank_from_stage(self, stage_id, **kwargs):
         return stage_id

@@ -26,26 +26,49 @@ from .collective import _set_default_group
 
 
 _initialized = False
+_process_store = None
 
 
 def init_parallel_env():
-    """Bootstrap multi-process (multi-host) or single-process multi-device."""
-    global _initialized
+    """Bootstrap multi-process (multi-host) or single-process multi-device.
+
+    Multi-process: ``jax.distributed.initialize`` against endpoint[0] (the
+    coordination service plays the reference's TCPStore rendezvous role);
+    the global mesh then spans every process's devices. When the launcher
+    exported ``PADDLE_STORE_ENDPOINT`` this process also connects a client
+    to the launcher-hosted native TCPStore — the channel the host-side
+    object collectives (broadcast_object_list / scatter_object_list) and
+    barriers ride (parallel.py:108 parity).
+    """
+    global _initialized, _process_store
     if _initialized:
         return env_mod.ParallelEnv()
     world = env_mod.get_world_size()
-    if world > 1 and "PADDLE_TRAINER_ENDPOINTS" in os.environ:
+    if world > 1 and "PADDLE_TRAINER_ENDPOINTS" in os.environ \
+            and not jax.distributed.is_initialized():
+        # normally already done at paddle_tpu import (the bootstrap must
+        # precede any XLA backend touch); kept for direct callers
         eps = env_mod.get_endpoints()
-        coordinator = eps[0]
         jax.distributed.initialize(
-            coordinator_address=coordinator,
+            coordinator_address=eps[0],
             num_processes=world,
             process_id=env_mod.get_rank())
+    store_ep = os.environ.get("PADDLE_STORE_ENDPOINT")
+    if world > 1 and store_ep:
+        from .store import TCPStore
+        host, port = store_ep.rsplit(":", 1)
+        _process_store = TCPStore(host, int(port), is_master=False,
+                                  world_size=world)
     mesh = build_mesh(dp=len(jax.devices()))
     set_global_mesh(mesh)
     _set_default_group(Group("dp", mesh))
     _initialized = True
     return env_mod.ParallelEnv()
+
+
+def get_process_store():
+    """The cross-process TCPStore client (multi-process launches), or None."""
+    return _process_store
 
 
 def is_initialized():

@@ -57,6 +57,8 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     """Single-tensor all-to-all: rows regroup across ranks. On one
     controller the global tensor already holds every rank's rows, so the
     exchange is an identity reshard; uneven splits are validated."""
+    from .collective import _single_controller_only
+    _single_controller_only("alltoall_single")
     group = _get_group(group)
     v = unwrap(in_tensor)
     n = group.nranks
@@ -81,6 +83,8 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     (SUM) / list[r] (MAX/MIN) / list[r] (AVG); this rank keeps the entry
     indexed by its group rank — compiled code uses prims.c_reducescatter
     for the mesh version."""
+    from .collective import _single_controller_only
+    _single_controller_only("reduce_scatter")
     group = _get_group(group)
     from . import env as env_mod
     r = group.get_group_rank(env_mod.get_rank())
@@ -100,9 +104,25 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
 
 def broadcast_object_list(object_list, src=0, group=None):
     """Pickle-based object broadcast (communication/broadcast.py
-    broadcast_object_list). Single-controller: rank src's list is
-    already the global truth; round-trip through pickle keeps the
-    by-value semantics (callers may mutate their copy)."""
+    broadcast_object_list). Multi-process: src publishes through the
+    launcher-hosted TCPStore and every other rank reads it back.
+    Single-controller: rank src's list is already the global truth;
+    round-trip through pickle keeps the by-value semantics (callers may
+    mutate their copy)."""
+    from .collective import _multi_process, _require_store, _store_seq
+    if _multi_process():
+        from . import env as env_mod
+        st = _require_store(_get_group(group))
+        seq = next(_store_seq)
+        key = f"objc/bc/{seq}"
+        from .collective import _store_cleanup
+        if env_mod.get_rank() == src:
+            st.set(key, pickle.dumps(list(object_list)))
+            object_list[:] = pickle.loads(pickle.dumps(list(object_list)))
+        else:
+            object_list[:] = pickle.loads(st.get(key))
+        _store_cleanup(st, [key], key + "/done", env_mod.get_world_size())
+        return object_list
     blob = pickle.dumps(list(object_list))
     object_list[:] = pickle.loads(blob)
     return object_list
@@ -111,9 +131,31 @@ def broadcast_object_list(object_list, src=0, group=None):
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
     """Each rank receives its element of src's list (communication/
-    scatter.py scatter_object_list)."""
+    scatter.py scatter_object_list). Multi-process: src publishes the
+    per-rank chunks through the TCPStore."""
     group = _get_group(group)
     from . import env as env_mod
+    from .collective import _multi_process, _require_store, _store_seq
+    if _multi_process():
+        st = _require_store(group)
+        seq = next(_store_seq)
+        rank, world = env_mod.get_rank(), env_mod.get_world_size()
+        if rank == src:
+            if in_object_list is None:
+                raise ValueError("src rank must pass in_object_list")
+            if len(in_object_list) % world:
+                raise ValueError(
+                    f"object list length {len(in_object_list)} must be "
+                    f"divisible by the world size {world}")
+            per = len(in_object_list) // world
+            for r in range(world):
+                st.set(f"objc/sc/{seq}/{r}",
+                       pickle.dumps(in_object_list[r * per:(r + 1) * per]))
+        out_object_list[:] = pickle.loads(st.get(f"objc/sc/{seq}/{rank}"))
+        from .collective import _store_cleanup
+        _store_cleanup(st, [f"objc/sc/{seq}/{r}" for r in range(world)],
+                       f"objc/sc/{seq}/done", world)
+        return out_object_list
     rank = group.get_group_rank(env_mod.get_rank())
     if rank < 0:
         return out_object_list  # this process is not a member of the group

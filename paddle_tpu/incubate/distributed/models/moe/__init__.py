@@ -8,5 +8,5 @@ GShard einsum formulation, so the whole layer jits to one XLA program and the
 expert dim shards over the ``ep`` mesh axis (XLA inserts the all_to_all).
 """
 from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate  # noqa: F401
-from .moe_layer import MoELayer, ExpertLayer  # noqa: F401
+from .moe_layer import MoELayer, ExpertLayer, ep_moe_ffn  # noqa: F401
 from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401

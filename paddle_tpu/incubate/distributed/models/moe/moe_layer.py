@@ -101,6 +101,56 @@ def _gather_combine(expert_out_flat, val, comb_idx):
     return jnp.einsum("skm,sk->sm", g, val.astype(g.dtype))
 
 
+def ep_moe_ffn(x, gate_w, gate_b, w1, b1, w2, b2, *, ep_axis, num_expert,
+               capacity, top_k=2, act=None):
+    """GShard MoE FFN with EXPLICIT expert-parallel all_to_all dispatch —
+    the compiled-path counterpart of MoELayer for use INSIDE a shard_map
+    region (global_scatter_op.cc / global_gather_op.cc parity, driven by
+    moe_layer.py:116-187's scatter→ffn→gather).
+
+    Layout contract (per rank): x [S_local, M] — tokens sharded over
+    ``ep_axis``; gate_w [M, E] / gate_b [E] replicated; w1 [E_local, M, H],
+    b1 [E_local, H], w2 [E_local, H, M], b2 [E_local, M] — experts sharded
+    over ``ep_axis``. Each rank bins its tokens into a static [E, C, M]
+    send buffer (capacity C per (rank, expert) pair, GShard drop
+    semantics), one ``lax.all_to_all`` regroups it to [E_local, ep*C, M]
+    (every expert receives its tokens from all ranks — the ICI ride the
+    reference does with NCCL grouped send/recv), the batched expert FFN
+    runs locally, and the reverse all_to_all + weighted combine return
+    [S_local, M]. ``ep_axis=None`` runs the identical program minus the
+    collectives (single-chip oracle / ep=1).
+    """
+    if act is None:
+        act = jax.nn.gelu
+    S, M = x.shape
+    E, C = num_expert, capacity
+    logits = x @ gate_w.astype(x.dtype) + gate_b.astype(x.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    val, idx = jax.lax.top_k(probs, top_k)                     # [S, k]
+    val = val / jnp.maximum(jnp.sum(val, -1, keepdims=True), 1e-9)
+    slot_token, comb_idx = _dispatch_indices(idx.astype(jnp.int32),
+                                             num_expert=E, capacity=C)
+    send = _gather_dispatch(x, slot_token).reshape(E, C, M)
+    if ep_axis is not None:
+        # [E, C, M] -> [E_local, ep*C, M]: expert e's rows from every rank
+        recv = jax.lax.all_to_all(send, ep_axis, split_axis=0,
+                                  concat_axis=1, tiled=True)
+    else:
+        recv = send
+    h = act(jnp.einsum("ecm,emh->ech", recv, w1.astype(x.dtype))
+            + b1.astype(x.dtype)[:, None, :])
+    out = jnp.einsum("ech,ehm->ecm", h, w2.astype(x.dtype)) \
+        + b2.astype(x.dtype)[:, None, :]
+    if ep_axis is not None:
+        # reverse exchange: every token's expert output returns to the
+        # rank that owns the token
+        back = jax.lax.all_to_all(out, ep_axis, split_axis=1,
+                                  concat_axis=0, tiled=True)
+    else:
+        back = out
+    return _gather_combine(back.reshape(E * C, M), val, comb_idx)
+
+
 class MoELayer(nn.Layer):
     """Mixture-of-experts layer (moe_layer.py:260 API parity).
 
