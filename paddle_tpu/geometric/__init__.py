@@ -2,4 +2,4 @@
 from .math import (  # noqa: F401
     segment_sum, segment_mean, segment_min, segment_max,
 )
-from .message_passing import send_u_recv, send_ue_recv  # noqa: F401
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401

@@ -58,3 +58,23 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
         return _reduce_rows(m, dst, n_out, reduce_op)
 
     return apply(f, x, y, op_name=f"send_ue_recv_{message_op}_{reduce_op}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-EDGE message from node pairs: out[e] = x[src[e]] (op) y[dst[e]]
+    (reference phi op ``send_uv``, geometric/message_passing/send_recv.py)."""
+    assert message_op in ("add", "sub", "mul", "div"), message_op
+    src = jnp.asarray(unwrap(src_index))
+    dst = jnp.asarray(unwrap(dst_index))
+
+    def f(xv, yv):
+        a, b = xv[src], yv[dst]
+        if message_op == "add":
+            return a + b
+        if message_op == "sub":
+            return a - b
+        if message_op == "mul":
+            return a * b
+        return a / b
+
+    return apply(f, x, y, op_name=f"send_uv_{message_op}")

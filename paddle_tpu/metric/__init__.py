@@ -1,4 +1,4 @@
 """paddle.metric parity (reference: ``python/paddle/metric/metrics.py``)."""
 from .metrics import (  # noqa: F401
-    Metric, Accuracy, Precision, Recall, Auc, accuracy,
+    Metric, Accuracy, Precision, Recall, Auc, accuracy, auc,
 )

@@ -197,3 +197,19 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         lab = lab[..., 0]
     corr = (idx == lab[..., None]).any(axis=-1)
     return wrap(np.asarray(corr.mean(), np.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None, name=None):
+    """Functional AUC (phi op ``auc`` / static.auc): ONE algorithm — this
+    delegates to the streaming :class:`Auc`'s histogram buckets and
+    trapezoid sweep. input [N, 2] (or [N] probabilities), label [N] or
+    [N, 1]. Returns ([auc, stat_pos, stat_neg]) like the reference."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    return (Tensor(jnp.asarray(m.accumulate(), jnp.float64)),
+            Tensor(jnp.asarray(m._stat_pos)),
+            Tensor(jnp.asarray(m._stat_neg)))

@@ -44,6 +44,7 @@ from .layer.rnn import (  # noqa: F401
 )
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+    clip_by_norm,
 )
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401

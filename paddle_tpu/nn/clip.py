@@ -97,3 +97,18 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
         if p.grad is not None:
             p.grad._value = unwrap(p.grad) * scale
     return Tensor(total)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale ``x`` so its L2 norm is at most ``max_norm`` (phi op
+    ``clip_by_norm``; reference fluid/layers clip_by_norm)."""
+    import jax.numpy as jnp
+    from ..framework.tape import apply
+
+    def f(v):
+        n = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12),
+                          1.0)
+        return (v.astype(jnp.float32) * scale).astype(v.dtype)
+
+    return apply(f, x, op_name="clip_by_norm")

@@ -13,6 +13,7 @@ __all__ = [
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
     "log_loss", "sigmoid_focal_loss", "dice_loss", "npair_loss",
+    "huber_loss",
 ]
 
 
@@ -276,3 +277,17 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         return ce + reg
 
     return apply(f, anchor, positive, op_name="npair_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """Huber loss (phi op ``huber_loss``): quadratic within ``delta``,
+    linear beyond — the unscaled cousin of smooth_l1_loss."""
+    from ...framework.tape import apply
+
+    def f(x, y):
+        d = x - y
+        a = jnp.abs(d)
+        out = jnp.where(a <= delta, 0.5 * d * d, delta * (a - 0.5 * delta))
+        return _reduce(out, reduction)
+
+    return apply(f, input, label, op_name="huber_loss")

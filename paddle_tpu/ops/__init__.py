@@ -13,6 +13,8 @@ from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
+from . import sequence  # noqa: F401
 
 from . import math as _math
 from . import manipulation as _manip

@@ -23,6 +23,7 @@ __all__ = [
     "sgn", "take", "reverse", "vsplit", "index_add_", "tanh_", "shape",
     "is_complex", "is_floating_point", "is_integer", "iinfo",
     "broadcast_shape", "set_printoptions", "create_parameter", "batch",
+    "edit_distance",
     "in_dynamic_mode", "LazyGuard", "check_shape",
     "disable_signal_handler",
 ]
@@ -246,3 +247,40 @@ def check_shape(shape):
 def disable_signal_handler():
     """The reference unhooks its C++ crash handlers; the TPU build
     installs none, so this is a documented no-op."""
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (phi op ``edit_distance``,
+    fluid/layers edit_distance). Host-side DP — this is a metric, not a
+    training op. input/label: [B, S] padded int sequences; *_length give
+    the true lengths. Returns (distance [B, 1] f32, sequence_num [1])."""
+    a = np.asarray(unwrap(input))
+    b = np.asarray(unwrap(label))
+    la = np.asarray(unwrap(input_length)) if input_length is not None \
+        else np.full((a.shape[0],), a.shape[1])
+    lb = np.asarray(unwrap(label_length)) if label_length is not None \
+        else np.full((b.shape[0],), b.shape[1])
+    ignored = set(np.asarray(unwrap(ignored_tokens)).tolist()) \
+        if ignored_tokens is not None else set()
+
+    def strip(row, n):
+        return [t for t in row[:n].tolist() if t not in ignored]
+
+    dists = []
+    for i in range(a.shape[0]):
+        s1, s2 = strip(a[i], la[i]), strip(b[i], lb[i])
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = dp[n]
+        if normalized:
+            d = d / max(n, 1)
+        dists.append(d)
+    return (Tensor(jnp.asarray(np.asarray(dists, np.float32)[:, None])),
+            Tensor(jnp.asarray([a.shape[0]], jnp.int64)))

@@ -18,7 +18,7 @@ __all__ = [
     "cholesky", "inv", "pinv", "svd", "qr", "lu", "eig", "eigh", "eigvals",
     "eigvalsh", "det", "slogdet", "solve", "triangular_solve", "cholesky_solve",
     "lstsq", "matrix_power", "matrix_rank", "multi_dot", "cov", "corrcoef",
-    "histogram", "bincount",
+    "histogram", "bincount", "inverse", "lu_unpack",
 ]
 
 
@@ -251,3 +251,45 @@ def bincount(x, weights=None, minlength=0, name=None):
     out = jnp.bincount(v.reshape(-1), weights=None if w is None else w.reshape(-1),
                        length=length)
     return wrap(out if w is not None else out.astype(jnp.int64))
+
+
+def inverse(x, name=None):
+    """paddle.inverse — alias of linalg.inv (phi op ``inverse``)."""
+    return inv(x, name=name)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack ``lu()``'s packed LU factorization into (P, L, U)
+    (phi op ``lu_unpack``; reference tensor/linalg.py lu_unpack).
+
+    x: [..., M, N] packed LU; y: [..., min(M,N)] 1-based pivot indices
+    (sequential row swaps, LAPACK getrf convention). Returns (P, L, U)
+    with P [..., M, M], L [..., M, K], U [..., K, N], K = min(M, N).
+    """
+    v = unwrap(x)
+    piv = np.asarray(unwrap(y)) - 1  # 0-based
+    M, N = v.shape[-2], v.shape[-1]
+    K = min(M, N)
+
+    def unpack_p(p1):
+        perm = np.arange(M)
+        for i, pi in enumerate(p1):
+            perm[i], perm[pi] = perm[pi], perm[i]
+        P = np.zeros((M, M), np.float32)
+        P[perm, np.arange(M)] = 1.0
+        return P
+
+    if piv.ndim == 1:
+        P = unpack_p(piv)
+    else:
+        flat = piv.reshape(-1, piv.shape[-1])
+        P = np.stack([unpack_p(p) for p in flat]).reshape(
+            piv.shape[:-1] + (M, M))
+
+    def f(lu_v):
+        L = jnp.tril(lu_v[..., :, :K], -1) + jnp.eye(M, K, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :K, :])
+        return L, U
+
+    L, U = apply(f, x, op_name="lu_unpack")
+    return wrap(jnp.asarray(P, np.asarray(v).dtype)), L, U

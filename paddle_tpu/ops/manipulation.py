@@ -26,6 +26,8 @@ __all__ = [
     "tril", "triu", "diag", "diagflat", "meshgrid", "tensordot", "moveaxis",
     "as_complex", "as_real", "view", "view_as", "slice", "strided_slice",
     "crop", "pad", "shard_index", "numel", "rank", "assign", "fill_", "zero_",
+    "fill_diagonal_", "fill_diagonal_tensor", "fill_diagonal_tensor_",
+    "exponential_", "uniform_",
     "diag_embed", "flatten_", "squeeze_", "unsqueeze_", "tolist", "atleast_1d",
     "atleast_2d", "atleast_3d",
 ]
@@ -517,6 +519,77 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         in_shard = (v // shard_size) == shard_id
         return jnp.where(in_shard, v % shard_size, ignore_value)
     return apply_nondiff(f, input)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (phi op ``fill_diagonal``). ``wrap`` repeats
+    the diagonal every N rows for tall 2-D matrices (reference parity)."""
+    def f(v):
+        if v.ndim == 2:
+            m, n = v.shape
+            if wrap and m > n:
+                # numpy fill_diagonal wrap: flat stride n+1, restarting
+                # one row below each full block
+                flat_idx = jnp.arange(0, m * n, n + 1)
+                return v.reshape(-1).at[flat_idx].set(
+                    jnp.asarray(value, v.dtype)).reshape(m, n)
+            rows = jnp.arange(m)
+            cols = rows + offset
+            ok = (cols >= 0) & (cols < n)
+            safe = jnp.clip(cols, 0, n - 1)
+            return v.at[rows, safe].set(
+                jnp.where(ok, jnp.asarray(value, v.dtype), v[rows, safe]))
+        idx = jnp.arange(min(v.shape))
+        return v.at[tuple(idx for _ in range(v.ndim))].set(
+            jnp.asarray(value, v.dtype))
+
+    out = apply(f, x, op_name="fill_diagonal_")
+    return x._inplace_assign(out)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal of ``x`` (phi op
+    ``fill_diagonal_tensor``)."""
+    def f(v, w):
+        v2 = jnp.moveaxis(v, (dim1, dim2), (-2, -1))
+        m, n = v2.shape[-2], v2.shape[-1]
+        k = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+        rows = jnp.arange(k) + (0 if offset >= 0 else -offset)
+        cols = jnp.arange(k) + (offset if offset >= 0 else 0)
+        v2 = v2.at[..., rows, cols].set(w.astype(v.dtype))
+        return jnp.moveaxis(v2, (-2, -1), (dim1, dim2))
+
+    return apply(f, x, y, op_name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return x._inplace_assign(fill_diagonal_tensor(x, y, offset, dim1, dim2))
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential-distribution fill (phi op ``exponential_``)."""
+    from ..framework import random as random_mod
+    key = random_mod.next_key()
+
+    def f(v):
+        return jax.random.exponential(key, v.shape, jnp.float32) \
+            .astype(v.dtype) / lam
+
+    out = apply(f, x, op_name="exponential_")
+    return x._inplace_assign(out)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place U(min, max) fill (phi op ``uniform_inplace``)."""
+    from ..framework import random as random_mod
+    key = random_mod.next_key() if not seed else __import__("jax").random.key(seed)
+
+    def f(v):
+        return jax.random.uniform(key, v.shape, jnp.float32,
+                                  min, max).astype(v.dtype)
+
+    out = apply(f, x, op_name="uniform_")
+    return x._inplace_assign(out)
 
 
 def fill_(x, value):

@@ -1,0 +1,308 @@
+"""Sequence ops over (data, length) pairs — the fluid sequence_ops family.
+
+Parity: ``/root/reference/paddle/fluid/operators/sequence_ops/`` (~30 ops
+over LoD tensors: sequence_pad_op.cc, sequence_pool_op.cc,
+sequence_expand_op.cc, sequence_softmax_op.cc, ...).
+
+TPU-native redesign: LoD (level-of-detail offset) tensors are a
+CPU-framework construct — ragged rows packed into one flat dim plus an
+offsets vector. XLA wants static shapes, so the native carrier here is
+either a PADDED batch + ``lengths`` vector (the layout every sequence op
+below takes and returns — also what the fleet datasets' ``<name>.lod``
+columns convert to) or the flat+offsets pair for ops whose reference
+semantics are inherently ragged (``sequence_unpad`` returns the flat
+form). Masks make every op exact on the padded layout, and everything is
+pure jnp — differentiable and jit-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tape import apply
+from ..framework.tensor import Tensor
+from ._dispatch import unwrap
+
+__all__ = [
+    "sequence_pad", "sequence_unpad", "sequence_mask_from_lengths",
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_expand", "sequence_expand_as", "sequence_concat",
+    "sequence_slice", "sequence_enumerate", "sequence_first_step",
+    "sequence_last_step", "sequence_reshape", "sequence_erase",
+]
+
+
+def _lengths(x, lengths):
+    lv = unwrap(lengths)
+    return jnp.asarray(lv).astype(jnp.int32)
+
+
+def _row_mask(lengths, maxlen):
+    return jnp.arange(maxlen)[None, :] < lengths[:, None]  # [B, T]
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """Flat ragged rows -> padded [B, T, ...] (sequence_pad_op.cc).
+
+    x: [sum(lengths), ...] flat concatenation; lengths: [B]. Returns
+    (padded [B, T, ...], lengths). T = maxlen or max(lengths)."""
+    ln = np.asarray(unwrap(lengths)).astype(np.int64)
+    offs = np.concatenate([[0], np.cumsum(ln)])
+    T = int(maxlen if maxlen is not None else ln.max(initial=0))
+    B = len(ln)
+    # static gather index: row b position t reads flat[offs[b] + t] when
+    # t < len_b, else the pad slot (last row of an extended buffer)
+    gather = np.full((B, T), offs[-1], np.int64)
+    for b in range(B):
+        gather[b, :ln[b]] = offs[b] + np.arange(ln[b])
+
+    def f(xv, pv):
+        padrow = jnp.broadcast_to(jnp.asarray(pv, xv.dtype), xv.shape[1:])
+        ext = jnp.concatenate([xv, padrow[None]], axis=0)
+        return ext[jnp.asarray(gather)]
+
+    out = apply(f, x, pad_value, op_name="sequence_pad")
+    return out, Tensor(jnp.asarray(ln))
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] -> flat [sum(len), ...] (sequence_unpad_op.cc)."""
+    ln = np.asarray(unwrap(length)).astype(np.int64)
+    idx = np.concatenate([b * np.asarray(unwrap(x)).shape[1]
+                          + np.arange(l) for b, l in enumerate(ln)]) \
+        if len(ln) else np.zeros((0,), np.int64)
+
+    def f(xv):
+        flat = xv.reshape((-1,) + xv.shape[2:])
+        return flat[jnp.asarray(idx)]
+
+    return apply(f, x, op_name="sequence_unpad")
+
+
+def sequence_mask_from_lengths(lengths, maxlen=None, dtype="int64"):
+    """Alias surface for the lengths->mask op (sequence_mask lives in
+    nn.functional; this name serves the sequence_ops corpus)."""
+    from ..nn.functional import sequence_mask
+    return sequence_mask(lengths, maxlen=maxlen, dtype=dtype)
+
+
+def sequence_pool(x, pool_type, lengths=None, pad_value=0.0, name=None):
+    """Per-row pooling over the time dim (sequence_pool_op.cc):
+    sum/average/sqrt/max/min/first/last. x [B, T, ...]; empty rows
+    produce ``pad_value``."""
+    pool_type = pool_type.lower()
+    ln = _lengths(x, lengths) if lengths is not None else None
+
+    def f(xv):
+        B, T = xv.shape[0], xv.shape[1]
+        l = ln if ln is not None else jnp.full((B,), T, jnp.int32)
+        mask = _row_mask(l, T)
+        mshape = mask.shape + (1,) * (xv.ndim - 2)
+        m = mask.reshape(mshape)
+        lf = jnp.maximum(l, 1).reshape((B,) + (1,) * (xv.ndim - 2)) \
+            .astype(xv.dtype)
+        if pool_type == "sum":
+            out = jnp.sum(jnp.where(m, xv, 0), axis=1)
+        elif pool_type in ("average", "mean"):
+            out = jnp.sum(jnp.where(m, xv, 0), axis=1) / lf
+        elif pool_type == "sqrt":
+            out = jnp.sum(jnp.where(m, xv, 0), axis=1) / jnp.sqrt(lf)
+        elif pool_type == "max":
+            out = jnp.max(jnp.where(m, xv, -jnp.inf), axis=1)
+        elif pool_type == "min":
+            out = jnp.min(jnp.where(m, xv, jnp.inf), axis=1)
+        elif pool_type == "first":
+            out = xv[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(l - 1, 0)
+            out = jnp.take_along_axis(
+                xv, idx.reshape((B, 1) + (1,) * (xv.ndim - 2)), axis=1
+            )[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        empty = (l == 0).reshape((B,) + (1,) * (xv.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, xv.dtype), out)
+
+    return apply(f, x, op_name=f"sequence_pool_{pool_type}")
+
+
+def sequence_first_step(x, lengths=None):
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    """Masked softmax over the time dim (sequence_softmax_op.cc)."""
+    ln = _lengths(x, lengths) if lengths is not None else None
+
+    def f(xv):
+        B, T = xv.shape[0], xv.shape[1]
+        l = ln if ln is not None else jnp.full((B,), T, jnp.int32)
+        mask = _row_mask(l, T)
+        while mask.ndim < xv.ndim:
+            mask = mask[..., None]
+        z = jnp.where(mask, xv.astype(jnp.float32), -jnp.inf)
+        out = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, out, 0.0).astype(xv.dtype)
+
+    return apply(f, x, op_name="sequence_softmax")
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row's valid prefix (sequence_reverse_op.cc)."""
+    ln = _lengths(x, lengths) if lengths is not None else None
+
+    def f(xv):
+        B, T = xv.shape[0], xv.shape[1]
+        l = ln if ln is not None else jnp.full((B,), T, jnp.int32)
+        t = jnp.arange(T)[None, :]
+        src = jnp.where(t < l[:, None], l[:, None] - 1 - t, t)
+        return jnp.take_along_axis(
+            xv, src.reshape((B, T) + (1,) * (xv.ndim - 2)), axis=1)
+
+    return apply(f, x, op_name="sequence_reverse")
+
+
+def sequence_expand(x, y_lengths, ref_level=0, name=None):
+    """Repeat each row of x per the reference sequence's row count
+    (sequence_expand_op.cc): row b of x appears y_lengths[b] times."""
+    rep = np.asarray(unwrap(y_lengths)).astype(np.int64)
+    idx = np.repeat(np.arange(len(rep)), rep)
+
+    def f(xv):
+        return xv[jnp.asarray(idx)]
+
+    return apply(f, x, op_name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand x's rows to match y's leading dim (sequence_expand_as_op.cc):
+    each x row repeats len(y)/len(x) times."""
+    n_x = unwrap(x).shape[0]
+    n_y = unwrap(y).shape[0]
+    assert n_y % n_x == 0, (n_x, n_y)
+    rep = n_y // n_x
+
+    def f(xv):
+        return jnp.repeat(xv, rep, axis=0)
+
+    return apply(f, x, op_name="sequence_expand_as")
+
+
+def sequence_concat(xs, lengths_list=None, name=None):
+    """Row-wise concatenation of sequence batches
+    (sequence_concat_op.cc): row b of the result is the concatenation of
+    row b's valid prefix from every input. Padded layout in/out."""
+    if lengths_list is None:
+        lengths_list = [None] * len(xs)
+    lns = []
+    for x, l in zip(xs, lengths_list):
+        T = unwrap(x).shape[1]
+        B = unwrap(x).shape[0]
+        lns.append(np.asarray(unwrap(l)).astype(np.int64)
+                   if l is not None else np.full((B,), T, np.int64))
+    total = np.stack(lns).sum(axis=0)
+    T_out = int(total.max(initial=0))
+    B = len(total)
+    Ts = [np.asarray(unwrap(x)).shape[1] for x in xs]
+    t_offs = np.concatenate([[0], np.cumsum(Ts)])
+    # ONE static gather (same pattern as sequence_pad): output slot
+    # (b, p) reads flat position b*sum(T) + t_offs[i] + t of the
+    # time-concatenated inputs; invalid slots read the pad row
+    gather = np.full((B, T_out), B * int(t_offs[-1]), np.int64)
+    for b in range(B):
+        pos = 0
+        for i, ln in enumerate(lns):
+            gather[b, pos:pos + ln[b]] = b * t_offs[-1] + t_offs[i] \
+                + np.arange(ln[b])
+            pos += ln[b]
+
+    def f(*xvs):
+        cat = jnp.concatenate(xvs, axis=1)             # [B, sum(T), ...]
+        flat = cat.reshape((-1,) + cat.shape[2:])
+        pad = jnp.zeros((1,) + cat.shape[2:], cat.dtype)
+        ext = jnp.concatenate([flat, pad], axis=0)
+        return ext[jnp.asarray(gather)]
+
+    out = apply(f, *xs, op_name="sequence_concat")
+    return out, Tensor(jnp.asarray(total))
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row slice (sequence_slice_op.cc): row b keeps
+    x[b, offset[b]:offset[b]+length[b]]. Returns padded [B, max(length)]
+    plus the new lengths."""
+    off = np.asarray(unwrap(offset)).astype(np.int64).reshape(-1)
+    ln = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    T_out = int(ln.max(initial=0))
+
+    def f(xv):
+        B = xv.shape[0]
+        t = jnp.arange(T_out)[None, :]
+        src = jnp.clip(jnp.asarray(off)[:, None] + t, 0, xv.shape[1] - 1)
+        got = jnp.take_along_axis(
+            xv, src.reshape((B, T_out) + (1,) * (xv.ndim - 2)), axis=1)
+        mask = t < jnp.asarray(ln)[:, None]
+        while mask.ndim < got.ndim:
+            mask = mask[..., None]
+        return jnp.where(mask, got, 0)
+
+    return apply(f, x, op_name="sequence_slice"), Tensor(jnp.asarray(ln))
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """Sliding windows of ids (sequence_enumerate_op.cc): out[b, t] =
+    x[b, t:t+win_size], positions beyond the row's length padded."""
+    ln = _lengths(x, lengths) if lengths is not None else None
+
+    def f(xv):
+        B, T = xv.shape[0], xv.shape[1]
+        l = ln if ln is not None else jnp.full((B,), T, jnp.int32)
+        t = jnp.arange(T)[None, :, None]
+        w = jnp.arange(win_size)[None, None, :]
+        src = jnp.clip(t + w, 0, T - 1)
+        got = xv[jnp.arange(B)[:, None, None], src]
+        ok = (t + w) < l[:, None, None]
+        return jnp.where(ok, got, pad_value)
+
+    return apply(f, x, op_name="sequence_enumerate")
+
+
+def sequence_reshape(x, new_dim, lengths=None, name=None):
+    """Re-chunk the feature dim (sequence_reshape_op.cc): [B, T, D] ->
+    [B, T*D/new_dim, new_dim] with lengths scaled by D/new_dim."""
+    D = unwrap(x).shape[-1]
+    assert (D * unwrap(x).shape[1]) % new_dim == 0
+
+    def f(xv):
+        B = xv.shape[0]
+        return xv.reshape(B, -1, new_dim)
+
+    out = apply(f, x, op_name="sequence_reshape")
+    if lengths is not None:
+        ln = np.asarray(unwrap(lengths)).astype(np.int64) * D // new_dim
+        return out, Tensor(jnp.asarray(ln))
+    return out
+
+
+def sequence_erase(x, tokens, lengths=None, name=None):
+    """Remove the listed tokens from each row (sequence_erase_op.cc).
+    Padded int layout: survivors compact left, tail zero-padded; returns
+    (out, new_lengths)."""
+    xv_np = np.asarray(unwrap(x))
+    ln = np.asarray(unwrap(lengths)).astype(np.int64) \
+        if lengths is not None else np.full((xv_np.shape[0],),
+                                            xv_np.shape[1], np.int64)
+    toks = set(np.asarray(tokens).reshape(-1).tolist())
+    B, T = xv_np.shape
+    out = np.zeros_like(xv_np)
+    new_ln = np.zeros((B,), np.int64)
+    for b in range(B):
+        kept = [v for v in xv_np[b, :ln[b]].tolist() if v not in toks]
+        out[b, :len(kept)] = kept
+        new_ln[b] = len(kept)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(new_ln))

@@ -1,5 +1,10 @@
 """paddle.static.nn parity — control flow + static layer helpers."""
 from .control_flow import while_loop, cond, case, switch_case  # noqa: F401
+from ...ops.sequence import (  # noqa: F401  (fluid.layers sequence_* home)
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_reverse, sequence_expand, sequence_expand_as, sequence_concat,
+    sequence_slice, sequence_enumerate, sequence_first_step,
+    sequence_last_step, sequence_reshape, sequence_erase)
 from .common import (  # noqa: F401
     fc, embedding, sparse_embedding, conv2d, conv2d_transpose, conv3d,
     conv3d_transpose, batch_norm, layer_norm, group_norm, instance_norm,

@@ -19,7 +19,10 @@ from ..framework.tensor import Tensor
 from .. import nn
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
-           "RoIAlign", "RoIPool", "ConvNormActivation"]
+           "RoIAlign", "RoIPool", "ConvNormActivation",
+           "prior_box", "multiclass_nms", "matrix_nms", "psroi_pool",
+           "distribute_fpn_proposals", "generate_proposals",
+           "deform_conv2d", "decode_jpeg", "DeformConv2D", "yolo_loss"]
 
 
 _NMS_DYGRAPH_ONLY = (
@@ -348,3 +351,602 @@ class ConvNormActivation(nn.Sequential):
         if activation_layer is not None:
             layers.append(activation_layer())
         super().__init__(*layers)
+
+
+# ---------------------------------------------------------------------------
+# anchor generation / proposal plumbing (detection/prior_box_op.cc,
+# generate_proposals_v2_op.cc, distribute_fpn_proposals_op.cc,
+# psroi_pool_op.cc, multiclass_nms_op.cc, matrix_nms_op.cc)
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from ..ops._dispatch import unwrap
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map
+    (detection/prior_box_op.cc). input [N,C,H,W] feature, image [N,3,IH,IW].
+    Returns (boxes [H,W,P,4] normalized xmin..ymax, variances [H,W,P,4])."""
+    fh, fw = unwrap(input).shape[2], unwrap(input).shape[3]
+    ih, iw = unwrap(image).shape[2], unwrap(image).shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # per-prior (w, h) in pixels
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                ps = float(np.sqrt(ms * max_sizes[k]))
+                whs.append((ps, ps))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * float(np.sqrt(ar)),
+                            ms / float(np.sqrt(ar))))
+        else:
+            for ar in ars:
+                whs.append((ms * float(np.sqrt(ar)),
+                            ms / float(np.sqrt(ar))))
+            if max_sizes:
+                ps = float(np.sqrt(ms * max_sizes[k]))
+                whs.append((ps, ps))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+    P = len(whs)
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    boxes = np.empty((fh, fw, P, 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - whs[:, 0] / 2) / iw
+    boxes[..., 1] = (cyg[..., None] - whs[:, 1] / 2) / ih
+    boxes[..., 2] = (cxg[..., None] + whs[:, 0] / 2) / iw
+    boxes[..., 3] = (cyg[..., None] + whs[:, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(vars_))
+
+
+def _nms_keep(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        if thresh >= 1.0:
+            continue
+        x1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        y1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        x2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        y2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / np.maximum(a[i] + a - inter, 1e-10)
+        suppressed |= iou > thresh
+        suppressed[i] = True  # already kept; stop revisiting
+    return keep
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """Per-class NMS over shared boxes (multiclass_nms_op.cc / the v3 phi
+    op). bboxes [N, M, 4], scores [N, C, M]. Host-side post-processing
+    (data-dependent output). Returns (out [K, 6] = [label, score, box],
+    nms_rois_num [N], index [K, 1] if requested)."""
+    bb = np.asarray(unwrap(bboxes))
+    sc = np.asarray(unwrap(scores))
+    outs, idxs, counts = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            if nms_top_k > 0 and sel.size > nms_top_k:
+                sel = sel[np.argsort(-s[sel])[:nms_top_k]]
+            keep = _nms_keep(bb[n, sel], s[sel], nms_threshold)
+            for k in keep:
+                dets.append((c, s[sel[k]], *bb[n, sel[k]], n * bb.shape[1]
+                             + sel[k]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    nums = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    if return_index:
+        return out, nums, Tensor(jnp.asarray(
+            np.asarray(idxs, np.int64).reshape(-1, 1)))
+    return out, nums
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; matrix_nms_op.cc): scores decay by the max IoU
+    with higher-scored boxes of the same class — parallel, no greedy loop."""
+    bb = np.asarray(unwrap(bboxes))
+    sc = np.asarray(unwrap(scores))
+    outs, idxs, counts = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = np.argsort(-s[sel])
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            sel = sel[order]
+            boxes, ss = bb[n, sel], s[sel]
+            m = len(sel)
+            x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            iou = inter / np.maximum(a[:, None] + a[None, :] - inter, 1e-10)
+            iou = np.triu(iou, 1)  # iou[i, j] for i < j (i higher-scored)
+            # compensation per box i: its own max IoU with a better box
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                decay = np.exp((np.square(comp[:, None])
+                                - np.square(iou)) / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou, bool), 1), decay,
+                             np.inf).min(axis=0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            ds = ss * decay
+            for k in range(m):
+                if ds[k] > post_threshold:
+                    dets.append((c, ds[k], *boxes[k],
+                                 n * bb.shape[1] + sel[k]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.asarray(idxs, np.int64).reshape(-1, 1))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (psroi_pool_op.cc, R-FCN):
+    input channels C = out_c * ph * pw; bin (i, j) of a RoI pools from its
+    OWN channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xv = unwrap(x)
+    C = xv.shape[1]
+    assert C % (ph * pw) == 0, "channels must divide output_size^2"
+    out_c = C // (ph * pw)
+    rois = np.asarray(unwrap(boxes))
+    nums = np.asarray(unwrap(boxes_num))
+
+    def f(feat):
+        outs = []
+        batch_of = np.repeat(np.arange(len(nums)), nums)
+        for r in range(rois.shape[0]):
+            b = int(batch_of[r])
+            x1, y1, x2, y2 = rois[r] * spatial_scale
+            rw = max(x2 - x1, 0.1) / pw
+            rh = max(y2 - y1, 0.1) / ph
+            bins = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.floor(y1 + i * rh))
+                    he = int(np.ceil(y1 + (i + 1) * rh))
+                    ws = int(np.floor(x1 + j * rw))
+                    we = int(np.ceil(x1 + (j + 1) * rw))
+                    hs, he = max(hs, 0), min(max(he, hs + 1), feat.shape[2])
+                    ws, we = max(ws, 0), min(max(we, ws + 1), feat.shape[3])
+                    # PS channel convention (output-channel-major): the
+                    # input channel for output c, bin (i,j) is
+                    # c*ph*pw + i*pw + j — a strided slice per bin
+                    grp = feat[b, i * pw + j::ph * pw, hs:he, ws:we]
+                    bins.append(jnp.mean(grp, axis=(1, 2)))
+            outs.append(jnp.stack(bins, 1).reshape(out_c, ph, pw))
+        return jnp.stack(outs)
+
+    return apply(f, x, op_name="psroi_pool")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by sqrt(area)
+    (distribute_fpn_proposals_op.cc). Returns (multi_rois list,
+    restore_index [R, 1], rois_num_per_level list or None)."""
+    rois = np.asarray(unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        per_img = np.asarray(unwrap(rois_num)).astype(np.int64)
+    else:
+        per_img = np.asarray([rois.shape[0]], np.int64)
+    img_of = np.repeat(np.arange(len(per_img)), per_img)
+    multi, order, nums_out = [], [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        multi.append(Tensor(jnp.asarray(rois[idx])))
+        # per-IMAGE counts at this level, shape [N] (reference contract)
+        nums_out.append(Tensor(jnp.asarray(np.bincount(
+            img_of[idx], minlength=len(per_img)).astype(np.int32))))
+        order.extend(idx.tolist())
+    restore = np.empty(len(order), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(order))
+    restore_t = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi, restore_t, nums_out
+    return multi, restore_t, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (generate_proposals_v2_op.cc): decode
+    anchors with deltas, clip to the image, filter small boxes, NMS.
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors [H, W, A, 4]
+    or [HWA, 4], variances like anchors."""
+    sc = np.asarray(unwrap(scores))
+    deltas = np.asarray(unwrap(bbox_deltas))
+    anc = np.asarray(unwrap(anchors)).reshape(-1, 4)
+    var = np.asarray(unwrap(variances)).reshape(-1, 4)
+    imgs = np.asarray(unwrap(img_size))
+    N, A = sc.shape[0], sc.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_scores, counts = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # HWA
+        d = deltas[n].reshape(A, 4, *deltas.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)          # HWA, 4
+        order = np.argsort(-s)
+        if pre_nms_top_n > 0:
+            order = order[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        hgt = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - hgt / 2,
+                          cx + w / 2 - off, cy + hgt / 2 - off], 1)
+        ih, iw = imgs[n, 0], imgs[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        ok = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = _nms_keep(boxes, s, nms_thresh)
+        if post_nms_top_n > 0:
+            keep = keep[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
+        counts.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois).astype(np.float32)))
+    rscores = Tensor(jnp.asarray(
+        np.concatenate(all_scores).astype(np.float32)[:, None]))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.asarray(counts, np.int32)))
+    return rois, rscores
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (deformable_conv_op.cu): sampling
+    locations are the regular grid plus learned offsets; v2 adds a
+    modulation mask. Implemented as bilinear gathers + one einsum —
+    differentiable through offsets, mask, weight, and input."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    kh, kw = unwrap(weight).shape[2], unwrap(weight).shape[3]
+
+    def f(xv, off, wv, *rest):
+        i = 0
+        mv = None
+        bv = None
+        if mask is not None:
+            mv = rest[i]; i += 1
+        if bias is not None:
+            bv = rest[i]
+        N, C, H, W = xv.shape
+        ph, pw_ = padding
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph, ph), (pw_, pw_)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw_
+        OH = (Hp - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1
+        OW = (Wp - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1
+        dg = deformable_groups
+        # offsets [N, 2*dg*kh*kw, OH, OW] ordered (dg, kh, kw, {y,x})
+        off = off.reshape(N, dg, kh * kw, 2, OH, OW)
+        base_y = (jnp.arange(OH) * stride[0])[:, None] \
+            + jnp.zeros((OH, OW), jnp.int32)
+        base_x = (jnp.arange(OW) * stride[1])[None, :] \
+            + jnp.zeros((OH, OW), jnp.int32)
+        ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+        ky = (ky * dilation[0]).reshape(-1)       # [K]
+        kx = (kx * dilation[1]).reshape(-1)
+        # sampling coords [N, dg, K, OH, OW]
+        sy = base_y[None, None, None] + ky[None, None, :, None, None] \
+            + off[:, :, :, 0]
+        sx = base_x[None, None, None] + kx[None, None, :, None, None] \
+            + off[:, :, :, 1]
+        y0 = jnp.floor(sy); x0 = jnp.floor(sx)
+        wy = sy - y0; wx = sx - x0
+        cg = C // dg
+        xg_flat = xp.reshape(N, dg, cg, Hp * Wp)
+
+        def gather(yy, xx):
+            # bilinear corner fetch: [N, dg, K, OH, OW] coords into the
+            # [N, dg, cg, Hp*Wp] feature, out-of-image points read zero
+            ok = (yy >= 0) & (yy < Hp) & (xx >= 0) & (xx < Wp)
+            yc = jnp.clip(yy, 0, Hp - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, Wp - 1).astype(jnp.int32)
+            flat = yc * Wp + xc                        # [N, dg, K, OH, OW]
+            got = jax.vmap(jax.vmap(
+                lambda feat, ind: feat[:, ind]         # [cg, K, OH, OW]
+            ))(xg_flat, flat)                          # [N, dg, cg, K, OH, OW]
+            return got * ok[:, :, None].astype(xv.dtype)
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, None].astype(xv.dtype)
+        wx_ = wx[:, :, None].astype(xv.dtype)
+        sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                   + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        # sampled [N, dg, cg, K, OH, OW] -> [N, C, K, OH, OW]
+        sampled = sampled.reshape(N, C, kh * kw, OH, OW)
+        if mv is not None:
+            m2 = mv.reshape(N, dg, kh * kw, OH, OW)
+            m2 = jnp.repeat(m2, C // dg, axis=1).reshape(
+                N, C, kh * kw, OH, OW) if dg > 1 else \
+                jnp.broadcast_to(m2[:, 0][:, None], (N, C, kh * kw, OH, OW))
+            sampled = sampled * m2.astype(xv.dtype)
+        # grouped conv as einsum: weight [Cout, C/groups, kh, kw]
+        Cout = wv.shape[0]
+        cg2 = C // groups
+        og = Cout // groups
+        samp_g = sampled.reshape(N, groups, cg2, kh * kw, OH, OW)
+        w_g = wv.reshape(groups, og, cg2, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", samp_g, w_g,
+                         optimize=True).reshape(N, Cout, OH, OW)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, op_name="deform_conv2d")
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (decode_jpeg op;
+    host-side via PIL — image IO is data-pipeline work, not chip work)."""
+    import io
+    from PIL import Image
+    data = bytes(np.asarray(unwrap(x)).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+
+class DeformConv2D(nn.Layer):
+    """Deformable conv layer wrapper (reference vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._attrs = (stride, padding, dilation, deformable_groups, groups)
+        from .. import nn as _nn
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr,
+            default_initializer=_nn.initializer.XavierNormal())
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_channels], is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        stride, padding, dilation, dg, groups = self._attrs
+        return deform_conv2d(x, offset, self.weight, self.bias, stride,
+                             padding, dilation, dg, groups, mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss for one detection scale (yolov3_loss_op.h).
+
+    x [N, A*(5+cls), H, W] raw head outputs; gt_box [N, B, 4] normalized
+    (cx, cy, w, h); gt_label [N, B] int; anchors = the FULL anchor list
+    (pixel w, h pairs flattened), anchor_mask = this scale's indices.
+    Returns per-sample loss [N]. Pure jnp — differentiable end to end.
+    Assignment parity: each gt's responsible anchor is the best
+    shape-IoU anchor over the full list; the gt contributes only if that
+    anchor belongs to this scale's mask. Predictions whose best IoU with
+    any gt exceeds ignore_thresh are excluded from the negative
+    objectness term. Box losses carry the (2 - gw*gh) scale.
+    """
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    A = len(mask)
+
+    def f(xv, gb, gl, *rest):
+        gs = rest[0] if rest else jnp.ones(gb.shape[:2], jnp.float32)
+        N, C, H, W = xv.shape
+        xv = xv.reshape(N, A, 5 + class_num, H, W)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        an = jnp.asarray(anchors)
+        an_this = an[jnp.asarray(mask)]               # [A, 2] pixels
+        tx, ty = xv[:, :, 0], xv[:, :, 1]
+        tw, th = xv[:, :, 2], xv[:, :, 3]
+        tobj = xv[:, :, 4]
+        tcls = xv[:, :, 5:]                           # [N, A, cls, H, W]
+
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        px = (jax.nn.sigmoid(tx) * alpha + beta + gx) / W    # [N,A,H,W]
+        py = (jax.nn.sigmoid(ty) * alpha + beta + gy) / H
+        pw = jnp.exp(jnp.clip(tw, -20, 20)) * an_this[None, :, 0, None,
+                                                      None] / in_w
+        ph = jnp.exp(jnp.clip(th, -20, 20)) * an_this[None, :, 1, None,
+                                                      None] / in_h
+
+        # ---- ignore mask: best IoU of each prediction with any gt ----
+        def iou_cxcywh(ax, ay, aw, ah, bx, by, bw, bh):
+            ax1, ay1 = ax - aw / 2, ay - ah / 2
+            ax2, ay2 = ax + aw / 2, ay + ah / 2
+            bx1, by1 = bx - bw / 2, by - bh / 2
+            bx2, by2 = bx + bw / 2, by + bh / 2
+            ix = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1),
+                          0, None)
+            iy = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1),
+                          0, None)
+            inter = ix * iy
+            return inter / jnp.maximum(aw * ah + bw * bh - inter, 1e-10)
+
+        ious = iou_cxcywh(
+            px[..., None], py[..., None], pw[..., None], ph[..., None],
+            gb[:, None, None, None, :, 0], gb[:, None, None, None, :, 1],
+            gb[:, None, None, None, :, 2], gb[:, None, None, None, :, 3])
+        valid_gt = (gb[..., 2] > 0) & (gb[..., 3] > 0)   # [N, B]
+        ious = jnp.where(valid_gt[:, None, None, None, :], ious, 0.0)
+        best_iou = jnp.max(ious, axis=-1)                # [N, A, H, W]
+        noobj_mask = (best_iou < ignore_thresh).astype(jnp.float32)
+
+        # ---- positive assignment per gt ----
+        # best shape-IoU anchor over the FULL anchor list
+        gwp = gb[..., 2] * in_w                          # pixels [N, B]
+        ghp = gb[..., 3] * in_h
+        inter = jnp.minimum(gwp[..., None], an[None, None, :, 0]) * \
+            jnp.minimum(ghp[..., None], an[None, None, :, 1])
+        union = gwp[..., None] * ghp[..., None] \
+            + an[None, None, :, 0] * an[None, None, :, 1] - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+        mask_arr = jnp.asarray(mask)
+        a_local = jnp.argmax(
+            (best_anchor[..., None] == mask_arr[None, None, :]), -1)
+        responsible = jnp.any(
+            best_anchor[..., None] == mask_arr[None, None, :], -1) \
+            & valid_gt                                   # [N, B]
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # gather predictions at assigned cells: [N, B, ...]
+        b_idx = jnp.arange(N)[:, None]
+        sel = lambda t: t[b_idx, a_local, gj, gi]
+        stx, sty = sel(tx), sel(ty)
+        stw, sth = sel(tw), sel(th)
+        scls = tcls[b_idx, a_local, :, gj, gi]           # [N, B, cls]
+
+        # targets
+        txt = gb[..., 0] * W - gi
+        tyt = gb[..., 1] * H - gj
+        aw_sel = an[jnp.asarray(mask)][a_local]          # [N, B, 2]
+        twt = jnp.log(jnp.clip(gwp / jnp.maximum(aw_sel[..., 0], 1e-6),
+                               1e-9, None))
+        tht = jnp.log(jnp.clip(ghp / jnp.maximum(aw_sel[..., 1], 1e-6),
+                               1e-9, None))
+        box_scale = 2.0 - gb[..., 2] * gb[..., 3]
+        wpos = responsible.astype(jnp.float32) * gs
+
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t \
+            + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        # note sigmoid targets under scale_x_y: invert the affine
+        sxt = jnp.clip((txt - beta) / alpha, 1e-4, 1 - 1e-4)
+        syt = jnp.clip((tyt - beta) / alpha, 1e-4, 1 - 1e-4)
+        loss_xy = (bce(stx, sxt) + bce(sty, syt)) * box_scale * wpos
+        loss_wh = (jnp.abs(stw - twt) + jnp.abs(sth - tht)) \
+            * box_scale * wpos
+        onehot = jax.nn.one_hot(gl, class_num)
+        if use_label_smooth:
+            smooth = 1.0 / max(class_num, 1)
+            onehot = onehot * (1 - smooth) + smooth / class_num
+        loss_cls = jnp.sum(bce(scls, onehot), -1) * wpos
+
+        # objectness: positive at assigned cells, negative elsewhere
+        pos_obj = jnp.zeros((N, A, H, W))
+        pos_obj = pos_obj.at[b_idx, a_local, gj, gi].add(wpos)
+        pos_obj = jnp.clip(pos_obj, 0.0, 1.0)
+        loss_obj_pos = bce(tobj, jnp.ones_like(tobj)) * pos_obj
+        loss_obj_neg = bce(tobj, jnp.zeros_like(tobj)) * (1 - pos_obj) \
+            * noobj_mask
+        per_sample = (jnp.sum(loss_xy, -1) + jnp.sum(loss_wh, -1)
+                      + jnp.sum(loss_cls, -1)
+                      + jnp.sum(loss_obj_pos, (1, 2, 3))
+                      + jnp.sum(loss_obj_neg, (1, 2, 3)))
+        return per_sample
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    return apply(f, *args, op_name="yolo_loss")

@@ -1,0 +1,312 @@
+"""Op-surface audit + OpTest-style numeric cases for the round-4 op batch
+(VERDICT r3 #7: audit vs phi/api/yaml + implement the top missing ops).
+
+Oracle style mirrors the reference's OpTest: hand-computed or
+numpy/jax-reference expected values per op.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.vision.ops as vops
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+YAML_DIR = "/root/reference/paddle/phi/api/yaml"
+
+
+@pytest.mark.skipif(not os.path.isdir(YAML_DIR), reason="no reference yaml")
+def test_audit_zero_missing():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_audit
+    results = op_audit.audit(YAML_DIR)
+    for fname, rows in results.items():
+        missing = [op for op, st in rows if st == "MISSING"]
+        assert not missing, f"{fname}: {missing}"
+
+
+def test_lu_unpack_reconstructs():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    lu_packed, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_packed, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_inverse_alias():
+    a = np.array([[2.0, 0.0], [1.0, 3.0]], np.float32)
+    inv = paddle.inverse(paddle.to_tensor(a))
+    np.testing.assert_allclose(inv.numpy() @ a, np.eye(2), atol=1e-5)
+
+
+def test_clip_by_norm():
+    x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    out = paddle.nn.clip_by_norm(x, 1.0)
+    np.testing.assert_allclose(out.numpy(), [0.6, 0.8], rtol=1e-5)
+    out2 = paddle.nn.clip_by_norm(x, 10.0)  # under the cap: unchanged
+    np.testing.assert_allclose(out2.numpy(), [3.0, 4.0], rtol=1e-6)
+
+
+def test_fill_diagonal_and_tensor():
+    x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    x.fill_diagonal_(5.0)
+    np.testing.assert_allclose(x.numpy(), np.eye(3) * 5)
+
+    # wrap=True matches numpy's fill_diagonal on tall matrices
+    t = paddle.to_tensor(np.zeros((7, 3), np.float32))
+    t.fill_diagonal_(1.0, wrap=True)
+    ref = np.zeros((7, 3), np.float32)
+    np.fill_diagonal(ref, 1.0, wrap=True)
+    np.testing.assert_allclose(t.numpy(), ref)
+
+    y = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    d = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = paddle.fill_diagonal_tensor(y, d)
+    np.testing.assert_allclose(out.numpy(), np.diag([1.0, 2.0, 3.0]))
+
+
+def test_inplace_random_fills():
+    paddle.seed(7)
+    x = paddle.to_tensor(np.zeros((1000,), np.float32))
+    x.uniform_(2.0, 3.0)
+    assert 2.0 <= float(x.numpy().min()) and float(x.numpy().max()) <= 3.0
+    y = paddle.to_tensor(np.zeros((4000,), np.float32))
+    y.exponential_(lam=2.0)
+    assert (y.numpy() >= 0).all()
+    assert abs(float(y.numpy().mean()) - 0.5) < 0.06  # E = 1/lam
+
+
+def test_huber_loss():
+    x = paddle.to_tensor(np.array([0.0, 2.0], np.float32))
+    t = paddle.to_tensor(np.array([0.5, 0.0], np.float32))
+    out = F.huber_loss(x, t, delta=1.0, reduction="none")
+    np.testing.assert_allclose(out.numpy(), [0.125, 1.5], rtol=1e-6)
+
+
+def test_edit_distance():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 3, 3, 4]], np.int64))
+    la = paddle.to_tensor(np.array([3], np.int64))
+    lb = paddle.to_tensor(np.array([4], np.int64))
+    d, n = paddle.edit_distance(a, b, normalized=False,
+                                input_length=la, label_length=lb)
+    # "123" -> "1334": sub(2->3) + ins(4) = 2
+    np.testing.assert_allclose(d.numpy(), [[2.0]])
+    assert int(n.numpy()[0]) == 1
+
+
+def test_send_uv():
+    import paddle_tpu.geometric as geo
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    y = paddle.to_tensor(np.array([[10.0], [20.0], [30.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 2], np.int64))
+    dst = paddle.to_tensor(np.array([1, 0], np.int64))
+    out = geo.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(out.numpy(), [[21.0], [13.0]])
+
+
+def test_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, variances = vops.prior_box(
+        feat, img, min_sizes=[16.0], aspect_ratios=[1.0], clip=True)
+    assert boxes.shape == [2, 2, 1, 4]
+    b = boxes.numpy()[0, 0, 0]  # center (8, 8), size 16 -> [0, 0, .5, .5]
+    np.testing.assert_allclose(b, [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    np.testing.assert_allclose(variances.numpy()[0, 0, 0],
+                               [0.1, 0.1, 0.2, 0.2])
+
+
+def test_multiclass_and_matrix_nms():
+    bb = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10], [20, 20, 30, 30]]],
+                  np.float32)
+    sc = np.zeros((1, 2, 3), np.float32)
+    sc[0, 1] = [0.9, 0.8, 0.7]  # class 1; class 0 = background
+    out, nums = vops.multiclass_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(sc),
+        score_threshold=0.1, nms_threshold=0.5, background_label=0)
+    # overlapping pair suppressed -> 2 detections
+    assert int(nums.numpy()[0]) == 2
+    assert out.numpy()[0][1] == pytest.approx(0.9)
+
+    out_m, nums_m = vops.matrix_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(sc),
+        score_threshold=0.1, post_threshold=0.5, background_label=0)
+    got = out_m.numpy()
+    # decay kills the overlapping 0.8 box below post_threshold
+    assert int(nums_m.numpy()[0]) == 2 and got.shape[1] == 6
+
+
+def test_psroi_pool():
+    # C = out_c * ph * pw, output-channel-major: channel for output c,
+    # bin (i, j) is c*ph*pw + i*pw + j (R-FCN convention)
+    x = np.zeros((1, 8, 4, 4), np.float32)
+    for c in range(8):
+        x[0, c] = c + 1
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.psroi_pool(paddle.to_tensor(x), boxes, num, 2)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(out.numpy()[0, 1], [[5.0, 6.0], [7.0, 8.0]])
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 300, 300]], np.float32)
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([1, 1], np.int32)))
+    sizes = [m.numpy().shape[0] for m in multi]
+    assert sizes == [1, 0, 1, 0]  # small->level2, 300px->level4
+    r = restore.numpy()[:, 0]
+    assert sorted(r.tolist()) == [0, 1]
+    # per-IMAGE counts, shape [N] per level
+    assert nums[0].numpy().tolist() == [1, 0]
+    assert nums[2].numpy().tolist() == [0, 1]
+
+
+def test_generate_proposals():
+    H = W = 4
+    A = 1
+    scores = np.random.default_rng(3).uniform(0, 1, (1, A, H, W)) \
+        .astype(np.float32)
+    deltas = np.zeros((1, 4 * A, H, W), np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 16, i * 8 + 16]
+    var = np.ones_like(anchors)
+    rois, rscores, num = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32, 32]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=16, post_nms_top_n=4, nms_thresh=0.5)
+    assert rois.numpy().shape[1] == 4
+    assert int(num.numpy()[0]) == rois.numpy().shape[0] <= 4
+    # scores sorted descending
+    s = rscores.numpy()[:, 0]
+    assert (np.diff(s) <= 1e-6).all()
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets and no mask, deformable conv == plain conv."""
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32) * 0.2
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w), stride=1, padding=0)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   stride=1, padding=0)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # offsets get gradients
+    offt = paddle.to_tensor(off)
+    offt.stop_gradient = False
+    out2 = vops.deform_conv2d(paddle.to_tensor(x), offt,
+                              paddle.to_tensor(w))
+    out2.sum().backward()
+    assert offt.grad is not None
+
+
+def test_yolo_loss_behavior():
+    """Perfect logits -> small loss; perturbed -> larger. Finite grads."""
+    N, A, cls, H, W = 1, 3, 2, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    gt = np.zeros((N, 2, 4), np.float32)
+    gt[0, 0] = [0.4, 0.4, 0.2, 0.2]   # one box; second is padding
+    gl = np.zeros((N, 2), np.int64)
+    x = np.zeros((N, A * (5 + cls), H, W), np.float32)
+    x[:, :] = -6.0  # low objectness everywhere
+
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    loss = vops.yolo_loss(t, paddle.to_tensor(gt), paddle.to_tensor(gl),
+                          anchors, [0, 1, 2], cls, ignore_thresh=0.7,
+                          downsample_ratio=8)
+    assert loss.shape == [N]
+    v = float(loss.numpy()[0])
+    assert np.isfinite(v) and v > 0
+    loss.sum().backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_sequence_ops_roundtrip_and_pool():
+    flat = paddle.to_tensor(
+        np.arange(10, dtype=np.float32).reshape(5, 2))
+    lens = paddle.to_tensor(np.array([2, 3], np.int64))
+    padded, out_lens = paddle.sequence_pad(flat, 0.0, lens)
+    assert list(padded.shape) == [2, 3, 2]
+    np.testing.assert_allclose(padded.numpy()[0, 2], [0, 0])  # padding
+    back = paddle.sequence_unpad(padded, out_lens)
+    np.testing.assert_allclose(back.numpy(), flat.numpy())
+
+    pooled = paddle.sequence_pool(padded, "average", lens)
+    np.testing.assert_allclose(pooled.numpy()[0], flat.numpy()[:2].mean(0))
+    np.testing.assert_allclose(pooled.numpy()[1], flat.numpy()[2:].mean(0))
+    last = paddle.sequence_last_step(padded, lens)
+    np.testing.assert_allclose(last.numpy()[1], flat.numpy()[4])
+
+    sm = paddle.sequence_softmax(padded[:, :, 0], lens)
+    s = sm.numpy()
+    np.testing.assert_allclose(s.sum(1), [1.0, 1.0], rtol=1e-5)
+    assert s[0, 2] == 0.0  # masked slot
+
+    rev = paddle.sequence_reverse(padded, lens)
+    np.testing.assert_allclose(rev.numpy()[0, 0], flat.numpy()[1])
+    np.testing.assert_allclose(rev.numpy()[0, 2], padded.numpy()[0, 2])
+
+
+def test_sequence_expand_concat_slice_enumerate_erase():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    rep = paddle.to_tensor(np.array([2, 3], np.int64))
+    ex = paddle.sequence_expand(x, rep)
+    np.testing.assert_allclose(ex.numpy()[:, 0], [1, 1, 2, 2, 2])
+
+    a = paddle.to_tensor(np.array([[1.0, 2.0, 0.0]], np.float32))
+    b = paddle.to_tensor(np.array([[5.0, 0.0, 0.0]], np.float32))
+    la = paddle.to_tensor(np.array([2], np.int64))
+    lb = paddle.to_tensor(np.array([1], np.int64))
+    cat, lc = paddle.sequence_concat([a, b], [la, lb])
+    np.testing.assert_allclose(cat.numpy()[0], [1.0, 2.0, 5.0])
+    assert int(lc.numpy()[0]) == 3
+
+    sl, ls = paddle.sequence_slice(
+        cat, paddle.to_tensor(np.array([1], np.int64)),
+        paddle.to_tensor(np.array([2], np.int64)))
+    np.testing.assert_allclose(sl.numpy()[0], [2.0, 5.0])
+
+    en = paddle.sequence_enumerate(
+        paddle.to_tensor(np.array([[1, 2, 3]], np.int64)), 2, pad_value=0)
+    np.testing.assert_allclose(en.numpy()[0], [[1, 2], [2, 3], [3, 0]])
+
+    er, le = paddle.sequence_erase(
+        paddle.to_tensor(np.array([[1, 2, 1, 3]], np.int64)), [1])
+    np.testing.assert_allclose(er.numpy()[0], [2, 3, 0, 0])
+    assert int(le.numpy()[0]) == 2
+
+
+def test_auc_functional():
+    p = paddle.to_tensor(np.array([0.1, 0.4, 0.35, 0.8], np.float32))
+    y = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    val, sp, sn = paddle.metric.auc(p, y)
+    # sklearn roc_auc_score for this case = 0.75
+    assert abs(float(val.numpy()) - 0.75) < 0.01
+
+
+def test_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    import io as _io
+    img = np.random.default_rng(0).integers(0, 255, (8, 8, 3)) \
+        .astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    data = np.frombuffer(buf.getvalue(), np.uint8)
+    out = vops.decode_jpeg(paddle.to_tensor(data))
+    assert list(out.shape)[0] == 3 and out.shape[1] == 8

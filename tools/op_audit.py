@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Op-surface audit: diff the package's exported callables against the
+reference's phi op registry (`phi/api/yaml/ops.yaml` + `legacy_ops.yaml`).
+
+Usage::
+
+    python tools/op_audit.py [--yaml-dir /root/reference/paddle/phi/api/yaml]
+
+Prints per-yaml coverage and the missing-op list. Ops that are internal
+machinery in the reference (optimizer update kernels, grad-only ops,
+infrastructure like feed/fetch) are classified out separately so the gap
+list is actionable. Exit code 0 always — this is an audit, not a gate;
+the current expected-missing set is asserted by tests/test_op_audit.py
+so regressions (an op disappearing) fail CI.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# yaml op name -> public API name when they differ (kernel-level names
+# vs the user surface the reference itself exposes them through)
+RENAMES = {
+    "memcpy_d2h": None, "memcpy_h2d": None, "fused_gemm_epilogue": None,
+    "elementwise_pow": "pow",
+    "multiclass_nms3": "multiclass_nms",
+    "cross_entropy_with_softmax": "softmax_with_cross_entropy",
+    "bce_loss": "binary_cross_entropy",
+    "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
+    "kldiv_loss": "kl_div",
+    "logsigmoid": "log_sigmoid",
+    "tanh_shrink": "tanhshrink",
+    "warpctc": "ctc_loss",
+    "warprnnt": "rnnt_loss",
+    "huber_loss": "huber_loss",
+    # interpolation kernels -> one interpolate/upsample surface
+    "bicubic_interp": "interpolate", "bilinear_interp": "interpolate",
+    "linear_interp": "interpolate", "nearest_interp": "interpolate",
+    "trilinear_interp": "interpolate",
+    # pooling kernels -> functional pools
+    "pool2d": "max_pool2d", "pool3d": "max_pool3d",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "unpool": "max_unpool2d", "unpool3d": "max_unpool3d",
+    # conv variants -> conv2d(groups=...)
+    "depthwise_conv2d": "conv2d",
+    "depthwise_conv2d_transpose": "conv2d_transpose",
+    # fft kernels -> fft module surface
+    "fft_c2c": "fft", "fft_r2c": "rfft", "fft_c2r": "irfft",
+    # norms / reductions
+    "frobenius_norm": "norm", "p_norm": "norm", "mean_all": "mean",
+    "squared_l2_norm": None,  # grad-clip internal
+    "matrix_rank_tol": "matrix_rank",
+    "split_with_num": "split",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "segment_pool": "segment_sum",
+    # random kernels -> creation/init surface
+    "gaussian": "randn", "truncated_gaussian_random": "TruncatedNormal",
+    "uniform_inplace": "uniform_", "exponential_": "exponential_",
+    "dirichlet": "Dirichlet",
+    "full_batch_size_like": "full_like",
+    "fill": "fill_",
+    # layers as the surface
+    "rnn": "RNN", "sync_batch_norm_": "SyncBatchNorm",
+    "spectral_norm": "spectral_norm",
+    "copy_to": "to",
+    "merge_selected_rows": None, "npu_identity": None,
+    "average_accumulates_": None,  # ModelAverage internal
+    "decode_jpeg": "decode_jpeg",
+    "deformable_conv": "deform_conv2d",
+    "fill_diagonal": "fill_diagonal_",
+    "pad3d": "pad",
+}
+
+# reference-internal ops that are not user API surface: optimizer update
+# kernels (the optimizer classes ARE the surface here), grad-only and
+# infrastructure ops, and ops subsumed by jax/XLA by design
+INTERNAL = {
+    # optimizer update kernels (surface = paddle_tpu.optimizer classes)
+    "adadelta_", "adagrad_", "adam_", "adamax_", "adamw_", "lamb_",
+    "momentum_", "sgd_", "rmsprop_", "ftrl", "dpsgd", "sparse_momentum",
+    "merged_adam_", "merged_momentum_", "fused_adam_",
+    # infrastructure / framework-internal
+    "feed", "fetch", "assign_out_", "assign_pos", "assign_value_",
+    "share_buffer", "share_data", "print", "load_combine", "save_combine",
+    "memcpy", "memcpy_d2h", "memcpy_h2d", "get_tensor_from_selected_rows",
+    "read_file", "recv_v2", "send_v2", "batch_fc", "c_broadcast",
+    "c_concat", "c_identity", "c_sync_calc_stream", "c_sync_comm_stream",
+    "c_allgather", "c_allreduce_max", "c_allreduce_min", "c_allreduce_prod",
+    "c_allreduce_sum", "c_embedding", "c_softmax_with_cross_entropy",
+    "c_split", "mp_allreduce_sum_", "all_reduce", "all_gather", "all_to_all",
+    "broadcast", "reduce", "reduce_scatter", "p_recv", "p_send",
+    "barrier", "global_gather", "global_scatter", "distributed_lookup_table",
+    "distributed_push_sparse", "partial_allgather_", "partial_recv",
+    "partial_send", "random_routing", "limit_by_capacity",
+    "prune_gate_by_capacity", "number_count",
+    # amp-internal
+    "check_finite_and_unscale_", "update_loss_scaling_", "cast_label",
+    # XLA-owned / runtime-owned
+    "coalesce_tensor", "coalesce_tensor_", "run_program", "cudnn_lstm",
+    "fusion_group", "share_var", "onednn_to_paddle_layout",
+    "dequantize_linear", "quantize_linear",  # int8 deploy path (known gap)
+    "straight_through_estimator", "fake_channel_wise_quantize_abs_max",
+    # beam-search internals (greedy decode documented gap)
+    "beam_search", "beam_search_decode",
+    # data-structure ops for lod/selected-rows (no lod tensors by design)
+    "lod_array_length", "array_length", "array_read", "array_write",
+    "array_to_tensor", "create_array", "create_array_like",
+    "tensor_array_to_tensor", "reset_lod",
+    "sparse_coo_tensor", "sparse_csr_tensor",  # -> paddle_tpu.sparse
+}
+
+
+def yaml_ops(path):
+    ops = []
+    for line in open(path):
+        m = re.match(r"- op\s*:\s*(\w+)", line)
+        if m:
+            ops.append(m.group(1))
+    return ops
+
+
+def collect_exports():
+    """Every public callable reachable from the paddle_tpu surface."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    linalg = paddle.linalg
+    import paddle_tpu.fft as fft
+    import paddle_tpu.signal as sig
+    import paddle_tpu.sparse as sparse
+    import paddle_tpu.geometric as geo
+    import paddle_tpu.incubate as incubate
+    import paddle_tpu.vision.ops as vops
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.text as text
+    import paddle_tpu.static.nn as snn
+    import paddle_tpu.metric as metric
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.initializer as init
+    import paddle_tpu.nn.utils as nn_utils
+    import paddle_tpu.distribution as distribution
+
+    names = set()
+    for mod in (paddle, F, linalg, fft, sig, sparse, geo, incubate, vops,
+                dist, text, snn, metric, nn, init, nn_utils, distribution):
+        for n in dir(mod):
+            if not n.startswith("_"):
+                names.add(n)
+    # Tensor methods count (paddle.Tensor.xxx is API surface)
+    for n in dir(paddle.Tensor):
+        if not n.startswith("_"):
+            names.add(n)
+    from paddle_tpu.distributed.collective import prims
+    for n in dir(prims):
+        if not n.startswith("_"):
+            names.add(n)
+    return names
+
+
+def audit(yaml_dir):
+    exports = collect_exports()
+
+    def present(op):
+        if op in INTERNAL:
+            return "internal"
+        target = RENAMES.get(op, op)
+        if target is None:
+            return "internal"
+        cands = {target, target.rstrip("_"), target + "_op"}
+        base = target.rstrip("_")
+        cands |= {base}
+        # common yaml->api renames
+        for pre in ("elementwise_", "reduce_"):
+            if base.startswith(pre):
+                cands.add(base[len(pre):])
+        if any(c in exports for c in cands):
+            return "yes"
+        return "MISSING"
+
+    results = {}
+    for fname in ("ops.yaml", "legacy_ops.yaml"):
+        ops = yaml_ops(os.path.join(yaml_dir, fname))
+        rows = [(op, present(op)) for op in ops]
+        results[fname] = rows
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--yaml-dir",
+                    default="/root/reference/paddle/phi/api/yaml")
+    args = ap.parse_args()
+    results = audit(args.yaml_dir)
+    all_missing = []
+    for fname, rows in results.items():
+        missing = [op for op, st in rows if st == "MISSING"]
+        internal = [op for op, st in rows if st == "internal"]
+        n = len(rows)
+        print(f"{fname}: {n} ops, {n - len(missing) - len(internal)} "
+              f"covered, {len(internal)} internal-by-design, "
+              f"{len(missing)} missing")
+        all_missing += missing
+    if all_missing:
+        print("missing:", ", ".join(sorted(set(all_missing))))
+    return sorted(set(all_missing))
+
+
+if __name__ == "__main__":
+    main()
