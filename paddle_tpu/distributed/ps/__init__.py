@@ -11,7 +11,10 @@ proposition on TPU hosts. The networked transport (``service.py``:
 ``run_server`` + sharded ``PsRpcClient``) rides the socket RPC agent +
 native TCPStore — the brpc_ps_server/client analog.
 """
-from .table import MemorySparseTable, MemoryDenseTable, SGDAccessor, AdagradAccessor  # noqa: F401
+from .table import (  # noqa: F401
+    MemorySparseTable, MemoryDenseTable, SGDAccessor, AdagradAccessor,
+    CtrAccessor, CtrSparseTable)
+from .communicator import Communicator, GeoCommunicator  # noqa: F401
 from .local_client import PsLocalClient  # noqa: F401
 from .the_one_ps import TheOnePs  # noqa: F401
 from .embedding import DistributedEmbedding  # noqa: F401

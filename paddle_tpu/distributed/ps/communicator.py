@@ -1,0 +1,211 @@
+"""Async / Geo push-pull communicator.
+
+Parity: ``/root/reference/paddle/fluid/distributed/ps/service/communicator/
+communicator.h`` (AsyncCommunicator :355, GeoCommunicator :538) — the
+background thread that decouples trainer steps from parameter-server
+round trips: trainers enqueue gradients, a send thread merges by key and
+flushes batches to the PS; async-SGD pulls fresh params on demand.
+
+TPU-native note: this is HOST-side machinery (the PS path trains sparse
+embeddings too big for HBM); the send thread batches over the repo's rpc
+PsRpcClient or the in-process PsLocalClient identically.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["Communicator", "GeoCommunicator"]
+
+
+class Communicator:
+    """Async communicator (communicator.h:355 AsyncCommunicator).
+
+    ``push_sparse_async(table_id, ids, grads)`` enqueues; the send thread
+    merges by feature id and flushes when ``send_queue_size`` batches
+    accumulated or ``send_wait_times`` elapsed. ``flush()`` forces a
+    synchronous drain (BarrierWithTable parity); ``stop()`` drains and
+    joins.
+    """
+
+    def __init__(self, client, send_queue_size=20, send_wait_times=0.05):
+        self.client = client
+        self.send_queue_size = send_queue_size
+        self.send_wait_times = send_wait_times
+        self._q: queue.Queue = queue.Queue()
+        self._thread = None
+        self._running = False
+
+    # -- trainer-side API ---------------------------------------------------
+    def push_sparse_async(self, table_id, ids, grads,
+                          shows=None, clicks=None):
+        self._q.put(("sparse", table_id, np.asarray(ids),
+                     np.asarray(grads), shows, clicks))
+
+    def push_dense_async(self, table_id, grad):
+        self._q.put(("dense", table_id, np.asarray(grad), None, None, None))
+
+    def pull_sparse(self, table_id, ids):
+        return self.client.pull_sparse(table_id, ids)
+
+    def pull_dense(self, table_id):
+        return self.client.pull_dense(table_id)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True,
+                                        name="ps-communicator")
+        self._thread.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self._running = False
+        self._thread.join(timeout=30)
+        self._flush_batch(self._drain_queue())
+
+    def flush(self, timeout=30):
+        """Block until everything enqueued so far reached the PS. Queue
+        task accounting (task_done per flushed item) makes this race-free:
+        an item is pending from put() until its PS push returned."""
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if time.monotonic() > deadline:
+                raise TimeoutError("communicator flush timed out")
+            time.sleep(0.005)
+
+    # -- send thread --------------------------------------------------------
+    def _drain_queue(self, max_items=None):
+        items = []
+        while max_items is None or len(items) < max_items:
+            try:
+                items.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return items
+
+    def _merge_sparse(self, entries):
+        """Merge gradients by feature id before the send — the reference's
+        MergeGradient: one PS update per key per flush."""
+        acc: dict[int, np.ndarray] = {}
+        sh: dict[int, float] = {}
+        ck: dict[int, float] = {}
+        has_stats = False
+        for _, _, ids, grads, shows, clicks in entries:
+            ids = ids.reshape(-1)
+            grads = grads.reshape(len(ids), -1)
+            shows_a = np.asarray(shows).reshape(-1) if shows is not None \
+                else None
+            clicks_a = np.asarray(clicks).reshape(-1) if clicks is not None \
+                else None
+            has_stats = has_stats or shows_a is not None \
+                or clicks_a is not None
+            for j, (i, g) in enumerate(zip(ids, grads)):
+                fid = int(i)
+                acc[fid] = acc.get(fid, 0) + g
+                if shows_a is not None:
+                    sh[fid] = sh.get(fid, 0.0) + float(shows_a[j])
+                if clicks_a is not None:
+                    ck[fid] = ck.get(fid, 0.0) + float(clicks_a[j])
+        ids = np.asarray(list(acc), np.int64)
+        grads = np.stack(list(acc.values())) if acc else \
+            np.zeros((0, 0), np.float32)
+        if not has_stats:
+            return ids, grads, None, None
+        return (ids, grads,
+                np.asarray([sh.get(int(i), 0.0) for i in ids], np.float32),
+                np.asarray([ck.get(int(i), 0.0) for i in ids], np.float32))
+
+    def _flush_batch(self, items):
+        by_table: dict[tuple, list] = {}
+        for it in items:
+            by_table.setdefault((it[0], it[1]), []).append(it)
+        for (kind, table_id), entries in by_table.items():
+            if kind == "dense":
+                total = entries[0][2]
+                for e in entries[1:]:
+                    total = total + e[2]
+                self.client.push_dense_grad(table_id, total)
+            else:
+                ids, grads, shows, clicks = self._merge_sparse(entries)
+                if len(ids) == 0:
+                    continue
+                try:
+                    self.client.push_sparse_grad(table_id, ids, grads,
+                                                 shows=shows, clicks=clicks)
+                except TypeError:  # client without CTR stats channel
+                    self.client.push_sparse_grad(table_id, ids, grads)
+        for _ in items:
+            self._q.task_done()
+
+    def _send_loop(self):
+        while self._running:
+            items = self._drain_queue(max_items=self.send_queue_size)
+            if items:
+                self._flush_batch(items)
+            if self._q.empty():
+                time.sleep(self.send_wait_times)
+
+
+class GeoCommunicator(Communicator):
+    """Geo-SGD communicator (communicator.h:538 GeoCommunicator): trainers
+    train a LOCAL copy; the send thread periodically ships the DELTA
+    (local - last_synced) per touched key and pulls the server's merged
+    value back — communication-efficient sparse geo replication."""
+
+    def __init__(self, client, local_table, table_id, trainers=1,
+                 sync_interval=0.1):
+        super().__init__(client, send_wait_times=sync_interval)
+        self.local = local_table
+        self.table_id = table_id
+        self.trainers = max(1, trainers)
+        self._synced: dict[int, np.ndarray] = {}
+        self._touched: set[int] = set()
+        self._lock = threading.Lock()
+
+    def record_touch(self, ids):
+        with self._lock:
+            for i in np.asarray(ids).reshape(-1):
+                fid = int(i)
+                self._touched.add(fid)
+                if fid not in self._synced:
+                    row = self.local._ensure(fid)
+                    self._synced[fid] = row.copy() if row is not None \
+                        else np.zeros(self.local.emb_dim, np.float32)
+
+    def _send_loop(self):
+        while self._running:
+            items = self._drain_queue(max_items=self.send_queue_size)
+            if items:  # inherited async pushes still flow
+                self._flush_batch(items)
+            self.sync_once()
+            time.sleep(self.send_wait_times)
+
+    def sync_once(self):
+        with self._lock:
+            touched = list(self._touched)
+            self._touched.clear()
+        if not touched:
+            return 0
+        ids = np.asarray(touched, np.int64)
+        local_rows = self.local.pull(ids)
+        deltas = np.stack([local_rows[j] - self._synced[int(i)]
+                           for j, i in enumerate(ids)])
+        # geo semantics (GeoCommunicator::Send): each trainer ships its
+        # drift divided by the trainer count so the merged server value
+        # is the average drift; the server table must be SGD at lr=1
+        # (applies -lr*grad, hence the negated delta)
+        self.client.push_sparse_grad(self.table_id, ids,
+                                     -deltas / self.trainers)
+        fresh = self.client.pull_sparse(self.table_id, ids)
+        for j, i in enumerate(ids):
+            fid = int(i)
+            self.local._rows[fid] = fresh[j].copy()
+            self._synced[fid] = fresh[j].copy()
+        return len(ids)

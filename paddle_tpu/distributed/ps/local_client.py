@@ -29,8 +29,14 @@ class PsLocalClient:
     def pull_sparse(self, table_id, ids):
         return self._tables[table_id].pull(ids)
 
-    def push_sparse_grad(self, table_id, ids, grads):
-        self._tables[table_id].push(ids, grads)
+    def push_sparse_grad(self, table_id, ids, grads, shows=None,
+                         clicks=None):
+        t = self._tables[table_id]
+        if shows is not None or clicks is not None:
+            # CTR tables take the show/click counters alongside the grads
+            t.push(ids, grads, shows=shows, clicks=clicks)
+        else:
+            t.push(ids, grads)
 
     # -- dense -------------------------------------------------------------
     def pull_dense(self, table_id):

@@ -153,3 +153,221 @@ class MemoryDenseTable:
                 # no slot state in the file: reset rather than keep stale
                 # accumulator state from before the load (sparse parity)
                 self._slots = self.accessor.init_slots(self.param.shape)
+
+
+class CtrAccessor:
+    """CTR feature accessor (ctr_accessor.cc CtrCommonAccessor parity).
+
+    Per-feature state beyond the embedding row: show/click statistics
+    with daily exponential decay, an unseen-days counter, and adagrad
+    slots. The show/click score
+    ``show_coeff * show + click_coeff * click`` drives the sparse-table
+    lifecycle: admission of the extended embedding (``embedx``) once a
+    feature proves itself, and eviction of stale/low-value features on
+    :meth:`CtrSparseTable.shrink`.
+    """
+
+    slots = 1  # adagrad g2sum
+
+    def __init__(self, learning_rate=0.05, initial_g2sum=0.0, epsilon=1e-10,
+                 nonclk_coeff=0.1, click_coeff=1.0, show_click_decay_rate=0.98,
+                 embedx_threshold=10.0, delete_threshold=0.8,
+                 delete_after_unseen_days=30):
+        self.lr = learning_rate
+        self.g0 = initial_g2sum
+        self.eps = epsilon
+        self.nonclk_coeff = nonclk_coeff
+        self.click_coeff = click_coeff
+        self.decay = show_click_decay_rate
+        self.embedx_threshold = embedx_threshold
+        self.delete_threshold = delete_threshold
+        self.delete_after_unseen_days = delete_after_unseen_days
+
+    def init_slots(self, dim):
+        return (np.full(dim, self.g0, np.float32),)
+
+    def update(self, row, grad, slots):
+        (g2,) = slots
+        g2 += grad * grad
+        row -= self.lr * grad / (np.sqrt(g2) + self.eps)
+        return (g2,)
+
+    def show_click_score(self, show, click):
+        """ctr_accessor.cc ShowClickScore: nonclick weighted low."""
+        return self.nonclk_coeff * (show - click) + self.click_coeff * click
+
+    def decay_stats(self, stats):
+        """Daily shrink pass: decay show/click, age unseen_days."""
+        stats["show"] *= self.decay
+        stats["click"] *= self.decay
+        stats["unseen_days"] += 1
+        return stats
+
+    def should_delete(self, stats):
+        if stats["unseen_days"] >= self.delete_after_unseen_days:
+            return True
+        return self.show_click_score(stats["show"], stats["click"]) \
+            < self.delete_threshold
+
+    def should_extend(self, stats):
+        return self.show_click_score(stats["show"], stats["click"]) \
+            >= self.embedx_threshold
+
+
+class CtrSparseTable(MemorySparseTable):
+    """Sparse table with the CTR lifecycle (ctr_accessor.cc over
+    memory_sparse_table.cc): per-feature show/click stats, entry-policy
+    admission of NEW features (ProbabilityEntry / CountFilterEntry from
+    ``distributed.entry_attr``), score-gated extended embeddings, and a
+    :meth:`shrink` eviction pass.
+    """
+
+    def __init__(self, emb_dim, embedx_dim=None, accessor=None,
+                 initializer=None, seed=0, entry=None):
+        super().__init__(emb_dim, accessor or CtrAccessor(),
+                         initializer, seed)
+        self.embedx_dim = embedx_dim if embedx_dim is not None else emb_dim
+        self.entry = entry  # admission policy; None admits everything
+        self._stats: dict[int, dict] = {}
+        self._embedx: dict[int, np.ndarray] = {}
+        self._embedx_slots: dict[int, tuple] = {}
+
+    def _admit(self, fid):
+        if self.entry is None:
+            return True
+        from ...distributed.parity import CountFilterEntry
+        if isinstance(self.entry, CountFilterEntry):
+            return bool(self.entry.should_admit(fid))
+        return bool(self.entry.should_admit())  # ProbabilityEntry et al.
+
+    def _ensure(self, fid):
+        if fid not in self._rows:
+            if not self._admit(fid):
+                return None
+            self._rows[fid] = self._init()
+            self._slots[fid] = self.accessor.init_slots(self.emb_dim)
+            self._stats[fid] = {"show": 0.0, "click": 0.0,
+                                "unseen_days": 0}
+        return self._rows[fid]
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        out = np.zeros((len(ids), self.emb_dim), np.float32)
+        for j, i in enumerate(ids):
+            row = self._ensure(int(i))
+            if row is not None:
+                out[j] = row
+        return out
+
+    def push(self, ids, grads, shows=None, clicks=None, embedx_grads=None):
+        """Gradient update + show/click accumulation. shows/clicks default
+        to one impression, no click, per occurrence (the data-pipeline
+        normally feeds the real counters). ``embedx_grads`` [n, embedx_dim]
+        update the extended embeddings of already-admitted features."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), self.emb_dim)
+        shows = np.ones(len(ids), np.float32) if shows is None \
+            else np.asarray(shows).reshape(-1)
+        clicks = np.zeros(len(ids), np.float32) if clicks is None \
+            else np.asarray(clicks).reshape(-1)
+        xg = np.asarray(embedx_grads).reshape(len(ids), self.embedx_dim) \
+            if embedx_grads is not None else None
+        acc: dict[int, list] = {}
+        for j, (i, g, s, c) in enumerate(zip(ids, grads, shows, clicks)):
+            fid = int(i)
+            if fid in acc:
+                acc[fid][0] = acc[fid][0] + g
+                acc[fid][1] += s
+                acc[fid][2] += c
+                if xg is not None:
+                    acc[fid][3] = acc[fid][3] + xg[j]
+            else:
+                acc[fid] = [g.copy(), float(s), float(c),
+                            xg[j].copy() if xg is not None else None]
+        for fid, (g, s, c, gx) in acc.items():
+            if self._ensure(fid) is None:
+                continue  # not admitted
+            st = self._stats[fid]
+            st["show"] += s
+            st["click"] += c
+            st["unseen_days"] = 0
+            self._slots[fid] = self.accessor.update(
+                self._rows[fid], g, self._slots[fid])
+            # extended embedding materializes once the feature's score
+            # crosses embedx_threshold (ctr_accessor embedx admission)
+            if fid not in self._embedx and \
+                    self.accessor.should_extend(st):
+                self._embedx[fid] = np.zeros(self.embedx_dim, np.float32)
+                self._embedx_slots[fid] = self.accessor.init_slots(
+                    self.embedx_dim)
+            if gx is not None and fid in self._embedx:
+                self._embedx_slots[fid] = self.accessor.update(
+                    self._embedx[fid], gx, self._embedx_slots[fid])
+
+    def pull_embedx(self, ids) -> np.ndarray:
+        """Extended embeddings; features below the score threshold read
+        zeros (the reference serves zero embedx until admission)."""
+        ids = np.asarray(ids).reshape(-1)
+        out = np.zeros((len(ids), self.embedx_dim), np.float32)
+        for j, i in enumerate(ids):
+            v = self._embedx.get(int(i))
+            if v is not None:
+                out[j] = v
+        return out
+
+    def shrink(self):
+        """Daily maintenance (memory_sparse_table.cc Shrink): decay every
+        feature's stats, evict the stale/low-score ones. Returns the
+        number of evicted features."""
+        dead = []
+        for fid, st in self._stats.items():
+            self.accessor.decay_stats(st)
+            if self.accessor.should_delete(st):
+                dead.append(fid)
+        for fid in dead:
+            self._rows.pop(fid, None)
+            self._slots.pop(fid, None)
+            self._stats.pop(fid, None)
+            self._embedx.pop(fid, None)
+            self._embedx_slots.pop(fid, None)
+        return len(dead)
+
+    # -- persistence: CTR state (stats + embedx) rides along --------------
+    def save(self, path):
+        super().save(path)
+        ids = np.array(list(self._rows), np.int64)
+        stats = np.stack([[self._stats[int(i)]["show"],
+                           self._stats[int(i)]["click"],
+                           self._stats[int(i)]["unseen_days"]]
+                          for i in ids]) if len(ids) else \
+            np.zeros((0, 3), np.float64)
+        x_ids = np.array(list(self._embedx), np.int64)
+        x_rows = np.stack([self._embedx[int(i)] for i in x_ids]) \
+            if len(x_ids) else np.zeros((0, self.embedx_dim), np.float32)
+        x_slots = np.stack([self._embedx_slots[int(i)][0]
+                            for i in x_ids]) if len(x_ids) else \
+            np.zeros((0, self.embedx_dim), np.float32)
+        base = path[:-4] if path.endswith(".npz") else path
+        np.savez(base + ".ctr", ids=ids, stats=stats, x_ids=x_ids,
+                 x_rows=x_rows, x_slots=x_slots)
+
+    def load(self, path):
+        super().load(path)
+        base = path[:-4] if path.endswith(".npz") else path
+        import os
+        ctr_path = base + ".ctr.npz"
+        if os.path.exists(ctr_path):
+            data = np.load(ctr_path)
+            for fid, st in zip(data["ids"], data["stats"]):
+                self._stats[int(fid)] = {"show": float(st[0]),
+                                         "click": float(st[1]),
+                                         "unseen_days": int(st[2])}
+            for j, fid in enumerate(data["x_ids"]):
+                self._embedx[int(fid)] = data["x_rows"][j] \
+                    .astype(np.float32)
+                self._embedx_slots[int(fid)] = (
+                    data["x_slots"][j].astype(np.float32),)
+        # features restored without CTR state start fresh (never crash)
+        for fid in self._rows:
+            self._stats.setdefault(fid, {"show": 0.0, "click": 0.0,
+                                         "unseen_days": 0})

@@ -420,7 +420,9 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
     return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(vars_))
 
 
-def _nms_keep(boxes, scores, thresh):
+def _nms_keep(boxes, scores, thresh, norm_off=0.0):
+    """norm_off: 0 for normalized coords, 1 for pixel boxes (the +1
+    width/height convention — same as box_coder's norm)."""
     order = np.argsort(-scores)
     keep = []
     suppressed = np.zeros(len(boxes), bool)
@@ -434,8 +436,10 @@ def _nms_keep(boxes, scores, thresh):
         y1 = np.maximum(boxes[i, 1], boxes[:, 1])
         x2 = np.minimum(boxes[i, 2], boxes[:, 2])
         y2 = np.minimum(boxes[i, 3], boxes[:, 3])
-        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
-        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        inter = np.clip(x2 - x1 + norm_off, 0, None) \
+            * np.clip(y2 - y1 + norm_off, 0, None)
+        a = (boxes[:, 2] - boxes[:, 0] + norm_off) \
+            * (boxes[:, 3] - boxes[:, 1] + norm_off)
         iou = inter / np.maximum(a[i] + a - inter, 1e-10)
         suppressed |= iou > thresh
         suppressed[i] = True  # already kept; stop revisiting
@@ -464,7 +468,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
                 continue
             if nms_top_k > 0 and sel.size > nms_top_k:
                 sel = sel[np.argsort(-s[sel])[:nms_top_k]]
-            keep = _nms_keep(bb[n, sel], s[sel], nms_threshold)
+            keep = _nms_keep(bb[n, sel], s[sel], nms_threshold,
+                             0.0 if normalized else 1.0)
             for k in keep:
                 dets.append((c, s[sel[k]], *bb[n, sel[k]], n * bb.shape[1]
                              + sel[k]))
@@ -507,12 +512,15 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
             sel = sel[order]
             boxes, ss = bb[n, sel], s[sel]
             m = len(sel)
+            noff = 0.0 if normalized else 1.0
             x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
             y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
             x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
             y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
-            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
-            a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            inter = np.clip(x2 - x1 + noff, 0, None) \
+                * np.clip(y2 - y1 + noff, 0, None)
+            a = (boxes[:, 2] - boxes[:, 0] + noff) \
+                * (boxes[:, 3] - boxes[:, 1] + noff)
             iou = inter / np.maximum(a[:, None] + a[None, :] - inter, 1e-10)
             iou = np.triu(iou, 1)  # iou[i, j] for i < j (i higher-scored)
             # compensation per box i: its own max IoU with a better box

@@ -167,3 +167,139 @@ def test_fleet_metrics():
     neg = np.zeros(10)
     neg[0] = 100  # all negatives in the bottom bucket
     assert abs(fleet_metrics.auc(pos, neg) - 1.0) < 1e-9
+
+
+# ---------------------------------------------------------------- CTR + async
+def test_ctr_accessor_lifecycle():
+    """ctr_accessor.cc parity: show/click score, decay, embedx admission,
+    shrink eviction, entry-policy admission."""
+    from paddle_tpu.distributed.ps import CtrAccessor, CtrSparseTable
+    from paddle_tpu.distributed import CountFilterEntry
+
+    acc = CtrAccessor(learning_rate=0.1, embedx_threshold=3.0,
+                      delete_threshold=0.5, delete_after_unseen_days=2,
+                      show_click_decay_rate=0.5)
+    # count-filter admission: a feature must be seen twice to be created
+    table = CtrSparseTable(4, accessor=acc, entry=CountFilterEntry(2))
+
+    ids = np.array([7, 7], np.int64)
+    g = np.ones((2, 4), np.float32) * 0.1
+    table.push(ids[:1], g[:1])          # 1st sight: rejected
+    assert table.size == 0
+    table.push(ids[:1], g[:1])          # 2nd sight: admitted
+    assert table.size == 1
+    row0 = table.pull(np.array([7]))[0].copy()
+
+    # clicks drive the score over the embedx threshold
+    assert table.pull_embedx(np.array([7])).max() == 0.0
+    table.push(np.array([7]), g[:1], shows=[5.0], clicks=[3.0])
+    assert 7 in table._embedx            # score = 0.1*(6-3) + 3 > 3
+    assert not np.allclose(table.pull(np.array([7]))[0], row0)
+
+    # shrink: decay halves show/click; two silent days evict
+    n0 = table.shrink()
+    assert n0 == 0 and table.size == 1
+    table._stats[7]["show"] = 0.0        # stale feature
+    table._stats[7]["click"] = 0.0
+    assert table.shrink() == 1 and table.size == 0
+
+
+def test_async_communicator_merges_and_flushes():
+    """communicator.h AsyncCommunicator: background merge-by-key push."""
+    from paddle_tpu.distributed.ps import (Communicator, PsLocalClient,
+                                           SGDAccessor)
+    client = PsLocalClient()
+    client.create_sparse_table(0, 4, accessor=SGDAccessor(1.0),
+                               initializer=lambda: np.zeros(4, np.float32))
+    comm = Communicator(client, send_wait_times=0.01)
+    comm.start()
+    try:
+        for _ in range(3):  # same id 3x -> one merged update per flush
+            comm.push_sparse_async(0, np.array([5]),
+                                   np.ones((1, 4), np.float32))
+        comm.flush()
+        row = client.pull_sparse(0, np.array([5]))[0]
+        np.testing.assert_allclose(row, -3.0)  # lr=1: row -= sum(grads)
+    finally:
+        comm.stop()
+
+
+def test_geo_communicator_syncs_deltas():
+    """communicator.h GeoCommunicator: local drift ships as delta; the
+    local copy re-syncs to the server's merged value."""
+    from paddle_tpu.distributed.ps import (GeoCommunicator, PsLocalClient,
+                                           MemorySparseTable, SGDAccessor)
+    client = PsLocalClient()
+    # geo server table applies raw deltas: SGD at lr=1
+    client.create_sparse_table(1, 2, accessor=SGDAccessor(1.0),
+                               initializer=lambda: np.zeros(2, np.float32))
+    local = MemorySparseTable(2, accessor=SGDAccessor(0.5),
+                              initializer=lambda: np.zeros(2, np.float32))
+    geo = GeoCommunicator(client, local, table_id=1)
+
+    ids = np.array([3], np.int64)
+    geo.record_touch(ids)
+    local.push(ids, np.ones((1, 2), np.float32))   # local -= 0.5
+    n = geo.sync_once()
+    assert n == 1
+    srv = client.pull_sparse(1, ids)[0]
+    np.testing.assert_allclose(srv, -0.5)          # delta arrived
+    np.testing.assert_allclose(local.pull(ids)[0], srv)  # re-synced
+    # second trainer drift composes on the server value
+    local.push(ids, np.ones((1, 2), np.float32))
+    geo.record_touch(ids)
+    geo.sync_once()
+    np.testing.assert_allclose(client.pull_sparse(1, ids)[0], -1.0)
+
+
+def test_ctr_table_save_load_roundtrip(tmp_path):
+    """CTR state (stats, embedx, slots) survives save/load; restored
+    features never crash push and stay evictable."""
+    from paddle_tpu.distributed.ps import CtrAccessor, CtrSparseTable
+    acc = CtrAccessor(learning_rate=0.1, embedx_threshold=2.0)
+    t = CtrSparseTable(4, accessor=acc)
+    t.push(np.array([1, 2]), np.ones((2, 4), np.float32) * 0.1,
+           shows=[5, 1], clicks=[3, 0])
+    assert 1 in t._embedx
+    t.push(np.array([1]), np.ones((1, 4), np.float32) * 0.1,
+           embedx_grads=np.ones((1, 4), np.float32))
+    assert np.abs(t._embedx[1]).max() > 0  # embedx actually trains
+    path = str(tmp_path / "ctr_table")
+    t.save(path)
+
+    t2 = CtrSparseTable(4, accessor=acc)
+    t2.load(path)
+    assert t2._stats[1]["click"] == t._stats[1]["click"]
+    np.testing.assert_allclose(t2.pull_embedx(np.array([1])),
+                               t.pull_embedx(np.array([1])))
+    t2.push(np.array([1]), np.ones((1, 4), np.float32))  # no KeyError
+    for _ in range(60):
+        t2.shrink()
+    assert t2.size == 0  # restored features are evictable
+
+
+def test_probability_entry_admission():
+    from paddle_tpu.distributed.ps import CtrSparseTable
+    from paddle_tpu.distributed import ProbabilityEntry
+    t = CtrSparseTable(4, entry=ProbabilityEntry(1.0))
+    t.push(np.array([9]), np.ones((1, 4), np.float32))
+    assert t.size == 1  # p=1 admits; no AttributeError on nonzero fid
+
+
+def test_multiclass_nms_pixel_convention():
+    """normalized=False uses the +1 pixel convention in IoU."""
+    import paddle_tpu.vision.ops as vops
+    import paddle_tpu as paddle
+    # two 1-pixel boxes: normalized math gives zero areas (iou=0, both
+    # kept); pixel math gives iou=1 for identical boxes (one suppressed)
+    bb = np.array([[[0, 0, 0, 0], [0, 0, 0, 0]]], np.float32)
+    sc = np.zeros((1, 2, 2), np.float32)
+    sc[0, 1] = [0.9, 0.8]
+    _, n_norm = vops.multiclass_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(sc), score_threshold=0.1,
+        nms_threshold=0.5, background_label=0, normalized=True)
+    _, n_pix = vops.multiclass_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(sc), score_threshold=0.1,
+        nms_threshold=0.5, background_label=0, normalized=False)
+    assert int(n_norm.numpy()[0]) == 2
+    assert int(n_pix.numpy()[0]) == 1
