@@ -409,7 +409,8 @@ class GPTHybridTrainStep:
                  beta1=0.9, beta2=0.95, eps=1e-8, weight_decay=0.01,
                  grad_clip_norm=1.0, remat=True, compute_dtype=None,
                  use_flash=None, virtual_pp_degree=1,
-                 pipeline_schedule="gpipe"):
+                 pipeline_schedule="gpipe", param_dtype=None,
+                 moment_dtype=None):
         gpt = model.gpt if isinstance(model, GPTForPretraining) else model
         self.model = model
         self.gpt = gpt
@@ -438,9 +439,17 @@ class GPTHybridTrainStep:
         self.hyper = (lr, beta1, beta2, eps, weight_decay, grad_clip_norm)
         self.remat = remat
         # AMP-O2 style: master params stay f32, forward runs in compute_dtype
-        # (bf16 on TPU keeps the matmuls on the MXU at full rate)
+        # (bf16 on TPU keeps the matmuls on the MXU at full rate).
+        # param_dtype/moment_dtype shrink the MASTER/optimizer storage
+        # (bf16 masters+moments fit GPT-1.3B + Adam on one 16GB chip: the
+        # update math still runs in f32, only storage rounds — the
+        # reference's pure-fp16 "O3" slot)
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
+        self.param_dtype = (jnp.dtype(param_dtype)
+                            if param_dtype is not None else None)
+        self.moment_dtype = (jnp.dtype(moment_dtype)
+                             if moment_dtype is not None else jnp.float32)
         # Pallas flash attention: None = auto (decided per sequence length at
         # trace time), True/False = forced
         self.use_flash = use_flash
@@ -480,16 +489,22 @@ class GPTHybridTrainStep:
             "lnf_b": P(),
         }
         ns = lambda s: NamedSharding(self.mesh, s)
-        # jnp.copy: the compiled step donates its inputs; never alias the eager
-        # model's (or another step's) buffers
+        # ALWAYS a real copy: the compiled step donates its inputs; never
+        # alias the eager model's (or another step's) buffers. A dtype
+        # CHANGE is a copy by itself; same-dtype needs the explicit copy
+        # (jnp.asarray would alias).
+        def pcast(v):
+            if self.param_dtype is None or v.dtype == self.param_dtype:
+                return jnp.copy(v)
+            return jnp.asarray(v, self.param_dtype)
         self.params = jax.tree.map(
-            lambda v, s: jax.device_put(jnp.copy(v), ns(s)), self.params,
+            lambda v, s: jax.device_put(pcast(v), ns(s)), self.params,
             self.param_specs, is_leaf=lambda x: isinstance(x, jax.Array))
         # AdamW moments: param layout + ZeRO-1 sharding of a free dim
         self.state_specs = jax.tree.map(self._moment_spec, self.param_specs,
                                         jax.tree.map(jnp.shape, self.params))
         zeros = lambda v, s: jax.device_put(
-            jnp.zeros(v.shape, jnp.float32), ns(s))
+            jnp.zeros(v.shape, self.moment_dtype), ns(s))
         self.opt_state = {
             "m": jax.tree.map(zeros, self.params, self.state_specs),
             "v": jax.tree.map(zeros, self.params, self.state_specs),
@@ -889,14 +904,15 @@ class GPTHybridTrainStep:
 
             def upd(p, g, m, v, decays):
                 g = g.astype(jnp.float32) * scale
-                m2 = b1 * m + (1 - b1) * g
-                v2 = b2 * v + (1 - b2) * jnp.square(g)
+                m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g
+                v2 = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
                 mhat = m2 / (1 - jnp.power(b1, t))
                 vhat = v2 / (1 - jnp.power(b2, t))
                 p32 = p.astype(jnp.float32)
                 p2 = p32 * (1 - lr * (wd if decays else 0.0)) \
                     - lr * mhat / (jnp.sqrt(vhat) + eps_o)
-                return p2.astype(p.dtype), m2, v2
+                return (p2.astype(p.dtype), m2.astype(m.dtype),
+                        v2.astype(v.dtype))
 
             out = jax.tree.map(upd, params, grads, opt_state["m"],
                                opt_state["v"], self._decay_mask())

@@ -12,6 +12,7 @@ from . import download  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
 from .install_check import run_check, require_version  # noqa: F401
+from .custom_op import register_op, get_custom_op, list_custom_ops  # noqa: F401
 
 __all__ = ["deprecated", "run_check", "require_version", "try_import",
            "unique_name", "dlpack"]

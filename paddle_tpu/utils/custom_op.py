@@ -1,0 +1,119 @@
+"""Out-of-tree custom op registration — the phi plugin-ABI analog.
+
+Parity: ``/root/reference/paddle/phi/capi/`` (out-of-tree kernel
+registration ABI) + ``python/paddle/utils/cpp_extension`` (build/load of
+custom C++/CUDA ops).
+
+TPU-native redesign: a custom "kernel" here is a pure jax function — a
+jnp composition or a Pallas TPU kernel — registered by name. Registration
+wires the op into the SAME dispatch the built-in corpus uses:
+
+* ``paddle_tpu.ops.<name>`` (and ``paddle.<name>``) — eager, recorded on
+  the autograd tape via ``apply`` so ``.backward()`` works;
+* a ``paddle.Tensor.<name>`` method (when the first arg is a tensor);
+* the static Program capture (lazy tracing routes through ``apply``);
+* custom gradients via ``bwd=`` (wrapped with ``jax.custom_vjp``), the
+  slot where a hand-written Pallas backward kernel plugs in.
+
+Example::
+
+    from paddle_tpu.utils.custom_op import register_op
+
+    @register_op("fancy_gelu")
+    def fancy_gelu(x):                  # pure jax / Pallas callable
+        return 0.5 * x * (1 + jnp.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+
+    y = paddle.ops.fancy_gelu(t)        # taped; y.backward() works
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["register_op", "get_custom_op", "list_custom_ops"]
+
+_REGISTRY: dict[str, object] = {}
+
+
+def register_op(name, fn=None, *, bwd=None, n_diff_args=None,
+                tensor_method=True):
+    """Register a pure-jax callable as a paddle_tpu op named ``name``.
+
+    fn(*arrays, **kwargs) -> array or tuple. With ``bwd`` given, the pair
+    is wrapped in ``jax.custom_vjp``: ``bwd(residuals, cotangents) ->
+    tuple(d_inputs)`` and ``fn`` must then return ``(out, residuals)``
+    from its fwd form — the same contract as jax.custom_vjp with
+    ``fn`` as both primal and fwd (residuals = the primal inputs) when
+    ``fn`` returns a single output. Usable as a decorator.
+    """
+    if fn is None:
+        return lambda f: register_op(name, f, bwd=bwd,
+                                     n_diff_args=n_diff_args,
+                                     tensor_method=tensor_method)
+    if not name.isidentifier():
+        raise ValueError(f"op name {name!r} must be a python identifier")
+    if name in _REGISTRY:
+        raise ValueError(f"custom op {name!r} already registered")
+
+    from ..framework.tape import apply
+    from ..framework.tensor import Tensor
+
+    if bwd is None:
+        def op(*args, **kwargs):
+            return apply(fn, *args, op_name=name, **kwargs)
+    else:
+        # custom_vjp demands positional-only primals, so kwargs become
+        # STATIC per-signature closures (one cached custom_vjp each) and
+        # bwd pads None cotangents for the non-diff tail (n_diff_args)
+        nd = n_diff_args
+        base = fn
+        vjp_cache: dict = {}
+
+        def _make_kernel(kw_items, n_args):
+            kw = dict(kw_items)
+
+            @jax.custom_vjp
+            def kernel(*args):
+                return base(*args, **kw)
+
+            def _fwd(*args):
+                return base(*args, **kw), args if nd is None else args[:nd]
+
+            def _bwd(res, cots):
+                grads = bwd(res, cots)
+                grads = tuple(grads) if isinstance(grads, (tuple, list)) \
+                    else (grads,)
+                return grads + (None,) * (n_args - len(grads))
+
+            kernel.defvjp(_fwd, _bwd)
+            kernel.__name__ = getattr(base, "__name__", name)
+            return kernel
+
+        def op(*args, **kwargs):
+            key = (tuple(sorted(kwargs.items())), len(args))
+            kernel = vjp_cache.get(key)
+            if kernel is None:
+                kernel = vjp_cache[key] = _make_kernel(
+                    tuple(sorted(kwargs.items())), len(args))
+            return apply(kernel, *args, op_name=name)
+
+    op.__name__ = name
+    op.__doc__ = fn.__doc__ or f"custom op {name}"
+    _REGISTRY[name] = op
+
+    # surface like a built-in: ops module + top level + Tensor method
+    from .. import ops as ops_mod
+    import paddle_tpu as paddle
+    setattr(ops_mod, name, op)
+    if not hasattr(paddle, name):
+        setattr(paddle, name, op)
+    if tensor_method and not hasattr(Tensor, name):
+        setattr(Tensor, name, op)
+    return op
+
+
+def get_custom_op(name):
+    return _REGISTRY[name]
+
+
+def list_custom_ops():
+    return sorted(_REGISTRY)

@@ -163,3 +163,88 @@ def test_onnx_export_requires_paddle2onnx(tmp_path):
         paddle.onnx.export(net, str(tmp_path / "m"))
     with pytest.raises(ValueError, match="file_prefix is empty"):
         paddle.onnx.export(net, str(tmp_path) + "/")
+
+
+# ------------------------------------------------------- custom op registry
+def test_custom_op_registration_and_grad():
+    """phi/capi analog: a registered pure-jax op dispatches through the
+    tape (eager + backward + Tensor method + static capture)."""
+    import jax.numpy as jnp
+    from paddle_tpu.utils.custom_op import register_op, list_custom_ops
+
+    @register_op("swishy")
+    def swishy(x, beta=1.0):
+        return x * (1.0 / (1.0 + jnp.exp(-beta * x)))
+
+    assert "swishy" in list_custom_ops()
+    x = paddle.to_tensor(np.array([0.5, -1.0], np.float32))
+    x.stop_gradient = False
+    y = paddle.ops.swishy(x, beta=2.0)
+    ref = x.numpy() / (1 + np.exp(-2.0 * x.numpy()))
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-6)
+    y.sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+    # tensor method + top-level surface
+    np.testing.assert_allclose(
+        paddle.to_tensor(ref).swishy().numpy(),
+        ref / (1 + np.exp(-ref)), rtol=1e-6)
+
+    # static capture routes through the same dispatch
+    from paddle_tpu import static
+    static.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            v = static.data("v", [2], "float32")
+            out = paddle.ops.swishy(v)
+        got = static.Executor().run(main, feed={"v": x.numpy()},
+                                    fetch_list=[out])[0]
+        np.testing.assert_allclose(
+            got, x.numpy() / (1 + np.exp(-x.numpy())), rtol=1e-6)
+    finally:
+        static.disable_static()
+
+
+def test_custom_op_custom_vjp():
+    """bwd= slot: a hand-written backward (the Pallas-kernel plug point)."""
+    import jax.numpy as jnp
+    from paddle_tpu.utils.custom_op import register_op
+
+    def bwd(res, cot):
+        (xv,) = res
+        return (cot * 3.0 * xv * xv,)  # d(x^3)
+
+    @register_op("cubed_custom", bwd=bwd)
+    def cubed_custom(x):
+        return x ** 3
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    paddle.ops.cubed_custom(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+    with pytest.raises(ValueError, match="already registered"):
+        register_op("cubed_custom", lambda x: x)
+
+
+def test_custom_op_vjp_kwargs_and_partial_diff():
+    """bwd ops accept kwargs (static per-signature) and n_diff_args pads
+    the non-diff tail's cotangents."""
+    import jax.numpy as jnp
+    from paddle_tpu.utils.custom_op import register_op
+
+    def bwd(res, cot):
+        (xv,) = res
+        return (cot * 2.0 * xv,)
+
+    @register_op("sq_scaled", bwd=bwd, n_diff_args=1)
+    def sq_scaled(x, s, gain=1.0):
+        return gain * x * x + 0.0 * s.sum()
+
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    x.stop_gradient = False
+    s = paddle.to_tensor(np.array([1.0], np.float32))
+    out = paddle.ops.sq_scaled(x, s, gain=2.0)
+    np.testing.assert_allclose(out.numpy(), [18.0], rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0], rtol=1e-6)
