@@ -293,69 +293,120 @@ def _onef1b_tick_loop(block_apply, head_apply, blocks_local, head_params,
     only on the last stage, dxs only on stage 0; callers psum/mask over
     ``pp`` (and any model-parallel axes) as their sharding requires.
     """
+    # vpp=1 reduces the interleaved schedule to EXACTLY this one
+    # (T = n_micro + 2(pp-1); u_f-keyed slots coincide with micro keys),
+    # so one implementation serves both — kept as the documented API.
+    return _interleaved_1f1b_tick_loop(
+        lambda bl, x, c: block_apply(bl, x), head_apply, blocks_local,
+        head_params, xs, labs, pp, 1, n_micro, seed_scale=seed_scale)
+
+
+def _interleaved_1f1b_tick_loop(block_apply, head_apply, blocks_local,
+                                head_params, xs, labs, pp, vpp, n_micro,
+                                seed_scale=1.0):
+    """Interleaved 1F1B (pipeline_parallel.py:463
+    PipelineParallelWithInterleave parity) — runs INSIDE a shard_map over
+    ``pp``. Physical stage s hosts vpp chunks; virtual stage v = c*pp + s.
+
+    Collision-free lockstep timing (unique per (stage, tick) by base-pp
+    digit decomposition):
+      forward  of (micro m, virtual v): t = (m//pp)*pp*vpp + (v//pp)*pp
+                                            + m%pp + v%pp
+      backward mirrors it shifted by D = V-1, so the LAST virtual stage
+      backwards a micro in the tick it forwards it, and both wavefronts
+      ride uniform ppermute(+1)/(-1) hops (a chunk boundary pp-1 -> 0 is
+      the same +1 rotation). Every stage does at most one chunk-forward
+      and one chunk-backward (recompute-in-vjp) per tick; saved stage
+      inputs live in a min(vpp*n_micro, 2V-1)-slot ring keyed by the
+      forward tick offset — live activations stay O(pp*vpp).
+
+    block_apply(blocks_local, x, c) applies chunk ``c`` of this stage's
+    sub-stack. Returns per-rank unreduced (loss_sum, dblocks_f32,
+    dhead_f32, dxs) like :func:`_onef1b_tick_loop`.
+    """
     stage = jax.lax.axis_index("pp")
-    K = min(n_micro, 2 * pp - 1)
-    T = n_micro + 2 * (pp - 1)
+    V = pp * vpp
+    D = V - 1
+    G_max = (n_micro - 1) // pp
+    # ring slots key on the forward TICK OFFSET u_f, whose range has holes
+    # when pp does not divide n_micro — bound K by the u_f span, not the
+    # unit count, or a late forward clobbers a live slot (max live window
+    # is 2D ticks, so 2V-1 slots always suffice)
+    K = min(G_max * pp * vpp + (vpp - 1) * pp + pp, 2 * V - 1)
+    T = 1 + D + G_max * pp * vpp + (vpp - 1) * pp + (n_micro - 1) % pp \
+        + (pp - 1)
     rot_f = [(i, (i + 1) % pp) for i in range(pp)]
     rot_b = [(i, (i - 1) % pp) for i in range(pp)]
     f32 = jnp.float32
     to_f32 = lambda tree: jax.tree.map(lambda v: v.astype(f32), tree)
     zeros_f32 = lambda tree: jax.tree.map(
         lambda v: jnp.zeros(v.shape, f32), tree)
+    PV = pp * vpp
+
+    def decompose(u):
+        """tick offset -> (micro, chunk-row r, block G); valid iff u>=0."""
+        G = u // PV
+        rem = u % PV
+        return G * pp + rem % pp, rem // pp, rem % pp
 
     def tick(carry, t):
         fstate, bstate, ring, gb, gh, dxs, loss_acc = carry
 
-        # ---- forward wavefront: micro m_f enters this stage ----
-        m_f = t - stage
-        valid_f = (m_f >= 0) & (m_f < n_micro)
-        x_in = jnp.where(stage == 0,
-                         jnp.take(xs, jnp.clip(m_f, 0, n_micro - 1), axis=0),
+        # ---- forward: this stage's scheduled (m_f, chunk c_f) ----
+        u_f = t - stage
+        m_f, c_f, _ = decompose(u_f)
+        valid_f = (u_f >= 0) & (m_f < n_micro)
+        v_f = c_f * pp + stage
+        x_in = jnp.where((v_f == 0),
+                         jnp.take(xs, jnp.clip(m_f, 0, n_micro - 1),
+                                  axis=0),
                          fstate)
-        slot_f = jnp.where(valid_f, m_f % K, 0)
+        slot_f = jnp.where(valid_f, u_f % K, 0)
         old = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
         ring = jax.lax.dynamic_update_index_in_dim(
             ring, jnp.where(valid_f, x_in, old), slot_f, 0)
-        y = block_apply(blocks_local, x_in)
+        y = block_apply(blocks_local, x_in, c_f)
 
-        # ---- backward wavefront: micro m_b leaves this stage ----
-        m_b = t - 2 * (pp - 1) + stage
-        valid_b = (m_b >= 0) & (m_b < n_micro)
-        slot_b = jnp.where(valid_b, m_b % K, 0)
+        # ---- backward: mirrored wavefront ----
+        u_b = t - D - (pp - 1 - stage)
+        m_b, cr, _ = decompose(u_b)
+        c_b = vpp - 1 - cr
+        valid_b = (u_b >= 0) & (m_b < n_micro)
+        v_b = c_b * pp + stage
+        u_f_of_b = (m_b // pp) * PV + c_b * pp + m_b % pp
+        slot_b = jnp.where(valid_b, u_f_of_b % K, 0)
         x_s = jax.lax.dynamic_index_in_dim(ring, slot_b, 0, keepdims=False)
         lab = jnp.take(labs, jnp.clip(m_b, 0, n_micro - 1), axis=0)
+        is_last_v = v_b == V - 1
 
-        def last_branch(x_s, lab, _cot):
-            # forward + head, loss cotangent seeds the vjp; masking the
-            # seed (not the grads) zeroes invalid ticks for free
+        def last_branch(x_s, lab, _cot, c):
             def f(bl, hp, xx):
-                return head_apply(hp, block_apply(bl, xx), lab)
+                return head_apply(hp, block_apply(bl, xx, c), lab)
             lv, vjp = jax.vjp(f, blocks_local, head_params, x_s)
             seed = jnp.where(valid_b, seed_scale, 0.0).astype(lv.dtype)
             db, dh, dx = vjp(seed)
             return (jnp.where(valid_b, lv, 0.0).astype(f32),
                     to_f32(db), to_f32(dh), dx)
 
-        def mid_branch(x_s, _lab, cot):
+        def mid_branch(x_s, _lab, cot, c):
             def f(bl, xx):
-                return block_apply(bl, xx)
+                return block_apply(bl, xx, c)
             _y, vjp = jax.vjp(f, blocks_local, x_s)
             db, dx = vjp(jnp.where(valid_b, cot, jnp.zeros_like(cot)))
             return (jnp.zeros((), f32), to_f32(db),
                     zeros_f32(head_params), dx)
 
-        # stage is uniform within every mp/dp group, so the collectives
-        # inside each branch stay collective-safe (same gate as gpipe head)
-        lv, db, dh, dx = jax.lax.cond(stage == pp - 1, last_branch,
-                                      mid_branch, x_s, lab, bstate)
+        lv, db, dh, dx = jax.lax.cond(is_last_v, last_branch, mid_branch,
+                                      x_s, lab, bstate, c_b)
 
         gb = jax.tree.map(jnp.add, gb, db)
         gh = jax.tree.map(jnp.add, gh, dh)
         loss_acc = loss_acc + lv
         slot_x = jnp.clip(m_b, 0, n_micro - 1)
-        old_dx = jax.lax.dynamic_index_in_dim(dxs, slot_x, 0, keepdims=False)
+        old_dx = jax.lax.dynamic_index_in_dim(dxs, slot_x, 0,
+                                              keepdims=False)
         dxs = jax.lax.dynamic_update_index_in_dim(
-            dxs, jnp.where(valid_b & (stage == 0), dx, old_dx), slot_x, 0)
+            dxs, jnp.where(valid_b & (v_b == 0), dx, old_dx), slot_x, 0)
 
         fstate = jax.lax.ppermute(y, "pp", rot_f)
         bstate = jax.lax.ppermute(dx, "pp", rot_b)

@@ -431,10 +431,6 @@ class GPTHybridTrainStep:
         # backward, live activations O(pp) (pipeline_parallel.py:119).
         if pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline_schedule {pipeline_schedule!r}")
-        if pipeline_schedule == "1f1b" and vpp > 1:
-            raise NotImplementedError(
-                "interleaved 1F1B (virtual_pp_degree>1) not implemented; "
-                "use the breadth-first virtual-pp gpipe schedule")
         self.pipeline_schedule = pipeline_schedule
         self.hyper = (lr, beta1, beta2, eps, weight_decay, grad_clip_norm)
         self.remat = remat
@@ -807,7 +803,9 @@ class GPTHybridTrainStep:
         eps = cfg.layer_norm_epsilon
         use_flash = self._use_flash(S)
 
-        from ..distributed.fleet.pipeline import _onef1b_tick_loop
+        from ..distributed.fleet.pipeline import (_interleaved_1f1b_tick_loop,
+                                                  _onef1b_tick_loop)
+        vpp = self.vpp
 
         def stage_prog(blocks_local, wte_local, lnf_w, lnf_b, xs, labs):
             stage = jax.lax.axis_index("pp")
@@ -820,6 +818,12 @@ class GPTHybridTrainStep:
                 out, _ = jax.lax.scan(lambda h_, p: (blk(p, h_), None), x, bl)
                 return out
 
+            def block_apply_chunk(bl, x, c):
+                # [vpp*chunk_len, ...] -> this stage's chunk c sub-stack
+                blc = {k: v.reshape((vpp, -1) + v.shape[1:])[c]
+                       for k, v in bl.items()}
+                return block_apply(blc, x)
+
             def head_apply(hp, y, lab):
                 x = _ln(y, hp["lnf_w"], hp["lnf_b"], eps).astype(
                     hp["wte"].dtype)
@@ -828,9 +832,15 @@ class GPTHybridTrainStep:
 
             head_params = {"wte": wte_local, "lnf_w": lnf_w, "lnf_b": lnf_b}
             seed = 1.0 / (n_micro * mp * dpsh)
-            loss_sum, gb, gh, dxs = _onef1b_tick_loop(
-                block_apply, head_apply, blocks_local, head_params,
-                xs, labs, pp, n_micro, seed_scale=seed)
+            if vpp > 1:
+                loss_sum, gb, gh, dxs = _interleaved_1f1b_tick_loop(
+                    block_apply_chunk, head_apply, blocks_local,
+                    head_params, xs, labs, pp, vpp, n_micro,
+                    seed_scale=seed)
+            else:
+                loss_sum, gb, gh, dxs = _onef1b_tick_loop(
+                    block_apply, head_apply, blocks_local, head_params,
+                    xs, labs, pp, n_micro, seed_scale=seed)
 
             # ---- reductions (see docstring) ----
             loss = jax.lax.psum(loss_sum, "pp") / n_micro
