@@ -50,10 +50,16 @@ class Model:
         self._metrics = []
         self._scaler = None
         self.stop_training = False
+        # adapters (reference model.py picks _DygraphAdapter vs
+        # _StaticGraphAdapter; here: compiled mesh step vs static Program)
+        self._parallel = None          # None=auto, True/False=forced
+        self._parallel_step = None     # (ParallelTrainStep, n_inputs)
+        self._static_state = None
+        self._no_parallel = False      # set on any update=False batch
 
     # ------------------------------------------------------------------ setup
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, parallel=None):
         self._optimizer = optimizer
         if loss is not None and not callable(loss):
             raise TypeError("loss must be a callable (Layer or function)")
@@ -71,7 +77,77 @@ class Model:
             self._scaler = GradScaler(
                 enable=self._amp_dtype == "float16",
                 init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15))
+        self._parallel = parallel
+        # a re-prepare swaps optimizer/loss: drop adapters built against
+        # the old ones (compiled step / captured Program bake them in)
+        self._parallel_step = None
+        self._static_state = None
+        self._no_parallel = False
         return self
+
+    # ------------------------------------------------- execution adapters
+    def _use_parallel(self):
+        """Compiled multi-device step (the reference's distributed fit):
+        auto-on when a global mesh exists and the loop is metric-free
+        (the compiled step returns only the loss; with metrics the eager
+        path keeps exact per-batch metric semantics)."""
+        if self._parallel is False or self._scaler is not None:
+            return False
+        from ..distributed.mesh import get_global_mesh
+        mesh = get_global_mesh()
+        has_mesh = mesh is not None and any(
+            d > 1 for d in mesh.shape.values())
+        if self._parallel is None:
+            return has_mesh and not self._metrics
+        return bool(self._parallel) and mesh is not None
+
+    def _get_parallel_step(self, n_inputs):
+        if self._parallel_step is None or \
+                self._parallel_step[1] != n_inputs:
+            from ..distributed.fleet.train_step import ParallelTrainStep
+
+            def loss_fn(model, *batch):
+                outs = _to_list(model(*batch[:n_inputs]))
+                return self._run_loss(outs, list(batch[n_inputs:]))
+
+            self._parallel_step = (ParallelTrainStep(
+                self.network, self._optimizer, loss_fn), n_inputs)
+        return self._parallel_step[0]
+
+    def _static_mode(self):
+        from ..static.program import static_build
+        return static_build()
+
+    def _static_train_batch(self, inputs, labels):
+        """Static-graph adapter (reference _StaticGraphAdapter): capture
+        the forward+loss+minimize Program once, then Executor.run per
+        batch with the feed dict."""
+        from .. import static
+        if self._static_state is None:
+            main = static.Program()
+            with static.program_guard(main):
+                feeds = [static.data(f"x{i}", list(np.shape(v)),
+                                     str(np.asarray(v).dtype))
+                         for i, v in enumerate(inputs)]
+                lfeeds = [static.data(f"y{i}", list(np.shape(v)),
+                                      str(np.asarray(v).dtype))
+                          for i, v in enumerate(labels)]
+                outs = _to_list(self.network(*feeds))
+                loss = self._run_loss(outs, lfeeds)
+                self._optimizer.minimize(loss)
+            self._static_state = (static.Executor(), main, loss, outs)
+        exe, main, loss, outs_v = self._static_state
+        feed = {f"x{i}": np.asarray(v) for i, v in enumerate(inputs)}
+        feed.update({f"y{i}": np.asarray(v) for i, v in enumerate(labels)})
+        fetched = exe.run(main, feed=feed, fetch_list=[loss] + outs_v)
+        lv = [float(np.asarray(fetched[0]))]
+        if not self._metrics:
+            return lv
+        outputs = [_to_tensor(o) for o in fetched[1:]]
+        labels_t = [_to_tensor(v) for v in labels]
+        metrics = [m.update(*_to_list(m.compute(*(outputs + labels_t))))
+                   for m in self._metrics]
+        return (lv, metrics)
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
@@ -86,8 +162,33 @@ class Model:
         assert self._optimizer is not None, \
             "call prepare(optimizer=..., loss=...) before train_batch"
         self.network.train()
+        if self._static_mode():
+            if not update:
+                raise ValueError(
+                    "gradient accumulation (update=False) is not supported "
+                    "by the static-graph adapter: minimize is captured in "
+                    "the Program and applies every run")
+            return self._static_train_batch(_to_list(inputs),
+                                            _to_list(labels))
         inputs = [_to_tensor(x) for x in _to_list(inputs)]
         labels = [_to_tensor(x) for x in _to_list(labels)]
+        if not update:
+            # gradient accumulation: the compiled step consumes only the
+            # current batch, so the whole accumulation window must stay on
+            # the eager path — disable parallel for this Model run
+            self._no_parallel = True
+        if update and not self._no_parallel and self._use_parallel():
+            step = self._get_parallel_step(len(inputs))
+            loss = step(*(inputs + labels))
+            lv = [float(np.asarray(loss._value))]
+            if not self._metrics:
+                return lv
+            # metrics under the compiled path: one no-grad eval forward
+            with tape_mod.no_grad_guard():
+                outputs = _to_list(self.network(*inputs))
+            metrics = [m.update(*_to_list(m.compute(*(outputs + labels))))
+                       for m in self._metrics]
+            return (lv, metrics)
         if self._scaler is not None:
             # AMP path (reference dygraph adapter model.py:798-809)
             from ..amp import auto_cast

@@ -60,7 +60,10 @@ class Tensor:
         self._data = np.ascontiguousarray(arr)
 
     def copy_to_cpu(self):
-        return np.asarray(self._data)
+        d = self._data
+        if hasattr(d, "numpy"):  # device-resident Tensor (zero-copy run)
+            return np.asarray(d.numpy())
+        return np.asarray(d)
 
     def reshape(self, shape):
         if self._data is not None:
@@ -71,25 +74,95 @@ class Tensor:
 
 
 class Predictor:
-    def __init__(self, config: Config):
+    """Serving predictor over jit.save artifacts (analysis_predictor.cc
+    parity, TPU-native): the loaded program is a FIXED-shape compiled
+    executable, and the serving conveniences the reference gets from its
+    optimization pipeline map to
+
+    * **batch bucketing** — requests smaller than the exported batch are
+      padded and the outputs sliced; larger requests run in exported-batch
+      chunks (one compiled program serves any batch size);
+    * **zero-copy outputs** — results stay device-resident in the output
+      handles until ``copy_to_cpu`` (the ZeroCopyTensor contract);
+    * **clone()** — a second Predictor sharing the same weights/program
+      (AnalysisPredictor::Clone for multi-thread serving).
+    """
+
+    def __init__(self, config: Config, _shared=None):
         self._config = config
-        path = config.prog_file()
-        if path is None or not os.path.exists(path + ".pdmodel"):
-            raise ValueError(f"no saved model at {path!r} "
-                             "(expect jit.save artifacts: .pdmodel/.pdiparams)")
-        self._layer = jit_io.load(path)
-        with open(path + ".pdmeta", "rb") as f:
-            meta = pickle.load(f)
-        self._input_specs = meta["input_specs"]
+        if _shared is not None:  # clone(): share program + weights
+            self._layer, self._input_specs = _shared
+        else:
+            path = config.prog_file()
+            if path is None or not os.path.exists(path + ".pdmodel"):
+                raise ValueError(
+                    f"no saved model at {path!r} "
+                    "(expect jit.save artifacts: .pdmodel/.pdiparams)")
+            self._layer = jit_io.load(path)
+            with open(path + ".pdmeta", "rb") as f:
+                meta = pickle.load(f)
+            self._input_specs = meta["input_specs"]
         self._inputs = [Tensor(f"input_{i}")
                         for i in range(len(self._input_specs))]
         self._outputs = []
+        # the exported (compiled) batch size: dim0 of the first input spec
+        # (pdmeta stores specs as (shape_tuple, dtype_str) pairs)
+        spec0 = self._input_specs[0] if self._input_specs else None
+        shape0 = spec0[0] if isinstance(spec0, (tuple, list)) \
+            else getattr(spec0, "shape", None)
+        self._exported_batch = int(shape0[0]) \
+            if shape0 is not None and len(shape0) else None
         # output arity is known statically from the exported program
         out_avals = getattr(self._layer._exported, "out_avals", None)
         try:
             self._n_outputs = len(out_avals) if out_avals is not None else 1
         except TypeError:
             self._n_outputs = 1
+
+    def clone(self):
+        """Share the compiled program + weights with a new Predictor
+        (AnalysisPredictor::Clone): handles are per-clone, weights aren't
+        duplicated."""
+        return Predictor(self._config,
+                         _shared=(self._layer, self._input_specs))
+
+    def _run_bucketed(self, vals):
+        """Serve ANY batch size through the fixed-shape program: pad up,
+        or chunk + pad the remainder, then slice outputs back."""
+        B0 = self._exported_batch
+        b = int(np.shape(vals[0])[0]) if np.ndim(vals[0]) else None
+        if B0 is None or b is None or b == B0:
+            out = self._layer(*vals)
+            return out if isinstance(out, (tuple, list)) else [out]
+
+        def pad(v, n):
+            width = [(0, n)] + [(0, 0)] * (np.ndim(v) - 1)
+            return np.pad(np.asarray(v), width)
+
+        def is_batched(i, v):
+            # only slice/pad inputs whose exported dim0 IS the batch dim;
+            # non-batched extras (lookup tables, scale vectors) pass as-is
+            spec = self._input_specs[i] if i < len(self._input_specs) \
+                else None
+            shape = spec[0] if isinstance(spec, (tuple, list)) \
+                else getattr(spec, "shape", None)
+            return (shape is not None and len(shape)
+                    and int(shape[0]) == B0 and np.ndim(v)
+                    and np.shape(v)[0] == b)
+
+        chunks = []
+        for lo in range(0, b, B0):
+            part = [np.asarray(v)[lo:lo + B0] if is_batched(i, v)
+                    else np.asarray(v) for i, v in enumerate(vals)]
+            n = min(B0, b - lo)
+            if n < B0:
+                part = [pad(v, B0 - n) if is_batched(i, vals[i]) else v
+                        for i, v in enumerate(part)]
+            out = self._layer(*part)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            chunks.append([o.numpy()[:n] for o in outs])
+        return [np.concatenate([c[i] for c in chunks])
+                for i in range(len(chunks[0]))]
 
     def get_input_names(self):
         return [t.name for t in self._inputs]
@@ -107,13 +180,14 @@ class Predictor:
             vals = [np.asarray(x) for x in inputs]
         else:
             vals = [t.copy_to_cpu() for t in self._inputs]
-        out = self._layer(*vals)
-        outs = out if isinstance(out, (tuple, list)) else [out]
+        outs = self._run_bucketed(vals)
         self._n_outputs = len(outs)
         results = []
         for i, o in enumerate(outs):
             h = self.get_output_handle(f"output_{i}")  # reuse pre-fetched
-            h.copy_from_cpu(np.asarray(o.numpy()))
+            # zero-copy: the handle keeps the device array; host
+            # materialization happens in copy_to_cpu
+            h._data = o
             results.append(h.copy_to_cpu())
         return results if inputs is not None else None
 

@@ -4,6 +4,8 @@ from .factory import QuanterFactory, quanter  # noqa: F401
 from .config import QuantConfig, SingleLayerConfig  # noqa: F401
 from .quanters import (  # noqa: F401
     FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+    MovingAverageAbsmaxObserver, HistObserver, KLObserver,
+    PerChannelAbsmaxObserver,
 )
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401

@@ -32,3 +32,24 @@ def fake_quant_dequant_abs_max(x, scale=None, bit_length=8, name=None):
     def f(v, s):
         return _fake_qdq(v, s, bit_length)
     return apply(f, x, scale, op_name="fake_quant_dequant_abs_max")
+
+
+def fake_quant_dequant_channel_wise(x, scales, quant_axis=0, bit_length=8):
+    """Per-channel fake quant-dequant: one scale per channel along
+    ``quant_axis`` (FakeChannelWiseQuantDequantAbsMax parity)."""
+    import jax.numpy as jnp
+    from ..framework.tape import apply
+    from ..ops._dispatch import unwrap
+
+    bound = 2.0 ** (bit_length - 1) - 1
+    sv = unwrap(scales)
+
+    def f(v):
+        ax = quant_axis % v.ndim
+        shape = [1] * v.ndim
+        shape[ax] = -1
+        s = jnp.maximum(jnp.asarray(sv, jnp.float32).reshape(shape), 1e-9)
+        q = jnp.clip(jnp.round(v / s * bound), -bound, bound)
+        return (q * s / bound).astype(v.dtype)
+
+    return apply(f, x, op_name="fake_channel_wise_quant_dequant")

@@ -12,7 +12,8 @@ from ..ops._dispatch import unwrap
 from .config import QuantConfig
 from .factory import QuanterFactory
 from .quanters import AbsmaxObserver
-from .functional import fake_quant_dequant_abs_max
+from .functional import (fake_quant_dequant_abs_max,
+                         fake_quant_dequant_channel_wise)
 from .qat import (
     QuantedWrapper, QUANTABLE_TYPES, install_wrappers, _maybe_copy,
     ConvertedLayer,
@@ -46,8 +47,16 @@ class PTQ:
                 inner = sub.inner
                 if sub.weight_quanter is not None:
                     bits = sub.weight_quanter.bit_length()
-                    wq = fake_quant_dequant_abs_max(inner.weight,
-                                                    bit_length=bits)
+                    wscales = np.asarray(unwrap(
+                        sub.weight_quanter.scales()))
+                    if wscales.ndim >= 1 and wscales.size > 1:
+                        # per-channel weight observer: use ITS scales/axis
+                        wq = fake_quant_dequant_channel_wise(
+                            inner.weight, wscales,
+                            sub.weight_quanter.quant_axis(), bits)
+                    else:
+                        wq = fake_quant_dequant_abs_max(inner.weight,
+                                                        bit_length=bits)
                     inner.weight.set_value(np.asarray(unwrap(wq)))
                 act_scale = 0.0
                 if sub.act_quanter is not None:

@@ -86,3 +86,185 @@ class AbsmaxObserver(BaseQuanter):
 
     def bit_length(self):
         return self._bit_length
+
+
+class MovingAverageAbsmaxObserver(BaseQuanter):
+    """PTQ observer: EMA of per-batch absmax (reference
+    quantization/observers/mse.py siblings — the moving-average scale is
+    less outlier-sensitive than the running max)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, name=None):
+        super().__init__()
+        self._bit_length = bit_length
+        self._rate = moving_rate
+        self._ema = None
+
+    def forward(self, input):
+        import jax
+        v = unwrap(input)
+        if not isinstance(v, jax.core.Tracer):
+            cur = float(np.abs(np.asarray(v)).max())
+            self._ema = cur if self._ema is None else \
+                self._rate * self._ema + (1 - self._rate) * cur
+        return input
+
+    def scales(self):
+        return Tensor(jnp.float32(self._ema or 0.0))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class HistObserver(BaseQuanter):
+    """PTQ histogram observer with percentile scale selection (reference
+    quantization/observers/hist.py): accumulate |x| histograms over
+    calibration batches, pick the scale covering ``percent`` of mass —
+    robust to activation outliers that wreck plain absmax."""
+
+    def __init__(self, bit_length=8, bins_count=2048, percent=0.999,
+                 name=None):
+        super().__init__()
+        self._bit_length = bit_length
+        self._bins = bins_count
+        self._percent = percent
+        self._hist = np.zeros(bins_count, np.float64)
+        self._range = 0.0
+
+    def forward(self, input):
+        import jax
+        v = unwrap(input)
+        if isinstance(v, jax.core.Tracer):
+            return input
+        a = np.abs(np.asarray(v)).reshape(-1)
+        mx = float(a.max()) if a.size else 0.0
+        if mx > self._range:
+            # rescale the existing histogram onto the wider range
+            if self._range > 0 and self._hist.sum() > 0:
+                old_edges = np.linspace(0, self._range, self._bins + 1)
+                new_edges = np.linspace(0, mx, self._bins + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                idx = np.clip(np.searchsorted(new_edges, centers) - 1,
+                              0, self._bins - 1)
+                nh = np.zeros_like(self._hist)
+                np.add.at(nh, idx, self._hist)
+                self._hist = nh
+            self._range = mx
+        if self._range > 0:
+            h, _ = np.histogram(a, bins=self._bins,
+                                range=(0.0, self._range))
+            self._hist += h
+        return input
+
+    def scales(self):
+        total = self._hist.sum()
+        if total == 0 or self._range == 0:
+            return Tensor(jnp.float32(0.0))
+        cum = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cum, self._percent))
+        edge = (idx + 1) * self._range / self._bins
+        return Tensor(jnp.float32(edge))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class KLObserver(HistObserver):
+    """PTQ KL-divergence observer (reference observers/kl.py / TensorRT
+    calibration): choose the clip threshold minimizing KL(P || Q) between
+    the fp histogram P and its int8-quantized redistribution Q."""
+
+    def __init__(self, bit_length=8, bins_count=2048, name=None):
+        super().__init__(bit_length=bit_length, bins_count=bins_count)
+
+    def scales(self):
+        total = self._hist.sum()
+        if total == 0 or self._range == 0:
+            return Tensor(jnp.float32(0.0))
+        levels = 2 ** (self._bit_length - 1)  # 128 for int8
+        hist = self._hist.copy()
+        # exclude the zero bin (TensorRT practice): ReLU outputs spike at
+        # zero and that mass says nothing about the useful clip range
+        hist[0] = 0.0
+        if hist.sum() == 0:
+            return Tensor(jnp.float32(self._range))
+        hist = hist / hist.sum()
+        best, best_kl = self._bins, np.inf
+        for t in range(levels, self._bins + 1, max(1, self._bins // 128)):
+            p = hist[:t].copy()
+            p[t - 1] += hist[t:].sum()  # clip mass into the last bin
+            # quantize the first t bins down to `levels` then re-expand
+            factor = t / levels
+            edges = np.minimum((np.arange(t) / factor).astype(np.int64),
+                               levels - 1)
+            q_small = np.zeros(levels)
+            np.add.at(q_small, edges, hist[:t])
+            counts = np.zeros(levels)
+            np.add.at(counts, edges, (hist[:t] > 0).astype(np.float64))
+            q = np.zeros(t)
+            nz = counts[edges] > 0
+            with np.errstate(invalid="ignore", divide="ignore"):
+                spread = np.where(counts[edges] > 0,
+                                  q_small[edges] / counts[edges], 0.0)
+            q[nz] = spread[nz]
+            # KL needs both sides normalized to probability mass
+            ps, qs = p.sum(), q.sum()
+            if ps <= 0 or qs <= 0:
+                continue
+            p, q = p / ps, q / qs
+            mask = (p > 0) & (q > 0)
+            kl = float(np.sum(p[mask] * np.log(p[mask] / q[mask])))
+            # mass in p that q cannot represent is infinite KL: penalize
+            kl += float(p[(p > 0) & (q <= 0)].sum()) * 10.0
+            if kl < best_kl:
+                best_kl, best = kl, t
+        return Tensor(jnp.float32(best * self._range / self._bins))
+
+
+class PerChannelAbsmaxObserver(BaseQuanter):
+    """Per-output-channel weight observer (reference
+    FakeQuanterChannelWiseAbsMaxObserver): one scale per channel along
+    ``quant_axis`` — the standard int8 WEIGHT scheme."""
+
+    def __init__(self, bit_length=8, quant_axis=0, name=None):
+        super().__init__()
+        self._bit_length = bit_length
+        self._axis = quant_axis
+        self._scales = None
+
+    def forward(self, input):
+        import jax
+        v = unwrap(input)
+        if not isinstance(v, jax.core.Tracer):
+            a = np.abs(np.asarray(v))
+            ax = self._axis % a.ndim  # normalize negative axes
+            axes = tuple(i for i in range(a.ndim) if i != ax)
+            cur = a.max(axis=axes) if axes else a
+            self._scales = cur if self._scales is None else \
+                np.maximum(self._scales, cur)
+        return input
+
+    def scales(self):
+        return Tensor(jnp.asarray(
+            self._scales if self._scales is not None else np.zeros(1),
+            jnp.float32))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def quant_axis(self):
+        return self._axis
+
+    def bit_length(self):
+        return self._bit_length

@@ -176,3 +176,174 @@ def test_switch_case():
     out = switch_case(paddle.to_tensor(np.int64(7)), fns,
                       default=lambda: paddle.to_tensor(np.float32(-1)))
     assert float(_np(out)) == -1.0
+
+
+def test_predictor_batch_bucketing_and_clone(tmp_path):
+    """Serving depth: one fixed-shape exported program serves any batch
+    (pad/chunk + slice), clone() shares weights, outputs stay device-
+    resident until copy_to_cpu (AnalysisPredictor parity)."""
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit.save_load import InputSpec
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "bucket_model")
+    jit.save(net, path, input_spec=[InputSpec([4, 4], "float32")])
+
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    rng = np.random.default_rng(0)
+
+    full = rng.standard_normal((4, 4)).astype(np.float32)
+    want = net(paddle.to_tensor(full)).numpy()
+
+    # smaller batch than exported: padded + sliced
+    out_small = pred.run([full[:2]])[0]
+    np.testing.assert_allclose(out_small, want[:2], rtol=1e-5, atol=1e-5)
+    # larger, non-multiple batch: chunked + remainder padded
+    big = rng.standard_normal((10, 4)).astype(np.float32)
+    out_big = pred.run([big])[0]
+    want_big = net(paddle.to_tensor(big)).numpy()
+    np.testing.assert_allclose(out_big, want_big, rtol=1e-5, atol=1e-5)
+
+    # clone shares program + weights; handle protocol end-to-end
+    c = pred.clone()
+    h = c.get_input_handle("input_0")
+    h.copy_from_cpu(full)
+    c.run()
+    got = c.get_output_handle("output_0").copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ptq_observer_family():
+    """Observer variety (reference observers/): hist-percentile and KL
+    reject outliers that wreck absmax; per-channel gives one scale per
+    output channel; moving-average EMA converges to the batch absmax."""
+    from paddle_tpu import quantization as Q
+    rng = np.random.default_rng(0)
+
+    # activations ~N(0,1) with one 100.0 outlier
+    data = rng.standard_normal((64, 32)).astype(np.float32)
+    data[0, 0] = 100.0
+    absmax = Q.AbsmaxObserver()
+    hist = Q.HistObserver(percent=0.999)
+    kl = Q.KLObserver()
+    ema = Q.MovingAverageAbsmaxObserver(moving_rate=0.5)
+    for obs in (absmax, hist, kl, ema):
+        for i in range(4):
+            obs(paddle.to_tensor(data))
+    s_absmax = float(absmax.scales().numpy())
+    s_hist = float(hist.scales().numpy())
+    s_kl = float(kl.scales().numpy())
+    assert s_absmax == pytest.approx(100.0)
+    # robust observers clip far below the outlier, above the bulk
+    assert 2.0 < s_hist < 50.0, s_hist
+    assert 2.0 < s_kl < 50.0, s_kl
+    assert float(ema.scales().numpy()) == pytest.approx(100.0, rel=0.2)
+
+    # per-channel: axis-0 scales match each row's absmax
+    w = rng.standard_normal((4, 16)).astype(np.float32) * \
+        np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    pc = Q.PerChannelAbsmaxObserver(quant_axis=0)
+    pc(paddle.to_tensor(w))
+    np.testing.assert_allclose(pc.scales().numpy(),
+                               np.abs(w).max(axis=1), rtol=1e-6)
+    assert pc.quant_axis() == 0
+
+
+def test_ptq_with_hist_observer_end_to_end():
+    from paddle_tpu import quantization as Q
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = Q.QuantConfig(activation=Q.QuanterFactory(Q.HistObserver),
+                        weight=Q.QuanterFactory(Q.AbsmaxObserver))
+    ptq = Q.PTQ(cfg)
+    m = ptq.quantize(net, inplace=False)
+    rng = np.random.default_rng(2)
+    for _ in range(4):
+        m(paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32)))
+    q = ptq.convert(m, inplace=True)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    ref = net(x).numpy()
+    got = q(x).numpy()
+    # int8 fake-quant stays close to the fp reference
+    assert np.abs(got - ref).max() < 0.25 * np.abs(ref).max()
+
+
+def test_ptq_per_channel_weight_flows_through_convert():
+    """PerChannelAbsmaxObserver as the weight quanter actually drives
+    per-channel fake quant in convert (scales + quant_axis consulted)."""
+    from paddle_tpu import quantization as Q
+    from functools import partial
+    lin = nn.Linear(8, 4)
+    # weight rows scaled very differently: per-tensor absmax would crush
+    # the small channels to ~zero resolution
+    w = np.ones((8, 4), np.float32) * 0.01
+    w[:, 0] = 100.0
+    lin.weight.set_value(w)
+    net = nn.Sequential(lin)
+    cfg = Q.QuantConfig(
+        activation=Q.QuanterFactory(Q.AbsmaxObserver),
+        weight=Q.QuanterFactory(Q.PerChannelAbsmaxObserver, quant_axis=-1))
+    ptq = Q.PTQ(cfg)
+    m = ptq.quantize(net, inplace=False)
+    rng = np.random.default_rng(3)
+    m(paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32)))
+    q = ptq.convert(m, inplace=True)
+    wq = None
+    for sub in q._sub_layers.values():
+        inner = getattr(sub, "inner", sub)
+        if hasattr(inner, "weight"):
+            wq = inner.weight.numpy()
+    # per-channel: the 0.01 channels survive quantization almost exactly
+    np.testing.assert_allclose(wq[:, 1], 0.01, rtol=0.02)
+    # negative quant_axis resolved (scales per OUTPUT channel, len 4)
+
+
+def test_predictor_non_batched_extra_input(tmp_path):
+    """Bucketing leaves non-batched inputs (dim0 != exported batch)
+    untouched."""
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit.save_load import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x, bias_vec):
+            return self.lin(x) + bias_vec
+
+    net = Net()
+    path = str(tmp_path / "nb_model")
+    jit.save(net, path, input_spec=[InputSpec([8, 4], "float32"),
+                                    InputSpec([4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((3, 4)).astype(np.float32)  # < exported 8
+    bias = rng.standard_normal((4,)).astype(np.float32)
+    got = pred.run([x, bias])[0]
+    want = net(paddle.to_tensor(x), paddle.to_tensor(bias)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_hapi_accumulation_stays_eager():
+    """update=False disables the compiled parallel path for the run
+    (the compiled step cannot consume accumulated eager grads)."""
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.distributed import mesh as mesh_mod
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    try:
+        mesh_mod._global_mesh, mesh_mod._hcg = None, None
+        HybridCommunicateGroup(dp_degree=8)
+        net = nn.Linear(4, 1)
+        import paddle_tpu.optimizer as opt
+        model = paddle.Model(net)
+        model.prepare(opt.SGD(learning_rate=0.1,
+                              parameters=net.parameters()), nn.MSELoss())
+        x = np.ones((8, 4), np.float32)
+        y = np.ones((8, 1), np.float32)
+        model.train_batch([x], [y], update=False)   # accumulate
+        model.train_batch([x], [y], update=True)    # must stay eager
+        assert model._parallel_step is None
+        assert model._no_parallel
+    finally:
+        mesh_mod._global_mesh, mesh_mod._hcg = saved
