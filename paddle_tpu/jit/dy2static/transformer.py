@@ -13,11 +13,16 @@ Rewrites are semantics-preserving for plain Python values (the convert
 operators keep truthiness/short-circuit), so the whole function is always
 transformed.
 
-Degradation contract: constructs lax cannot express — ``break``/
-``continue``/``return`` inside a loop, mixed return/assign branches —
-stay plain python (correct for python conditions; tensor conditions then
-surface the standard trace error at that location). Single-return-per-
-branch ``if/else`` IS converted, to ``return convert_ifelse(...)``.
+``break``/``continue`` inside a loop lower through the flag rewrite
+(reference break_continue_transformer.py): break -> flag + ``not flag``
+folded into the loop test, continue -> flag guarding the rest of the
+iteration — so break-carrying loops still become ``lax.while_loop``.
+
+Degradation contract: constructs lax cannot express — ``return`` inside
+a loop, mixed return/assign branches — stay plain python (correct for
+python conditions; tensor conditions then surface the standard trace
+error at that location). Single-return-per-branch ``if/else`` IS
+converted, to ``return convert_ifelse(...)``.
 """
 from __future__ import annotations
 
@@ -88,19 +93,23 @@ def _loaded(node):
     return v.names
 
 
-class _FindsBreak(ast.NodeVisitor):
+class _FindsCtl(ast.NodeVisitor):
     """break/continue belonging to THIS loop (not nested ones)."""
 
-    def __init__(self):
+    def __init__(self, kinds):
+        self.kinds = kinds
         self.found = False
 
     def visit_Break(self, node):
-        self.found = True
+        if ast.Break in self.kinds:
+            self.found = True
 
-    visit_Continue = visit_Break
+    def visit_Continue(self, node):
+        if ast.Continue in self.kinds:
+            self.found = True
 
     def visit_While(self, node):
-        pass  # nested loop owns its breaks
+        pass  # nested loop owns its breaks/continues
 
     visit_For = visit_While
 
@@ -110,11 +119,19 @@ class _FindsBreak(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
-def _has_own_break(stmts):
-    v = _FindsBreak()
+def _has_own_ctl(stmts, kinds):
+    v = _FindsCtl(kinds)
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _has_own_break(stmts):
+    return _has_own_ctl(stmts, (ast.Break, ast.Continue))
+
+
+def _has_own_continue(stmts):
+    return _has_own_ctl(stmts, (ast.Continue,))
 
 
 class _FindsReturn(ast.NodeVisitor):
@@ -135,6 +152,69 @@ def _has_return(stmts):
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _flags_guard_rewrite(stmts, brk, cont):
+    """Replace this loop's ``break``/``continue`` with flag assignments
+    and guard every statement after a potential flag-set with
+    ``if not (brk or cont):`` — the reference's
+    break_continue_transformer.py scheme, which is what lets break-
+    carrying loops lower to ``lax.while_loop`` (the loop test picks up
+    ``not brk``). Does not descend into nested loops (they own their own
+    break) or nested function defs. Returns (new_stmts, changed)."""
+    def set_flag(name):
+        return ast.Assign(targets=[_name(name, ast.Store())],
+                          value=ast.Constant(True))
+
+    def guard_test():
+        flags = [_name(brk)] + ([_name(cont)] if cont else [])
+        inner = flags[0] if len(flags) == 1 else \
+            ast.BoolOp(op=ast.Or(), values=flags)
+        return ast.UnaryOp(op=ast.Not(), operand=inner)
+
+    out, changed = [], False
+    for i, st in enumerate(stmts):
+        set_here = False
+        if isinstance(st, ast.Break):
+            out.append(set_flag(brk))
+            set_here = True
+        elif isinstance(st, ast.Continue):
+            out.append(set_flag(cont))
+            set_here = True
+        elif isinstance(st, ast.If):
+            nb, cb = _flags_guard_rewrite(st.body, brk, cont)
+            no, co = _flags_guard_rewrite(st.orelse, brk, cont)
+            set_here = cb or co
+            out.append(ast.If(test=st.test, body=nb or [ast.Pass()],
+                              orelse=no))
+        elif isinstance(st, ast.With):
+            nb, cb = _flags_guard_rewrite(st.body, brk, cont)
+            set_here = cb
+            out.append(ast.With(items=st.items,
+                                body=nb or [ast.Pass()]))
+        elif isinstance(st, ast.Try):
+            nb, c1 = _flags_guard_rewrite(st.body, brk, cont)
+            no, c2 = _flags_guard_rewrite(st.orelse, brk, cont)
+            nf, c3 = _flags_guard_rewrite(st.finalbody, brk, cont)
+            hs, ch = [], False
+            for h in st.handlers:
+                hb, c4 = _flags_guard_rewrite(h.body, brk, cont)
+                ch = ch or c4
+                hs.append(ast.ExceptHandler(type=h.type, name=h.name,
+                                            body=hb or [ast.Pass()]))
+            set_here = c1 or c2 or c3 or ch
+            out.append(ast.Try(body=nb or [ast.Pass()], handlers=hs,
+                               orelse=no, finalbody=nf))
+        else:
+            out.append(st)  # nested loops/defs own their breaks
+        changed = changed or set_here
+        if set_here and i + 1 < len(stmts):
+            rest, rchanged = _flags_guard_rewrite(stmts[i + 1:], brk, cont)
+            changed = changed or rchanged
+            out.append(ast.If(test=guard_test(),
+                              body=rest or [ast.Pass()], orelse=[]))
+            return out, changed
+    return out, changed
 
 
 def _name(id_, ctx=None):
@@ -163,6 +243,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def _uid(self):
         self._n += 1
         return self._n
+
+    def _rewrite_loop_flags(self, body):
+        """break/continue -> flag rewrite shared by while and for-range.
+        Returns (pre_stmts, new_body, brk_name) or None when the body
+        still carries a raw break/continue afterwards (an unhandled
+        container) — callers then leave the loop as plain python."""
+        i = self._uid()
+        brk = f"_brkflag_{i}"
+        cont = f"_contflag_{i}" if _has_own_continue(body) else None
+        new_body, changed = _flags_guard_rewrite(body, brk, cont)
+        if not changed or _has_own_break(new_body):
+            return None  # residual break/continue: python fallback
+        pre = [ast.Assign(targets=[_name(brk, ast.Store())],
+                          value=ast.Constant(False))]
+        self._fn_assigned.add(brk)
+        if cont:
+            # init BEFORE the loop too: the lax path builds the carry
+            # from `opt(lambda: cont)` ahead of the first iteration
+            pre.append(ast.Assign(targets=[_name(cont, ast.Store())],
+                                  value=ast.Constant(False)))
+            new_body = [ast.Assign(targets=[_name(cont, ast.Store())],
+                                   value=ast.Constant(False))] + new_body
+            self._fn_assigned.add(cont)
+        return pre, new_body, brk
 
     # ---------------- boolean operators ------------------------------
     def visit_BoolOp(self, node):
@@ -258,18 +362,33 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # plain python — correct for python conditions; a tensor condition
         # then surfaces the standard trace error at this location
         # (lax.while_loop cannot express early exit).
+        # break/continue lower via the flag rewrite (reference
+        # break_continue_transformer.py): break -> brk=True + `not brk`
+        # folded into the loop test; continue -> cont=True skipping the
+        # rest of the iteration. Return-in-loop and loop-else stay python.
+        pre = []
+        if (_has_own_break(node.body)
+                and not _has_return(node.body) and not node.orelse):
+            rewritten = self._rewrite_loop_flags(node.body)
+            if rewritten is not None:
+                pre, new_body, brk = rewritten
+                node = ast.While(
+                    test=ast.BoolOp(op=ast.And(), values=[
+                        ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                        node.test]),
+                    body=new_body, orelse=[])
         # transform nested constructs either way (visit_If refuses ifs
         # that contain this loop's break, so nothing moves it into a
         # nested function)
         self.generic_visit(node)
         if _has_own_break(node.body) or _has_return(node.body) \
                 or node.orelse:
-            return node
+            return pre + [node] if pre else node
         i = self._uid()
         loop_names = sorted(
             (_assigned(node.body) | _loaded(node.test)) & self._fn_assigned)
         if not loop_names:
-            return node  # nothing carried: leave as python
+            return pre + [node] if pre else node  # nothing carried
         args = ast.arguments(
             posonlyargs=[],
             args=[ast.arg(arg=n) for n in loop_names],
@@ -297,7 +416,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
                                ctx=ast.Store())],
             value=_jst_call("convert_while_loop",
                             [_name(cname), _name(bname), inits, names]))
-        return [cond_fn, body_fn, assign]
+        return pre + [cond_fn, body_fn, assign]
 
     # ---------------- for ... in range(...) ---------------------------
     def visit_For(self, node):
@@ -306,9 +425,22 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 and node.iter.func.id == "range"
                 and isinstance(node.target, ast.Name)
                 and not node.orelse) \
-                or _has_own_break(node.body) or _has_return(node.body):
+                or _has_return(node.body):
             self.generic_visit(node)
             return node  # python iteration (static under trace)
+        brk_pre = []
+        if _has_own_break(node.body):
+            # flag rewrite BEFORE the while desugar so the iterator
+            # increment lands AFTER the guarded region (a guarded
+            # increment would spin forever on continue)
+            rewritten = self._rewrite_loop_flags(node.body)
+            if rewritten is None:
+                self.generic_visit(node)
+                return node  # residual break/continue: python for
+            brk_pre, new_body, brk = rewritten
+            node = ast.For(target=node.target, iter=node.iter,
+                           body=new_body, orelse=[])
+            node._jst_brk = brk  # folded into the range test below
         i = self._uid()
         r = node.iter.args
         start = r[0] if len(r) >= 2 else ast.Constant(0)
@@ -336,10 +468,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
             + [ast.Assign(targets=[_name(it, ast.Store())],
                           value=ast.BinOp(left=_name(it), op=ast.Add(),
                                           right=_name(sp)))])
-        loop = ast.While(
-            test=_jst_call("range_cond", [_name(it), _name(st), _name(sp)]),
-            body=body, orelse=[])
-        out = init + [self.visit(loop)]
+        test = _jst_call("range_cond", [_name(it), _name(st), _name(sp)])
+        if getattr(node, "_jst_brk", None):
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=_name(node._jst_brk)),
+                test])
+        loop = ast.While(test=test, body=body, orelse=[])
+        out = init + brk_pre + [self.visit(loop)]
         flat = []
         for s in out:
             flat.extend(s if isinstance(s, list) else [s])

@@ -158,8 +158,10 @@ def test_elif_chain_and_nested_if():
                                    np.asarray(f(_t(v)).numpy()))
 
 
-def test_python_loop_with_break_stays_python():
-    # plain python loop with break must keep working through the transform
+def test_loop_break_continue_lower_to_lax():
+    """break/continue lower via the flag rewrite
+    (break_continue_transformer.py parity): the loop still becomes
+    lax.while_loop and numerics match plain python."""
     def f(x):
         out = x
         for i in range(10):
@@ -170,21 +172,59 @@ def test_python_loop_with_break_stays_python():
 
     g = ast_transform(f)
     np.testing.assert_allclose(np.asarray(g(_t([0.0])).numpy()), [4.0])
+    assert "convert_while_loop" in g.__dy2static_source__
 
-    # tensor-cond loop with break cannot lower: standard trace error
+    def fc(x):
+        s = x * 0
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            s = s + x * i
+        return s
+
+    gc = ast_transform(fc)
+    np.testing.assert_allclose(np.asarray(gc(_t([2.0])).numpy()),
+                               [2.0 * (1 + 3 + 5)])
+    assert "convert_while_loop" in gc.__dy2static_source__
+
+    # TENSOR-cond while with break+continue: lowers to lax.while_loop
+    # (the condition is a Tensor comparison, so this exercises the lax
+    # branch of convert_while_loop, not the python unroll)
     def h(x):
         s = x
-        while paddle.sum(s) < 10:
-            if paddle.max(s) > 3:
+        i = x * 0
+        while paddle.sum(i) < 100:
+            i = i + 1
+            if paddle.sum(i) > 6:
                 break
+            if paddle.sum(i) == 3:
+                continue
+            s = s + x * paddle.sum(i)
+        return s
+
+    gh = ast_transform(h)
+    np.testing.assert_allclose(np.asarray(gh(_t([2.0])).numpy()),
+                               [2.0 + 2.0 * (1 + 2 + 4 + 5 + 6)])
+    assert "convert_while_loop" in gh.__dy2static_source__
+
+    # with-block continue: residual raw continue falls back to python for
+    class _Ctx:
+        def __enter__(self):
+            return self
+        def __exit__(self, *a):
+            return False
+
+    def fw(x):
+        s = x
+        for i in range(3):
+            with _Ctx():
+                if i == 1:
+                    s = s + 10
             s = s + 1
         return s
 
-    import jax
-    sh = paddle.jit.to_static(h)
-    with pytest.raises((jax.errors.TracerBoolConversionError,
-                        jax.errors.ConcretizationTypeError)):
-        sh(_t([1.0]))
+    gw = ast_transform(fw)
+    np.testing.assert_allclose(np.asarray(gw(_t([0.0])).numpy()), [13.0])
 
 
 def test_unbound_name_errors_on_use():
