@@ -985,10 +985,10 @@ class GPTHybridTrainStep:
 # autoregressive generation (KV-cache incremental decode)
 # ---------------------------------------------------------------------------
 
-def gpt_block_with_kv(p, x, eps):
+def gpt_block_with_kv(p, x, eps, use_flash=False):
     """gpt_block that also returns this block's K/V for cache prefill —
     single source of truth: delegates to gpt_block(return_kv=True)."""
-    return gpt_block(p, x, eps, return_kv=True)
+    return gpt_block(p, x, eps, use_flash=use_flash, return_kv=True)
 
 
 def gpt_block_decode(p, x_t, k_cache, v_cache, pos, eps):
@@ -1026,9 +1026,12 @@ class GPTGenerator:
     Sampling: greedy (temperature=0) or temperature + optional top-k.
     """
 
-    def __init__(self, model, temperature=0.0, top_k=0, seed=0):
+    def __init__(self, model, temperature=0.0, top_k=0, seed=0,
+                 use_flash=None):
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.cfg = gpt.config
+        # Pallas flash prefill (None = auto: TPU + gate-friendly prompt)
+        self.use_flash = use_flash
         self.blocks = {k: jnp.stack([getattr(l, k)._value
                                      for l in gpt.layers])
                        for k in _BLOCK_KEYS}
@@ -1059,12 +1062,23 @@ class GPTGenerator:
         blocks, wte, wpe = self.blocks, self.wte, self.wpe
         lnf_w, lnf_b = self.lnf_w, self.lnf_b
 
+        # prefill rides the Pallas flash kernel when the prompt shape
+        # fits the gate (same criteria as the training step); the decode
+        # loop stays XLA (single-token q has no tiling to win)
+        if self.use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        else:
+            use_flash = self.use_flash
+        use_flash = use_flash and S_prompt % 128 == 0 and S_prompt >= 128 \
+            and cfg.head_dim <= 128
+
         def run(ids, key):
             # ---- prefill: full pass, capture KV per layer
             h = wte[ids] + wpe[jnp.arange(S_prompt)]
 
             def pre(x, p_slice):
-                out, k, v = gpt_block_with_kv(p_slice, x, eps)
+                out, k, v = gpt_block_with_kv(p_slice, x, eps,
+                                              use_flash=use_flash)
                 return out, (k, v)
 
             h, (ks, vs) = jax.lax.scan(pre, h, blocks)
