@@ -155,6 +155,17 @@ def gpt_block(p, x, eps, mp_axis=None, use_flash=False, return_kv=False):
 # schedules use lax.scan. Patchable for tests of the scan path.
 _UNROLL_TICKS = 32
 
+
+def flash_attention_gate(S, head_dim, use_flash=None):
+    """ONE flash-attention gate for every GPT compute path (training
+    schedules AND generator prefill — tuning-sensitive, retune here).
+    auto (None): flash beats XLA's fused attention from S>=512 even at
+    d=64 (measured +9% tokens/s on GPT-345M @1024 on v5e); off on the
+    CPU mesh (interpret mode inside shard_map is slow)."""
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and S >= 512)
+    return bool(use_flash) and S % 128 == 0 and S >= 128 and head_dim <= 128
+
 _CE_CHUNK = 2048  # tokens per chunk: logits buffer ~= 2048*V*4B ≈ 400MB @50k
 
 
@@ -532,18 +543,8 @@ class GPTHybridTrainStep:
                 f"{self.config.max_position_embeddings}")
 
     def _use_flash(self, S):
-        """ONE flash-attention gate for every schedule (tuning-sensitive:
-        retunes must apply to gpipe and 1f1b alike). auto: flash beats
-        XLA's fused attention from S>=512 even at d=64 (measured +9%
-        tokens/s on GPT-345M @1024 on v5e — the lane padding is outweighed
-        by skipping the materialized probs matrix); off on the CPU mesh
-        (interpret mode inside shard_map is slow)."""
-        if self.use_flash is None:
-            use_flash = (jax.default_backend() == "tpu" and S >= 512)
-        else:
-            use_flash = self.use_flash
-        return use_flash and S % 128 == 0 and S >= 128 \
-            and self.config.head_dim <= 128
+        return flash_attention_gate(S, self.config.head_dim,
+                                    self.use_flash)
 
     def _loss_fn(self, params, ids, labels):
         """Full forward: embed (GSPMD) -> GPipe decoder shard_map -> loss."""
@@ -1062,15 +1063,11 @@ class GPTGenerator:
         blocks, wte, wpe = self.blocks, self.wte, self.wpe
         lnf_w, lnf_b = self.lnf_w, self.lnf_b
 
-        # prefill rides the Pallas flash kernel when the prompt shape
-        # fits the gate (same criteria as the training step); the decode
-        # loop stays XLA (single-token q has no tiling to win)
-        if self.use_flash is None:
-            use_flash = jax.default_backend() == "tpu"
-        else:
-            use_flash = self.use_flash
-        use_flash = use_flash and S_prompt % 128 == 0 and S_prompt >= 128 \
-            and cfg.head_dim <= 128
+        # prefill rides the Pallas flash kernel through the SAME gate as
+        # the training schedules; the decode loop stays XLA (a 1-row q
+        # has nothing to tile)
+        use_flash = flash_attention_gate(S_prompt, cfg.head_dim,
+                                         self.use_flash)
 
         def run(ids, key):
             # ---- prefill: full pass, capture KV per layer
