@@ -18,16 +18,28 @@ _DEFAULT_SEED = 34342423252
 
 
 class _GlobalGenerator:
+    """Global PRNG key, created LAZILY: materializing a key initializes
+    the XLA backend (on this stack: attaches the TPU), which must not
+    happen at ``import paddle_tpu`` — host-only processes (the launcher,
+    data-generator children, PS servers) import the package without ever
+    touching a device."""
+
     def __init__(self, seed: int = _DEFAULT_SEED):
-        self._key = jax.random.key(seed)
+        self._key = None
         self._seed = seed
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     def seed(self, s: int):
         self._seed = int(s)
-        self._key = jax.random.key(self._seed)
+        self._key = None  # lazily rematerialized: paddle.seed() in a
+        # host-only process must not attach a device either
 
     def split(self):
         """Return a fresh subkey, advancing the stateful global key."""
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 
@@ -35,6 +47,7 @@ class _GlobalGenerator:
         self._key = key
 
     def get_key(self):
+        self._ensure()
         return self._key
 
 

@@ -108,6 +108,9 @@ def test_pipe_command_runs_data_generator(tmp_path):
     gen = tmp_path / "gen.py"
     gen.write_text(textwrap.dedent(f"""
         import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # no TPU attach for a
+        # data-prep child (and survives a wedged/busy chip)
         sys.path.insert(0, {REPO!r})
         from paddle_tpu.distributed.fleet.data_generator import \\
             MultiSlotDataGenerator
@@ -216,6 +219,8 @@ def test_global_shuffle_reshards_disjoint_filelists(tmp_path, monkeypatch):
     child = tmp_path / "gs_child.py"
     child.write_text(textwrap.dedent(f"""
         import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # survive a wedged chip
         sys.path.insert(0, {REPO!r})
         import numpy as np
         from paddle_tpu.distributed import InMemoryDataset

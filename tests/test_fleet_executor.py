@@ -105,6 +105,8 @@ def test_cross_process_pipeline(tmp_path):
     child = tmp_path / "fe_child.py"
     child.write_text(textwrap.dedent(f"""
         import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # survive a wedged chip
         sys.path.insert(0, {REPO!r})
         from paddle_tpu.distributed import rpc
         from paddle_tpu.distributed.fleet_executor import (

@@ -24,6 +24,8 @@ def test_ps_service_end_to_end(tmp_path):
     server_script = tmp_path / "ps_server.py"
     server_script.write_text(textwrap.dedent("""
         import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # survive a wedged chip
         sys.path.insert(0, os.environ["REPO"])
         from paddle_tpu.distributed.ps import service
         rank = int(os.environ["PADDLE_TRAINER_ID"])

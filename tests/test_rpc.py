@@ -82,6 +82,8 @@ def test_rpc_two_processes(tmp_path):
     script = tmp_path / "rpc_child.py"
     script.write_text(textwrap.dedent("""
         import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # survive a wedged chip
         sys.path.insert(0, os.environ["REPO"])
         from paddle_tpu.distributed import rpc
 
