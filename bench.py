@@ -1,40 +1,166 @@
 """Benchmark harness — ONE JSON line PER BASELINE config for the driver.
 
-Default run covers all five BASELINE.md configs: ResNet50 (#1), BERT-base
-(#2), ERNIE-MoE (#5), GPT-1.3B (#3), and the headline GPT-345M last (#4's
-single-chip proxy). `vs_baseline` is this round's value over the previous
-round's recorded value (`_PREV`, from BENCH_r03 + the README measurement
-table) — >1.0 is a speedup; configs measured for the first time report 1.0.
-The reference publishes no in-tree numbers (BASELINE.json `published: {}`).
+Default run covers the BASELINE.md configs: ResNet50 (#1), BERT-base
+(#2), ERNIE-MoE (#5), GPT-1.3B (#3), the headline GPT-345M (#4's
+single-chip proxy), then the round-5 evidence rows — the 13B stage-shard
+proxy + 13B compile-only HBM probe (#4) and the GPTGenerator serving
+benchmark. `vs_baseline` is this round's value over the
+previous round's recorded value — read from the newest parseable
+`BENCH_r*.json` on disk, falling back to the measurement table below for
+metrics no artifact captured — so >1.0 is a speedup and first-ever
+measurements report 1.0. A CPU-fallback run suffixes every metric with
+`_cpu_smoke` so its numbers can never become TPU baselines. The
+reference publishes no in-tree numbers (BASELINE.json `published: {}`).
 
-Run: python bench.py                      # all five configs
+The harness must degrade, not die (VERDICT r4 #1): backend acquisition
+retries transient TPU-unavailable errors, falls back to a CPU smoke run,
+and a config that cannot run emits a `*_ERROR`/`*_SKIPPED` line while the
+rest of the sweep proceeds. Exit code is 0 whenever the sweep itself ran.
+
+Run: python bench.py                      # all configs
      python bench.py --model gpt --config 345m   # one config
 """
 import argparse
+import glob
 import json
+import os
+import re
 import sys
 import time
 import traceback
 
 import numpy as np
 
-# previous round's measured values (BENCH_r03.json + the README/COMPONENTS
-# measurement table, one v5e chip) — the vs_baseline denominators
-_PREV = {
+# fallback vs_baseline denominators for metrics no BENCH_r*.json artifact
+# captured (the driver keeps only the output tail, so older metrics may
+# be absent on disk) — measured values, one v5e chip
+_PREV_FALLBACK = {
     "gpt_345m_tokens_per_sec_per_chip": 42974.6,   # BENCH_r03.json
     "bert_base_tokens_per_sec_per_chip": 60200.0,  # README 2026-07-30
     "resnet50_imgs_per_sec_per_chip": 1692.0,      # README 2026-07-30
     "ernie_moe_tokens_per_sec_per_chip": 59900.0,  # README 2026-07-30
-    # gpt_1p3b: first-ever measurement in r4 (no denominator)
+    "gpt_1p3b_tokens_per_sec_per_chip": 12200.0,   # README 2026-07-31 (r4)
 }
 
 
+def _load_prev(repo_dir=os.path.dirname(os.path.abspath(__file__))):
+    """vs_baseline denominators: every metric line recoverable from the
+    BENCH_r*.json artifacts on disk, newest round winning; the hardcoded
+    fallback table covers metrics whose artifact tail was truncated."""
+    prev = dict(_PREV_FALLBACK)
+    rounds = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rounds.append((int(m.group(1)), doc))
+    for _, doc in sorted(rounds):  # ascending: newer rounds overwrite
+        lines = [ln for ln in str(doc.get("tail", "")).splitlines()]
+        if isinstance(doc.get("parsed"), dict):
+            lines.append(json.dumps(doc["parsed"]))
+        for ln in lines:
+            ln = ln.strip()
+            if not (ln.startswith("{") and '"metric"' in ln):
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            metric, value = rec.get("metric"), rec.get("value")
+            device = str((rec.get("extras") or {}).get("device", ""))
+            if (isinstance(metric, str) and isinstance(value, (int, float))
+                    and value > 0
+                    and not metric.endswith(("_ERROR", "_SKIPPED"))
+                    and "_cpu_smoke" not in metric
+                    and "cpu" not in device.lower()):
+                # CPU-fallback numbers must never become the TPU
+                # denominator (they would fabricate 30-100x "speedups")
+                prev[metric] = float(value)
+    return prev
+
+
+_PREV = _load_prev()
+_CPU_SMOKE = False  # set when the sweep fell back to the CPU backend
+
+
 def emit(metric, value, unit, extras):
+    if _CPU_SMOKE:
+        metric += "_cpu_smoke"  # never comparable to (or adopted as) TPU
     prev = _PREV.get(metric)
     vs = round(value / prev, 4) if prev else 1.0
     print(json.dumps({"metric": metric, "value": round(value, 1),
                       "unit": unit, "vs_baseline": vs, "extras": extras}),
           flush=True)
+
+
+def emit_skip(metric, why):
+    print(json.dumps({"metric": f"{metric}_SKIPPED", "value": 0.0,
+                      "unit": "skipped", "vs_baseline": 0.0,
+                      "extras": {"reason": why}}), flush=True)
+
+
+def _probe_backend_subprocess(timeout_s):
+    """First TPU contact happens in a THROWAWAY subprocess: on a wedged
+    tunnel ``jax.devices()`` can HANG (not raise — observed live, and the
+    r4 outage raised only after a long stall), and a hang in the bench
+    process zeroes the whole artifact. A subprocess we can kill turns
+    both failure modes into a clean boolean."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        out = (r.stdout or "").strip().splitlines()
+        return r.returncode == 0 and bool(out), out[-1] if out else ""
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+    except Exception as e:  # noqa: BLE001
+        return False, repr(e)[:120]
+
+
+def acquire_devices(retries=2, wait_s=15.0, probe_timeout=150.0):
+    """Backend acquisition that degrades instead of dying (VERDICT r4 #1:
+    a transient TPU-backend outage zeroed the whole r4 sweep). Probes the
+    default (TPU) backend out-of-process with a timeout + retries, then
+    falls back to CPU — via jax.config, because the axon sitecustomize
+    force-selects TPU and ignores the JAX_PLATFORMS env var. Returns a
+    device list or None if even CPU is unreachable."""
+    import jax
+
+    for attempt in range(retries):
+        ok, detail = _probe_backend_subprocess(probe_timeout)
+        if ok:
+            try:
+                return jax.devices()
+            except Exception as e:
+                detail = repr(e)[:200]
+                try:
+                    from jax._src import xla_bridge as xb
+                    xb._clear_backends()  # drop the cached init failure
+                except Exception:
+                    pass
+        print(f"bench: backend attempt {attempt + 1}/{retries} failed: "
+              f"{detail}", file=sys.stderr, flush=True)
+        if attempt + 1 < retries:
+            time.sleep(wait_s)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as xb
+        xb._clear_backends()
+        devs = jax.devices()
+        print("bench: TPU unavailable — CPU smoke fallback",
+              file=sys.stderr, flush=True)
+        return devs
+    except Exception as e:
+        print(f"bench: no backend at all: {e!r}"[:300],
+              file=sys.stderr, flush=True)
+        return None
 
 
 def model_flops_per_token(cfg, seq_len):
@@ -378,10 +504,201 @@ def bench_gpt(args, config_name=None):
          })
 
 
+def bench_serving(args):
+    """Serving/decode benchmark (VERDICT r4 #6): GPTGenerator at 345M —
+    flash prefill tokens/sec (ragged prompt length exercises the
+    pad-to-block path) and per-token cached-decode latency. The serving
+    role of reference inference/api/analysis_predictor.cc + its fused
+    decode attention."""
+    import jax
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTGenerator,
+                                       GPTModel, gpt_345m_config,
+                                       gpt_tiny_config)
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg, B, S_prompt, max_new = gpt_tiny_config(), 1, 48, 8
+    else:
+        cfg = gpt_345m_config(max_position_embeddings=1024, num_heads=8)
+        # ragged prompt (not a 128-multiple): rides the padded flash path
+        B, S_prompt, max_new = 4, 937, 64
+
+    import contextlib
+    try:
+        host = jax.devices("cpu")[0] if not on_cpu else None
+    except RuntimeError:
+        host = None
+    with jax.default_device(host) if host is not None \
+            else contextlib.nullcontext():
+        model = GPTForPretraining(GPTModel(cfg))
+    gen = GPTGenerator(model, temperature=0.0)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (B, S_prompt)).astype(np.int32)
+
+    def timed(max_new_tokens, reps):
+        out = gen(ids, max_new_tokens=max_new_tokens)  # compile + warm
+        np.asarray(out.numpy()[0, -1])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = gen(ids, max_new_tokens=max_new_tokens)
+        np.asarray(out.numpy()[0, -1])  # host readback = true barrier
+        return (time.perf_counter() - t0) / reps
+
+    reps = 3
+    t_prefill = timed(1, reps)          # prefill + 1 sampled token
+    t_full = timed(max_new, reps)       # prefill + max_new tokens
+    decode_ms = 1e3 * (t_full - t_prefill) / max(max_new - 1, 1)
+    prefill_tps = B * S_prompt / t_prefill
+    emit("gpt_345m_prefill_tokens_per_sec_per_chip", prefill_tps,
+         "tokens/s/chip",
+         {"batch": B, "prompt_len": S_prompt, "ragged": S_prompt % 128 != 0,
+          "reps": reps})
+    emit("gpt_345m_decode_ms_per_token", decode_ms, "ms/token",
+         {"batch": B, "prompt_len": S_prompt, "max_new": max_new,
+          "note": "lower is better; vs_baseline>1 means SLOWER"})
+
+
+def bench_gpt_13b_stage_proxy(args):
+    """BASELINE #4 single-chip evidence (VERDICT r4 #2a): one pp-stage x
+    mp-slice of gpt_13b_config under mp=4 x pp=4 — 10 layers of H=5120
+    with this chip's 10-of-40 heads (d=128) and F/4 FFN slice, ~0.79B
+    params/chip — run as the 1F1B per-tick compute (fwd + per-tick vjp,
+    per-block remat) + the AdamW slice update. Excludes the CE head and
+    inter-chip collectives (mid-stage chip; noted in extras)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import gpt_13b_config, gpt_block
+
+    cfg = gpt_13b_config()
+    mp, pp = 4, 4
+    L_stage = cfg.num_layers // pp           # 10
+    nh_loc = cfg.num_heads // mp             # 10 heads (d=128)
+    d = cfg.head_dim
+    H = cfg.hidden_size                      # 5120 (global)
+    F_loc = cfg.intermediate_size // mp      # 5120
+    mb = args.batch or 1
+    S = args.seq or cfg.max_position_embeddings
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        L_stage, H, nh_loc, d, F_loc, S = 2, 64, 2, 32, 128, 128
+
+    rng = np.random.default_rng(0)
+    bf = jnp.bfloat16
+    mk = lambda *shape: jnp.asarray(
+        rng.standard_normal(shape).astype(np.float32) * 0.02, bf)
+    blocks = {
+        "ln1_w": jnp.ones((L_stage, H), bf),
+        "ln1_b": jnp.zeros((L_stage, H), bf),
+        "wqkv": mk(L_stage, H, 3, nh_loc, d),
+        "bqkv": jnp.zeros((L_stage, 3, nh_loc, d), bf),
+        "wo": mk(L_stage, nh_loc, d, H),
+        "bo": jnp.zeros((L_stage, H), bf),
+        "ln2_w": jnp.ones((L_stage, H), bf),
+        "ln2_b": jnp.zeros((L_stage, H), bf),
+        "w1": mk(L_stage, H, F_loc), "b1": jnp.zeros((L_stage, F_loc), bf),
+        "w2": mk(L_stage, F_loc, H), "b2": jnp.zeros((L_stage, H), bf),
+    }
+    moments = {k: (jnp.zeros_like(v), jnp.zeros_like(v))
+               for k, v in blocks.items()}
+    eps = cfg.layer_norm_epsilon
+    use_flash = not on_cpu
+
+    def stage_fwd(bl, x):
+        blk = jax.checkpoint(  # per-block remat: the 1F1B+remat config
+            lambda p, xx: gpt_block(p, xx, eps, use_flash=use_flash),
+            prevent_cse=False)
+        out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, bl)
+        return out
+
+    @jax.jit
+    def tick(bl, mom, x, cot):
+        # the 1F1B steady-state per-tick work: one stage forward AND one
+        # stage backward (vjp from the saved input), then the Adam update
+        y, vjp = jax.vjp(stage_fwd, bl, x)
+        db, dx = vjp(cot)
+        def upd(p, g, mv):
+            m, v = mv
+            g32 = g.astype(jnp.float32)
+            m2 = 0.9 * m.astype(jnp.float32) + 0.1 * g32
+            v2 = 0.95 * v.astype(jnp.float32) + 0.05 * jnp.square(g32)
+            p2 = p.astype(jnp.float32) - 1e-4 * m2 / (jnp.sqrt(v2) + 1e-8)
+            return p2.astype(p.dtype), (m2.astype(m.dtype),
+                                        v2.astype(v.dtype))
+        new_bl, new_mom = {}, {}
+        for k in bl:
+            new_bl[k], new_mom[k] = upd(bl[k], db[k], mom[k])
+        return y, new_bl, new_mom
+
+    x = jnp.asarray(rng.standard_normal((mb, S, H)).astype(np.float32), bf)
+    cot = jnp.ones((mb, S, H), bf)
+
+    y, blocks, moments = tick(blocks, moments, x, cot)  # compile
+    np.asarray(y[0, 0, 0])
+    steps = args.steps
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        y, blocks, moments = tick(blocks, moments, x, cot)
+    np.asarray(y[0, 0, 0])
+    dt = time.perf_counter() - t0
+
+    tps = mb * S * steps / dt
+    per_layer = (H * 3 * nh_loc * d) + (nh_loc * d * H) \
+        + (H * F_loc) + (F_loc * H)
+    n_params = L_stage * per_layer
+    # 6N matmul flops (fwd 2N + bwd 4N) + remat refwd 2N = 8N, + attention
+    flops_per_token = 8 * n_params + 12 * L_stage * nh_loc * d * S
+    mfu = tps * flops_per_token / peak_flops_per_chip()
+    emit("gpt_13b_stage_proxy_tokens_per_sec_per_chip", tps,
+         "tokens/s/chip",
+         {"mfu": round(mfu, 4), "params_per_chip": n_params,
+          "mesh": "mp4 x pp4 slice", "layers_per_stage": L_stage,
+          "micro_batch": mb, "seq": S, "steps": steps,
+          "remat": "full", "dtype": "bf16 params+moments",
+          "excludes": "CE head + inter-chip collectives (mid-stage)"})
+
+
+def bench_gpt_13b_compile(args):
+    """BASELINE #4 compile-only evidence (VERDICT r4 #2b): the FULL 13B
+    hybrid step (mp=4 x pp=4, 1F1B + remat, bf16 storage) lowered and
+    compiled on a 16-way virtual mesh via tools/mem_probe.py; emits XLA's
+    per-device memory_analysis."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(repo, "tools", "mem_probe.py"),
+           "--config", "13b", "--mp", "4", "--pp", "4",
+           "--batch", "16", "--seq", "2048", "--n-micro", "16",
+           "--schedules", "1f1b", "--remat", "full",
+           "--param-dtype", "bfloat16", "--moment-dtype", "bfloat16"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1500)
+    rec = None
+    for ln in r.stdout.splitlines():
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue
+        if doc.get("schedule") == "1f1b" and "peak_hbm_gb" in doc:
+            rec = doc
+    if rec is None:
+        raise RuntimeError(
+            f"mem_probe produced no 13B record: rc={r.returncode} "
+            f"stderr={r.stderr[-400:]}")
+    emit("gpt_13b_hybrid_peak_hbm_gb_per_device", rec["peak_hbm_gb"],
+         "GiB/device",
+         {"temp_gb": rec["temp_gb"], "argument_gb": rec["argument_gb"],
+          "mesh": "mp4 x pp4 (16 virtual devices)", "n_micro": 16,
+          "batch": 16, "seq": 2048, "schedule": "1f1b", "remat": True,
+          "dtype": "bf16 masters+moments",
+          "fits_16gb_chip": bool(rec["peak_hbm_gb"] <= 15.75),
+          "note": "compile-only (AOT memory_analysis); lower is better"})
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="all",
-                    choices=["all", "gpt", "resnet50", "bert", "ernie-moe"])
+                    choices=["all", "gpt", "resnet50", "bert", "ernie-moe",
+                             "serving", "13b-proxy", "13b-compile"])
     ap.add_argument("--config", default="345m",
                     choices=["tiny", "345m", "1.3b"])
     ap.add_argument("--steps", type=int, default=10)
@@ -395,25 +712,52 @@ def main():
     args = ap.parse_args()
     sys.path.insert(0, ".")
 
-    if args.model == "resnet50":
-        return bench_resnet50(args)
-    if args.model == "bert":
-        return bench_bert(args)
-    if args.model == "ernie-moe":
-        return bench_ernie_moe(args)
-    if args.model == "gpt":
-        return bench_gpt(args)
+    devices = acquire_devices()
+    single = {"resnet50": bench_resnet50, "bert": bench_bert,
+              "ernie-moe": bench_ernie_moe, "gpt": bench_gpt,
+              "serving": bench_serving,
+              "13b-proxy": bench_gpt_13b_stage_proxy,
+              "13b-compile": bench_gpt_13b_compile}
+    if devices is None:
+        gpt_name = f"gpt_{args.config.replace('.', 'p')}"
+        names = ([gpt_name if args.model == "gpt"
+                  else args.model.replace("-", "_")]
+                 if args.model in single
+                 else ["resnet50", "bert", "ernie_moe", "gpt_1p3b",
+                       "gpt_345m", "gpt_13b_stage_proxy", "serving"])
+        for name in names:
+            emit_skip(name, "no jax backend available (TPU and CPU init "
+                            "both failed after retries)")
+        return  # exit 0: the harness ran; the environment did not
 
-    # default: ALL five BASELINE configs, one JSON line each; a failing
-    # config reports an error line and the rest still run (the headline
-    # GPT-345M goes last so a last-line-only parser still sees it)
-    import jax
-    on_cpu = jax.devices()[0].platform == "cpu"
+    global _CPU_SMOKE
+    _CPU_SMOKE = devices[0].platform == "cpu"
+
+    if args.model in single:
+        return single[args.model](args)
+
+    # default: ALL BASELINE configs, one JSON line each; a failing config
+    # reports an error line and the rest still run. The driver records
+    # only the output TAIL, which truncation eats from the FRONT — so
+    # the headline GPT-345M goes LAST (a truncated capture still has it,
+    # and last-line parsers see it); the bounded-by-timeout 13B compile
+    # probe sits just before it.
+    on_cpu = _CPU_SMOKE
     runs = [("resnet50", lambda: bench_resnet50(args)),
             ("bert", lambda: bench_bert(args)),
             ("ernie_moe", lambda: bench_ernie_moe(args))]
-    if not on_cpu:
+    if on_cpu:
+        emit_skip("gpt_1p3b", "CPU backend: 1.3B needs the 16GB TPU chip")
+    else:
         runs.append(("gpt_1p3b", lambda: bench_gpt(args, "1.3b")))
+    runs.append(("gpt_13b_stage_proxy",
+                 lambda: bench_gpt_13b_stage_proxy(args)))
+    runs.append(("serving", lambda: bench_serving(args)))
+    if on_cpu:
+        emit_skip("gpt_13b_hybrid_peak_hbm",
+                  "CPU smoke run: skipping the 25-min 13B AOT compile")
+    else:
+        runs.append(("gpt_13b_compile", lambda: bench_gpt_13b_compile(args)))
     runs.append(("gpt_345m", lambda: bench_gpt(args, "345m")))
     for name, fn in runs:
         try:
@@ -428,4 +772,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # the sweep must never zero the artifact
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "bench_ERROR", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "extras": {"error": repr(e)[:300]}}), flush=True)
+    sys.exit(0)
