@@ -20,15 +20,21 @@ import jax as _jax
 if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
         and _os.environ.get("PADDLE_TRAINER_ENDPOINTS") \
         and "PADDLE_LOCAL_RANK" in _os.environ \
+        and "_PADDLE_TPU_BOOTSTRAPPED" not in _os.environ \
         and not _jax.distributed.is_initialized():
     # PADDLE_LOCAL_RANK marks a launcher-SPAWNED worker: stale shell
     # exports of the other contract vars must not hijack an unrelated
-    # process (e.g. the launcher itself) into the coordination service
+    # process (e.g. the launcher itself) into the coordination service.
+    # _PADDLE_TPU_BOOTSTRAPPED (set below, inherited by ANY subprocess a
+    # worker spawns — pipe-command data generators, PS servers) keeps
+    # those children from re-joining the coordination service with a
+    # duplicate process_id on import.
     _jax.distributed.initialize(
         coordinator_address=_os.environ["PADDLE_TRAINER_ENDPOINTS"]
         .split(",")[0],
         num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
         process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _os.environ["_PADDLE_TPU_BOOTSTRAPPED"] = "1"
 
 # Paddle dtype semantics need real int64/float64 (python ints -> int64 tensors).
 # Weak typing keeps python scalars from promoting compute dtypes, and all perf-path

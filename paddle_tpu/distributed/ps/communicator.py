@@ -59,15 +59,26 @@ class Communicator:
         if self._running:
             return
         self._running = True
+        self._flushed = False
         self._thread = threading.Thread(target=self._send_loop, daemon=True,
                                         name="ps-communicator")
         self._thread.start()
 
     def stop(self):
-        if not self._running:
+        if self._thread is None or getattr(self, "_flushed", False):
             return
-        self._running = False
+        self._running = False  # request thread exit (idempotent)
         self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # a wedged send thread may still be inside _flush_batch;
+            # draining here too would interleave pushes and corrupt the
+            # queue's task accounting — surface it instead. _flushed
+            # stays False, so a RETRY of stop() re-joins and can still
+            # flush once the thread finally exits.
+            raise RuntimeError(
+                "communicator send thread did not exit within 30s; "
+                "queued pushes were NOT flushed (retry stop())")
+        self._flushed = True
         self._flush_batch(self._drain_queue())
 
     def flush(self, timeout=30):

@@ -177,15 +177,33 @@ class Model:
             # current batch, so the whole accumulation window must stay on
             # the eager path — disable parallel for this Model run
             self._no_parallel = True
-        if update and not self._no_parallel and self._use_parallel():
+        use_parallel = (update and not self._no_parallel
+                        and self._use_parallel())
+        if not getattr(self, "_adapter_logged", False):
+            # say which path runs ONCE, so a user profiling fit on a mesh
+            # can tell compiled-parallel from the eager fallback
+            self._adapter_logged = True
+            why = ("compiled-parallel" if use_parallel else
+                   "eager (update=False window)" if self._no_parallel else
+                   "eager (AMP scaler)" if self._scaler is not None else
+                   "eager (metrics need per-batch semantics)"
+                   if self._metrics and self._parallel is None else
+                   "eager (no multi-device mesh)")
+            import logging
+            logging.getLogger("paddle_tpu.hapi").info(
+                "Model.train_batch adapter: %s", why)
+        if use_parallel:
             step = self._get_parallel_step(len(inputs))
+            if self._metrics:
+                # metrics under the compiled path: one no-grad forward
+                # BEFORE step() so they score pre-update parameters, like
+                # the eager path scores the forward that produced the loss
+                with tape_mod.no_grad_guard():
+                    outputs = _to_list(self.network(*inputs))
             loss = step(*(inputs + labels))
             lv = [float(np.asarray(loss._value))]
             if not self._metrics:
                 return lv
-            # metrics under the compiled path: one no-grad eval forward
-            with tape_mod.no_grad_guard():
-                outputs = _to_list(self.network(*inputs))
             metrics = [m.update(*_to_list(m.compute(*(outputs + labels))))
                        for m in self._metrics]
             return (lv, metrics)

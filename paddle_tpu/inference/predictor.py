@@ -151,6 +151,7 @@ class Predictor:
                     and np.shape(v)[0] == b)
 
         chunks = []
+        out_batched = None
         for lo in range(0, b, B0):
             part = [np.asarray(v)[lo:lo + B0] if is_batched(i, v)
                     else np.asarray(v) for i, v in enumerate(vals)]
@@ -160,8 +161,27 @@ class Predictor:
                         for i, v in enumerate(part)]
             out = self._layer(*part)
             outs = out if isinstance(out, (tuple, list)) else [out]
-            chunks.append([o.numpy()[:n] for o in outs])
+            outs = [np.asarray(o.numpy()) if hasattr(o, "numpy")
+                    else np.asarray(o) for o in outs]
+            if out_batched is None:
+                # outputs whose leading dim is NOT the exported batch
+                # (scalar aggregates, global stats) pass through from one
+                # chunk unsliced instead of being truncated/concatenated
+                out_batched = [o.ndim >= 1 and o.shape[0] == B0
+                               for o in outs]
+                if not all(out_batched) and b > B0:
+                    import warnings
+                    warnings.warn(
+                        "Predictor: request batch exceeds the exported "
+                        "batch and the program has non-batched outputs; "
+                        "those reflect the FIRST exported-batch chunk "
+                        "only, not the whole request. Export with a "
+                        "larger batch or drop the aggregate output for "
+                        "chunked serving.", stacklevel=3)
+            chunks.append([o[:n] if out_batched[i] else o
+                           for i, o in enumerate(outs)])
         return [np.concatenate([c[i] for c in chunks])
+                if out_batched[i] else chunks[0][i]
                 for i in range(len(chunks[0]))]
 
     def get_input_names(self):

@@ -265,26 +265,37 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     x: [..., M, N] packed LU; y: [..., min(M,N)] 1-based pivot indices
     (sequential row swaps, LAPACK getrf convention). Returns (P, L, U)
     with P [..., M, M], L [..., M, K], U [..., K, N], K = min(M, N).
+    ``unpack_ludata=False`` returns (P, None, None); ``unpack_pivots=False``
+    returns None for P. Pivot unpacking materializes y on the host (the
+    sequential-swap permutation build is host-side by design), so it is
+    not jit-traceable over y; L/U unpacking is pure jnp and traces fine.
     """
     v = unwrap(x)
-    piv = np.asarray(unwrap(y)) - 1  # 0-based
     M, N = v.shape[-2], v.shape[-1]
     K = min(M, N)
 
-    def unpack_p(p1):
-        perm = np.arange(M)
-        for i, pi in enumerate(p1):
-            perm[i], perm[pi] = perm[pi], perm[i]
-        P = np.zeros((M, M), np.float32)
-        P[perm, np.arange(M)] = 1.0
-        return P
+    P = None
+    if unpack_pivots:
+        piv = np.asarray(unwrap(y)) - 1  # 0-based; host-side (see doc)
 
-    if piv.ndim == 1:
-        P = unpack_p(piv)
-    else:
-        flat = piv.reshape(-1, piv.shape[-1])
-        P = np.stack([unpack_p(p) for p in flat]).reshape(
-            piv.shape[:-1] + (M, M))
+        def unpack_p(p1):
+            perm = np.arange(M)
+            for i, pi in enumerate(p1):
+                perm[i], perm[pi] = perm[pi], perm[i]
+            Pm = np.zeros((M, M), np.float32)
+            Pm[perm, np.arange(M)] = 1.0
+            return Pm
+
+        if piv.ndim == 1:
+            Pn = unpack_p(piv)
+        else:
+            flat = piv.reshape(-1, piv.shape[-1])
+            Pn = np.stack([unpack_p(p) for p in flat]).reshape(
+                piv.shape[:-1] + (M, M))
+        P = wrap(jnp.asarray(Pn, np.asarray(v).dtype))
+
+    if not unpack_ludata:
+        return P, None, None
 
     def f(lu_v):
         L = jnp.tril(lu_v[..., :, :K], -1) + jnp.eye(M, K, dtype=lu_v.dtype)
@@ -292,4 +303,4 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         return L, U
 
     L, U = apply(f, x, op_name="lu_unpack")
-    return wrap(jnp.asarray(P, np.asarray(v).dtype)), L, U
+    return P, L, U

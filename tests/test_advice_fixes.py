@@ -270,3 +270,158 @@ def test_jit_apply_preserves_param_dtype():
     new_vals, _ = o._jit_apply([p], [p._value], [g],
                                lr=jnp.asarray(0.1, jnp.float32))
     assert new_vals[0].dtype == jnp.float16
+
+
+# ---------------------------------------------------------------- round 4
+
+def test_lu_unpack_honors_flags():
+    """ADVICE r4: lu_unpack must honor unpack_ludata/unpack_pivots."""
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+    P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+    assert L2 is None and U2 is None
+    np.testing.assert_allclose(P2.numpy(), P.numpy())
+
+    P3, L3, U3 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
+    assert P3 is None
+    np.testing.assert_allclose(L3.numpy(), L.numpy())
+    np.testing.assert_allclose(U3.numpy(), U.numpy())
+
+
+def test_predictor_non_batched_output_passthrough(tmp_path):
+    """ADVICE r4: chunked serving must not truncate/mis-concat outputs
+    whose leading dim is not the batch (scalar aggregates)."""
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit.save_load import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 2)
+
+        def forward(self, x):
+            y = self.lin(x)
+            return y, y.mean()  # second output: scalar aggregate
+
+    net = Net()
+    path = str(tmp_path / "nbout_model")
+    jit.save(net, path, input_spec=[InputSpec([4, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((10, 4)).astype(np.float32)  # > exported 4
+    y, agg = pred.run([x])
+    assert y.shape == (10, 2)
+    # the scalar output passes through from one chunk, unsliced
+    assert np.ndim(agg) == 0 or agg.shape == ()
+
+
+def test_communicator_stop_wedged_thread_raises():
+    """ADVICE r4: stop() must not flush concurrently with a wedged send
+    thread."""
+    import threading
+    from paddle_tpu.distributed.ps.communicator import Communicator
+
+    class _Client:
+        def push_sparse_grad(self, *a, **k):
+            pass
+
+        def push_dense_grad(self, *a, **k):
+            pass
+
+    comm = Communicator(_Client())
+    comm._running = True
+    release = threading.Event()
+    wedged = threading.Thread(target=release.wait, daemon=True)
+    wedged.start()
+    comm._thread = wedged
+    orig_join = wedged.join
+    comm._thread.join = lambda timeout=None: orig_join(timeout=0.05)
+    try:
+        with pytest.raises(RuntimeError, match="did not exit"):
+            comm.stop()
+    finally:
+        release.set()
+
+
+def test_hapi_parallel_metrics_pre_update():
+    """ADVICE r4: forced-parallel train_batch metrics must score the
+    pre-update parameters (same contract as the eager path)."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric.metrics import Metric
+
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    try:
+        HybridCommunicateGroup(dp_degree=2, mp_degree=1, pp_degree=1)
+
+        class CaptureMetric(Metric):
+            def __init__(self):
+                self.seen = None
+
+            def name(self):
+                return "capture"
+
+            def compute(self, pred, label):
+                self.seen = np.asarray(pred.numpy()).copy()
+                return pred
+
+            def update(self, *a):
+                return 0.0
+
+            def reset(self):
+                pass
+
+            def accumulate(self):
+                return 0.0
+
+        net = nn.Linear(4, 2)
+        w0 = net.weight.numpy().copy()
+        b0 = net.bias.numpy().copy()
+        x = np.random.default_rng(4).standard_normal((4, 4)).astype(np.float32)
+        y = np.zeros((4, 2), np.float32)
+        cap = CaptureMetric()
+        m = Model(net)
+        m.prepare(optimizer=opt.SGD(learning_rate=10.0,
+                                    parameters=net.parameters()),
+                  loss=lambda p, t: ((p - t) ** 2).mean(),
+                  metrics=[cap], parallel=True)
+        m.train_batch([x], [y])
+        # the metric saw outputs of the ORIGINAL weights, not post-update
+        pre = x @ w0.T if w0.shape[0] == 2 else x @ w0
+        pre = pre + b0
+        np.testing.assert_allclose(cap.seen, pre, rtol=1e-4, atol=1e-5)
+        # and the step really updated (lr=10 moves weights a lot)
+        assert np.abs(net.weight.numpy() - w0).max() > 0.1
+    finally:
+        mesh_mod._global_mesh, mesh_mod._hcg = None, None
+
+
+def test_bootstrap_guard_blocks_child_processes():
+    """ADVICE r4: a subprocess inheriting the launch contract env vars
+    plus _PADDLE_TPU_BOOTSTRAPPED must NOT try to join the coordination
+    service on import (a dead coordinator would hang/fail it)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINERS_NUM": "2",
+        "PADDLE_TRAINER_ENDPOINTS": "127.0.0.1:1,127.0.0.1:2",  # dead
+        "PADDLE_LOCAL_RANK": "0",
+        "PADDLE_TRAINER_ID": "0",
+        "_PADDLE_TPU_BOOTSTRAPPED": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    r = subprocess.run(
+        [_sys.executable, "-c",
+         "import jax, paddle_tpu; "
+         "assert not jax.distributed.is_initialized(); print('ok')"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0 and "ok" in r.stdout, r.stderr[-2000:]
