@@ -61,14 +61,71 @@ def opt(thunk):
         return UNDEFINED
 
 
+def _fill_ret_placeholders(inits, names, probe, ph_all=False):
+    """The lax analog of the reference's RETURN_NO_VALUE constant
+    (return_transformer.py): a transformer-generated ``_retval_*`` carry
+    that is still unbound gets a zeros placeholder of the value the
+    branch/body would produce, so lax.cond/while_loop carry types unify.
+    Safe ONLY for these names — the ``_retflag_*`` guard discipline
+    guarantees the placeholder is never read. ``ph_all=True`` (return-
+    rewrite guard continuations) widens this to every unbound name: on
+    the skip path the original program had returned, so anything the
+    continuation assigns is dead afterwards. ``probe()`` runs the
+    branch(es)/body once to discover the defined side's aval."""
+    idxs = [i for i, n in enumerate(names or ())
+            if (ph_all or n.startswith("_retval_"))
+            and i < len(inits) and inits[i] is UNDEFINED]
+    if not idxs:
+        return inits
+    inits = list(inits)
+    for outs in probe():
+        outs = tuple(outs) if isinstance(outs, (tuple, list)) else (outs,)
+        for i in list(idxs):
+            if i < len(outs) and outs[i] is not UNDEFINED \
+                    and outs[i] is not None:
+                from ... import ops as _ops
+                inits[i] = _ops.zeros_like(outs[i])
+                idxs.remove(i)
+    return tuple(inits)
+
+
+def ret_out(flag, val_thunk, may_falloff=False):
+    """Final return of a return-rewritten function
+    (return_transformer.py:126). Python flag: exact python semantics
+    (None when no return executed). Traced flag: the guarded selects
+    already merged every return site into the value — unless the
+    function may also fall off the end, a None/Tensor union lax cannot
+    type."""
+    v = opt(val_thunk)
+    if _is_traced(flag):
+        if may_falloff:
+            from .transformer import Dy2StaticError
+            raise Dy2StaticError(
+                "dy2static: function may fall off the end while an "
+                "early return depends on a tensor — add an unconditional "
+                "final return")
+        return v
+    fv = bool(unwrap(flag)) if isinstance(flag, Tensor) else bool(flag)
+    if not fv or v is UNDEFINED:
+        return None
+    return v
+
+
 def convert_ifelse(pred, true_fn, false_fn, inits=(), n_outs=None,
-                   names=None):
+                   names=None, ret_guard=False):
     """Branch; branch fns take the union of branch-assigned names as
     parameters (initial values in ``inits``) and return them as a tuple —
     the transformer wires the assignment back. ``n_outs`` fixes the
-    arity of the assignment form (static.nn.cond collapses 1-tuples)."""
+    arity of the assignment form (static.nn.cond collapses 1-tuples).
+    ``ret_guard`` marks a return-rewrite guard continuation (see
+    ``_fill_ret_placeholders``)."""
     if _is_traced(pred):
         from ...static.nn import cond
+
+        inits = _fill_ret_placeholders(
+            inits, names,
+            lambda: (true_fn(*inits), false_fn(*inits)),
+            ph_all=ret_guard)
 
         def run(fn, branch):
             out = fn(*inits)
@@ -106,6 +163,8 @@ def convert_while_loop(cond_fn, body_fn, init_vars, names=None):
         out = body_fn(*vals)
         vals = tuple(out) if isinstance(out, (tuple, list)) else (out,)
         probe = cond_fn(*vals)
+    vals = _fill_ret_placeholders(vals, names,
+                                  lambda: (body_fn(*vals),))
     _check_defined(vals, names, "while loop")
     from ...static.nn import while_loop
     out = while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)), list(vals))

@@ -18,11 +18,25 @@ transformed.
 folded into the loop test, continue -> flag guarding the rest of the
 iteration — so break-carrying loops still become ``lax.while_loop``.
 
-Degradation contract: constructs lax cannot express — ``return`` inside
-a loop, mixed return/assign branches — stay plain python (correct for
-python conditions; tensor conditions then surface the standard trace
-error at that location). Single-return-per-branch ``if/else`` IS
-converted, to ``return convert_ifelse(...)``.
+Early returns — ``return`` inside a loop, mixed return/assign branches —
+lower through the RETURN flag rewrite (reference
+``return_transformer.py:126 ReturnTransformer``): each ``return expr``
+becomes ``_retflag = True; _retval = expr`` (+ ``break`` inside loops,
+cascading outward), statements after a potential return are guarded by
+``if not _retflag``, and the function ends with one
+``return _jst.ret_out(...)``. The convert operators substitute a
+zeros placeholder for a not-yet-bound ``_retval_*`` carry (the lax
+analog of the reference's RETURN_NO_VALUE constant), which is safe
+because the flag discipline guarantees the placeholder is never
+selected.
+
+Degradation contract (what still stays plain python): ``return`` inside
+``try``/``with``-with-handlers, loops with ``else`` clauses carrying
+returns, and functions that may fall off the end while a
+tensor-dependent early return exists (a None/Tensor union lax cannot
+type) — the last raises a descriptive error instead of mis-lowering.
+Single-return-per-branch ``if/else`` converts directly to
+``return convert_ifelse(...)`` without the flag machinery.
 """
 from __future__ import annotations
 
@@ -152,6 +166,160 @@ def _has_return(stmts):
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _pure_return_if(st):
+    """`if` whose every leaf is a bare Return (possibly an elif chain) —
+    visit_If already converts these to `return convert_ifelse(...)`."""
+    def pure(stmts):
+        if len(stmts) != 1:
+            return False
+        s = stmts[0]
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If):
+            return pure(s.body) and pure(s.orelse)
+        return False
+    return pure([st])
+
+
+def _returns_need_rewrite(stmts):
+    """True when a return exists that the base transforms can't express:
+    inside a loop, or in an `if` that isn't a pure-return chain."""
+    for st in stmts:
+        if isinstance(st, (ast.While, ast.For)):
+            if _has_return(st.body) or _has_return(st.orelse):
+                return True
+        elif isinstance(st, ast.If):
+            if (_has_return(st.body) or _has_return(st.orelse)) \
+                    and not _pure_return_if(st):
+                return True
+        elif isinstance(st, (ast.With, ast.Try)):
+            if _has_return([st]):
+                return True
+    return False
+
+
+class _ReturnBlockers(ast.NodeVisitor):
+    """Shapes the flag rewrite must not touch: returns inside try (the
+    handler dataflow is python-only) and loops with `else` clauses whose
+    semantics the injected `break` would change."""
+
+    def __init__(self):
+        self.blocked = False
+
+    def visit_Try(self, node):
+        if _has_return([node]):
+            self.blocked = True
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if node.orelse and _has_return([node]):
+            self.blocked = True
+        self.generic_visit(node)
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _return_rewrite_blocked(stmts):
+    v = _ReturnBlockers()
+    for s in stmts:
+        v.visit(s)
+    return v.blocked
+
+
+def _guarantees_return(stmts):
+    """Conservative all-paths-return analysis (tail statement only)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return _guarantees_return(last.body) \
+            and _guarantees_return(last.orelse)
+    return False
+
+
+def _rewrite_early_returns(stmts, flag, val):
+    """The ReturnTransformer core (reference return_transformer.py:126):
+    ``return expr`` -> flag+value assignment (+ ``break`` cascading out
+    of enclosing loops); statements after a potential return are guarded
+    by ``if not flag``. Returns (new_stmts, changed). Call only after
+    ``_return_rewrite_blocked`` said no."""
+    def rw(stmts, in_loop):
+        out, may = [], False
+        for i, st in enumerate(stmts):
+            set_here = False
+            if isinstance(st, ast.Return):
+                out.append(ast.Assign(targets=[_name(flag, ast.Store())],
+                                      value=ast.Constant(True)))
+                out.append(ast.Assign(targets=[_name(val, ast.Store())],
+                                      value=st.value or ast.Constant(None)))
+                if in_loop:
+                    out.append(ast.Break())
+                set_here = True
+            elif isinstance(st, ast.If):
+                nb, c1 = rw(st.body, in_loop)
+                no, c2 = rw(st.orelse, in_loop)
+                if c1 or c2:
+                    set_here = True
+                    st = ast.If(test=st.test, body=nb or [ast.Pass()],
+                                orelse=no)
+                    # a branch that RETURNED in the original program never
+                    # flows past this `if`: names it assigns are dead on
+                    # the other path, so unbound carries may placeholder
+                    # (same argument as the guard continuations below)
+                    st._jst_ret_guard = True
+                out.append(st)
+            elif isinstance(st, (ast.While, ast.For)):
+                nb, c = rw(st.body, True)
+                if c:
+                    set_here = True
+                    if isinstance(st, ast.While):
+                        st = ast.While(test=st.test, body=nb, orelse=[])
+                    else:
+                        st = ast.For(target=st.target, iter=st.iter,
+                                     body=nb, orelse=[])
+                out.append(st)
+                if c and in_loop:
+                    # cascade the exit through the enclosing loop
+                    out.append(ast.If(test=_name(flag),
+                                      body=[ast.Break()], orelse=[]))
+            elif isinstance(st, ast.With):
+                nb, c = rw(st.body, in_loop)
+                if c:
+                    set_here = True
+                    st = ast.With(items=st.items, body=nb or [ast.Pass()])
+                out.append(st)
+            else:
+                out.append(st)
+            may = may or set_here
+            # outside loops the set path keeps flowing — guard the rest;
+            # inside loops the injected `break` already left the body
+            if set_here and not in_loop and i + 1 < len(stmts):
+                rest, rmay = rw(stmts[i + 1:], in_loop)
+                may = may or rmay
+                guard = ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=_name(flag)),
+                    body=rest or [ast.Pass()], orelse=[])
+                # names assigned in this continuation are DEAD after it
+                # on the skip path (the original program had returned) —
+                # visit_If may therefore placeholder any unbound ones
+                guard._jst_ret_guard = True
+                out.append(guard)
+                return out, may
+        return out, may
+
+    return rw(stmts, False)
 
 
 def _flags_guard_rewrite(stmts, brk, cont):
@@ -342,7 +510,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
                           ast.Constant(len(out_names)),
                           ast.Tuple(elts=[ast.Constant(n)
                                           for n in out_names],
-                                    ctx=ast.Load())])
+                                    ctx=ast.Load())]
+                         + ([ast.Constant(True)]
+                            if getattr(node, "_jst_ret_guard", False)
+                            else []))
         if out_names:
             assign = ast.Assign(
                 targets=[ast.Tuple(elts=[_name(n, ast.Store())
@@ -508,6 +679,24 @@ def ast_transform(fn):
         fn_assigned.add(fdef.args.vararg.arg)
     if fdef.args.kwarg:
         fn_assigned.add(fdef.args.kwarg.arg)
+
+    # ReturnTransformer pre-pass (reference return_transformer.py:126):
+    # early returns become flag+value dataflow so the later if/loop
+    # transforms see only assignments (and loop-exiting breaks)
+    if _returns_need_rewrite(fdef.body) \
+            and not _return_rewrite_blocked(fdef.body):
+        flag, val = "_retflag_0", "_retval_0"
+        may_falloff = not _guarantees_return(fdef.body)
+        new_body, changed = _rewrite_early_returns(fdef.body, flag, val)
+        if changed:
+            fdef.body = (
+                [ast.Assign(targets=[_name(flag, ast.Store())],
+                            value=ast.Constant(False))]
+                + new_body
+                + [ast.Return(value=_jst_call(
+                    "ret_out", [_name(flag), _lambda0(_name(val)),
+                                ast.Constant(may_falloff)]))])
+            fn_assigned |= {flag, val}
 
     new_tree = ast.Module(
         body=[ControlFlowTransformer(fn_assigned).visit(fdef)],

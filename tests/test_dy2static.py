@@ -286,3 +286,168 @@ def test_transformed_source_attached():
 
     g = ast_transform(f)
     assert "convert_ifelse" in g.__dy2static_source__
+
+
+# ------------------------------------------------------- early returns
+# (reference dy2static return_transformer.py:126 / test_return.py cases)
+
+def test_return_in_for_loop_python_bounds():
+    """return inside a python-bounded for loop converts (flag rewrite +
+    break cascade) instead of silently staying python."""
+    def f(x):
+        for i in range(10):
+            x = x + 1
+            if i == 3:
+                return x * 2
+        return x
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(_t([0.0])).numpy()), [8.0])
+    # the rewrite really happened: no raw early return remains
+    assert "_retflag_0" in g.__dy2static_source__
+
+
+def test_return_in_while_tensor_cond_lowers_to_lax():
+    """return-in-loop with a TENSOR condition: the loop still lowers to
+    lax.while_loop; the retval carry takes the zeros placeholder (the
+    RETURN_NO_VALUE analog) and the flag guard selects the right value."""
+    def f(x):
+        s = x * 0
+        while paddle.sum(s) < 100:
+            s = s + x
+            if paddle.sum(s) > 6:
+                return s * 10
+        return s
+
+    g = ast_transform(f)
+    want = f(_t([2.0])).numpy()  # eager oracle: 8 * 10
+    got = g(_t([2.0])).numpy()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(got), [80.0])
+    assert "convert_while_loop" in g.__dy2static_source__
+    assert "_retflag_0" in g.__dy2static_source__
+    # under a REAL jit trace the condition is a Tracer, so this takes
+    # the lax.while_loop branch with the zeros-placeholder retval carry
+    sf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(np.asarray(sf(_t([2.0])).numpy()), [80.0])
+
+
+def test_return_mixed_branch_tensor_pred():
+    """Mixed return/assign branches with a tensor predicate convert via
+    the flag rewrite (previously stayed python)."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x * 10
+        y = x - 1
+        return y
+
+    g = ast_transform(f)
+    for v in ([1.0], [-3.0]):
+        np.testing.assert_allclose(np.asarray(g(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+    assert "_retflag_0" in g.__dy2static_source__
+    assert "convert_ifelse" in g.__dy2static_source__
+    sf = paddle.jit.to_static(f)  # lax.cond path (traced predicate)
+    for v in ([1.0], [-3.0]):
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(f(_t(v)).numpy()))
+
+
+def test_return_branch_local_temp_tensor_pred():
+    """The returned value bound to a local the continuation also assigns
+    (the common early-return shape): the return-carrying `if` may
+    placeholder the dead-on-other-path local under a tensor predicate."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x + 1
+            return y
+        y = x * 3
+        return y
+
+    g = ast_transform(f)
+    sf = paddle.jit.to_static(f)
+    for v in ([2.0], [-2.0]):
+        want = f(_t(v)).numpy()
+        np.testing.assert_allclose(np.asarray(g(_t(v)).numpy()),
+                                   np.asarray(want))
+        np.testing.assert_allclose(np.asarray(sf(_t(v)).numpy()),
+                                   np.asarray(want))
+
+
+def test_return_nested_loops():
+    """return inside nested loops exits BOTH loops (break cascade)."""
+    def f(x):
+        for i in range(4):
+            for j in range(4):
+                x = x + 1
+                if i + j == 3:
+                    return x
+        return x * 0
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(_t([0.0])).numpy()),
+                               np.asarray(f(_t([0.0])).numpy()))
+    assert "_retflag_0" in g.__dy2static_source__
+
+
+def test_return_falloff_end_python_path():
+    """No return executed -> the function returns None, exactly like
+    python."""
+    def f(x, lim):
+        for i in range(3):
+            if i == lim:
+                return x * i
+        # falls off the end
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(_t([2.0]), 2).numpy()), [4.0])
+    assert g(_t([2.0]), 99) is None
+
+
+def test_return_falloff_with_tensor_pred_raises():
+    """Tensor-dependent early return + possible fall-off-the-end is a
+    None/Tensor union lax cannot type: descriptive error, not a
+    mis-lowered zeros."""
+    def f(x):
+        for i in range(3):
+            if paddle.sum(x) > 0:
+                return x
+
+    g = ast_transform(f)
+    # eager call: python semantics, returns x (no error needed)
+    np.testing.assert_allclose(np.asarray(g(_t([1.0])).numpy()), [1.0])
+    # traced call: the None/Tensor union must error, not mis-lower
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="fall off"):
+        sf(_t([1.0]))
+
+
+def test_return_in_try_stays_python():
+    """Returns inside try keep the python path (degradation contract)."""
+    def f(x):
+        try:
+            if paddle.sum(x) > 0:
+                x = x + 1
+            return x * 2
+        except ValueError:
+            return x
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(np.asarray(g(_t([1.0])).numpy()), [4.0])
+    assert "_retflag_0" not in g.__dy2static_source__
+
+
+def test_return_value_none_early():
+    """A bare `return` taken early yields None on the python path."""
+    def f(x, stop):
+        acc = x
+        for i in range(5):
+            if stop and i == 1:
+                return
+            acc = acc + 1
+        return acc
+
+    g = ast_transform(f)
+    assert g(_t([0.0]), True) is None
+    np.testing.assert_allclose(np.asarray(g(_t([0.0]), False).numpy()),
+                               [5.0])
