@@ -51,8 +51,9 @@ def test_flash_backward_matches_ref(causal):
 def test_supported_gate():
     assert supported((2, 256, 4, 64))
     assert supported((1, 128, 1, 128))
-    assert not supported((2, 100, 4, 64))   # seq not multiple of block
-    assert not supported((2, 64, 4, 64))    # seq too short
+    assert supported((2, 100, 4, 64))       # ragged: pads to block
+    assert supported((2, 64, 4, 64))        # half a block: still profitable
+    assert not supported((2, 32, 4, 64))    # mostly padding -> XLA
     assert not supported((2, 256, 4, 256))  # head_dim too wide
     assert not supported((2, 256, 64))      # wrong rank
 
@@ -144,4 +145,63 @@ def test_supported_gate_gqa_cross():
     assert not supported((2, 256, 4, 64), (2, 512, 4, 64),
                          (2, 512, 4, 64), causal=True)
     assert not supported((2, 256, 4, 64), (2, 256, 3, 64), (2, 256, 3, 64))
-    assert not supported((2, 256, 4, 64), (2, 200, 4, 64), (2, 200, 4, 64))
+    assert supported((2, 256, 4, 64), (2, 200, 4, 64),
+                     (2, 200, 4, 64))  # ragged cross: pads to block
+
+
+# ------------------------------------------------------ ragged shapes
+# (VERDICT r4 weak #6: pad-to-block inside the wrapper)
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [200, 130, 100])
+def test_flash_ragged_forward_matches_ref(causal, s):
+    """Arbitrary (non-128-multiple) prompt lengths run the kernel via
+    internal padding + key-bounds masking, exactly matching XLA."""
+    b, n, d = 2, 2, 64
+    q, k, v = (_rand((b, s, n, d), seed=20 + i) for i in range(3))
+    ref = _sdpa_ref(q, k, v, None, 0.0, causal, None, False)
+    out = flash_attention_bshd(q, k, v, causal=causal)
+    assert out.shape == (b, s, n, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_ragged_cross_attention():
+    """Ragged cross attention: sq=190 vs sk=75 (both non-multiples)."""
+    b, n, d = 2, 2, 64
+    q = _rand((b, 190, n, d), seed=30)
+    k = _rand((b, 75, n, d), seed=31)
+    v = _rand((b, 75, n, d), seed=32)
+    ref = _sdpa_ref(q, k, v, None, 0.0, False, None, False)
+    out = flash_attention_bshd(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_ragged_backward_matches_ref():
+    bn, s, d = 2, 200, 64
+    q, k, v = (_rand((bn, s, d), seed=40 + i) for i in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, causal=True)))
+
+    def loss_ref(q, k, v):
+        e = lambda t: t[:, :, None, :]
+        out = _sdpa_ref(e(q), e(k), e(v), None, 0.0, True, None, False)
+        return jnp.sum(jnp.square(out[:, :, 0, :]))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4,
+                                   err_msg=f"d{name} ragged")
+
+
+def test_supported_gate_ragged():
+    # ragged lengths are now supported (pad-to-block)
+    assert supported((2, 200, 4, 64))
+    assert supported((2, 130, 4, 64), (2, 75, 4, 64), (2, 75, 4, 64))
+    # but mostly-padding shapes stay on XLA
+    assert not supported((2, 10, 4, 64))
+    assert not supported((2, 256, 4, 64), (2, 10, 4, 64), (2, 10, 4, 64))
