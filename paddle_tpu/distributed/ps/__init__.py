@@ -13,7 +13,8 @@ native TCPStore — the brpc_ps_server/client analog.
 """
 from .table import (  # noqa: F401
     MemorySparseTable, MemoryDenseTable, SGDAccessor, AdagradAccessor,
-    CtrAccessor, CtrSparseTable)
+    CtrAccessor, CtrSparseTable, SsdSparseTable)
+from .graph_table import GraphTable  # noqa: F401
 from .communicator import Communicator, GeoCommunicator  # noqa: F401
 from .local_client import PsLocalClient  # noqa: F401
 from .the_one_ps import TheOnePs  # noqa: F401

@@ -1,0 +1,181 @@
+"""Graph table: node/edge storage + neighbor sampling on the PS.
+
+Parity: ``/root/reference/paddle/fluid/distributed/ps/table/
+common_graph_table.cc`` (GraphTable :1-2565 — node shards, weighted edge
+lists, random_sample_neighbors, random_sample_nodes, feature slots, edge
+file loading) — the storage substrate of the reference's graph-learning
+stack (PGL). Host-side machinery by design: graphs are sparse,
+pointer-chasing structures that belong in host RAM; the TPU consumes the
+SAMPLED sub-batches (padded [n, k] numpy blocks ready for device upload).
+
+Server routing mirrors the sparse tables: node id -> server
+``id % num_servers``; every server owns its nodes' outgoing edges and
+features, so one round trip serves any batch (``PsRpcClient`` merges)."""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+class GraphTable:
+    """One shard of a property graph (common_graph_table.cc GraphTable).
+
+    Edges are stored per source node as (dst ids, weights); sampling is
+    weighted-with-replacement (or uniform without, matching the
+    reference's two sample modes). Features are named per-node slots.
+    """
+
+    def __init__(self, seed=0, track_dst_nodes=True):
+        self._adj: dict[int, list] = {}      # src -> [dst...]
+        self._w: dict[int, list] = {}        # src -> [weight...]
+        self._feat: dict[int, dict] = {}     # node -> {name: np.ndarray}
+        self._nodes: set[int] = set()
+        self._rng = np.random.default_rng(seed)
+        # a SHARD must not count edge destinations it does not own (the
+        # client registers them on their owning shard); a standalone
+        # table counts both endpoints (common_graph_table node semantics)
+        self._track_dst = bool(track_dst_nodes)
+        self._frozen = None  # (adj arrays, cumw) built lazily for sampling
+
+    # -- construction (GraphTable::add_graph_node / load) -----------------
+    def add_nodes(self, ids):
+        self._nodes.update(int(i) for i in np.asarray(ids).reshape(-1))
+
+    def add_edges(self, src, dst, weights=None):
+        src = np.asarray(src).reshape(-1)
+        dst = np.asarray(dst).reshape(-1)
+        w = (np.ones(len(src), np.float32) if weights is None
+             else np.asarray(weights, np.float32).reshape(-1))
+        for s, d, wi in zip(src, dst, w):
+            s, d = int(s), int(d)
+            self._adj.setdefault(s, []).append(d)
+            self._w.setdefault(s, []).append(float(wi))
+            self._nodes.add(s)
+            if self._track_dst:
+                self._nodes.add(d)
+        self._frozen = None
+
+    def load_edge_file(self, path, reverse=False):
+        """``src \\t dst [\\t weight]`` per line (load_edges parity)."""
+        srcs, dsts, ws = [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                s, d = int(parts[0]), int(parts[1])
+                if reverse:
+                    s, d = d, s
+                srcs.append(s)
+                dsts.append(d)
+                ws.append(float(parts[2]) if len(parts) > 2 else 1.0)
+        self.add_edges(srcs, dsts, ws)
+        return len(srcs)
+
+    def load_node_file(self, path):
+        """``node_type \\t id`` or bare ``id`` per line."""
+        n = 0
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                self.add_nodes([int(parts[-1])])
+                n += 1
+        return n
+
+    # -- features (Node::get_feature parity) ------------------------------
+    def set_node_feat(self, ids, name, values):
+        values = np.asarray(values)
+        for i, fid in enumerate(np.asarray(ids).reshape(-1)):
+            self._feat.setdefault(int(fid), {})[name] = values[i]
+            self._nodes.add(int(fid))
+
+    def get_node_feat(self, ids, name, default=None):
+        ids = np.asarray(ids).reshape(-1)
+        out = []
+        for fid in ids:
+            f = self._feat.get(int(fid), {})
+            if name in f:
+                out.append(np.asarray(f[name]))
+            elif default is not None:
+                out.append(np.asarray(default))
+            else:
+                raise KeyError(f"node {int(fid)} has no feature {name!r}")
+        return np.stack(out) if out else np.zeros((0,), np.float32)
+
+    # -- sampling (GraphTable::random_sample_neighbors) -------------------
+    def sample_neighbors(self, ids, sample_size, need_weight=False):
+        """Per node: up to ``sample_size`` neighbors — WITHOUT replacement
+        uniformly when the node has more than ``sample_size`` neighbors
+        ignoring weights is the reference default; weighted sampling uses
+        the edge weights as probabilities (with replacement). Returns
+        (neighbors [n, k] int64 padded with -1, counts [n] int32[, weights]).
+        """
+        ids = np.asarray(ids).reshape(-1)
+        k = int(sample_size)
+        nbrs = np.full((len(ids), k), -1, np.int64)
+        wout = np.zeros((len(ids), k), np.float32)
+        counts = np.zeros(len(ids), np.int32)
+        for row, fid in enumerate(ids):
+            adj = self._adj.get(int(fid))
+            if not adj:
+                continue
+            n = len(adj)
+            if n <= k:
+                take = np.arange(n)
+            elif need_weight:
+                p = np.asarray(self._w[int(fid)], np.float64)
+                p = p / p.sum()
+                take = self._rng.choice(n, size=k, replace=True, p=p)
+            else:
+                take = self._rng.choice(n, size=k, replace=False)
+            counts[row] = len(take)
+            nbrs[row, :len(take)] = np.asarray(adj, np.int64)[take]
+            if need_weight:
+                wout[row, :len(take)] = np.asarray(
+                    self._w[int(fid)], np.float32)[take]
+        if need_weight:
+            return nbrs, counts, wout
+        return nbrs, counts
+
+    def sample_nodes(self, n):
+        """Uniform sample of node ids (random_sample_nodes parity)."""
+        pool = np.fromiter(self._nodes, np.int64, len(self._nodes))
+        if len(pool) == 0:
+            return np.zeros(0, np.int64)
+        return self._rng.choice(pool, size=int(n),
+                                replace=len(pool) < int(n))
+
+    def node_degree(self, ids):
+        return np.asarray([len(self._adj.get(int(i), ()))
+                           for i in np.asarray(ids).reshape(-1)], np.int64)
+
+    # -- introspection / persistence --------------------------------------
+    @property
+    def node_ids(self):
+        return np.sort(np.fromiter(self._nodes, np.int64,
+                                   len(self._nodes)))
+
+    @property
+    def size(self):
+        return len(self._nodes)
+
+    def edge_count(self):
+        return sum(len(v) for v in self._adj.values())
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump({"adj": self._adj, "w": self._w,
+                         "feat": self._feat,
+                         "nodes": sorted(self._nodes)}, f)
+
+    def load(self, path):
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+        self._adj = {int(k): list(v) for k, v in doc["adj"].items()}
+        self._w = {int(k): list(v) for k, v in doc["w"].items()}
+        self._feat = {int(k): dict(v) for k, v in doc["feat"].items()}
+        self._nodes = set(int(i) for i in doc["nodes"])
+        self._frozen = None

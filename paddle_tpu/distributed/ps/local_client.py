@@ -22,6 +22,11 @@ class PsLocalClient:
         self._tables[table_id] = MemoryDenseTable(shape, accessor, **kw)
         return self._tables[table_id]
 
+    def create_graph_table(self, table_id, **kw):
+        from .graph_table import GraphTable
+        self._tables[table_id] = GraphTable(**kw)
+        return self._tables[table_id]
+
     def get_table(self, table_id):
         return self._tables[table_id]
 

@@ -108,9 +108,57 @@ def _srv_table_size(table_id):
 
 
 def _srv_table_kind(table_id):
+    from .graph_table import GraphTable
     from .table import MemoryDenseTable
-    return ("dense" if isinstance(_local.get_table(table_id),
-                                  MemoryDenseTable) else "sparse")
+    t = _local.get_table(table_id)
+    if isinstance(t, MemoryDenseTable):
+        return "dense"
+    if isinstance(t, GraphTable):
+        return "graph"
+    return "sparse"
+
+
+# -- graph table handlers (common_graph_table.cc service surface) ----------
+
+def _srv_create_graph(table_id, kw):
+    _local.create_graph_table(table_id, **kw)
+    return True
+
+
+def _srv_graph_add_edges(table_id, src, dst, weights):
+    _local.get_table(table_id).add_edges(src, dst, weights)
+    return True
+
+
+def _srv_graph_add_nodes(table_id, ids):
+    _local.get_table(table_id).add_nodes(ids)
+    return True
+
+
+def _srv_graph_sample_neighbors(table_id, ids, k, need_weight):
+    return _local.get_table(table_id).sample_neighbors(
+        np.asarray(ids), k, need_weight=need_weight)
+
+
+def _srv_graph_sample_nodes(table_id, n):
+    return _local.get_table(table_id).sample_nodes(n)
+
+
+def _srv_graph_set_feat(table_id, ids, name, values):
+    _local.get_table(table_id).set_node_feat(ids, name, values)
+    return True
+
+
+def _srv_graph_get_feat(table_id, ids, name, default):
+    return _local.get_table(table_id).get_node_feat(ids, name, default)
+
+
+def _srv_graph_degree(table_id, ids):
+    return _local.get_table(table_id).node_degree(ids)
+
+
+def _srv_graph_edge_count(table_id):
+    return _local.get_table(table_id).edge_count()
 
 
 def _srv_sparse_dim(table_id):
@@ -146,10 +194,14 @@ class PsRpcClient:
     (``rpc.init_rpc``).
     """
 
-    def __init__(self, servers):
+    def __init__(self, servers, seed=None):
         from .. import rpc
         self._rpc = rpc
         self.servers = list(servers)
+        # client-side sampling rng (cross-shard multinomial + shuffle):
+        # seed it for reproducible graph-learning batches, matching the
+        # per-shard GraphTable(seed=...) determinism
+        self._rng = np.random.default_rng(seed)
         self._sparse_dims = {}
         # dense tables exist only on servers[0] (create_dense_table), so
         # save/load/table_size must not fan out for them; kind is cached
@@ -221,6 +273,133 @@ class PsRpcClient:
     def push_dense_grad(self, table_id, grad):
         self._rpc.rpc_sync(self.servers[0], _srv_push_dense,
                            args=(table_id, np.asarray(grad)))
+
+    # -- graph (node id -> shard id % n; a server owns its nodes'
+    #    outgoing edges + features, common_graph_table.cc shard scheme) ---
+    def create_graph_table(self, table_id, **kw):
+        self._kinds[table_id] = "graph"
+        # shards own only their id-range: edge destinations register on
+        # their OWN shard (add_graph_edges below), never the source's
+        kw = dict(kw, track_dst_nodes=False)
+        for s in self.servers:
+            self._rpc.rpc_sync(s, _srv_create_graph, args=(table_id, kw))
+
+    def add_graph_nodes(self, table_id, ids):
+        ids_flat, owner = self._shard(ids)
+        futs = []
+        for s in range(len(self.servers)):
+            sel = ids_flat[owner == s]
+            if sel.size:
+                futs.append(self._rpc.rpc_async(
+                    self.servers[s], _srv_graph_add_nodes,
+                    args=(table_id, sel)))
+        for f in futs:
+            f.result()
+
+    def add_graph_edges(self, table_id, src, dst, weights=None):
+        src = np.asarray(src).reshape(-1)
+        dst = np.asarray(dst).reshape(-1)
+        w = None if weights is None else \
+            np.asarray(weights, np.float32).reshape(-1)
+        _, owner = self._shard(src)  # edges live with their SOURCE node
+        futs = []
+        for s in range(len(self.servers)):
+            mask = owner == s
+            if mask.any():
+                futs.append(self._rpc.rpc_async(
+                    self.servers[s], _srv_graph_add_edges,
+                    args=(table_id, src[mask], dst[mask],
+                          None if w is None else w[mask])))
+        for f in futs:
+            f.result()
+        # destinations become nodes on THEIR shard (size partitions)
+        self.add_graph_nodes(table_id, dst)
+
+    def sample_neighbors(self, table_id, ids, sample_size,
+                         need_weight=False):
+        """Batched neighbor sampling across shards; rows come back in the
+        caller's id order (padded with -1 like GraphTable)."""
+        ids_flat, owner = self._shard(ids)
+        n = len(self.servers)
+        futs = [None] * n
+        for s in range(n):
+            sel = ids_flat[owner == s]
+            if sel.size:
+                futs[s] = self._rpc.rpc_async(
+                    self.servers[s], _srv_graph_sample_neighbors,
+                    args=(table_id, sel, sample_size, need_weight))
+        nbrs = np.full((ids_flat.size, sample_size), -1, np.int64)
+        counts = np.zeros(ids_flat.size, np.int32)
+        wout = np.zeros((ids_flat.size, sample_size), np.float32)
+        for s in range(n):
+            if futs[s] is None:
+                continue
+            res = futs[s].result()
+            mask = owner == s
+            if need_weight:
+                nbrs[mask], counts[mask], wout[mask] = res
+            else:
+                nbrs[mask], counts[mask] = res
+        if need_weight:
+            return nbrs, counts, wout
+        return nbrs, counts
+
+    def sample_graph_nodes(self, table_id, n):
+        """Uniform node sample (random_sample_nodes parity): a
+        multinomial by shard size allocates the draw across servers, so
+        the merged sample is uniform over ALL nodes."""
+        rng = self._rng
+        sizes = [self._rpc.rpc_sync(s, _srv_table_size, args=(table_id,))
+                 for s in self.servers]
+        total = sum(sizes)
+        if total == 0:
+            return np.zeros(0, np.int64)
+        counts = rng.multinomial(int(n), [sz / total for sz in sizes])
+        parts = [np.asarray(self._rpc.rpc_sync(
+                     srv, _srv_graph_sample_nodes, args=(table_id, int(c))))
+                 for srv, c in zip(self.servers, counts) if c]
+        out = (np.concatenate(parts) if parts else np.zeros(0, np.int64))
+        rng.shuffle(out)
+        return out
+
+    def set_node_feat(self, table_id, ids, name, values):
+        ids_flat, owner = self._shard(ids)
+        values = np.asarray(values)
+        futs = []
+        for s in range(len(self.servers)):
+            mask = owner == s
+            if mask.any():
+                futs.append(self._rpc.rpc_async(
+                    self.servers[s], _srv_graph_set_feat,
+                    args=(table_id, ids_flat[mask], name, values[mask])))
+        for f in futs:
+            f.result()
+
+    def get_node_feat(self, table_id, ids, name, default=None):
+        ids_flat, owner = self._shard(ids)
+        n = len(self.servers)
+        futs = [None] * n
+        for s in range(n):
+            sel = ids_flat[owner == s]
+            if sel.size:
+                futs[s] = self._rpc.rpc_async(
+                    self.servers[s], _srv_graph_get_feat,
+                    args=(table_id, sel, name, default))
+        out = None
+        for s in range(n):
+            if futs[s] is None:
+                continue
+            res = np.asarray(futs[s].result())
+            if out is None:
+                out = np.zeros((ids_flat.size,) + res.shape[1:],
+                               res.dtype)
+            out[owner == s] = res
+        return out if out is not None else np.zeros(0, np.float32)
+
+    def graph_edge_count(self, table_id):
+        return sum(self._rpc.rpc_sync(s, _srv_graph_edge_count,
+                                      args=(table_id,))
+                   for s in self.servers)
 
     # -- persistence / lifecycle -------------------------------------------
     def _table_servers(self, table_id):

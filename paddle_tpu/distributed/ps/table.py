@@ -371,3 +371,111 @@ class CtrSparseTable(MemorySparseTable):
         for fid in self._rows:
             self._stats.setdefault(fid, {"show": 0.0, "click": 0.0,
                                          "unseen_days": 0})
+
+
+class SsdSparseTable(MemorySparseTable):
+    """Beyond-memory sparse table: hot rows in RAM, cold rows spilled to
+    disk (parity: ``paddle/fluid/distributed/ps/table/ssd_sparse_table.cc``
+    — the rocksdb-backed SSDSparseTable; sqlite stands in for rocksdb,
+    same design: an LRU of hot rows over a persistent key-value store).
+
+    ``max_mem_rows`` bounds resident rows; the least-recently-USED rows
+    (pull or push both touch) spill with their accessor slots and return
+    transparently on next touch. ``size`` counts ALL rows (mem + disk).
+    """
+
+    def __init__(self, emb_dim, accessor=None, initializer=None, seed=0,
+                 max_mem_rows=1 << 20, path=None):
+        super().__init__(emb_dim, accessor, initializer, seed)
+        import sqlite3
+        import tempfile
+        from collections import OrderedDict
+        self._rows = OrderedDict()  # insertion order == LRU order
+        self.max_mem_rows = int(max_mem_rows)
+        if path is None:
+            f = tempfile.NamedTemporaryFile(suffix=".ssdtable",
+                                            delete=False)
+            f.close()
+            path = f.name
+        self._path = path
+        self._db = sqlite3.connect(self._path)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (fid INTEGER PRIMARY KEY, "
+            "blob BLOB)")
+        self._spilled = 0  # lifetime eviction count (observability)
+
+    # -- spill machinery ---------------------------------------------------
+    def _pack(self, fid):
+        import pickle
+        return pickle.dumps((self._rows[fid], self._slots[fid]),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _evict_lru(self):
+        while len(self._rows) > self.max_mem_rows:
+            fid, _ = next(iter(self._rows.items()))
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows (fid, blob) VALUES (?, ?)",
+                (fid, self._pack(fid)))
+            del self._rows[fid]
+            del self._slots[fid]
+            self._spilled += 1
+        self._db.commit()
+
+    def _ensure(self, fid):
+        if fid in self._rows:
+            self._rows.move_to_end(fid)  # touch
+            return self._rows[fid]
+        got = self._db.execute(
+            "SELECT blob FROM rows WHERE fid = ?", (fid,)).fetchone()
+        if got is not None:
+            import pickle
+            row, slots = pickle.loads(got[0])
+            self._db.execute("DELETE FROM rows WHERE fid = ?", (fid,))
+            self._rows[fid] = row
+            self._slots[fid] = slots
+        else:
+            self._rows[fid] = self._init()
+            self._slots[fid] = self.accessor.init_slots(self.emb_dim)
+        self._evict_lru()
+        return self._rows[fid]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def mem_rows(self):
+        return len(self._rows)
+
+    @property
+    def disk_rows(self):
+        return self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+
+    @property
+    def size(self):
+        return self.mem_rows + self.disk_rows
+
+    # -- persistence: save/load cover BOTH tiers ---------------------------
+    def save(self, path):
+        """Dump disk + resident rows WITHOUT mutating either tier (a
+        spill-then-dump would leave resident rows duplicated in the
+        store, inflating size/disk_rows on every save)."""
+        import pickle
+        data = {}
+        for fid, blob in self._db.execute("SELECT fid, blob FROM rows"):
+            data[int(fid)] = pickle.loads(blob)
+        for fid in self._rows:  # resident rows are the fresher tier
+            data[fid] = (self._rows[fid], self._slots[fid])
+        ids = sorted(data)
+        rows = [data[f][0] for f in ids]
+        slots = [data[f][1] for f in ids]
+        arrs = {f"slot_{s}": np.stack([sl[s] for sl in slots])
+                if slots else np.zeros((0, self.emb_dim), np.float32)
+                for s in range(self.accessor.slots)}
+        np.savez(path, ids=np.asarray(ids, np.int64),
+                 rows=np.stack(rows) if rows
+                 else np.zeros((0, self.emb_dim), np.float32), **arrs)
+
+    def load(self, path):
+        super().load(path)
+        self._evict_lru()  # respect the residency bound after restore
+
+    def close(self):
+        self._db.close()

@@ -1,0 +1,230 @@
+"""PS graph table + SSD-spill sparse table (VERDICT r4 #7/#8).
+
+Reference models: ``common_graph_table.cc`` (node/edge shards, neighbor
+sampling) and ``ssd_sparse_table.cc`` (beyond-memory spill). The
+2-process test drives the same server-routed path as the sparse tables.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.distributed.ps import (GraphTable, MemorySparseTable,
+                                       SsdSparseTable)
+from paddle_tpu.distributed.ps.table import AdagradAccessor
+
+
+# ------------------------------------------------------------- graph local
+
+def test_graph_table_neighbors_and_nodes():
+    g = GraphTable(seed=0)
+    g.add_edges([1, 1, 1, 2], [10, 11, 12, 20],
+                weights=[1.0, 2.0, 3.0, 1.0])
+    assert g.size == 6 and g.edge_count() == 4
+
+    nbrs, counts = g.sample_neighbors([1, 2, 99], sample_size=2)
+    assert nbrs.shape == (3, 2) and counts.tolist()[1:] == [1, 0]
+    assert counts[0] == 2
+    assert set(nbrs[0]) <= {10, 11, 12}
+    assert nbrs[1, 0] == 20 and nbrs[1, 1] == -1
+    assert (nbrs[2] == -1).all()
+
+    # all neighbors returned when k >= degree
+    nbrs3, c3 = g.sample_neighbors([1], sample_size=8)
+    assert c3[0] == 3 and sorted(nbrs3[0][:3].tolist()) == [10, 11, 12]
+
+    # weighted sampling draws only real neighbors and returns weights
+    nw, cw, w = g.sample_neighbors([1], 2, need_weight=True)
+    assert set(nw[0]) <= {10, 11, 12} and (w[0] > 0).all()
+
+    nodes = g.sample_nodes(4)
+    assert set(nodes.tolist()) <= {1, 2, 10, 11, 12, 20}
+    assert g.node_degree([1, 2, 10]).tolist() == [3, 1, 0]
+
+
+def test_graph_table_features_and_persistence(tmp_path):
+    g = GraphTable()
+    g.set_node_feat([1, 2], "emb", np.eye(2, 3, dtype=np.float32))
+    got = g.get_node_feat([2, 1], "emb")
+    np.testing.assert_allclose(got, np.eye(2, 3)[::-1])
+    # default fills missing nodes
+    d = g.get_node_feat([1, 7], "emb", default=np.zeros(3, np.float32))
+    np.testing.assert_allclose(d[1], 0.0)
+
+    g.add_edges([1], [2])
+    path = str(tmp_path / "graph.bin")
+    g.save(path)
+    g2 = GraphTable()
+    g2.load(path)
+    assert g2.size == g.size and g2.edge_count() == 1
+    np.testing.assert_allclose(g2.get_node_feat([1], "emb"),
+                               g.get_node_feat([1], "emb"))
+
+
+def test_graph_table_edge_file(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("1 2 0.5\n1 3\n4 1\n")
+    g = GraphTable()
+    assert g.load_edge_file(str(p)) == 3
+    assert g.edge_count() == 3 and g.size == 4
+    nbrs, counts = g.sample_neighbors([1], 4)
+    assert counts[0] == 2 and set(nbrs[0][:2]) == {2, 3}
+    # reverse=True flips the direction
+    g2 = GraphTable()
+    g2.load_edge_file(str(p), reverse=True)
+    nbrs2, c2 = g2.sample_neighbors([2], 4)
+    assert c2[0] == 1 and nbrs2[0, 0] == 1
+
+
+# ------------------------------------------------------------- ssd spill
+
+def test_ssd_table_spills_and_restores(tmp_path):
+    t = SsdSparseTable(emb_dim=4, max_mem_rows=4,
+                       path=str(tmp_path / "t.ssd"))
+    oracle = MemorySparseTable(emb_dim=4)
+    # identical init: zero rows
+    t._init = oracle._init = lambda: np.zeros(4, np.float32)
+
+    ids = np.arange(20, dtype=np.int64)
+    grads = np.outer(np.arange(20), np.ones(4)).astype(np.float32)
+    t.push(ids, grads)
+    oracle.push(ids, grads)
+    assert t.mem_rows <= 4
+    assert t.size == 20 and t.disk_rows >= 16
+    assert t._spilled > 0
+
+    # rows come back transparently from disk, exact
+    np.testing.assert_allclose(t.pull(ids), oracle.pull(ids))
+    assert t.mem_rows <= 4  # the sweep re-evicted
+
+
+def test_ssd_table_accessor_slots_survive_spill(tmp_path):
+    """Adagrad g2sum must spill and return with the row, or post-restore
+    updates use the wrong learning rate."""
+    t = SsdSparseTable(emb_dim=2, accessor=AdagradAccessor(),
+                       max_mem_rows=2, path=str(tmp_path / "a.ssd"))
+    oracle = MemorySparseTable(emb_dim=2, accessor=AdagradAccessor())
+    t._init = oracle._init = lambda: np.zeros(2, np.float32)
+    ids = np.arange(8, dtype=np.int64)
+    g = np.ones((8, 2), np.float32)
+    for _ in range(3):  # repeated pushes force spill/reload cycles
+        t.push(ids, g)
+        oracle.push(ids, g)
+    np.testing.assert_allclose(t.pull(ids), oracle.pull(ids), rtol=1e-6)
+
+
+def test_ssd_table_save_does_not_mutate_tiers(tmp_path):
+    """save() must not spill-then-dump: resident rows would end up in
+    BOTH tiers, inflating size on every checkpoint."""
+    t = SsdSparseTable(emb_dim=2, max_mem_rows=100,
+                       path=str(tmp_path / "nm.ssd"))
+    ids = np.arange(10, dtype=np.int64)
+    t.push(ids, np.ones((10, 2), np.float32))
+    assert t.size == 10 and t.disk_rows == 0
+    t.save(str(tmp_path / "ck.npz"))
+    assert t.size == 10 and t.disk_rows == 0 and t.mem_rows == 10
+
+
+def test_ssd_table_save_load_covers_both_tiers(tmp_path):
+    t = SsdSparseTable(emb_dim=3, max_mem_rows=2,
+                       path=str(tmp_path / "s.ssd"))
+    ids = np.arange(6, dtype=np.int64)
+    t.push(ids, np.ones((6, 3), np.float32))
+    vals = t.pull(ids)
+    save_path = str(tmp_path / "ckpt.npz")
+    t.save(save_path)
+
+    t2 = SsdSparseTable(emb_dim=3, max_mem_rows=2,
+                        path=str(tmp_path / "s2.ssd"))
+    t2.load(save_path)
+    assert t2.size == 6 and t2.mem_rows <= 2  # residency bound holds
+    np.testing.assert_allclose(t2.pull(ids), vals)
+
+
+# ------------------------------------------------------ 2-process service
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_graph_service_two_servers(tmp_path):
+    server_script = tmp_path / "graph_server.py"
+    server_script.write_text(textwrap.dedent("""
+        import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed.ps import service
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        service.run_server(f"ps{rank}")
+        print("server-exit-ok", flush=True)
+    """))
+    port = _free_port()
+    world = 3
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu", "REPO": REPO,
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_MASTER_ENDPOINT": f"127.0.0.1:{port}"}
+    procs = [subprocess.Popen(
+        [sys.executable, str(server_script)],
+        env={**env_base, "PADDLE_TRAINER_ID": str(rank)},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)]
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsRpcClient
+    rpc.init_rpc("trainer0", rank=2, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        client = PsRpcClient(["ps0", "ps1"])
+        client.create_graph_table(7, seed=3)
+        # node ids land on BOTH shards (odd/even)
+        src = np.array([0, 0, 1, 1, 2, 3], np.int64)
+        dst = np.array([1, 2, 2, 3, 0, 0], np.int64)
+        client.add_graph_edges(7, src, dst)
+        assert client.graph_edge_count(7) == 6
+        assert client.table_size(7) == 4
+
+        nbrs, counts = client.sample_neighbors(7, [0, 1, 2, 3, 9], 2)
+        assert nbrs.shape == (5, 2)
+        assert counts.tolist() == [2, 2, 1, 1, 0]
+        assert set(nbrs[0]) == {1, 2} and set(nbrs[1]) == {2, 3}
+        assert nbrs[2, 0] == 0 and nbrs[3, 0] == 0
+
+        client.set_node_feat(7, [0, 1, 2, 3], "h",
+                             np.arange(8, dtype=np.float32).reshape(4, 2))
+        got = client.get_node_feat(7, [3, 0], "h")
+        np.testing.assert_allclose(got, [[6, 7], [0, 1]])
+
+        nodes = client.sample_graph_nodes(7, 6)
+        assert len(nodes) == 6 and set(nodes.tolist()) <= {0, 1, 2, 3}
+
+        # per-shard persistence round trip
+        client.save(7, str(tmp_path / "g"))
+        client.load(7, str(tmp_path / "g"))
+        assert client.graph_edge_count(7) == 6
+
+    finally:
+        # stop servers BEFORE rpc.shutdown (shutdown blocks while peers
+        # serve), and never let a failed assertion leave them running
+        try:
+            client.stop_server()
+        except Exception:
+            pass
+        rpc.shutdown()
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                raise AssertionError(f"server hung: {out[-2000:]}")
+            assert p.returncode == 0, out[-2000:]
+            assert "server-exit-ok" in out

@@ -347,3 +347,89 @@ def test_hapi_accumulation_stays_eager():
         assert model._no_parallel
     finally:
         mesh_mod._global_mesh, mesh_mod._hcg = saved
+
+
+# --------------------------------------------------- int8 deploy path
+# (VERDICT r4 #4: save_quantized_model -> jit.save -> Predictor;
+#  reference quantization/imperative/qat.py:293, ptq.py:112)
+
+def test_save_quantized_model_roundtrip(tmp_path):
+    """QAT model exports as an int8 artifact; Predictor serves it with
+    near-fp32 accuracy and the weights really store as int8."""
+    import pickle
+    from paddle_tpu import inference
+    from paddle_tpu.jit.save_load import InputSpec
+    from paddle_tpu.quantization import QAT, save_quantized_model
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((6, 8)).astype(np.float32)
+    fp32_out = _np(net(paddle.to_tensor(x)))
+
+    qat = QAT()
+    qmodel = qat.quantize(net)
+    for _ in range(4):  # run calibration batches through the observers
+        qmodel(paddle.to_tensor(
+            rng.standard_normal((6, 8)).astype(np.float32)))
+
+    path = str(tmp_path / "int8_model")
+    deploy = save_quantized_model(
+        qmodel, path, input_spec=[InputSpec([6, 8], "float32")])
+
+    # the deploy form really stores int8 weights + scales
+    from paddle_tpu.quantization import Int8DeployLayer
+    int8_layers = [l for l in deploy.sublayers()
+                   if isinstance(l, Int8DeployLayer)]
+    assert len(int8_layers) == 2
+    assert np.asarray(int8_layers[0].q_weight._value).dtype == np.int8
+
+    # ...and the artifact blob holds int8 (4x smaller than f32)
+    with open(path + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+
+    def _leaf_dtypes(o):
+        if isinstance(o, dict):
+            for v in o.values():
+                yield from _leaf_dtypes(v)
+        elif hasattr(o, "array"):  # framework/io.py _TensorPayload
+            yield np.asarray(o.array).dtype
+    leaf_dtypes = set(_leaf_dtypes(blob))
+    assert np.dtype(np.int8) in leaf_dtypes, leaf_dtypes
+
+    pred = inference.create_predictor(inference.Config(path))
+    (got,) = pred.run([x])
+    # int8 per-channel weight quant + frozen act scales: close to fp32
+    err = np.abs(got - fp32_out).max() / (np.abs(fp32_out).max() + 1e-9)
+    assert err < 0.1, f"relative error {err}"
+
+    # jit.load also serves the artifact (TranslatedLayer path)
+    loaded = paddle.jit.load(path)
+    got2 = _np(loaded(paddle.to_tensor(x)))
+    np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
+
+
+def test_save_quantized_model_after_convert(tmp_path):
+    """convert()ed models (observer-stripped) export too — the PTQ flow."""
+    from paddle_tpu import inference
+    from paddle_tpu.jit.save_load import InputSpec
+    from paddle_tpu.quantization import PTQ, save_quantized_model
+
+    paddle.seed(12)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Tanh(), nn.Linear(4, 2))
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    fp32_out = _np(net(paddle.to_tensor(x)))
+
+    ptq = PTQ()
+    qmodel = ptq.quantize(net)
+    qmodel(paddle.to_tensor(x))  # calibrate
+    converted = ptq.convert(qmodel)
+
+    path = str(tmp_path / "ptq_int8")
+    save_quantized_model(converted, path,
+                         input_spec=[InputSpec([3, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    (got,) = pred.run([x])
+    err = np.abs(got - fp32_out).max() / (np.abs(fp32_out).max() + 1e-9)
+    assert err < 0.12, f"relative error {err}"
