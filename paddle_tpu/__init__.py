@@ -17,11 +17,13 @@ import jax as _jax
 # backend, and importing this package is the first thing every worker
 # does — so the bootstrap lives here. endpoints[0] hosts the coordination
 # service (the reference's TCPStore-rendezvous slot, parallel.py:108).
+from ._jax_compat import distributed_is_initialized as _dist_is_init
+
 if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
         and _os.environ.get("PADDLE_TRAINER_ENDPOINTS") \
         and "PADDLE_LOCAL_RANK" in _os.environ \
         and "_PADDLE_TPU_BOOTSTRAPPED" not in _os.environ \
-        and not _jax.distributed.is_initialized():
+        and not _dist_is_init():
     # PADDLE_LOCAL_RANK marks a launcher-SPAWNED worker: stale shell
     # exports of the other contract vars must not hijack an unrelated
     # process (e.g. the launcher itself) into the coordination service.
@@ -29,6 +31,8 @@ if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
     # worker spawns — pipe-command data generators, PS servers) keeps
     # those children from re-joining the coordination service with a
     # duplicate process_id on import.
+    from ._jax_compat import enable_cpu_multiprocess_collectives
+    enable_cpu_multiprocess_collectives()
     _jax.distributed.initialize(
         coordinator_address=_os.environ["PADDLE_TRAINER_ENDPOINTS"]
         .split(",")[0],

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P, NamedSharding
-from jax import shard_map
+from .._jax_compat import shard_map
 
 from ..framework.tensor import Tensor
 from ..ops._dispatch import unwrap, wrap
@@ -109,8 +109,9 @@ class prims:
 
     @staticmethod
     def c_split(x, axis_name):  # take this rank's slice of last dim
+        from .._jax_compat import axis_size as _axis_size
         idx = jax.lax.axis_index(axis_name)
-        n = jax.lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         k = x.shape[-1] // n
         return jax.lax.dynamic_slice_in_dim(x, idx * k, k, axis=x.ndim - 1)
 
@@ -134,7 +135,8 @@ class prims:
 
     @staticmethod
     def axis_size(axis_name):
-        return jax.lax.axis_size(axis_name)
+        from .._jax_compat import axis_size as _axis_size
+        return _axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------

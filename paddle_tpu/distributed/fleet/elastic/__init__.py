@@ -1,5 +1,6 @@
 """Elastic training (reference: ``distributed/fleet/elastic/``)."""
 from .manager import (  # noqa: F401
     ElasticManager, ElasticStatus, LauncherInterface, ELASTIC_TTL,
-    ELASTIC_TIMEOUT,
+    ELASTIC_TIMEOUT, start_worker_heartbeat, maybe_start_worker_heartbeat,
 )
+from .fault_injection import FaultInjector  # noqa: F401

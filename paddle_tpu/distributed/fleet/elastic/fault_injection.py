@@ -1,0 +1,71 @@
+"""Fault injection for elastic-training tests.
+
+Drives real failures against a live ``PodLauncher`` pod: SIGKILL (crash),
+SIGSTOP (wedge — process alive but not making progress, the case only lease
+expiry can detect), delayed kills from a timer thread.  Test-harness
+machinery, but shipped in-package so operators can stage game-day drills
+against a staging pod the same way the tests do.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+
+class FaultInjector:
+    """Inject process faults into a launcher's worker pod.
+
+    ``launcher`` must expose ``pid_of(local_rank)`` (PodLauncher does).
+    Every injection is recorded in ``events`` as
+    ``(monotonic_ts, local_rank, signal)``.
+    """
+
+    def __init__(self, launcher):
+        self.launcher = launcher
+        self.events = []
+        self._timers = []
+
+    def _send(self, local_rank, sig):
+        pid = self.launcher.pid_of(local_rank)
+        if pid is None:
+            raise RuntimeError(f"no live worker at local rank {local_rank}")
+        os.kill(pid, sig)
+        self.events.append((time.monotonic(), local_rank, sig))
+        return pid
+
+    def kill(self, local_rank, sig=signal.SIGKILL):
+        """Hard-kill one worker (default SIGKILL: no handlers, no cleanup —
+        the preemption/OOM-killer model)."""
+        return self._send(local_rank, sig)
+
+    def stall(self, local_rank):
+        """SIGSTOP one worker: still "running" to the supervisor's poll, but
+        its heartbeat freezes — exercises lease-expiry detection."""
+        return self._send(local_rank, signal.SIGSTOP)
+
+    def resume(self, local_rank):
+        return self._send(local_rank, signal.SIGCONT)
+
+    def kill_after(self, delay, local_rank, sig=signal.SIGKILL):
+        """Arm a timer that kills ``local_rank`` after ``delay`` seconds
+        (ignored silently if the worker already exited)."""
+        def fire():
+            try:
+                self._send(local_rank, sig)
+            except (RuntimeError, ProcessLookupError):
+                pass
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+        return t
+
+    def cancel(self):
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()
+
+    def last_injection_time(self):
+        return self.events[-1][0] if self.events else None

@@ -31,7 +31,12 @@ class ElasticStatus:
 
 
 class LauncherInterface:
-    """What the manager drives on membership change (manager.py launcher)."""
+    """What the manager drives on membership change (manager.py launcher).
+
+    The concrete implementation is ``launch.controller.PodLauncher``; the
+    ``ElasticRelaunchController`` there turns watch/lease events into
+    kill + respawn.
+    """
 
     def launch(self):
         raise NotImplementedError
@@ -40,7 +45,8 @@ class LauncherInterface:
         raise NotImplementedError
 
     def watch(self):
-        """Return process status: None=running, 0=done, >0 failed."""
+        """Return process status: None=running, 0=done, nonzero=failed
+        (negative = died to that signal)."""
         raise NotImplementedError
 
 
@@ -75,8 +81,8 @@ class ElasticManager:
         np_spec = np if np is not None else os.getenv("PADDLE_ELASTIC_NP", "1")
         self.min_np, self.max_np = self._parse_np(np_spec)
         self.host = host or os.getenv("POD_IP", "127.0.0.1")
-        self.ttl = elastic_ttl or int(os.getenv("PADDLE_ELASTIC_TTL",
-                                                ELASTIC_TTL))
+        self.ttl = float(elastic_ttl if elastic_ttl is not None
+                         else os.getenv("PADDLE_ELASTIC_TTL", ELASTIC_TTL))
         # level 0: any pod loss is fatal; >=1: tolerate & rescale within
         # [min_np, max_np] (manager.py:179)
         self.fault_tolerance_level = fault_tolerance_level \
@@ -90,6 +96,7 @@ class ElasticManager:
         self._keepalive_thread = None
         self._watch_thread = None
         self.prefix = f"/paddle/{self.job_id}/nodes/"
+        self.done_prefix = f"/paddle/{self.job_id}/done/"
 
     @staticmethod
     def _parse_np(np_spec):
@@ -150,6 +157,17 @@ class ElasticManager:
                 out.append(lease["host"])
         return sorted(out)
 
+    # ------------------------------------------------- completion markers
+    def mark_done(self, host=None):
+        """Record a *clean* departure, so a watcher can tell graceful exit
+        apart from a fault (lease expiry without a marker)."""
+        self.store.set(f"{self.done_prefix}{host or self.host}", b"1")
+
+    def done_hosts(self):
+        n = len(self.done_prefix)
+        return sorted(k[n:] for k in
+                      self.store.keys_with_prefix(self.done_prefix))
+
     # -------------------------------------------------------------- watch
     def watch(self, callback=None, interval=1.0):
         """Poll membership; on change invoke callback(old, new) and record
@@ -161,11 +179,19 @@ class ElasticManager:
             prev = self.hosts()
             while not self.stopped:
                 time.sleep(interval)
-                cur = self.hosts()
+                try:
+                    cur = self.hosts()
+                except Exception:
+                    continue  # transient store error: retry next poll
                 if cur != prev:
                     self.need_sync = True
                     for cb in self._watchers:
-                        cb(prev, cur)
+                        # a raising callback must not kill the watch
+                        # thread — lease-expiry detection outlives it
+                        try:
+                            cb(prev, cur)
+                        except Exception:
+                            pass
                     prev = cur
 
         self._watch_thread = threading.Thread(target=loop, daemon=True)
@@ -196,5 +222,58 @@ class ElasticManager:
         if self._keepalive_thread is not None and \
                 self._keepalive_thread.is_alive():
             self._keepalive_thread.join(timeout=self.ttl)
+        if completed:
+            self.mark_done()
         self.store.delete_key(self._node_key())
         return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
+
+
+# ---------------------------------------------------------------------------
+# worker-side liveness lease (consumed by launch.controller relaunch logic)
+# ---------------------------------------------------------------------------
+
+_worker_heartbeat = None
+
+
+def start_worker_heartbeat(store_endpoint, job_id="default", host_id=None,
+                           ttl=None):
+    """Register this worker process's TTL lease against the controller-hosted
+    TCPStore and keep refreshing it from a daemon thread.
+
+    A worker that dies (SIGKILL) or wedges (SIGSTOP, deadlock) stops
+    refreshing; the controller's watcher sees the lease expire and triggers
+    kill+respawn — failure detection that covers hangs, which a plain
+    ``Popen.poll`` cannot see.  Clean exit marks done + drops the lease.
+    """
+    from ...store import TCPStore
+
+    host, port = store_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=False)
+    manager = ElasticManager(job_id=job_id, np="1", host=host_id,
+                             store=store, elastic_ttl=ttl)
+    manager.register()
+
+    import atexit
+    atexit.register(lambda: manager.stopped or manager.exit(completed=True))
+    return manager
+
+
+def maybe_start_worker_heartbeat():
+    """Start the heartbeat iff launched under an elastic controller (the
+    PADDLE_ELASTIC_STORE_ENDPOINT contract var is present). Idempotent."""
+    global _worker_heartbeat
+    if _worker_heartbeat is not None:
+        return _worker_heartbeat
+    endpoint = os.getenv("PADDLE_ELASTIC_STORE_ENDPOINT")
+    if not endpoint:
+        return None
+    rank = os.getenv("PADDLE_TRAINER_ID", "0")
+    host_id = os.getenv("PADDLE_ELASTIC_HOST_ID") or \
+        f"{os.getenv('POD_IP', '127.0.0.1')}:r{rank}"
+    job_id = os.getenv("PADDLE_ELASTIC_JOB_ID") or \
+        os.getenv("PADDLE_JOB_ID", "default")
+    ttl = os.getenv("PADDLE_ELASTIC_TTL")
+    _worker_heartbeat = start_worker_heartbeat(
+        endpoint, job_id=job_id, host_id=host_id,
+        ttl=float(ttl) if ttl else None)
+    return _worker_heartbeat

@@ -227,7 +227,7 @@ class ParallelCrossEntropy(nn.Layer):
             mesh = get_global_mesh()
             nd = unwrap(input).ndim
             in_spec = P(*([None] * (nd - 1)), "mp")
-            from jax import shard_map
+            from ..._jax_compat import shard_map
 
             def f(lg, lab):
                 return shard_map(

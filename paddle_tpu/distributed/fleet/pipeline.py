@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..._jax_compat import shard_map
 
 from ... import nn
 from ...framework.tensor import Tensor
@@ -193,6 +193,13 @@ class PipelineParallel(nn.Layer):
         return self._layers(*a, **kw)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One pipeline step; with ``scaler`` the loss scales inside the
+        compiled program, gradients unscale + finite-check globally (the
+        grad arrays span every pp stage, so the found-inf reduction across
+        stages is the XLA all-reduce over the sharded tree — the
+        HybridParallelGradScaler cross-group allreduce of the reference),
+        and an overflow skips the whole update before shrinking the scale.
+        """
         from .train_step import ParallelTrainStep
         inputs, labels = data
         if self._step is None:
@@ -204,8 +211,14 @@ class PipelineParallel(nn.Layer):
                 return loss_fn(out, y) if self._layers._loss_fn else out
 
             self._step = ParallelTrainStep(self._layers, optimizer, full_loss,
-                                           hcg=self._hcg)
+                                           hcg=self._hcg, scaler=scaler)
+        elif scaler is not None and scaler.is_enable() and \
+                self._step.scaler is None:
+            raise RuntimeError(
+                "train_batch compiled without a scaler; pass the scaler on "
+                "the first call")
         loss = self._step(inputs, labels)
+        self.last_found_inf = self._step.last_found_inf
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
@@ -385,7 +398,7 @@ def _interleaved_1f1b_tick_loop(block_apply, head_apply, blocks_local,
             lv, vjp = jax.vjp(f, blocks_local, head_params, x_s)
             seed = jnp.where(valid_b, seed_scale, 0.0).astype(lv.dtype)
             db, dh, dx = vjp(seed)
-            return (jnp.where(valid_b, lv, 0.0).astype(f32),
+            return (jnp.where(valid_b, lv, 0.0).astype(f32).reshape(1),
                     to_f32(db), to_f32(dh), dx)
 
         def mid_branch(x_s, _lab, cot, c):
@@ -393,7 +406,7 @@ def _interleaved_1f1b_tick_loop(block_apply, head_apply, blocks_local,
                 return block_apply(bl, xx, c)
             _y, vjp = jax.vjp(f, blocks_local, x_s)
             db, dx = vjp(jnp.where(valid_b, cot, jnp.zeros_like(cot)))
-            return (jnp.zeros((), f32), to_f32(db),
+            return (jnp.zeros((1,), f32), to_f32(db),
                     zeros_f32(head_params), dx)
 
         lv, db, dh, dx = jax.lax.cond(is_last_v, last_branch, mid_branch,
@@ -415,10 +428,12 @@ def _interleaved_1f1b_tick_loop(block_apply, head_apply, blocks_local,
     init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs[0]),
             jnp.zeros((K,) + xs.shape[1:], xs.dtype),
             zeros_f32(blocks_local), zeros_f32(head_params),
-            jnp.zeros_like(xs), jnp.zeros((), f32))
+            jnp.zeros_like(xs), jnp.zeros((1,), f32))
+    # (1,)-shaped loss accumulator: rank-0 scan residuals break the
+    # check_rep=False shard_map transpose on jax 0.4.x
     (_, _, _, gb, gh, dxs, loss_acc), _ = jax.lax.scan(
         tick, init, jnp.arange(T))
-    return loss_acc, gb, gh, dxs
+    return loss_acc.reshape(()), gb, gh, dxs
 
 
 def onef1b_spmd(block_fn, stacked_params, x_micro, mesh, n_micro,

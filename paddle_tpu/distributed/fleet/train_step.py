@@ -64,7 +64,8 @@ class ParallelTrainStep:
     """Build once per (model, optimizer, loss_fn); call with batches."""
 
     def __init__(self, model, optimizer, loss_fn, hcg=None, zero_stage=1,
-                 batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES):
+                 batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES,
+                 scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn  # loss_fn(model, *batch_tensors) -> scalar Tensor
@@ -74,15 +75,25 @@ class ParallelTrainStep:
         self.accumulate_steps = accumulate_steps
         self.data_axes = tuple(a for a in data_axes if self.mesh.shape[a] >= 1)
         self.batch_spec = batch_spec
+        # dynamic loss scaling INSIDE the compiled step (GradScaler parity):
+        # loss scales up before grad, grads unscale + finite-check before the
+        # update, and the update is skipped wholesale on overflow. The
+        # found-inf check runs over the GLOBAL (sharded) gradient arrays, so
+        # XLA emits the cross-stage/cross-rank reduction the reference gets
+        # from check_finite_and_unscale + hybrid found-inf allreduce.
+        self.scaler = scaler if (scaler is not None and
+                                 scaler.is_enable()) else None
+        self.last_found_inf = False
         self._params = [p for p in model.parameters() if p.trainable]
         self._buffers = [b for b in model.buffers()]
         self._compiled = None
         self._step_count = 0
 
     # ------------------------------------------------------------------
-    def _pure_step(self, param_vals, state_vals, buffer_vals, key, lr,
+    def _pure_step(self, param_vals, state_vals, buffer_vals, key, lr, scale,
                    *batch_vals):
         params, buffers = self._params, self._buffers
+        use_scaler = self.scaler is not None
 
         def compute_loss(pvals):
             # no_grad: grads come from jax.value_and_grad tracing, not the tape —
@@ -93,10 +104,20 @@ class ParallelTrainStep:
                 batch = [Tensor(v) for v in batch_vals]
                 loss = self.loss_fn(self.model, *batch)
                 new_buf = [b._value for b in buffers]
-            return loss._value, new_buf
+            raw = loss._value
+            scaled = raw * scale.astype(raw.dtype) if use_scaler else raw
+            return scaled, (raw, new_buf)
 
-        (loss_val, new_buf), grads = jax.value_and_grad(
+        (_, (loss_val, new_buf)), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(list(param_vals))
+
+        if use_scaler:
+            inv = (1.0 / scale)
+            grads = [g * inv.astype(g.dtype) for g in grads]
+            found_inf = jnp.logical_not(jnp.asarray(
+                [jnp.all(jnp.isfinite(g)) for g in grads]).all())
+        else:
+            found_inf = jnp.asarray(False)
 
         # restore optimizer accumulators from carried state, then step
         with no_grad_guard():
@@ -104,7 +125,14 @@ class ParallelTrainStep:
                 self.optimizer._restore_jit_state(state_vals)
             new_vals, new_state = self.optimizer._jit_apply(
                 params, param_vals, grads, lr=lr)
-        return loss_val, new_vals, new_state, new_buf
+        if use_scaler:
+            # overflow: keep params + accumulators exactly as they were
+            new_vals = [jnp.where(found_inf, pv, nv)
+                        for pv, nv in zip(param_vals, new_vals)]
+            if state_vals is not None:
+                new_state = [jnp.where(found_inf, sv, nv)
+                             for sv, nv in zip(state_vals, new_state)]
+        return loss_val, new_vals, new_state, new_buf, found_inf
 
     # ------------------------------------------------------------------
     def _build(self, batch_vals):
@@ -117,9 +145,11 @@ class ParallelTrainStep:
         # live/restored accumulator state must survive the discovery trace
         snapshot = self.optimizer._concrete_state_snapshot()
         # discover optimizer state structure abstractly
+        scale0 = jnp.asarray(1.0, jnp.float32)
         state_shapes = jax.eval_shape(
-            lambda pv, bv, k, lr, *b: self._pure_step(pv, None, bv, k, lr, *b),
-            param_vals, buffer_vals, key, lr0, *batch_vals)[2]
+            lambda pv, bv, k, lr, sc, *b:
+                self._pure_step(pv, None, bv, k, lr, sc, *b),
+            param_vals, buffer_vals, key, lr0, scale0, *batch_vals)[2]
 
         p_specs = [_param_spec(p, self.zero_stage, mesh) for p in self._params]
         s_specs = []
@@ -143,6 +173,7 @@ class ParallelTrainStep:
             [ns(P()) for _ in buffer_vals],
             ns(P()),  # rng key
             ns(P()),  # lr
+            ns(P()),  # loss scale
             *[ns(s) for s in b_specs],
         )
         out_shardings = (
@@ -150,6 +181,7 @@ class ParallelTrainStep:
             [ns(s) for s in p_specs],
             [ns(s) for s in s_specs],
             [ns(P()) for _ in buffer_vals],
+            ns(P()),  # found_inf
         )
         self._compiled = jax.jit(
             self._pure_step,
@@ -184,16 +216,26 @@ class ParallelTrainStep:
             self._build(batch_vals)
         key = random_mod.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        scale = jnp.asarray(
+            self.scaler._scale if self.scaler is not None else 1.0,
+            jnp.float32)
         param_vals = [p._value for p in self._params]
         buffer_vals = [b._value for b in self._buffers]
-        loss, new_params, new_state, new_buf = self._compiled(
-            param_vals, self._state_vals, buffer_vals, key, lr, *batch_vals)
+        loss, new_params, new_state, new_buf, found_inf = self._compiled(
+            param_vals, self._state_vals, buffer_vals, key, lr, scale,
+            *batch_vals)
         for p, v in zip(self._params, new_params):
             p._value = v
         for b, v in zip(self._buffers, new_buf):
             b._value = v
         self._state_vals = new_state
         self._step_count += 1
+        if self.scaler is not None:
+            # feed the compiled step's global found-inf into the scaler's
+            # dynamic-scale bookkeeping (grow/shrink + skip accounting)
+            self.last_found_inf = bool(found_inf)
+            self.scaler._found_inf = self.last_found_inf
+            self.scaler.update()
         return Tensor(loss)
 
     train_batch = __call__

@@ -1,2 +1,5 @@
 """paddle.distributed.launch parity (reference: ``distributed/launch/``)."""
 from .main import launch, main  # noqa: F401
+from .controller import (  # noqa: F401
+    PodLauncher, ElasticRelaunchController,
+)

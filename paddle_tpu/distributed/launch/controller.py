@@ -1,0 +1,417 @@
+"""Pod controller: worker process ownership + elastic relaunch.
+
+Parity: ``/root/reference/python/paddle/distributed/launch/controllers/
+collective.py`` (CollectiveController — spawn/watch/kill the local worker
+pod) and ``controllers/master.py`` + ``fleet/elastic/manager.py:126`` (the
+elastic master that turns membership changes into kill+respawn).
+
+Two layers:
+
+- ``PodLauncher`` — a concrete ``LauncherInterface``: owns the worker
+  subprocesses, allocates fresh endpoints per launch *generation*
+  (re-exchanged through the store with bounded exponential backoff on
+  multi-node), tees per-rank logs, polls liveness, and stops with
+  SIGTERM -> grace timeout -> SIGKILL escalation.
+
+- ``ElasticRelaunchController`` — wires ``ElasticManager.watch`` lease
+  events and the launcher's own process polling together: a dead (SIGKILL)
+  or wedged (lease expired while the pid still "runs") worker triggers
+  kill-remaining + backoff + respawn at the world size the configured
+  fault-tolerance level allows.  Workers resume from their latest
+  ``framework/io.py`` checkpoint — the controller guarantees *process*
+  recovery; step recovery is the training loop's checkpoint contract.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ..fleet.elastic.manager import (
+    ElasticManager, ElasticStatus, LauncherInterface,
+)
+
+
+def _free_ports(n, host="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _node_ip(master_host):
+    """This node's IP on the route toward the master (endpoint the other
+    nodes can reach). PADDLE_NODE_IP overrides."""
+    if os.environ.get("PADDLE_NODE_IP"):
+        return os.environ["PADDLE_NODE_IP"]
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((master_host, 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class PodLauncher(LauncherInterface):
+    """Own the local worker pod: spawn, log, poll, stop-with-escalation.
+
+    ``launch()`` may be called repeatedly; every call is a new *generation*
+    with freshly allocated endpoints (and, multi-node, a fresh
+    generation-scoped endpoint exchange through the store so a relaunched
+    pod can never read a dead generation's endpoints).
+    """
+
+    def __init__(self, cmd, nproc, job_id="default", node_rank=0, nnodes=1,
+                 log_dir=None, master=None, store=None, store_endpoint=None,
+                 base_env=None, grace_period=3.0, elastic_env=None,
+                 exchange_timeout=120.0):
+        self.cmd = list(cmd)
+        self.nproc = int(nproc)
+        self.job_id = job_id
+        self.node_rank = int(node_rank)
+        self.nnodes = int(nnodes)
+        self.log_dir = log_dir
+        self.master = master
+        self.store = store
+        self.store_endpoint = store_endpoint
+        self.base_env = base_env
+        self.grace_period = grace_period
+        self.elastic_env = dict(elastic_env) if elastic_env else None
+        self.exchange_timeout = exchange_timeout
+        self.generation = -1
+        self.endpoints = []
+        self._procs = []   # [{rank, local_rank, proc, log}]
+        self._codes = []   # exit codes of the current generation
+
+    # ---------------------------------------------------------- identity
+    def global_rank(self, local_rank):
+        return self.node_rank * self.nproc + local_rank
+
+    def host_id(self, local_rank):
+        """Worker lease identity (must be unique across the whole job and
+        stable across generations so a respawn overwrites, not ghosts)."""
+        return f"w{self.global_rank(local_rank)}"
+
+    def pid_of(self, local_rank):
+        for w in self._procs:
+            if w["local_rank"] == local_rank and w["proc"].poll() is None:
+                return w["proc"].pid
+        return None
+
+    @property
+    def exit_codes(self):
+        return list(self._codes)
+
+    # --------------------------------------------------- endpoint exchange
+    def _read_with_backoff(self, key):
+        """Poll the store for ``key`` with bounded exponential backoff."""
+        deadline = time.monotonic() + self.exchange_timeout
+        delay = 0.05
+        while True:
+            val = self.store.get_nowait(key)
+            if val is not None:
+                return val.decode()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"endpoint exchange: {key} not published within "
+                    f"{self.exchange_timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+
+    def _exchange_endpoints(self):
+        my_host = _node_ip(self.master.rsplit(":", 1)[0]) \
+            if (self.master and self.nnodes > 1) else "127.0.0.1"
+        ports = _free_ports(self.nproc, host=my_host)
+        local_eps = [f"{my_host}:{p}" for p in ports]
+        if self.nnodes <= 1 or self.store is None:
+            return local_eps
+        prefix = f"launch/{self.job_id}/g{self.generation}/eps"
+        self.store.set(f"{prefix}/{self.node_rank}", ",".join(local_eps))
+        endpoints = []
+        for nr in range(self.nnodes):
+            endpoints.extend(
+                self._read_with_backoff(f"{prefix}/{nr}").split(","))
+        return endpoints
+
+    # ------------------------------------------------------------- launch
+    def launch(self):
+        self.generation += 1
+        self.endpoints = self._exchange_endpoints()
+        world = self.nproc * self.nnodes
+        master_ep = self.master or self.endpoints[0]
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+        self._procs = []
+        self._codes = [None] * self.nproc
+        for local_rank in range(self.nproc):
+            rank = self.global_rank(local_rank)
+            env = dict(self.base_env if self.base_env is not None
+                       else os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_CURRENT_ENDPOINT": self.endpoints[rank],
+                "PADDLE_MASTER": master_ep,
+                "PADDLE_JOB_ID": self.job_id,
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(self.endpoints),
+                "PADDLE_RESTART_COUNT": str(self.generation),
+            })
+            if self.store_endpoint:
+                env["PADDLE_STORE_ENDPOINT"] = self.store_endpoint
+            if self.elastic_env:
+                env.update(self.elastic_env)
+                env["PADDLE_ELASTIC_HOST_ID"] = self.host_id(local_rank)
+            log = None
+            if self.log_dir:
+                log = open(os.path.join(self.log_dir,
+                                        f"workerlog.{local_rank}"), "a")
+                log.write(f"==== generation {self.generation} ====\n")
+                log.flush()
+            proc = subprocess.Popen(
+                self.cmd, env=env,
+                stdout=log if log else None,
+                stderr=subprocess.STDOUT if log else None)
+            self._procs.append({"rank": rank, "local_rank": local_rank,
+                                "proc": proc, "log": log})
+        return self._procs
+
+    # -------------------------------------------------------------- watch
+    def watch(self):
+        """Process status: None=running, 0=all done, nonzero=first failure
+        (LauncherInterface contract; negative = killed by that signal)."""
+        for i, w in enumerate(self._procs):
+            if self._codes[i] is None:
+                self._codes[i] = w["proc"].poll()
+        failures = [c for c in self._codes if c is not None and c != 0]
+        if failures:
+            return failures[0]
+        if all(c == 0 for c in self._codes) and self._codes:
+            return 0
+        return None
+
+    # --------------------------------------------------------------- stop
+    def stop(self, grace_period=None):
+        """SIGTERM the pod, wait out the grace timeout, SIGKILL stragglers.
+
+        SIGKILL is not optional politeness: a SIGSTOPped (wedged) worker
+        never delivers SIGTERM, and escalation is the only way it dies.
+        """
+        grace = self.grace_period if grace_period is None else grace_period
+        live = [w for w in self._procs if w["proc"].poll() is None]
+        for w in live:
+            try:
+                w["proc"].send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and \
+                any(w["proc"].poll() is None for w in live):
+            time.sleep(0.05)
+        for w in live:
+            if w["proc"].poll() is None:
+                try:
+                    w["proc"].kill()
+                except OSError:
+                    pass
+        for i, w in enumerate(self._procs):
+            try:
+                self._codes[i] = w["proc"].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._codes[i] = -signal.SIGKILL
+            if w["log"]:
+                w["log"].close()
+                w["log"] = None
+        return list(self._codes)
+
+    # ---------------------------------------------------------- supervise
+    def supervise(self, poll_interval=0.2):
+        """Non-elastic run-to-completion: first failure kills the pod
+        (legacy controllers/collective.py watch loop). Returns exit codes."""
+        try:
+            while True:
+                st = self.watch()
+                if st == 0:
+                    break
+                if st is not None:
+                    self.stop()
+                    break
+                time.sleep(poll_interval)
+        finally:
+            for w in self._procs:
+                if w["proc"].poll() is None:
+                    w["proc"].kill()
+                if w["log"]:
+                    w["log"].close()
+                    w["log"] = None
+        return [c if c is not None else -signal.SIGKILL
+                for c in self._codes]
+
+
+class ElasticRelaunchController:
+    """Turn fault signals into kill+respawn (reference elastic master).
+
+    Two detection paths feed one relaunch decision:
+
+    - ``launcher.watch()`` — a worker *exited* nonzero (crash, SIGKILL);
+    - ``manager.watch`` lease events — a worker's TTL lease expired without
+      a clean-exit marker (covers wedged workers whose pid still runs).
+
+    On fault: stop the remaining pod with escalation, back off
+    exponentially (bounded), re-exchange endpoints, respawn.  Fault
+    tolerance level 0 aborts instead (``ElasticStatus.ERROR``); levels
+    >= 1 relaunch until ``max_restarts`` is exhausted.
+    """
+
+    def __init__(self, launcher, manager, max_restarts=3, backoff_base=0.5,
+                 backoff_cap=8.0, poll_interval=0.2, watch_interval=0.25,
+                 register_pod=False, worker_job_id=None):
+        self.launcher = launcher
+        self.manager = manager
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.poll_interval = poll_interval
+        self.watch_interval = watch_interval
+        self.register_pod = register_pod
+        # where the LOCAL workers' leases live: same namespace as `manager`
+        # in single-node worker-lease mode, a separate one in multi-node
+        # pod mode (worker leases must not count toward the pod quorum)
+        if worker_job_id:
+            self.worker_prefix = f"/paddle/{worker_job_id}/nodes/"
+            self.worker_done_prefix = f"/paddle/{worker_job_id}/done/"
+        else:
+            self.worker_prefix = manager.prefix
+            self.worker_done_prefix = manager.done_prefix
+        self.restarts = 0
+        self.events = []          # (monotonic_ts, kind, detail) audit trail
+        self._fault = threading.Event()
+        self._relaunching = False
+
+    # ------------------------------------------------------------- events
+    def _record(self, kind, detail=""):
+        self.events.append((time.monotonic(), kind, detail))
+
+    def _local_host_ids(self):
+        return {self.launcher.host_id(lr): lr
+                for lr in range(self.launcher.nproc)}
+
+    def _on_membership(self, old, new):
+        if self._relaunching:
+            return  # self-inflicted churn while tearing down / respawning
+        departed = set(old) - set(new)
+        if not departed:
+            self._record("join", ",".join(sorted(set(new) - set(old))))
+            return
+        done = set(self.manager.done_hosts())
+        local = self._local_host_ids()
+        codes = self.launcher.exit_codes
+        benign = set()
+        for host in departed:
+            if host in done:
+                benign.add(host)        # clean exit, marker present
+            elif host in local and local[host] < len(codes):
+                if codes[local[host]] == 0:
+                    benign.add(host)    # our worker, exited cleanly
+        faulty = departed - benign
+        if faulty:
+            self._record("lease_expired", ",".join(sorted(faulty)))
+            self._fault.set()
+
+    # ----------------------------------------------------------- decision
+    def _decide(self):
+        """Map the fault to an ElasticStatus per FT level / world bounds."""
+        if self.manager.fault_tolerance_level <= 0:
+            return ElasticStatus.ERROR
+        if self.launcher.nnodes > 1:
+            # pod-level membership: rescale within [min_np, max_np]
+            n_alive = len(self.manager.hosts())
+            return self.manager.pod_leave_status(n_alive)
+        return ElasticStatus.RESTART
+
+    # ------------------------------------------------------------ relaunch
+    def _clear_worker_state(self):
+        """Drop our workers' leases + done markers so the next generation
+        starts from a clean membership baseline (a lease expiring *after*
+        respawn must not read as a fresh fault)."""
+        for host in self._local_host_ids():
+            self.manager.store.delete_key(f"{self.worker_prefix}{host}")
+            self.manager.store.delete_key(
+                f"{self.worker_done_prefix}{host}")
+
+    def _relaunch(self):
+        self._relaunching = True
+        try:
+            self.restarts += 1
+            self._record("stop", f"restart {self.restarts}")
+            self.launcher.stop()
+            self._clear_worker_state()
+            backoff = min(self.backoff_cap,
+                          self.backoff_base * (2 ** (self.restarts - 1)))
+            time.sleep(backoff)
+            self.launcher.launch()
+            self._record("relaunch", f"generation {self.launcher.generation}")
+        finally:
+            self._fault.clear()
+            self._relaunching = False
+
+    # ----------------------------------------------------------------- run
+    def run(self):
+        """Supervise until completion (returns 0) or unrecoverable failure
+        (returns the failing worker's exit code)."""
+        if self.register_pod:
+            self.manager.register()
+        self.manager.watch(self._on_membership,
+                           interval=self.watch_interval)
+        self.launcher.launch()
+        self._record("launch", "generation 0")
+        completed = False
+        try:
+            while True:
+                st = self.launcher.watch()
+                if st == 0:
+                    self._record("completed")
+                    completed = True
+                    return 0
+                fault = st is not None or self._fault.is_set()
+                if fault:
+                    detail = f"exit={st}" if st is not None else "lease"
+                    self._record("fault", detail)
+                    decision = self._decide()
+                    if decision == ElasticStatus.HOLD:
+                        # wait (bounded by the manager's timeout contract)
+                        # for membership to recover before respawning; a
+                        # quorum that never comes back is an abort, not a
+                        # doomed relaunch into a timed-out endpoint exchange
+                        self._record("hold")
+                        self.launcher.stop()
+                        if not self.manager.wait_ready():
+                            self._record("abort", "hold timeout")
+                            decision = ElasticStatus.ERROR
+                    if decision == ElasticStatus.ERROR or \
+                            self.restarts >= self.max_restarts:
+                        self._record("abort",
+                                     f"decision={decision} "
+                                     f"restarts={self.restarts}")
+                        codes = self.launcher.stop()
+                        bad = [c for c in codes if c]
+                        return (st if st else (bad[0] if bad else 1))
+                    self._relaunch()
+                time.sleep(self.poll_interval)
+        finally:
+            self.manager.stopped = True
+            if self.register_pod:
+                # a failed pod must NOT leave a done marker: peers use the
+                # marker to tell clean exit from a fault they must react to
+                self.manager.exit(completed=completed)

@@ -3,7 +3,10 @@
 Parity: ``/root/reference/python/paddle/distributed/launch/main.py:18 launch``
 + ``controllers/collective.py`` — spawn one worker process per device with the
 PADDLE_TRAINER_* env contract, tee per-rank logs, kill the pod on first
-failure.
+failure.  Process ownership lives in ``controller.PodLauncher``; with
+``--elastic_level > 0`` the pod is supervised by
+``controller.ElasticRelaunchController`` which kills + respawns workers on
+fault (dead process or expired liveness lease) instead of aborting.
 
 TPU-native notes: on a TPU pod slice the runtime already runs one process per
 host and ``jax.distributed.initialize()`` discovers peers from the TPU
@@ -15,43 +18,18 @@ coordinator (the TCPStore analog).
 Usage::
 
     python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py --lr 3
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 \
+        --elastic_level 1 --max_restarts 3 train.py   # self-healing pod
 """
 from __future__ import annotations
 
 import argparse
 import os
-import signal
-import socket
-import subprocess
 import sys
-import time
 
-
-def _free_ports(n, host="127.0.0.1"):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.bind((host, 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
-def _node_ip(master_host):
-    """This node's IP on the route toward the master (endpoint the other
-    nodes can reach). PADDLE_NODE_IP overrides."""
-    if os.environ.get("PADDLE_NODE_IP"):
-        return os.environ["PADDLE_NODE_IP"]
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect((master_host, 1))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+from .controller import (
+    ElasticRelaunchController, PodLauncher, _free_ports, _node_ip,  # noqa: F401
+)
 
 
 def _parse_args(argv=None):
@@ -71,6 +49,17 @@ def _parse_args(argv=None):
     p.add_argument("--job_id", default="default")
     p.add_argument("--run_mode", default="collective",
                    choices=["collective"])
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0)),
+                   help="fault tolerance: 0 = first failure kills the pod; "
+                        ">= 1 = kill + respawn workers on fault")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS",
+                                              3)))
+    p.add_argument("--elastic_ttl", type=float,
+                   default=float(os.environ.get("PADDLE_ELASTIC_TTL", 10.0)),
+                   help="worker liveness lease TTL seconds (elastic mode)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -84,7 +73,9 @@ def launch(argv=None):
     # pod's workers (they would override the fresh contract below)
     for var in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
                 "PADDLE_LOCAL_RANK", "PADDLE_CURRENT_ENDPOINT",
-                "PADDLE_TRAINER_ENDPOINTS", "PADDLE_STORE_ENDPOINT"):
+                "PADDLE_TRAINER_ENDPOINTS", "PADDLE_STORE_ENDPOINT",
+                "PADDLE_RESTART_COUNT", "PADDLE_ELASTIC_STORE_ENDPOINT",
+                "PADDLE_ELASTIC_HOST_ID"):
         os.environ.pop(var, None)
 
     if args.nproc_per_node is not None:
@@ -96,96 +87,75 @@ def launch(argv=None):
     nnodes = int(str(args.nnodes).split(":")[0])
     world = nproc * nnodes
 
-    host = "127.0.0.1"
     store = None
     store_ep = None
     if args.master:
         # multi-node: node 0's launcher hosts the native TCPStore at
         # --master; every node publishes its workers' endpoints and reads
-        # the full sorted list back (controllers/master.py endpoint
-        # exchange). The same store stays alive for the workers' host-side
-        # object collectives (PADDLE_STORE_ENDPOINT).
+        # the full list back (controllers/master.py endpoint exchange —
+        # done inside PodLauncher, per launch generation). The same store
+        # stays alive for the workers' host-side object collectives
+        # (PADDLE_STORE_ENDPOINT).
         from ..store import TCPStore
         mhost, mport = args.master.rsplit(":", 1)
         store = TCPStore(mhost, int(mport),
                          is_master=(args.node_rank == 0),
                          world_size=nnodes)
-        my_host = _node_ip(mhost) if nnodes > 1 else host
-        ports = _free_ports(nproc, host=my_host)
-        local_eps = [f"{my_host}:{p}" for p in ports]
-        store.set(f"launch/{args.job_id}/eps/{args.node_rank}",
-                  ",".join(local_eps))
-        endpoints = []
-        for nr in range(nnodes):
-            endpoints.extend(
-                store.get(f"launch/{args.job_id}/eps/{nr}")
-                .decode().split(","))
-        master_ep = args.master
         store_ep = args.master
     else:
-        ports = _free_ports(nproc + 1)
-        endpoints = [f"{host}:{p}" for p in ports[:nproc]]
-        master_ep = endpoints[0]
         # host a store for the workers' object collectives; optional on a
-        # single node (everything else works without it)
+        # single node unless elastic supervision needs worker leases
         try:
             from ..store import TCPStore
-            store = TCPStore(host, ports[nproc], is_master=True,
+            store = TCPStore("127.0.0.1", 0, is_master=True,
                              world_size=world)
-            store_ep = f"{host}:{store.port}"
+            store_ep = f"127.0.0.1:{store.port}"
         except Exception:
+            if args.elastic_level > 0:
+                raise
             store = None
 
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
+    elastic_env = None
+    worker_job_id = None
+    if args.elastic_level > 0:
+        # single node: worker leases ARE the membership the controller
+        # watches. Multi node: membership is pod leases under args.job_id,
+        # so worker heartbeats go to a per-node namespace — they must not
+        # count toward the pod quorum in rescale decisions.
+        worker_job_id = args.job_id if nnodes == 1 else \
+            f"{args.job_id}--wk{args.node_rank}"
+        elastic_env = {
+            "PADDLE_ELASTIC_STORE_ENDPOINT": store_ep,
+            "PADDLE_ELASTIC_JOB_ID": worker_job_id,
+            "PADDLE_ELASTIC_TTL": str(args.elastic_ttl),
+        }
 
-    procs = []
-    for local_rank in range(nproc):
-        rank = args.node_rank * nproc + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-            "PADDLE_MASTER": master_ep,
-            "PADDLE_JOB_ID": args.job_id,
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-        })
-        if store_ep:
-            env["PADDLE_STORE_ENDPOINT"] = store_ep
-        cmd = [sys.executable, args.training_script] + \
-            list(args.training_script_args)
-        if args.log_dir:
-            log = open(os.path.join(args.log_dir,
-                                    f"workerlog.{local_rank}"), "w")
-            procs.append((subprocess.Popen(cmd, env=env, stdout=log,
-                                           stderr=subprocess.STDOUT), log))
-        else:
-            procs.append((subprocess.Popen(cmd, env=env), None))
+    cmd = [sys.executable, args.training_script] + \
+        list(args.training_script_args)
+    launcher = PodLauncher(
+        cmd, nproc, job_id=args.job_id, node_rank=args.node_rank,
+        nnodes=nnodes, log_dir=args.log_dir, master=args.master,
+        store=store, store_endpoint=store_ep, elastic_env=elastic_env)
 
-    # supervise: first failure kills the pod (controllers/collective.py watch)
-    codes = [None] * nproc
     try:
-        while any(c is None for c in codes):
-            for i, (proc, _log) in enumerate(procs):
-                if codes[i] is None:
-                    rc = proc.poll()
-                    if rc is not None:
-                        codes[i] = rc
-                        if rc != 0:
-                            for j, (p2, _l2) in enumerate(procs):
-                                if codes[j] is None:
-                                    p2.send_signal(signal.SIGTERM)
-            time.sleep(0.2)
+        if args.elastic_level > 0:
+            manager = _build_elastic_manager(
+                args, store, world, nnodes)
+            controller = ElasticRelaunchController(
+                launcher, manager, max_restarts=args.max_restarts,
+                register_pod=(nnodes > 1),
+                worker_job_id=worker_job_id if nnodes > 1 else None)
+            rc = controller.run()
+            codes = launcher.exit_codes
+            if rc == 0:
+                codes = [0] * nproc
+        else:
+            launcher.launch()
+            codes = launcher.supervise()
     finally:
-        for proc, log in procs:
-            if proc.poll() is None:
-                proc.kill()
-            if log:
-                log.close()
         if store is not None:
-            if args.master and nnodes > 1 and all(c == 0 for c in codes):
+            if args.master and nnodes > 1 and \
+                    all(c == 0 for c in launcher.exit_codes):
                 # multi-node: node 0 hosts the store every node's workers
                 # use — sync launchers before the master tears it down
                 # (skipped on failure so a dead node cannot hang teardown)
@@ -195,6 +165,21 @@ def launch(argv=None):
                     pass
             store.close()
     return codes
+
+
+def _build_elastic_manager(args, store, world, nnodes):
+    """Build the membership manager the relaunch controller watches.
+
+    Single node: leases are the *workers* (min = max = world — any missing
+    worker is a fault to repair). Multi node: leases are pods, bounded by
+    the ``--nnodes lo:hi`` spec so membership loss can rescale.
+    """
+    from ..fleet.elastic import ElasticManager
+    np_spec = args.nnodes if (nnodes > 1 and ":" in str(args.nnodes)) \
+        else str(world if nnodes == 1 else nnodes)
+    return ElasticManager(job_id=args.job_id, np=np_spec, store=store,
+                          elastic_ttl=args.elastic_ttl,
+                          fault_tolerance_level=args.elastic_level)
 
 
 def main():

@@ -43,11 +43,14 @@ def init_parallel_env():
     global _initialized, _process_store
     if _initialized:
         return env_mod.ParallelEnv()
+    from .._jax_compat import distributed_is_initialized
     world = env_mod.get_world_size()
     if world > 1 and "PADDLE_TRAINER_ENDPOINTS" in os.environ \
-            and not jax.distributed.is_initialized():
+            and not distributed_is_initialized():
         # normally already done at paddle_tpu import (the bootstrap must
         # precede any XLA backend touch); kept for direct callers
+        from .._jax_compat import enable_cpu_multiprocess_collectives
+        enable_cpu_multiprocess_collectives()
         eps = env_mod.get_endpoints()
         jax.distributed.initialize(
             coordinator_address=eps[0],
@@ -59,6 +62,10 @@ def init_parallel_env():
         host, port = store_ep.rsplit(":", 1)
         _process_store = TCPStore(host, int(port), is_master=False,
                                   world_size=world)
+    # under an elastic relaunch controller, publish this worker's liveness
+    # lease so a wedged (not just dead) worker is detected (no-op otherwise)
+    from .fleet.elastic import maybe_start_worker_heartbeat
+    maybe_start_worker_heartbeat()
     mesh = build_mesh(dp=len(jax.devices()))
     set_global_mesh(mesh)
     _set_default_group(Group("dp", mesh))
@@ -111,45 +118,6 @@ class DataParallel(Layer):
 
 ParallelEnv = env_mod.ParallelEnv
 
-
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """paddle.distributed.spawn parity: fork `nprocs` python processes with the
-    PADDLE_* env contract on localhost."""
-    import multiprocessing as mp
-    import socket
-
-    if nprocs <= 0:
-        nprocs = max(1, len(jax.devices()))
-
-    def find_free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    ports = [find_free_port() for _ in range(nprocs)]
-    eps = ",".join(f"127.0.0.1:{p}" for p in ports)
-    ctx = mp.get_context("spawn")
-    procs = []
-    for rank in range(nprocs):
-        child_env = {
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(nprocs),
-            "PADDLE_TRAINER_ENDPOINTS": eps,
-            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{ports[rank]}",
-        }
-        p = ctx.Process(target=_spawn_entry, args=(func, args, child_env),
-                        daemon=daemon)
-        p.start()
-        procs.append(p)
-    if join:
-        for p in procs:
-            p.join()
-        for p in procs:
-            if p.exitcode != 0:
-                raise RuntimeError(f"spawned process failed: {p.exitcode}")
-    return procs
-
-
-def _spawn_entry(func, args, child_env):
-    os.environ.update(child_env)
-    func(*args)
+# paddle.distributed.spawn moved to its own module (store-backed rendezvous);
+# re-exported here for the historical import path
+from .spawn import spawn, SpawnContext  # noqa: F401,E402

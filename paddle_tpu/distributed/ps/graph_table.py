@@ -36,7 +36,10 @@ class GraphTable:
         # client registers them on their owning shard); a standalone
         # table counts both endpoints (common_graph_table node semantics)
         self._track_dst = bool(track_dst_nodes)
-        self._frozen = None  # (adj arrays, cumw) built lazily for sampling
+        # src -> (dst int64[], w float32[], p float64[]) built lazily on
+        # first sample; mutation (add_edges/load) invalidates. Sampling a
+        # static graph then never re-converts Python adjacency lists.
+        self._frozen = None
 
     # -- construction (GraphTable::add_graph_node / load) -----------------
     def add_nodes(self, ids):
@@ -106,6 +109,18 @@ class GraphTable:
         return np.stack(out) if out else np.zeros((0,), np.float32)
 
     # -- sampling (GraphTable::random_sample_neighbors) -------------------
+    def _freeze(self):
+        """Materialize per-source numpy adjacency (+ normalized sampling
+        probabilities) once per graph version."""
+        if self._frozen is None:
+            frozen = {}
+            for src, adj in self._adj.items():
+                w = np.asarray(self._w[src], np.float32)
+                p = w.astype(np.float64)
+                frozen[src] = (np.asarray(adj, np.int64), w, p / p.sum())
+            self._frozen = frozen
+        return self._frozen
+
     def sample_neighbors(self, ids, sample_size, need_weight=False):
         """Per node: up to ``sample_size`` neighbors — WITHOUT replacement
         uniformly when the node has more than ``sample_size`` neighbors
@@ -115,27 +130,26 @@ class GraphTable:
         """
         ids = np.asarray(ids).reshape(-1)
         k = int(sample_size)
+        frozen = self._freeze()
         nbrs = np.full((len(ids), k), -1, np.int64)
         wout = np.zeros((len(ids), k), np.float32)
         counts = np.zeros(len(ids), np.int32)
         for row, fid in enumerate(ids):
-            adj = self._adj.get(int(fid))
-            if not adj:
+            entry = frozen.get(int(fid))
+            if entry is None:
                 continue
-            n = len(adj)
+            dst, w, p = entry
+            n = len(dst)
             if n <= k:
                 take = np.arange(n)
             elif need_weight:
-                p = np.asarray(self._w[int(fid)], np.float64)
-                p = p / p.sum()
                 take = self._rng.choice(n, size=k, replace=True, p=p)
             else:
                 take = self._rng.choice(n, size=k, replace=False)
             counts[row] = len(take)
-            nbrs[row, :len(take)] = np.asarray(adj, np.int64)[take]
+            nbrs[row, :len(take)] = dst[take]
             if need_weight:
-                wout[row, :len(take)] = np.asarray(
-                    self._w[int(fid)], np.float32)[take]
+                wout[row, :len(take)] = w[take]
         if need_weight:
             return nbrs, counts, wout
         return nbrs, counts

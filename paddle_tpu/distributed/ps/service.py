@@ -281,8 +281,12 @@ class PsRpcClient:
         # shards own only their id-range: edge destinations register on
         # their OWN shard (add_graph_edges below), never the source's
         kw = dict(kw, track_dst_nodes=False)
-        for s in self.servers:
-            self._rpc.rpc_sync(s, _srv_create_graph, args=(table_id, kw))
+        base_seed = kw.pop("seed", 0) or 0
+        for i, s in enumerate(self.servers):
+            # distinct per-shard seed: identical streams across shards
+            # would correlate the per-shard draws a sampled batch merges
+            self._rpc.rpc_sync(s, _srv_create_graph,
+                               args=(table_id, dict(kw, seed=base_seed + i)))
 
     def add_graph_nodes(self, table_id, ids):
         ids_flat, owner = self._shard(ids)

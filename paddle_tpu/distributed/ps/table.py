@@ -392,6 +392,7 @@ class SsdSparseTable(MemorySparseTable):
         from collections import OrderedDict
         self._rows = OrderedDict()  # insertion order == LRU order
         self.max_mem_rows = int(max_mem_rows)
+        self._owns_path = path is None
         if path is None:
             f = tempfile.NamedTemporaryFile(suffix=".ssdtable",
                                             delete=False)
@@ -474,8 +475,22 @@ class SsdSparseTable(MemorySparseTable):
                  else np.zeros((0, self.emb_dim), np.float32), **arrs)
 
     def load(self, path):
+        # restore REPLACES table contents: stale spill rows from the
+        # pre-load state would otherwise inflate size/disk_rows and
+        # resurrect dead values when an absent fid is next touched
+        self._db.execute("DELETE FROM rows")
+        self._db.commit()
+        self._rows.clear()
+        self._slots.clear()
+        self._spilled = 0
         super().load(path)
         self._evict_lru()  # respect the residency bound after restore
 
     def close(self):
         self._db.close()
+        if self._owns_path:
+            import os
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass

@@ -1,0 +1,171 @@
+"""``paddle.distributed.spawn`` — in-process multiprocessing launch.
+
+Parity: ``/root/reference/python/paddle/distributed/spawn.py:472 spawn`` +
+``MultiprocessContext`` — fork ``nprocs`` worker processes from Python (no
+CLI launcher), give each the PADDLE_* env contract, and propagate child
+tracebacks to the parent.
+
+TPU-native substitution: instead of the reference's pre-assigned port list,
+rendezvous is *store-backed*: the parent hosts the native TCPStore, every
+child binds its own free port and publishes ``spawn/<job>/ep/<rank>``, then
+reads the full endpoint list back.  Child-chosen ports cannot race a parent
+pre-allocation, and the same store stays alive as the workers'
+``PADDLE_STORE_ENDPOINT`` for host-side object collectives — the role the
+reference's gloo store plays after rendezvous.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+import traceback
+
+
+class SpawnContext:
+    """Handle over the spawned pod (reference MultiprocessContext parity).
+
+    ``join(timeout)`` reaps the workers and raises the first failing child's
+    traceback in the parent.  Iterating/indexing exposes the raw
+    ``multiprocessing.Process`` objects.
+    """
+
+    def __init__(self, processes, store, job_id):
+        self.processes = processes
+        self._store = store
+        self._job_id = job_id
+
+    # list-like access keeps code written against a plain process list
+    # (the previous spawn() return type) working
+    def __iter__(self):
+        return iter(self.processes)
+
+    def __getitem__(self, i):
+        return self.processes[i]
+
+    def __len__(self):
+        return len(self.processes)
+
+    def pids(self):
+        return [p.pid for p in self.processes]
+
+    def join(self, timeout=None):
+        """Wait for every worker; raise on the first nonzero exit."""
+        try:
+            for p in self.processes:
+                p.join(timeout)
+            for rank, p in enumerate(self.processes):
+                if p.is_alive():
+                    raise TimeoutError(
+                        f"spawned rank {rank} still running after "
+                        f"{timeout}s")
+                if p.exitcode != 0:
+                    err = self._store.get_nowait(
+                        f"spawn/{self._job_id}/err/{rank}")
+                    detail = f":\n{err.decode()}" if err else ""
+                    raise RuntimeError(
+                        f"spawned rank {rank} failed with exit code "
+                        f"{p.exitcode}{detail}")
+            return True
+        finally:
+            if all(not p.is_alive() for p in self.processes):
+                self._close()
+
+    def _close(self):
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+            self._store = None
+
+    def terminate(self):
+        for p in self.processes:
+            if p.is_alive():
+                p.terminate()
+        self._close()
+
+
+def _bind_free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(func, args, rank, nprocs, store_port, job_id):
+    """Child entry: store-backed endpoint exchange, env contract, run."""
+    from .store import TCPStore
+
+    store = TCPStore("127.0.0.1", store_port, is_master=False,
+                     world_size=nprocs)
+    try:
+        port = _bind_free_port()
+        store.set(f"spawn/{job_id}/ep/{rank}", f"127.0.0.1:{port}")
+        endpoints = [store.get(f"spawn/{job_id}/ep/{r}").decode()
+                     for r in range(nprocs)]
+        os.environ.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_LOCAL_RANK": str(rank),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": endpoints[0],
+            "PADDLE_JOB_ID": job_id,
+            "PADDLE_STORE_ENDPOINT": f"127.0.0.1:{store_port}",
+        })
+        func(*args)
+    except BaseException:
+        # ship the traceback to the parent through the rendezvous store —
+        # the reference uses an error queue (spawn.py _func_wrapper)
+        try:
+            store.set(f"spawn/{job_id}/err/{rank}",
+                      traceback.format_exc().encode())
+        except Exception:
+            pass
+        raise
+    finally:
+        store.close()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func`` in ``nprocs`` fresh processes with the PADDLE_* env
+    contract (reference ``paddle.distributed.spawn``).
+
+    Returns the joined ``SpawnContext`` (``join=True``, the default — raises
+    if any child failed) or the live context (``join=False``).
+    """
+    from .store import TCPStore
+
+    if nprocs <= 0:
+        env_n = os.environ.get("PADDLE_TRAINERS_NUM")
+        if env_n:
+            nprocs = int(env_n)
+        else:
+            import jax
+            nprocs = max(1, len(jax.devices()))
+
+    job_id = options.get("job_id", f"spawn{os.getpid()}")
+    ctx = multiprocessing.get_context(options.get("start_method", "spawn"))
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=nprocs)
+
+    procs = []
+    try:
+        for rank in range(nprocs):
+            p = ctx.Process(
+                target=_spawn_worker,
+                args=(func, args, rank, nprocs, store.port, job_id),
+                daemon=daemon)
+            p.start()
+            procs.append(p)
+    except Exception:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        store.close()
+        raise
+
+    context = SpawnContext(procs, store, job_id)
+    if join:
+        context.join()
+    return context

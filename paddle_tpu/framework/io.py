@@ -61,8 +61,19 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    # write-then-rename so a checkpoint is never half-written: a worker
+    # SIGKILLed (preemption, elastic relaunch) mid-save must leave the
+    # previous checkpoint intact for resume, not a truncated pickle
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load(path, return_numpy=False, **configs):

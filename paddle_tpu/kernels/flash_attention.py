@@ -85,9 +85,16 @@ def _interpret() -> bool:
 
 
 def _no_x64(fn):
+    from .._jax_compat import enable_x64
+
     @functools.wraps(fn)
     def inner(*a, **kw):
-        with jax.enable_x64(False):
+        if _interpret():
+            # interpret mode has no Mosaic 64-bit restriction, and toggling
+            # x64 inside an outer trace splits cached sub-jaxprs across
+            # dtype regimes (i32/i64 func.call mismatch at lowering)
+            return fn(*a, **kw)
+        with enable_x64(False):
             return fn(*a, **kw)
     return inner
 
@@ -107,8 +114,9 @@ def _kv_bounds_mask(s, ki, bk, kv_len):
     return jnp.where(col < np.int32(kv_len), s, jnp.float32(_NEG_INF))
 
 
-_ARB = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel", "arbitrary"))
+# CompilerParams is the jax>=0.6 name; 0.4.x calls it TPUCompilerParams
+_ARB = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_ARB = _ARB(dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 # ---------------------------------------------------------------------------

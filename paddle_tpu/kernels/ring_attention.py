@@ -34,7 +34,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
     """
     B, Sl, H, D = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(D)
-    n = lax.axis_size(axis_name)
+    from .._jax_compat import axis_size as _axis_size
+    n = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
 
     qt = jnp.einsum("bshd->bhsd", q).astype(jnp.float32)
@@ -68,12 +69,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
         return (o, m_new, l, kb, vb), None
 
     # mark the accumulators device-varying over the ring axis so the scan
-    # carry type matches across iterations (they mix with the varying kv)
+    # carry type matches across iterations (they mix with the varying kv);
+    # identity on jax versions without the varying-axis type system
     def _vary(x):
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return lax.pvary(x, (axis_name,))
+        from .._jax_compat import pvary
+        return pvary(x, (axis_name,))
 
     init = (
         _vary(jnp.zeros((B, H, Sl, D), jnp.float32)),
@@ -93,7 +93,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str, causal=False,
     the ring. q/k/v: [B, S, H, D] jax arrays (or anything with seq on dim 1).
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .._jax_compat import shard_map
 
     spec_entries = [None] * q.ndim
     spec_entries[seq_dim] = axis_name

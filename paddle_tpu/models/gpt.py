@@ -31,7 +31,7 @@ from dataclasses import dataclass, field
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from .. import nn
@@ -696,12 +696,16 @@ class GPTHybridTrainStep:
                 if n_micro == 1:
                     total = head(apply_blocks(xs[0]), labs[0])
                 else:
+                    # (1,)-shaped accumulator: a rank-0 scan carry/residual
+                    # breaks shard_map's check_rep=False transpose on jax
+                    # 0.4.x (spec check rejects rank-0 residuals)
                     def micro(total, xl):
                         x, lab = xl
-                        return total + head(apply_blocks(x), lab), None
+                        return total + head(apply_blocks(x),
+                                            lab).reshape(1), None
                     total, _ = jax.lax.scan(
-                        micro, jnp.zeros((), jnp.float32), (xs, labs))
-                    total = total / n_micro
+                        micro, jnp.zeros((1,), jnp.float32), (xs, labs))
+                    total = total.reshape(()) / n_micro
                 return jax.lax.pmean(total, ("dp", "sharding"))
 
             n_ticks = n_micro + pp - 1
@@ -728,8 +732,9 @@ class GPTHybridTrainStep:
                             if last:
                                 total = total + jax.lax.cond(
                                     stage == pp - 1,
-                                    lambda s=state, l=labs[mi]: head(s, l),
-                                    lambda: jnp.zeros((), jnp.float32))
+                                    lambda s=state, l=labs[mi]:
+                                        head(s, l).reshape(1),
+                                    lambda: jnp.zeros((1,), jnp.float32))
                             else:
                                 collect = collect.at[mi].set(
                                     jnp.where(stage == pp - 1, state,
@@ -753,8 +758,8 @@ class GPTHybridTrainStep:
                             lab = jnp.take(labs, mi_c, axis=0)
                             tot = tot + jax.lax.cond(
                                 valid & (stage == pp - 1),
-                                lambda: head(state, lab),
-                                lambda: jnp.zeros((), jnp.float32))
+                                lambda: head(state, lab).reshape(1),
+                                lambda: jnp.zeros((1,), jnp.float32))
                         else:
                             cur = jax.lax.dynamic_index_in_dim(
                                 collect, mi_c, 0, keepdims=False)
@@ -773,13 +778,13 @@ class GPTHybridTrainStep:
 
                 run_round = run_round_unrolled if unroll else run_round_scan
                 cur_in = xs
-                total = jnp.zeros((), jnp.float32)
+                total = jnp.zeros((1,), jnp.float32)
                 for c in range(vpp):
                     last = c == vpp - 1
                     collect, total = run_round(cur_in, c, last, total)
                     if not last:
                         cur_in = jax.lax.ppermute(collect, "pp", rotate)
-                total = jax.lax.psum(total, "pp") / n_micro
+                total = jax.lax.psum(total.reshape(()), "pp") / n_micro
                 return jax.lax.pmean(total, ("dp", "sharding"))
 
             if n_ticks <= _UNROLL_TICKS:
@@ -819,18 +824,20 @@ class GPTHybridTrainStep:
                 valid = (stage == pp - 1) & (mi >= 0) & (mi < n_micro)
                 lab = jnp.take(labs, jnp.clip(mi, 0, n_micro - 1), axis=0)
                 loss_t = jax.lax.cond(
-                    valid, lambda: head(state, lab),
-                    lambda: jnp.zeros((), jnp.float32))
+                    valid, lambda: head(state, lab).reshape(1),
+                    lambda: jnp.zeros((1,), jnp.float32))
                 total = total + loss_t
                 state = jax.lax.ppermute(state, "pp", rotate)
                 return (state, total), None
 
             state0 = jnp.zeros_like(xs[0])
+            # (1,)-shaped accumulator: rank-0 scan residuals break the
+            # check_rep=False shard_map transpose on jax 0.4.x
             (state, total), _ = jax.lax.scan(
-                tick, (state0, jnp.zeros((), jnp.float32)),
+                tick, (state0, jnp.zeros((1,), jnp.float32)),
                 jnp.arange(n_ticks))
             # mean over micro-batches and over dp/sharding batch shards
-            total = jax.lax.psum(total, "pp") / n_micro
+            total = jax.lax.psum(total.reshape(()), "pp") / n_micro
             return jax.lax.pmean(total, ("dp", "sharding"))
 
         data_spec = P(None, ("dp", "sharding"), None)

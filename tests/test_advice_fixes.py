@@ -422,6 +422,7 @@ def test_bootstrap_guard_blocks_child_processes():
     r = subprocess.run(
         [_sys.executable, "-c",
          "import jax, paddle_tpu; "
-         "assert not jax.distributed.is_initialized(); print('ok')"],
+         "assert not paddle_tpu._jax_compat.distributed_is_initialized(); "
+         "print('ok')"],
         env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0 and "ok" in r.stdout, r.stderr[-2000:]

@@ -1,0 +1,450 @@
+"""Elastic fault tolerance: relaunch controller, distributed.spawn, and
+kill-and-recover fault injection.
+
+Parity model: reference test_fleet_elastic_manager / test_launch_coverage +
+the elastic master's kill-and-respawn loop (reference
+``fleet/elastic/manager.py:126``, ``launch/controllers/master.py``), and
+``paddle.distributed.spawn`` tests (spawn.py:472) — here against REAL worker
+subprocesses: a SIGKILLed trainer is detected, the pod is torn down with
+escalation, respawned, and training resumes from the latest checkpoint with
+a loss trajectory equivalent to an uninterrupted run.
+"""
+import os
+import signal
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.launch import (
+    PodLauncher, ElasticRelaunchController,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FaultInjector,
+)
+from paddle_tpu.distributed.fleet.elastic.manager import _MemStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# the fault-injection training worker: checkpoints each step via
+# framework/io (atomic save), resumes from the latest checkpoint on respawn,
+# and heartbeats a liveness lease to the controller's store
+# ---------------------------------------------------------------------------
+TRAIN_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, "__REPO__")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # these workers train independently (no collective), so skip the
+    # jax.distributed world bootstrap the launcher contract would trigger
+    os.environ["_PADDLE_TPU_BOOTSTRAPPED"] = "1"
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.distributed.fleet.elastic import (
+        maybe_start_worker_heartbeat,
+    )
+
+    maybe_start_worker_heartbeat()
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    workdir = os.environ["FT_WORKDIR"]
+    steps = int(os.environ.get("FT_STEPS", "8"))
+    step_sleep = float(os.environ.get("FT_STEP_SLEEP", "0.25"))
+
+    paddle.seed(1234 + rank)
+    net = nn.Linear(4, 1)
+    o = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+    ckpt = os.path.join(workdir, f"ckpt_rank{rank}.pdparams")
+    start = 0
+    if os.path.exists(ckpt):
+        state = paddle.load(ckpt)
+        net.set_state_dict(state["model"])
+        o.set_state_dict(state["opt"])
+        start = int(state["step"]) + 1
+    for step in range(start, steps):
+        x = paddle.to_tensor(
+            np.cos(np.arange(8, dtype=np.float32) + step).reshape(2, 4))
+        y = paddle.to_tensor(
+            np.sin(np.arange(2, dtype=np.float32) + step).reshape(2, 1))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        with open(os.path.join(workdir, f"loss_rank{rank}.log"), "a") as f:
+            f.write(f"{step} {float(loss.numpy()):.10f} "
+                    f"gen={os.environ.get('PADDLE_RESTART_COUNT')}\\n")
+        paddle.save({"model": net.state_dict(), "opt": o.state_dict(),
+                     "step": step}, ckpt)
+        time.sleep(step_sleep)
+    print("TRAIN_DONE", rank, flush=True)
+""").replace("__REPO__", REPO)
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_") and k != "_PADDLE_TPU_BOOTSTRAPPED"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _losses_by_step(path):
+    """Parse 'step loss gen=g' lines; the LAST write per step wins (a step
+    re-executed after relaunch overwrites its pre-kill entry)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[int(parts[0])] = float(parts[1])
+    return out
+
+
+class _FtHarness:
+    """A 2-worker elastic pod around TRAIN_WORKER."""
+
+    def __init__(self, tmp_path, steps=8, ttl=1.5, level=1, max_restarts=3,
+                 step_sleep=0.25):
+        self.workdir = tmp_path / "ft"
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        worker_py = tmp_path / "ft_worker.py"
+        worker_py.write_text(TRAIN_WORKER)
+        self.store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                              timeout=30)
+        store_ep = f"127.0.0.1:{self.store.port}"
+        env = _clean_env()
+        env["FT_WORKDIR"] = str(self.workdir)
+        env["FT_STEPS"] = str(steps)
+        env["FT_STEP_SLEEP"] = str(step_sleep)
+        self.launcher = PodLauncher(
+            [sys.executable, str(worker_py)], nproc=2, job_id="ftjob",
+            log_dir=str(tmp_path / "logs"), store=self.store,
+            store_endpoint=store_ep, base_env=env, grace_period=1.0,
+            elastic_env={
+                "PADDLE_ELASTIC_STORE_ENDPOINT": store_ep,
+                "PADDLE_ELASTIC_JOB_ID": "ftjob",
+                "PADDLE_ELASTIC_TTL": str(ttl),
+            })
+        self.manager = ElasticManager(
+            job_id="ftjob", np="2", store=self.store, elastic_ttl=ttl,
+            fault_tolerance_level=level)
+        self.controller = ElasticRelaunchController(
+            self.launcher, self.manager, max_restarts=max_restarts,
+            backoff_base=0.3, backoff_cap=1.0)
+        self.rc = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=lambda: setattr(self, "rc", self.controller.run()),
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self, timeout=120):
+        self._thread.join(timeout)
+        assert not self._thread.is_alive(), "controller did not finish"
+        return self.rc
+
+    def wait_for_step(self, rank, step, timeout=60):
+        path = self.workdir / f"loss_rank{rank}.log"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if path.exists() and any(s >= step for s in
+                                     _losses_by_step(path)):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"rank {rank} never reached step {step}")
+
+    def close(self):
+        self.store.close()
+
+
+# ===========================================================================
+# the headline acceptance test: SIGKILL a real training worker mid-run
+# ===========================================================================
+def test_kill_and_recover_resumes_from_checkpoint(tmp_path):
+    # uninterrupted oracle run first (same harness, no faults)
+    oracle = _FtHarness(tmp_path / "oracle", steps=6,
+                        step_sleep=0.05).start()
+    assert oracle.wait() == 0
+    assert oracle.launcher.generation == 0  # no relaunch happened
+    oracle_losses = _losses_by_step(
+        oracle.workdir / "loss_rank1.log")
+    oracle.close()
+
+    h = _FtHarness(tmp_path / "faulty", steps=6, step_sleep=0.25).start()
+    try:
+        h.wait_for_step(rank=1, step=2)
+        injector = FaultInjector(h.launcher)
+        injector.kill(1)  # SIGKILL, mid-training
+        t_kill = time.monotonic()
+        rc = h.wait()
+        assert rc == 0, f"controller failed: rc={rc}"
+
+        # exactly one relaunch; detection + respawn within the bound
+        assert h.launcher.generation == 1
+        relaunches = [t for (t, kind, _) in h.controller.events
+                      if kind == "relaunch"]
+        assert len(relaunches) == 1
+        assert relaunches[0] - t_kill < 15.0
+        # the healthy worker was torn down and re-ran too
+        log0 = (tmp_path / "faulty" / "logs" / "workerlog.0").read_text()
+        assert log0.count("==== generation") == 2
+
+        # resume happened from the checkpoint: rank1's second generation
+        # starts at a step > 0 (not from scratch)
+        lines1 = (h.workdir / "loss_rank1.log").read_text().splitlines()
+        gen1_steps = [int(l.split()[0]) for l in lines1
+                      if l.endswith("gen=1")]
+        assert gen1_steps and gen1_steps[0] > 0
+
+        # loss trajectory equivalent to the uninterrupted run
+        faulty_losses = _losses_by_step(h.workdir / "loss_rank1.log")
+        assert set(faulty_losses) == set(oracle_losses)
+        for s in oracle_losses:
+            np.testing.assert_allclose(faulty_losses[s], oracle_losses[s],
+                                       rtol=1e-6, err_msg=f"step {s}")
+    finally:
+        h.close()
+
+
+def test_stalled_worker_detected_via_lease_expiry(tmp_path):
+    """SIGSTOP: the pid still 'runs' (poll sees nothing) — only the expired
+    lease can reveal the wedge, and only SIGKILL escalation can clear it."""
+    h = _FtHarness(tmp_path, steps=6, ttl=1.2, step_sleep=0.25).start()
+    try:
+        h.wait_for_step(rank=0, step=1)
+        injector = FaultInjector(h.launcher)
+        stalled_pid = injector.stall(0)
+        t_stall = time.monotonic()
+        rc = h.wait(timeout=120)
+        assert rc == 0
+        assert h.launcher.generation >= 1
+        # the fault was seen as a lease expiry, not a process exit
+        assert any(kind == "lease_expired" and "w0" in detail
+                   for (_, kind, detail) in h.controller.events)
+        relaunches = [t for (t, kind, _) in h.controller.events
+                      if kind == "relaunch"]
+        assert relaunches[0] - t_stall < 20.0
+        # escalation really had to SIGKILL the frozen pid
+        with pytest.raises(OSError):
+            os.kill(stalled_pid, 0)
+    finally:
+        h.close()
+
+
+def test_level0_aborts_instead_of_relaunching(tmp_path):
+    h = _FtHarness(tmp_path, steps=8, level=0, step_sleep=0.25).start()
+    try:
+        h.wait_for_step(rank=1, step=1)
+        FaultInjector(h.launcher).kill(1)
+        rc = h.wait()
+        assert rc != 0
+        assert h.launcher.generation == 0  # never respawned
+        assert any(kind == "abort" for (_, kind, _) in h.controller.events)
+    finally:
+        h.close()
+
+
+def test_max_restarts_exhaustion(tmp_path):
+    """A crash-looping worker burns max_restarts then the pod aborts."""
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    launcher = PodLauncher([sys.executable, str(bad)], nproc=1,
+                           job_id="crashloop", base_env=_clean_env(),
+                           grace_period=0.5)
+    manager = ElasticManager(job_id="crashloop", np="1", store=_MemStore(),
+                             elastic_ttl=5, fault_tolerance_level=1)
+    controller = ElasticRelaunchController(launcher, manager, max_restarts=2,
+                                           backoff_base=0.05,
+                                           backoff_cap=0.1)
+    rc = controller.run()
+    assert rc == 3
+    assert controller.restarts == 2
+    assert launcher.generation == 2  # initial + two respawns
+
+
+def test_pod_launcher_stop_escalation(tmp_path):
+    """A SIGTERM-ignoring worker dies by SIGKILL inside the grace bound."""
+    stubborn = tmp_path / "stubborn.py"
+    flag = tmp_path / "ready"
+    stubborn.write_text(textwrap.dedent(f"""
+        import signal, time
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        open({str(flag)!r}, "w").write("up")
+        time.sleep(60)
+    """))
+    launcher = PodLauncher([sys.executable, str(stubborn)], nproc=1,
+                           job_id="stubborn", base_env=_clean_env(),
+                           grace_period=0.8)
+    launcher.launch()
+    deadline = time.monotonic() + 15
+    while not flag.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert flag.exists()
+    t0 = time.monotonic()
+    codes = launcher.stop()
+    assert time.monotonic() - t0 < 10
+    assert codes == [-signal.SIGKILL]
+
+
+def test_rescale_decision_multi_node():
+    """Pod-level membership loss maps through pod_leave_status: enough
+    survivors -> RESTART at the smaller world; below min -> HOLD."""
+    manager = ElasticManager(job_id="pods", np="2:3", store=_MemStore(),
+                             elastic_ttl=60, fault_tolerance_level=1)
+    launcher = PodLauncher(["true"], nproc=1, nnodes=3, node_rank=0,
+                           job_id="pods")
+    controller = ElasticRelaunchController(launcher, manager)
+    for host in ("a", "b", "c"):
+        ElasticManager(job_id="pods", np="2:3", host=host,
+                       store=manager.store, elastic_ttl=60)._refresh_lease()
+    assert controller._decide() == ElasticStatus.RESTART  # 3 >= min 2
+    manager.store.delete_key(f"{manager.prefix}c")
+    assert controller._decide() == ElasticStatus.RESTART  # 2 >= min 2
+    manager.store.delete_key(f"{manager.prefix}b")
+    assert controller._decide() == ElasticStatus.HOLD     # 1 < min, level 1
+    manager.fault_tolerance_level = 0
+    assert controller._decide() == ElasticStatus.ERROR
+
+
+# ===========================================================================
+# paddle_tpu.distributed.spawn — store-backed rendezvous, real collectives
+# ===========================================================================
+def _spawn_collective_fn(out_dir):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed as d
+
+    env = d.init_parallel_env()
+    assert env.world_size == 2, env.world_size
+    assert jax.process_count() == 2, jax.process_count()
+    gathered = []
+    d.all_gather_object(gathered, f"r{env.rank}")
+    assert gathered == ["r0", "r1"], gathered
+    d.barrier()
+    with open(os.path.join(out_dir, f"ok{env.rank}.txt"), "w") as f:
+        f.write(",".join(gathered))
+
+
+def _spawn_failing_fn():
+    raise ValueError("intentional spawn-worker boom")
+
+
+def test_spawn_two_proc_collective(tmp_path):
+    """Acceptance: spawn(fn, nprocs=2) forms a real 2-process world via
+    store-backed endpoint exchange — no CLI launcher involved."""
+    ctx = dist.spawn(_spawn_collective_fn, args=(str(tmp_path),), nprocs=2)
+    assert all(p.exitcode == 0 for p in ctx.processes)
+    for r in (0, 1):
+        assert (tmp_path / f"ok{r}.txt").read_text() == "r0,r1"
+
+
+def test_spawn_propagates_child_traceback():
+    with pytest.raises(RuntimeError, match="intentional spawn-worker boom"):
+        dist.spawn(_spawn_failing_fn, nprocs=1)
+
+
+def test_spawn_nonblocking_context():
+    ctx = dist.spawn(_sleep_then_exit, nprocs=1, join=False)
+    assert len(ctx.pids()) == 1
+    assert ctx.join(timeout=60) is True
+
+
+def _sleep_then_exit():
+    time.sleep(0.2)
+
+
+# ===========================================================================
+# elastic lease expiry edge cases (satellite)
+# ===========================================================================
+class _SlowStore:
+    """Store wrapper injecting latency on every operation."""
+
+    def __init__(self, delay=0.15):
+        self._inner = _MemStore()
+        self.delay = delay
+
+    def _lag(self):
+        time.sleep(self.delay)
+
+    def set(self, k, v):
+        self._lag()
+        self._inner.set(k, v)
+
+    def get_nowait(self, k):
+        self._lag()
+        return self._inner.get_nowait(k)
+
+    def delete_key(self, k):
+        self._lag()
+        self._inner.delete_key(k)
+
+    def keys_with_prefix(self, prefix):
+        self._lag()
+        return self._inner.keys_with_prefix(prefix)
+
+
+def test_lease_survives_slow_store():
+    """Keepalive refresh at ttl/3 keeps the lease alive even when every
+    store round-trip eats a sizable fraction of the ttl."""
+    em = ElasticManager(job_id="slow", np="1", host="n1",
+                        store=_SlowStore(delay=0.15), elastic_ttl=1.5)
+    em.register()
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            assert em.hosts() == ["n1"]
+            time.sleep(0.2)
+    finally:
+        em.exit()
+
+
+def test_pod_leave_status_at_np_bounds():
+    em = ElasticManager(job_id="b", np="2:4", fault_tolerance_level=1)
+    assert em.pod_leave_status(4) == ElasticStatus.RESTART  # at max
+    assert em.pod_leave_status(2) == ElasticStatus.RESTART  # exactly min
+    assert em.pod_leave_status(1) == ElasticStatus.HOLD     # below min, FT>=1
+    assert em.pod_leave_status(0) == ElasticStatus.HOLD
+    em0 = ElasticManager(job_id="b0", np="2:4", fault_tolerance_level=0)
+    assert em0.pod_leave_status(1) == ElasticStatus.ERROR
+    em1 = ElasticManager(job_id="b1", np="1", fault_tolerance_level=0)
+    assert em1.pod_leave_status(1) == ElasticStatus.RESTART  # min==max==1
+
+
+def test_wait_ready_timeout_and_late_join():
+    em = ElasticManager(job_id="w", np="2", host="h0", elastic_ttl=5)
+    em.register()
+    try:
+        t0 = time.monotonic()
+        assert em.wait_ready(timeout=0.5) is False
+        assert 0.4 <= time.monotonic() - t0 < 3.0
+
+        def late_join():
+            time.sleep(0.4)
+            em2 = ElasticManager(job_id="w", np="2", host="h1",
+                                 store=em.store, elastic_ttl=5)
+            em2._refresh_lease()
+
+        threading.Thread(target=late_join, daemon=True).start()
+        assert em.wait_ready(timeout=5) is True
+    finally:
+        em.exit()
+
+
+def test_done_marker_distinguishes_clean_exit():
+    em = ElasticManager(job_id="d", np="1", host="h0", elastic_ttl=5)
+    em.register()
+    assert em.done_hosts() == []
+    em.exit(completed=True)
+    assert em.done_hosts() == ["h0"]
+    em2 = ElasticManager(job_id="d2", np="1", host="h1", elastic_ttl=5)
+    em2.register()
+    em2.exit(completed=False)
+    assert em2.done_hosts() == []

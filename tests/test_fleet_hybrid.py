@@ -117,6 +117,7 @@ def test_vocab_parallel_embedding_forward():
     np.testing.assert_allclose(_np(emb(ids)), _np(ref(ids)), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpipe_pp4_matches_sequential():
     hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=1, pp_degree=4)
     mesh = hcg.mesh
@@ -149,6 +150,7 @@ def test_gpipe_pp4_matches_sequential():
     np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpipe_grads_match_sequential():
     """Backward through the compiled schedule == backward through the stack."""
     hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=4)
@@ -330,7 +332,7 @@ def test_parallel_cross_entropy_mp2_matches_oracle():
 def test_mp_rng_streams_differ_per_rank_inside_compiled():
     """Dropout streams: distinct per mp rank INSIDE a shard_map mp region,
     identical outside (mpu/random.py:35 parity)."""
-    from jax import shard_map
+    from paddle_tpu._jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=2)
     mesh = hcg.mesh
@@ -373,3 +375,81 @@ def test_parallel_cross_entropy_2d_labels():
     ref = F.cross_entropy(paddle.to_tensor(lg),
                           paddle.to_tensor(lab[:, 0]), reduction="none")
     np.testing.assert_allclose(_np(out)[:, 0], _np(ref), rtol=1e-5)
+
+
+def test_pipeline_train_batch_scaler_skips_on_overflow():
+    """fp16/amp regression: the scaler threads through PipelineParallel.
+    train_batch — the compiled step scales the loss, unscales + finite-
+    checks grads globally (the found-inf reduction spans pp stages because
+    the grad arrays are sharded over the whole mesh), skips the update on
+    overflow, and drives the dynamic-scale bookkeeping."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.distributed.fleet.pipeline import (
+        PipelineLayer, PipelineParallel,
+    )
+
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=2)
+    model = PipelineLayer(
+        [nn.Linear(8, 8), nn.Linear(8, 8)], num_stages=2,
+        loss_fn=lambda out, y: ((out - y) * (out - y)).mean())
+    pp = PipelineParallel(model, hcg, None)
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 10,
+                        decr_every_n_nan_or_inf=1, incr_every_n_steps=3)
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((4, 8)).astype(np.float16)  # fp16 inputs
+    y = rng.standard_normal((4, 8)).astype(np.float16)
+
+    loss1 = pp.train_batch(
+        (paddle.to_tensor(x.astype(np.float32)),
+         paddle.to_tensor(y.astype(np.float32))), o, scaler=scaler)
+    assert np.isfinite(float(loss1.numpy()))
+    assert pp.last_found_inf is False
+    w_good = [_np(p).copy() for p in model.parameters()]
+    scale_before = scaler._scale
+
+    # overflow batch: an inf in the input makes every grad non-finite
+    x_bad = x.astype(np.float32).copy()
+    x_bad[0, 0] = np.inf
+    pp.train_batch((paddle.to_tensor(x_bad),
+                    paddle.to_tensor(y.astype(np.float32))), o,
+                   scaler=scaler)
+    assert pp.last_found_inf is True
+    # the update was skipped wholesale and the scale backed off
+    for p, w in zip(model.parameters(), w_good):
+        np.testing.assert_array_equal(_np(p), w)
+    assert scaler._scale == scale_before * 0.5
+
+    # recovery: the next clean batch steps again
+    pp.train_batch((paddle.to_tensor(x.astype(np.float32)),
+                    paddle.to_tensor(y.astype(np.float32))), o,
+                   scaler=scaler)
+    assert pp.last_found_inf is False
+    assert any(not np.array_equal(_np(p), w)
+               for p, w in zip(model.parameters(), w_good))
+
+
+def test_pipeline_train_batch_disabled_scaler_is_noop():
+    """GradScaler(enable=False) passed every call must behave like no
+    scaler at all (regression: step 2 used to raise 'compiled without a
+    scaler')."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.distributed.fleet.pipeline import (
+        PipelineLayer, PipelineParallel,
+    )
+
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=2)
+    model = PipelineLayer(
+        [nn.Linear(8, 8), nn.Linear(8, 8)], num_stages=2,
+        loss_fn=lambda out, y: ((out - y) * (out - y)).mean())
+    pp = PipelineParallel(model, hcg, None)
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = GradScaler(enable=False)
+    rng = np.random.default_rng(12)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    l1 = pp.train_batch((x, y), o, scaler=scaler)
+    l2 = pp.train_batch((x, y), o, scaler=scaler)  # must not raise
+    assert float(l2.numpy()) < float(l1.numpy())
+    assert pp.last_found_inf is False

@@ -126,6 +126,7 @@ def test_gpt_virtual_pipeline_scan_path_matches_oracle(monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_hybrid_remat_matches_noremat():
     mesh_mod._global_mesh, mesh_mod._hcg = None, None
     cfg = gpt_tiny_config()
@@ -140,6 +141,7 @@ def test_gpt_hybrid_remat_matches_noremat():
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_sync_params_back():
     mesh_mod._global_mesh, mesh_mod._hcg = None, None
     cfg = gpt_tiny_config()
@@ -185,6 +187,7 @@ def test_chunked_vocab_ce_matches_full():
                                rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_generator_matches_full_forward_greedy():
     """KV-cache incremental decode == repeated full-forward argmax."""
     import jax.numpy as jnp
@@ -245,6 +248,7 @@ def test_generator_sampling_modes():
     assert o3.shape == o1.shape  # different seed may differ; just runs
 
 
+@pytest.mark.slow
 def test_bert_fused_mlm_loss_matches_criterion():
     """forward_with_mlm_loss == BertPretrainingCriterion(model(ids)) on
     both CE paths (full logits AND the chunked gate at V>=16384)."""
@@ -312,6 +316,7 @@ def test_gpt_1f1b_matches_gpipe_oracle():
                                    err_msg=f"step {i}")
 
 
+@pytest.mark.slow
 def test_gpt_hybrid_step_live_lr_schedule():
     """lr accepts an LRScheduler: each compiled step consumes the live
     value (traced input, no recompile) and advances the schedule."""
@@ -424,6 +429,7 @@ def test_gpt_1f1b_remat_matches_oracle():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_generator_flash_prefill_matches_xla():
     """Flash-kernel prefill (interpret mode here) produces the same KV
     caches/logits as the XLA prefill: greedy decodes agree exactly."""

@@ -28,6 +28,7 @@ def _img(n=1, size=64):
     (lambda: M.shufflenet_v2_x0_25(num_classes=10), 64),
     (lambda: M.inception_v3(num_classes=10), 128),
 ])
+@pytest.mark.slow
 def test_vision_model_forward(builder, size):
     paddle.seed(0)
     net = builder()
@@ -37,6 +38,7 @@ def test_vision_model_forward(builder, size):
     assert np.isfinite(np.asarray(out._value)).all()
 
 
+@pytest.mark.slow
 def test_googlenet_returns_aux():
     paddle.seed(0)
     net = M.googlenet(num_classes=10)
@@ -46,6 +48,7 @@ def test_googlenet_returns_aux():
         assert tuple(o.shape) == (1, 10)
 
 
+@pytest.mark.slow
 def test_densenet_trains():
     paddle.seed(1)
     net = M.densenet121(num_classes=2)

@@ -14,6 +14,12 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
+
+# real multi-process jax worlds are the slowest tier of the
+# suite; tier-1 (-m 'not slow') relies on the in-proc elastic
+# + spawn coverage in test_elastic_relaunch.py instead
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
