@@ -163,6 +163,58 @@ def acquire_devices(retries=2, wait_s=15.0, probe_timeout=150.0):
         return None
 
 
+class _StepTelemetry:
+    """Registry-delta + per-step-time collector for bench extras.
+
+    Construct BEFORE the measured run (captures counter baselines), then
+    ``extras(step_times)`` yields the telemetry columns every BENCH line
+    carries: step-time p50/p95/max, peak device memory, compile seconds,
+    and collective bytes moved — the breakdown that makes a tokens/sec
+    regression explainable from the artifact alone.
+    """
+
+    def __init__(self):
+        from paddle_tpu import device
+        # peak memory must be THIS bench's peak, not an earlier config's
+        # (live-array high-water mark resets; allocator peaks are runtime-
+        # owned and process-lifetime — on TPU the number is an upper bound)
+        device.reset_max_memory_allocated()
+        self._compile_s0, self._coll_bytes0 = self._cums()
+
+    @staticmethod
+    def _cums():
+        from paddle_tpu.observability import get_registry
+        compile_s = coll = 0.0
+        for rec in get_registry().snapshot():
+            if rec["name"] == "paddle_jit_compile_seconds_total":
+                compile_s += rec.get("value", 0.0)
+            elif rec["name"] == "paddle_collective_bytes_total":
+                coll += rec.get("value", 0.0)
+        return compile_s, coll
+
+    def extras(self, step_times=None, wall_s=None):
+        from paddle_tpu import device
+        compile_s1, coll1 = self._cums()
+        out = {
+            "peak_mem_mb": round(device.max_memory_allocated() / 2 ** 20, 1),
+            "compile_s": round(compile_s1 - self._compile_s0, 2),
+            "collective_bytes": int(coll1 - self._coll_bytes0),
+        }
+        if step_times:
+            st = sorted(step_times)
+            q = lambda p: st[min(len(st) - 1, int(round(p * (len(st) - 1))))]
+            out.update({"step_ms_p50": round(1e3 * q(0.50), 2),
+                        "step_ms_p95": round(1e3 * q(0.95), 2),
+                        "step_ms_max": round(1e3 * st[-1], 2)})
+            # per-step times are host-side; the loops pipeline with one
+            # trailing sync, so if most wall time drained in that sync the
+            # percentiles reflect dispatch latency, not device step time —
+            # flag it rather than publish misleading numbers silently
+            if wall_s and sum(step_times) < 0.8 * wall_s:
+                out["step_times_host_async"] = True
+        return out
+
+
 def model_flops_per_token(cfg, seq_len):
     """Standard 6N + attention estimate (FLOPs/token, fwd+bwd).
 
@@ -178,31 +230,24 @@ def model_flops_per_token(cfg, seq_len):
 
 
 def peak_flops_per_chip():
-    """bf16 peak for the attached chip; conservative v5p default."""
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    table = {
-        "v5p": 459e12, "v5 lite": 197e12, "v5e": 197e12,
-        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    if d.platform == "cpu":
-        return 1e12  # nominal, keeps MFU finite in CPU smoke runs
-    return 459e12
+    """bf16 peak for the attached chip (shared with the framework's MFU
+    gauge — one table, one answer)."""
+    from paddle_tpu.observability.instrument import peak_flops_per_chip as f
+    return f()
 
 
 def _timed_static_train(build, feed, args):
     """Shared static-path measurement scaffold: build the program under
     AMP bf16, run warmup, then `steps` pipelined runs (device-resident
     feeds, one trailing sync — the tunnel's per-step host round-trip
-    would otherwise dominate). Returns (seconds, final_loss)."""
+    would otherwise dominate). Returns (seconds, final_loss, extras) where
+    extras carries the telemetry columns (_StepTelemetry)."""
     from paddle_tpu import amp, static
 
     static.enable_static()
     try:
+        telemetry = _StepTelemetry()
+        t_build0 = time.perf_counter()
         main_prog = static.Program()
         with static.program_guard(main_prog):
             with amp.auto_cast(enable=True, dtype="bfloat16"):
@@ -215,12 +260,23 @@ def _timed_static_train(build, feed, args):
                           return_numpy=False)
         if args.warmup:
             float(np.asarray(out[0]._value))  # sync: warmup/compile done
+        build_s = time.perf_counter() - t_build0
+        step_times = []
         t0 = time.perf_counter()
         for _ in range(args.steps):
+            t1 = time.perf_counter()
             out = exe.run(main_prog, feed=feed, fetch_list=[loss],
                           return_numpy=False)
+            step_times.append(time.perf_counter() - t1)
         final = float(np.asarray(out[0]._value))
-        return time.perf_counter() - t0, final
+        dt = time.perf_counter() - t0  # BEFORE extras(): the registry
+        # snapshot + live-array sweep must not bill into the benchmark
+        extras = telemetry.extras(step_times, wall_s=dt)
+        # the static path compiles in Executor.run, outside the jit-build
+        # counters — report the program build+warmup wall time instead
+        if not extras.get("compile_s"):
+            extras["compile_s"] = round(build_s, 2)
+        return dt, final, extras
     finally:
         static.disable_static()
 
@@ -250,13 +306,13 @@ def bench_resnet50(args):
     feed = {"img": jnp.asarray(rng.standard_normal(
                 (B, 3, 224, 224)).astype(np.float32)),
             "label": jnp.asarray(rng.integers(0, 1000, B).astype(np.int64))}
-    dt, final = _timed_static_train(build, feed, args)
+    dt, final, tele = _timed_static_train(build, feed, args)
     ips = B * args.steps / dt
     # ~4.1 GFLOP/img fwd; x3 for fwd+bwd
     mfu = ips * 3 * 4.1e9 / peak_flops_per_chip()
     emit("resnet50_imgs_per_sec_per_chip", ips, "imgs/s/chip",
          {"mfu": round(mfu, 4), "batch": B, "steps": args.steps,
-          "final_loss": round(final, 4), "amp": "bfloat16"})
+          "final_loss": round(final, 4), "amp": "bfloat16", **tele})
 
 
 def bench_bert(args):
@@ -288,7 +344,7 @@ def bench_bert(args):
                 0, cfg.vocab_size, (B, S)).astype(np.int64)),
             "labels": jnp.asarray(rng.integers(
                 0, cfg.vocab_size, (B, S)).astype(np.int64))}
-    dt, final = _timed_static_train(build, feed, args)
+    dt, final, tele = _timed_static_train(build, feed, args)
     tps = B * S * args.steps / dt
     # adapt the GPT flops helper to BertConfig field names
     gptish = type("C", (), dict(
@@ -301,7 +357,7 @@ def bench_bert(args):
     emit("bert_base_tokens_per_sec_per_chip", tps, "tokens/s/chip",
          {"mfu": round(mfu, 4), "n_params": n_params, "batch": B,
           "seq": S, "steps": args.steps,
-          "final_loss": round(final, 4), "amp": "bfloat16"})
+          "final_loss": round(final, 4), "amp": "bfloat16", **tele})
 
 
 def bench_ernie_moe(args):
@@ -334,13 +390,13 @@ def bench_ernie_moe(args):
                 0, cfg.vocab_size, (B, S)).astype(np.int64)),
             "labels": jnp.asarray(rng.integers(
                 0, cfg.vocab_size, (B, S)).astype(np.int64))}
-    dt, final = _timed_static_train(build, feed, args)
+    dt, final, tele = _timed_static_train(build, feed, args)
     tps = B * S * args.steps / dt
     emit("ernie_moe_tokens_per_sec_per_chip", tps, "tokens/s/chip",
          {"batch": B, "seq": S, "steps": args.steps,
           "experts": cfg.num_experts, "top_k": cfg.top_k,
           "moe_every": cfg.moe_every, "final_loss": round(final, 4),
-          "amp": "bfloat16",
+          "amp": "bfloat16", **tele,
           "dispatch_overhead": _moe_dispatch_overhead(cfg)})
 
 
@@ -475,20 +531,26 @@ def bench_gpt(args, config_name=None):
     ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1).astype(np.int32)
 
+    fpt, n_params = model_flops_per_token(cfg, S)
+    step.flops_per_token = fpt  # feeds the framework MFU gauge too
+    telemetry = _StepTelemetry()
+
     for _ in range(args.warmup):
         loss = step(ids, labels)
     if args.warmup:
         loss.numpy()  # sync; with --warmup 0 the first timed step compiles
 
+    step_times = []
     t0 = time.perf_counter()
     for _ in range(args.steps):
+        t1 = time.perf_counter()
         loss = step(ids, labels)
+        step_times.append(time.perf_counter() - t1)
     final_loss = float(loss.numpy())  # sync
     dt = time.perf_counter() - t0
 
     tokens = B * S * args.steps
     tps = tokens / dt
-    fpt, n_params = model_flops_per_token(cfg, S)
     mfu = tps * fpt / peak_flops_per_chip()
 
     emit(f"gpt_{config_name.replace('.', 'p')}_tokens_per_sec_per_chip",
@@ -501,6 +563,7 @@ def bench_gpt(args, config_name=None):
              "step_time_ms": round(1000 * dt / args.steps, 2),
              "final_loss": round(final_loss, 4),
              "device": str(jax.devices()[0].device_kind), **extra,
+             **telemetry.extras(step_times, wall_s=dt),
          })
 
 
@@ -709,8 +772,17 @@ def main():
                     choices=["full", "dots", "none"],
                     help="GPT block rematerialization: full checkpoint, "
                          "dots policy (save matmul outputs), or off")
+    ap.add_argument("--smoke", action="store_true",
+                    help="telemetry smoke run: tiny GPT, few steps — "
+                         "verifies the enriched step-time p50/p95 / "
+                         "peak-memory / compile-time columns end to end")
     args = ap.parse_args()
     sys.path.insert(0, ".")
+
+    if args.smoke:
+        args.model, args.config = "gpt", "tiny"
+        args.steps = min(args.steps, 5)
+        args.warmup = min(args.warmup, 1)
 
     devices = acquire_devices()
     single = {"resnet50": bench_resnet50, "bert": bench_bert,

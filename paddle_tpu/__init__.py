@@ -71,7 +71,7 @@ def __getattr__(name):
         "distributed", "incubate", "models", "kernels", "profiler", "utils",
         "metric", "device", "hapi", "distribution", "sparse", "fft", "signal",
         "text", "audio", "quantization", "inference", "geometric", "hub",
-        "onnx",
+        "onnx", "observability",
     }
     if name in _lazy:
         try:

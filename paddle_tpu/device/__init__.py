@@ -100,3 +100,85 @@ class cuda:
     @staticmethod
     def is_available():
         return False
+
+
+# ---------------------------------------------------------------------------
+# device memory accounting (paddle.device.cuda.memory_* parity, TPU-native)
+# ---------------------------------------------------------------------------
+
+_peak_live_bytes = 0
+
+
+def _live_array_bytes(devices=None) -> int:
+    """Bytes held by live jax arrays (per addressable shard), optionally
+    restricted to a set of devices. The CPU backend exposes no allocator
+    stats, so this is the portable accounting path."""
+    dev_set = set(devices) if devices is not None else None
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for s in arr.addressable_shards:
+                if dev_set is None or s.device in dev_set:
+                    total += s.data.nbytes
+        except Exception:
+            continue  # deleted/donated array racing the sweep
+    return total
+
+
+def memory_stats(device=None) -> dict:
+    """Current + peak device memory for this process.
+
+    TPU/GPU backends report the XLA allocator's ``bytes_in_use`` /
+    ``peak_bytes_in_use``; the CPU backend (no allocator stats) falls back
+    to summing live jax array bytes, with the peak tracked as a process-
+    local high-water mark over sampling calls. Keys:
+
+    - ``allocated_bytes`` — bytes currently held by device arrays
+    - ``peak_allocated_bytes`` — high-water mark (allocator peak when the
+      backend provides one, else max over ``memory_stats()`` calls)
+    - ``bytes_limit`` — device capacity when known, else 0
+    - ``source`` — ``"allocator"`` or ``"live_arrays"``
+    """
+    global _peak_live_bytes
+    devs = [d for d in jax.devices()
+            if device is None or d == device or
+            str(device) in (f"{d.platform}:{d.id}", d.platform)]
+    if device is not None and not devs:
+        raise ValueError(
+            f"device {device!r} not found; available: "
+            f"{[f'{d.platform}:{d.id}' for d in jax.devices()]}")
+    alloc = peak = limit = 0
+    have_allocator = False
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if st and st.get("bytes_in_use") is not None:
+            have_allocator = True
+            alloc += int(st.get("bytes_in_use", 0))
+            peak += int(st.get("peak_bytes_in_use",
+                               st.get("bytes_in_use", 0)))
+            limit += int(st.get("bytes_limit", 0))
+    if not have_allocator:
+        alloc = _live_array_bytes(devs if device is not None else None)
+        _peak_live_bytes = max(_peak_live_bytes, alloc)
+        peak = _peak_live_bytes
+    return {"allocated_bytes": alloc, "peak_allocated_bytes": peak,
+            "bytes_limit": limit,
+            "source": "allocator" if have_allocator else "live_arrays"}
+
+
+def memory_allocated(device=None) -> int:
+    return memory_stats(device)["allocated_bytes"]
+
+
+def max_memory_allocated(device=None) -> int:
+    return memory_stats(device)["peak_allocated_bytes"]
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    """Reset the live-array high-water mark (allocator peaks are owned by
+    the runtime and reset only on process restart)."""
+    global _peak_live_bytes
+    _peak_live_bytes = 0

@@ -26,8 +26,23 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .._jax_compat import shard_map
 
 from ..framework.tensor import Tensor
+from ..observability import instrument as _obs
 from ..ops._dispatch import unwrap, wrap
+from ..profiler.utils import RecordEvent
 from .mesh import Group, get_global_mesh, get_hybrid_communicate_group
+
+
+def _traced(op, v=None, group=None, scale=1, nbytes=None):
+    """Account one eager collective (calls + bytes-moved counters, labeled
+    by op/group/dtype) and return the RecordEvent span wrapping its body so
+    the op lands in the chrome trace next to the XLA work it launches.
+    ``scale`` multiplies the payload size for gather-shaped ops where every
+    rank's shard moves."""
+    if nbytes is None:
+        nbytes = int(getattr(v, "nbytes", 0) or 0) * scale
+    _obs.record_collective(op, nbytes, group=group,
+                           dtype=getattr(v, "dtype", None))
+    return RecordEvent(f"collective.{op}", "Communication")
 
 
 class ReduceOp:
@@ -164,10 +179,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
            ReduceOp.MIN: jax.lax.pmin}.get(op, jax.lax.psum)
 
     spec = _current_spec(v, mesh, axis)
-    reduced = shard_map(
-        lambda x: red(x, axis) if op != ReduceOp.AVG
-        else jax.lax.pmean(x, axis),
-        mesh=mesh, in_specs=spec, out_specs=spec)(v)
+    with _traced("all_reduce", v, group):
+        reduced = shard_map(
+            lambda x: red(x, axis) if op != ReduceOp.AVG
+            else jax.lax.pmean(x, axis),
+            mesh=mesh, in_specs=spec, out_specs=spec)(v)
     out = Tensor(reduced)
     if isinstance(tensor, Tensor):
         tensor._inplace_assign(out)  # reference mutates in place
@@ -215,9 +231,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         spec = _axis_only_spec(_current_spec(v, mesh, axis), axis)
         # all_gather output is invariant over the axis; the vma checker can't
         # infer that, so disable it for this call
-        gathered = shard_map(
-            lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False),
-            mesh=mesh, in_specs=spec, out_specs=P(), check_vma=False)(v)
+        with _traced("all_gather", v, group, scale=group.nranks):
+            gathered = shard_map(
+                lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False),
+                mesh=mesh, in_specs=spec, out_specs=P(), check_vma=False)(v)
         out = [Tensor(gathered[i]) for i in range(group.nranks)]
     if tensor_list is not None:
         tensor_list.clear()
@@ -294,8 +311,11 @@ def all_gather_object(object_list, obj, group=None):
         seq = next(_store_seq)
         r, world = env_mod.get_rank(), env_mod.get_world_size()
         keys = [f"objc/ag/{seq}/{i}" for i in range(world)]
-        st.set(keys[r], pickle.dumps(obj))
-        outs = [pickle.loads(st.get(k)) for k in keys]
+        payload = pickle.dumps(obj)
+        with _traced("all_gather_object", group=group,
+                     nbytes=len(payload) * world):
+            st.set(keys[r], payload)
+            outs = [pickle.loads(st.get(k)) for k in keys]
         object_list.clear()
         object_list.extend(outs)
         _store_cleanup(st, keys, f"objc/ag/{seq}/done", world)
@@ -329,9 +349,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     g_src = group.get_group_rank(src)  # src is a global rank (paddle API)
     if g_src < 0:
         raise ValueError(f"src rank {src} is not a member of {group}")
-    out = shard_map(
-        lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)[g_src],
-        mesh=mesh, in_specs=spec, out_specs=spec)(v)
+    with _traced("broadcast", v, group):
+        out = shard_map(
+            lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)[g_src],
+            mesh=mesh, in_specs=spec, out_specs=spec)(v)
     res = Tensor(out)
     if isinstance(tensor, Tensor):
         tensor._inplace_assign(res)
@@ -359,8 +380,9 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if r < 0:
             return tensor  # this process is not a member of the group
         chunk = tensor_list[r]
-        tensor._inplace_assign(chunk.clone() if isinstance(chunk, Tensor)
-                               else Tensor(chunk))
+        with _traced("scatter", unwrap(chunk), group):
+            tensor._inplace_assign(chunk.clone() if isinstance(chunk, Tensor)
+                                   else Tensor(chunk))
     return tensor
 
 
@@ -375,14 +397,18 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     Compiled code should use prims.all_to_all / the MoE dispatch instead."""
     _single_controller_only("all_to_all")
     group = _get_group(group)
+    moved = sum(int(getattr(unwrap(t), "nbytes", 0) or 0)
+                for t in in_tensor_list)
     if group.nranks <= 1 or group.mesh is None:
-        outs = [t.clone() if isinstance(t, Tensor) else Tensor(t)
-                for t in in_tensor_list]
+        with _traced("all_to_all", group=group, nbytes=moved):
+            outs = [t.clone() if isinstance(t, Tensor) else Tensor(t)
+                    for t in in_tensor_list]
     else:
         mesh = group.mesh
         repl = NamedSharding(mesh, P())
-        outs = [Tensor(jax.device_put(unwrap(t), repl))
-                for t in in_tensor_list]
+        with _traced("all_to_all", group=group, nbytes=moved):
+            outs = [Tensor(jax.device_put(unwrap(t), repl))
+                    for t in in_tensor_list]
     out_tensor_list.clear()
     out_tensor_list.extend(outs)
     return out_tensor_list
@@ -482,7 +508,8 @@ def isend(tensor, dst=0, group=None):
         seq = _p2p_send_seq.get(dst, 0)
         _p2p_send_seq[dst] = seq + 1
     arr = np.asarray(unwrap(tensor))
-    fut = rpc_mod.rpc_async(names[dst], _p2p_deliver, args=(me, seq, arr))
+    with _traced("isend", arr, group):
+        fut = rpc_mod.rpc_async(names[dst], _p2p_deliver, args=(me, seq, arr))
     return _P2PTask(lambda timeout: fut.result(timeout))
 
 
@@ -502,6 +529,8 @@ def irecv(tensor, src=0, group=None):
     with _p2p_lock:
         seq = _p2p_recv_seq.get(src, 0)
         _p2p_recv_seq[src] = seq + 1
+    _traced("irecv", unwrap(tensor), group)  # count at post time; the
+    # span would otherwise dangle until a peer sends — counters only
 
     def resolve(timeout):
         import time
@@ -564,14 +593,15 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
-    if _multi_process():
-        # real cross-process barrier over the launcher-hosted TCPStore
-        # (a fixed name: TCPStore.barrier is generation-reusable and
-        # prunes its own done-keys — no per-call key leak)
-        st = _require_store(_get_group(group))
-        st.barrier("objc/bar")
-        return
-    jax.effects_barrier()
+    with _traced("barrier", group=group, nbytes=0):
+        if _multi_process():
+            # real cross-process barrier over the launcher-hosted TCPStore
+            # (a fixed name: TCPStore.barrier is generation-reusable and
+            # prunes its own done-keys — no per-call key leak)
+            st = _require_store(_get_group(group))
+            st.barrier("objc/bar")
+            return
+        jax.effects_barrier()
 
 
 def get_world_size(group=None):

@@ -142,7 +142,9 @@ class ElasticManager:
             self._stop_event.wait(max(self.ttl / 3.0, 0.05))
 
     def hosts(self):
-        """Live (unexpired-lease) nodes."""
+        """Live (unexpired-lease) nodes. As a side effect each poll updates
+        the per-host lease-age gauge (seconds since last heartbeat refresh),
+        the liveness signal dashboards watch between expiry events."""
         now = time.time()
         out = []
         for k in self.store.keys_with_prefix(self.prefix):
@@ -153,6 +155,13 @@ class ElasticManager:
                 lease = json.loads(raw.decode())
             except (ValueError, AttributeError):
                 continue
+            try:
+                from ....observability import instrument as _obs
+                _obs.lease_age_gauge().set(
+                    max(0.0, now - (lease.get("expire", now) - self.ttl)),
+                    host=str(lease.get("host")))
+            except Exception:
+                pass
             if lease.get("expire", 0) > now:
                 out.append(lease["host"])
         return sorted(out)

@@ -200,6 +200,7 @@ class PipelineParallel(nn.Layer):
         HybridParallelGradScaler cross-group allreduce of the reference),
         and an overflow skips the whole update before shrinking the scale.
         """
+        from ...profiler.utils import RecordEvent
         from .train_step import ParallelTrainStep
         inputs, labels = data
         if self._step is None:
@@ -212,12 +213,16 @@ class PipelineParallel(nn.Layer):
 
             self._step = ParallelTrainStep(self._layers, optimizer, full_loss,
                                            hcg=self._hcg, scaler=scaler)
+            # the inner step does the per-step accounting (histogram,
+            # tokens/s, memory); label its series as the pipeline path
+            self._step.telemetry_path = "pipeline"
         elif scaler is not None and scaler.is_enable() and \
                 self._step.scaler is None:
             raise RuntimeError(
                 "train_batch compiled without a scaler; pass the scaler on "
                 "the first call")
-        loss = self._step(inputs, labels)
+        with RecordEvent("PipelineParallel.train_batch", "Operator"):
+            loss = self._step(inputs, labels)
         self.last_found_inf = self._step.last_found_inf
         if lr_scheduler is not None:
             lr_scheduler.step()

@@ -31,9 +31,23 @@ import sys
 import threading
 import time
 
+from ...observability import instrument as _obs
+from ...observability.runlog import RunLogger
 from ..fleet.elastic.manager import (
     ElasticManager, ElasticStatus, LauncherInterface,
 )
+
+
+def _controller_runlog():
+    """Controller-side run logger (rank -1 so worker rank files stay
+    per-worker-owned); None when telemetry is not enabled for this run."""
+    run_dir = os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not run_dir:
+        return None
+    try:
+        return RunLogger(run_dir, rank=-1, generation=0)
+    except OSError:
+        return None
 
 
 def _free_ports(n, host="127.0.0.1"):
@@ -93,6 +107,8 @@ class PodLauncher(LauncherInterface):
         self.endpoints = []
         self._procs = []   # [{rank, local_rank, proc, log}]
         self._codes = []   # exit codes of the current generation
+        self._runlog = _controller_runlog()
+        self._exit_recorded = set()  # (generation, local_rank) tallied
 
     # ---------------------------------------------------------- identity
     def global_rank(self, local_rank):
@@ -185,7 +201,35 @@ class PodLauncher(LauncherInterface):
                 stderr=subprocess.STDOUT if log else None)
             self._procs.append({"rank": rank, "local_rank": local_rank,
                                 "proc": proc, "log": log})
+        _obs.generation_gauge().set(self.generation)
+        if self._runlog:
+            self._runlog.log("launch", generation_launched=self.generation,
+                             world=world, nproc=self.nproc)
         return self._procs
+
+    def _flush_and_merge(self):
+        """Snapshot the controller registry and fold every rank's JSONL
+        into run_summary.json; shared by both supervision exits."""
+        if not self._runlog:
+            return
+        from ...observability.runlog import merge_run_dir
+        self._runlog.flush_metrics()
+        try:
+            merge_run_dir(self._runlog.run_dir)
+        except Exception:
+            pass  # telemetry must never turn a clean exit into a failure
+
+    def _note_exit(self, local_rank, code):
+        """Tally a worker exit code once per (generation, worker)."""
+        key = (self.generation, local_rank)
+        if code is None or key in self._exit_recorded:
+            return
+        self._exit_recorded.add(key)
+        _obs.worker_exit_counter().inc(code=str(code))
+        if self._runlog:
+            self._runlog.log("worker_exit", code=int(code),
+                             rank_exited=self.global_rank(local_rank),
+                             generation_exited=self.generation)
 
     # -------------------------------------------------------------- watch
     def watch(self):
@@ -194,6 +238,7 @@ class PodLauncher(LauncherInterface):
         for i, w in enumerate(self._procs):
             if self._codes[i] is None:
                 self._codes[i] = w["proc"].poll()
+                self._note_exit(w["local_rank"], self._codes[i])
         failures = [c for c in self._codes if c is not None and c != 0]
         if failures:
             return failures[0]
@@ -230,6 +275,7 @@ class PodLauncher(LauncherInterface):
                 self._codes[i] = w["proc"].wait(timeout=10)
             except subprocess.TimeoutExpired:
                 self._codes[i] = -signal.SIGKILL
+            self._note_exit(w["local_rank"], self._codes[i])
             if w["log"]:
                 w["log"].close()
                 w["log"] = None
@@ -255,6 +301,7 @@ class PodLauncher(LauncherInterface):
                 if w["log"]:
                     w["log"].close()
                     w["log"] = None
+            self._flush_and_merge()
         return [c if c is not None else -signal.SIGKILL
                 for c in self._codes]
 
@@ -302,6 +349,10 @@ class ElasticRelaunchController:
     # ------------------------------------------------------------- events
     def _record(self, kind, detail=""):
         self.events.append((time.monotonic(), kind, detail))
+        runlog = getattr(self.launcher, "_runlog", None)
+        if runlog:
+            runlog.log(kind, detail=detail, restarts=self.restarts,
+                       launch_generation=self.launcher.generation)
 
     def _local_host_ids(self):
         return {self.launcher.host_id(lr): lr
@@ -354,6 +405,7 @@ class ElasticRelaunchController:
         self._relaunching = True
         try:
             self.restarts += 1
+            _obs.restarts_counter().inc()
             self._record("stop", f"restart {self.restarts}")
             self.launcher.stop()
             self._clear_worker_state()
@@ -415,3 +467,6 @@ class ElasticRelaunchController:
                 # a failed pod must NOT leave a done marker: peers use the
                 # marker to tell clean exit from a fault they must react to
                 self.manager.exit(completed=completed)
+            flush = getattr(self.launcher, "_flush_and_merge", None)
+            if flush:
+                flush()

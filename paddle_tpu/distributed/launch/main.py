@@ -60,6 +60,11 @@ def _parse_args(argv=None):
     p.add_argument("--elastic_ttl", type=float,
                    default=float(os.environ.get("PADDLE_ELASTIC_TTL", 10.0)),
                    help="worker liveness lease TTL seconds (elastic mode)")
+    p.add_argument("--telemetry_dir",
+                   default=os.environ.get("PADDLE_TELEMETRY_DIR"),
+                   help="run-telemetry directory: every rank writes JSONL "
+                        "events/metrics there and the launcher merges them "
+                        "into run_summary.json (observability.runlog)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -77,6 +82,11 @@ def launch(argv=None):
                 "PADDLE_RESTART_COUNT", "PADDLE_ELASTIC_STORE_ENDPOINT",
                 "PADDLE_ELASTIC_HOST_ID"):
         os.environ.pop(var, None)
+
+    if args.telemetry_dir:
+        # both the controller (PodLauncher events) and the workers (their
+        # inherited env) key off this var
+        os.environ["PADDLE_TELEMETRY_DIR"] = args.telemetry_dir
 
     if args.nproc_per_node is not None:
         nproc = args.nproc_per_node

@@ -285,6 +285,10 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose, metrics=self._metrics_name(), mode="train")
+        # fresh throughput denominators per fit loop: a second fit on the
+        # same process must not average against the previous run's steps
+        from ..profiler import benchmark
+        benchmark().reset()
         self.stop_training = False
         cbks.on_train_begin()
         it = 0

@@ -1051,12 +1051,21 @@ class GPTHybridTrainStep:
 
     # ------------------------------------------------------------------
     def __call__(self, input_ids, labels):
+        import time as _time
+        from ..observability import instrument as _obs
+        from ..profiler.utils import RecordEvent
+        t_step = _time.perf_counter()
         ids = unwrap(input_ids) if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         labs = unwrap(labels) if isinstance(labels, Tensor) \
             else jnp.asarray(labels)
-        if self._compiled is None:
-            self._build()
+        first_call = self._compiled is None
+        if first_call:
+            t0 = _time.perf_counter()
+            with RecordEvent("GPTHybridTrainStep.build", "Compile"):
+                self._build()
+            t_built = _time.perf_counter()
+            _obs.record_compile(t_built - t0, what="GPTHybridTrainStep.build")
         self._t += 1
         # lr is a traced jit input, so a live LR schedule is free: pass an
         # optimizer.lr.LRScheduler (or any callable) as ``lr`` and each
@@ -1071,8 +1080,22 @@ class GPTHybridTrainStep:
             lr_val = lr_src
         lr = jnp.asarray(lr_val, jnp.float32)
         t = jnp.asarray(self._t, jnp.float32)
-        loss, self.params, self.opt_state = self._compiled(
-            self.params, self.opt_state, ids, labs, lr, t)
+        with RecordEvent("GPTHybridTrainStep.step", "Operator"):
+            loss, self.params, self.opt_state = self._compiled(
+                self.params, self.opt_state, ids, labs, lr, t)
+        if first_call:
+            # jax.jit compiles inside the first dispatch (lazy) — measured
+            # from the end of build so the two compile series are disjoint;
+            # the compile-dominated first call stays out of the step-time
+            # histogram
+            _obs.record_compile(_time.perf_counter() - t_built,
+                                what="GPTHybridTrainStep.first_call")
+        else:
+            _obs.record_train_step(
+                _time.perf_counter() - t_step, tokens=int(ids.size),
+                flops_per_token=getattr(self, "flops_per_token", None),
+                path="gpt_hybrid")
+        _obs.sample_device_memory()
         return Tensor(loss)
 
     train_batch = __call__

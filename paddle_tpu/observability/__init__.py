@@ -1,0 +1,29 @@
+"""Framework-wide telemetry.
+
+No reference counterpart — the reference ships a profiler/statistics layer
+(host event recorder, chrome-trace logger, benchmark timer) but no metrics
+API; this package adds the measurement substrate the ROADMAP's perf work
+needs on top of ``paddle_tpu.profiler``:
+
+- :mod:`.metrics` — a thread-safe process-local registry of Counter /
+  Gauge / Histogram instruments with labels, exposable as Prometheus text
+  or JSONL snapshots.
+- :mod:`.runlog` — a structured per-run event logger (rank, generation,
+  wall clock) writing per-rank JSONL files into a shared run directory,
+  plus ``merge_run_dir`` which the elastic launcher / tests use to fold
+  every rank's stream into one ``run_summary.json``.
+- :class:`.TelemetryCallback` — a hapi callback sampling step time,
+  throughput and device memory into the registry (and optionally a run
+  directory) during ``Model.fit``.
+
+Hot paths emit here by default (``ParallelTrainStep``, ``PipelineParallel``,
+``distributed.collective``, the elastic launcher); the registry is cheap
+enough to stay always-on — an increment is a dict lookup + float add under
+a lock, far off the device-step critical path.
+"""
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry,
+    get_registry, counter, gauge, histogram,
+)
+from .runlog import RunLogger, get_run_logger, merge_run_dir  # noqa: F401
+from .callback import TelemetryCallback  # noqa: F401

@@ -1,0 +1,107 @@
+"""TelemetryCallback — hapi ``Model.fit`` consumption of the registry.
+
+Deliberately not a subclass of ``hapi.callbacks.Callback`` (which would
+import the whole hapi stack into every telemetry user); ``CallbackList``
+dispatches by ``getattr``, so implementing the same hook names is the
+whole contract.
+"""
+from __future__ import annotations
+
+import time
+
+
+class TelemetryCallback:
+    """Sample step time, throughput and device memory during ``fit``.
+
+    Usage::
+
+        model.fit(data, callbacks=[TelemetryCallback(run_dir="/tmp/run")])
+
+    Per train batch: observes ``paddle_train_step_seconds{path="fit"}``,
+    sets tokens/sec (when ``batch_size`` is known from fit params) and the
+    device-memory gauges, and feeds ``profiler.benchmark()``. With a
+    ``run_dir`` (or ``PADDLE_TELEMETRY_DIR``), writes per-rank JSONL events
+    at epoch boundaries and snapshots the metrics registry at train end,
+    the files ``observability.merge_run_dir`` folds into a run summary.
+    """
+
+    def __init__(self, run_dir: str | None = None, sample_memory: bool = True,
+                 memory_every: int = 1):
+        self.run_dir = run_dir
+        self.sample_memory = sample_memory
+        self.memory_every = max(1, int(memory_every))
+        self.model = None
+        self.params = {}
+        self._logger = None
+        self._t0 = None
+        self._seen_steps = 0
+
+    # hapi CallbackList contract ------------------------------------------
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def _get_logger(self):
+        if self._logger is None:
+            from .runlog import RunLogger, get_run_logger
+            if self.run_dir:
+                self._logger = RunLogger(self.run_dir)
+            else:
+                self._logger = get_run_logger()  # env-driven; may be None
+        return self._logger
+
+    def on_train_begin(self, logs=None):
+        from ..profiler import benchmark
+        # Model.fit owns the per-fit benchmark().reset(); only start timing
+        benchmark().begin()
+        logger = self._get_logger()
+        if logger:
+            logger.log("fit_begin", epochs=self.params.get("epochs"),
+                       steps=self.params.get("steps"))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from . import instrument as _obs
+        from ..profiler import benchmark
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        bs = self.params.get("batch_size")
+        benchmark().step(num_samples=bs)
+        _obs.record_train_step(dt, tokens=bs, path="fit")
+        self._seen_steps += 1
+        if self.sample_memory and self._seen_steps % self.memory_every == 0:
+            _obs.sample_device_memory()
+
+    def on_epoch_end(self, epoch, logs=None):
+        logger = self._get_logger()
+        if logger:
+            from ..profiler import benchmark
+            rep = benchmark().report()
+            logger.log("epoch_end", epoch=epoch, ips=rep["ips"],
+                       steps=rep["steps"],
+                       loss=_scalar(logs, "loss"))
+            logger.flush_metrics()
+
+    def on_train_end(self, logs=None):
+        logger = self._get_logger()
+        if logger:
+            logger.log("fit_end", loss=_scalar(logs, "loss"))
+            logger.flush_metrics()
+
+
+def _scalar(logs, key):
+    v = (logs or {}).get(key)
+    if isinstance(v, (list, tuple)):
+        v = v[0] if v else None
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None

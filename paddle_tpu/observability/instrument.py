@@ -1,0 +1,230 @@
+"""Hot-path instrumentation helpers.
+
+One place defines the metric names the framework emits, so producers
+(``ParallelTrainStep``, ``PipelineParallel``, ``distributed.collective``,
+the elastic launcher) and consumers (``merge_run_dir``, bench.py, the
+Prometheus exposition) agree on the schema:
+
+====================================  =========  =============================
+metric                                type       labels
+====================================  =========  =============================
+paddle_train_step_seconds             histogram  path={parallel,pipeline,fit}
+paddle_tokens_per_sec                 gauge      path
+paddle_train_mfu                      gauge      path
+paddle_loss_scale                     gauge      —
+paddle_found_inf_total                counter    —
+paddle_loss_scale_skips_total         counter    —
+paddle_jit_compile_total              counter    what
+paddle_jit_compile_seconds_total      counter    what
+paddle_collective_calls_total         counter    op, group, dtype
+paddle_collective_bytes_total         counter    op, group, dtype
+paddle_device_memory_bytes            gauge      —
+paddle_device_peak_memory_bytes       gauge      —
+paddle_elastic_restarts_total         counter    —
+paddle_elastic_generation             gauge      —
+paddle_elastic_lease_age_seconds      gauge      host
+paddle_worker_exit_total              counter    code
+====================================  =========  =============================
+
+Everything here must stay off the device critical path: increments are a
+dict lookup + float add; the memory sampler reads allocator stats (cheap)
+or sweeps live arrays (CPU fallback) once per step.
+"""
+from __future__ import annotations
+
+import time
+
+from .metrics import get_registry
+
+# step-time buckets from 0.5ms to 2min, tuned around training step scales
+STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 120.0)
+
+
+def step_seconds():
+    return get_registry().histogram(
+        "paddle_train_step_seconds",
+        "wall-clock seconds per training step", buckets=STEP_BUCKETS)
+
+
+def tokens_per_sec():
+    return get_registry().gauge(
+        "paddle_tokens_per_sec", "training throughput, tokens (or samples)/s")
+
+
+def train_mfu():
+    return get_registry().gauge(
+        "paddle_train_mfu", "model flops utilization vs chip peak")
+
+
+def loss_scale_gauge():
+    return get_registry().gauge(
+        "paddle_loss_scale", "current dynamic loss scale")
+
+
+def found_inf_counter():
+    return get_registry().counter(
+        "paddle_found_inf_total", "steps whose gradients contained inf/nan")
+
+
+def skip_counter():
+    return get_registry().counter(
+        "paddle_loss_scale_skips_total",
+        "optimizer updates skipped on overflow")
+
+
+def compile_counter():
+    return get_registry().counter(
+        "paddle_jit_compile_total", "jit build/compile invocations")
+
+
+def compile_seconds():
+    return get_registry().counter(
+        "paddle_jit_compile_seconds_total",
+        "wall-clock seconds spent in jit build/compile")
+
+
+def collective_calls():
+    return get_registry().counter(
+        "paddle_collective_calls_total", "eager collective op invocations")
+
+
+def collective_bytes():
+    return get_registry().counter(
+        "paddle_collective_bytes_total",
+        "bytes moved through eager collective ops (payload size x ranks "
+        "for gather-shaped ops)")
+
+
+def restarts_counter():
+    return get_registry().counter(
+        "paddle_elastic_restarts_total", "elastic kill+respawn cycles")
+
+
+def generation_gauge():
+    return get_registry().gauge(
+        "paddle_elastic_generation", "current launch generation")
+
+
+def lease_age_gauge():
+    return get_registry().gauge(
+        "paddle_elastic_lease_age_seconds",
+        "seconds since each worker lease was last refreshed")
+
+
+def worker_exit_counter():
+    return get_registry().counter(
+        "paddle_worker_exit_total", "worker exits by code")
+
+
+# ---------------------------------------------------------------- recorders
+
+_FLUSH_INTERVAL_S = 5.0
+_last_flush = 0.0
+
+
+def record_train_step(seconds: float, tokens: int | None = None,
+                      flops_per_token: float | None = None,
+                      path: str = "parallel"):
+    """Per-step accounting: step-time histogram + derived throughput/MFU.
+    Under a telemetry-enabled launch (``PADDLE_TELEMETRY_DIR``) this also
+    snapshots the registry into the rank's JSONL every few seconds, so a
+    SIGKILLed worker still leaves near-current telemetry behind (the
+    snapshot write is atomic via rename)."""
+    global _last_flush
+    step_seconds().observe(seconds, path=path)
+    if tokens and seconds > 0:
+        tps = tokens / seconds
+        tokens_per_sec().set(tps, path=path)
+        if flops_per_token:
+            train_mfu().set(tps * flops_per_token / peak_flops_per_chip(),
+                            path=path)
+    from .runlog import get_run_logger
+    logger = get_run_logger()
+    if logger is not None:
+        now = time.monotonic()
+        if now - _last_flush > _FLUSH_INTERVAL_S:
+            _last_flush = now
+            logger.flush_metrics()
+
+
+def record_compile(seconds: float, what: str):
+    compile_counter().inc(what=what)
+    compile_seconds().inc(seconds, what=what)
+
+
+def record_collective(op: str, nbytes: int, group=None, dtype=None):
+    labels = {"op": op,
+              "group": str(getattr(group, "axis_name", group or "world")),
+              "dtype": str(dtype)}
+    collective_calls().inc(**labels)
+    if nbytes:
+        collective_bytes().inc(float(nbytes), **labels)
+
+
+_LIVE_ARRAY_SAMPLE_EVERY = 10
+_mem_calls = 0
+_mem_source = None  # discovered on first sample
+
+
+def sample_device_memory(chrome_counter: bool = True) -> dict | None:
+    """Read device memory stats into the registry gauges; when a profiler
+    record span is active, also emit a chrome-trace counter sample
+    (``"ph": "C"``) so the memory track lines up with the event spans.
+
+    Allocator-backed devices (TPU/GPU) sample every call — the read is a
+    stat fetch. The CPU fallback sweeps every live jax array, O(n) python
+    work that must stay off the hot path, so it samples every
+    ``_LIVE_ARRAY_SAMPLE_EVERY``-th call unless a profiler record span is
+    active (trace fidelity wins there). Returns None on skipped calls."""
+    global _mem_calls, _mem_source
+    _mem_calls += 1
+    if _mem_source == "live_arrays":
+        from ..profiler import utils as _putils
+        if not _putils._collecting and \
+                _mem_calls % _LIVE_ARRAY_SAMPLE_EVERY != 1:
+            return None
+    from .. import device as device_mod
+    stats = device_mod.memory_stats()
+    _mem_source = stats["source"]
+    reg = get_registry()
+    reg.gauge("paddle_device_memory_bytes",
+              "bytes currently allocated on device").set(
+        stats["allocated_bytes"])
+    reg.gauge("paddle_device_peak_memory_bytes",
+              "peak bytes allocated on device").set(
+        stats["peak_allocated_bytes"])
+    if chrome_counter:
+        from ..profiler.utils import record_counter
+        record_counter("device_memory_bytes", stats["allocated_bytes"])
+    return stats
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the attached chip; conservative v5p default (the
+    table bench.py historically carried, now shared)."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    table = {
+        "v5p": 459e12, "v5 lite": 197e12, "v5e": 197e12,
+        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if d.platform == "cpu":
+        return 1e12  # nominal, keeps MFU finite in CPU smoke runs
+    return 459e12
+
+
+class timed:
+    """Context manager returning its elapsed seconds via ``.seconds``."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._t0
+        return False

@@ -1,0 +1,371 @@
+"""Thread-safe metrics registry: Counter / Gauge / Histogram with labels.
+
+Model: the Prometheus client data model (the de-facto exposition contract),
+reduced to what the framework's hot paths need. Instruments are created
+through the registry (or the module-level ``counter()/gauge()/histogram()``
+helpers against the default registry); a ``labels(**kv)`` call returns the
+child series for one label-set. All mutation is lock-protected and
+allocation-free after the first observation of a series, so instrumenting
+a per-step path costs a dict lookup and a float add.
+
+Exposition:
+- ``to_prometheus()`` — Prometheus text format 0.0.4 (counters get the
+  ``_total`` convention left to the caller's metric name; histograms emit
+  ``_bucket``/``_sum``/``_count`` with cumulative ``le`` buckets).
+- ``snapshot()`` / ``export_jsonl(path)`` — one JSON record per series,
+  the form the run logger and bench.py consume.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+import time
+
+# step-time-ish default buckets (seconds): 1ms .. ~2min, log-spaced
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _Instrument:
+    """Base: a named family of label-keyed series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", registry=None):
+        self.name = name
+        self.help = help
+        self._registry = registry
+        self._lock = registry._lock if registry is not None \
+            else threading.RLock()
+        self._series = {}   # labels_key -> state
+
+    def labels(self, **labels):
+        key = _labels_key(labels)
+        with self._lock:
+            child = self._series.get(key)
+            if child is None:
+                child = self._make_child(dict(labels))
+                self._series[key] = child
+        return child
+
+    def _make_child(self, labels):
+        raise NotImplementedError
+
+    def _default(self):
+        """The no-label child (used by the bare inc/set/observe sugar)."""
+        return self.labels()
+
+    def collect(self):
+        """[(labels_dict, state_dict)] for every live series."""
+        with self._lock:
+            return [(dict(c.label_values), c._state()) for c in
+                    self._series.values()]
+
+
+class _CounterChild:
+    __slots__ = ("label_values", "_value", "_lock")
+
+    def __init__(self, labels, lock):
+        self.label_values = labels
+        self._value = 0.0
+        self._lock = lock
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+    def _state(self):
+        return {"value": self._value}
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def _make_child(self, labels):
+        return _CounterChild(labels, self._lock)
+
+    def inc(self, amount: float = 1.0, **labels):
+        self.labels(**labels).inc(amount)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class _GaugeChild:
+    __slots__ = ("label_values", "_value", "_lock")
+
+    def __init__(self, labels, lock):
+        self.label_values = labels
+        self._value = 0.0
+        self._lock = lock
+
+    def set(self, value: float):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        return self._value
+
+    def _state(self):
+        return {"value": self._value}
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def _make_child(self, labels):
+        return _GaugeChild(labels, self._lock)
+
+    def set(self, value: float, **labels):
+        self.labels(**labels).set(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        self.labels(**labels).inc(amount)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class _HistogramChild:
+    __slots__ = ("label_values", "_bounds", "_counts", "_sum", "_count",
+                 "_min", "_max", "_lock", "_samples")
+
+    # ring of raw samples kept for quantile summaries (p50/p95 in bench /
+    # run summaries need better resolution than bucket interpolation on
+    # short runs); bounded so a long run cannot grow it
+    MAX_SAMPLES = 4096
+
+    def __init__(self, labels, bounds, lock):
+        self.label_values = labels
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +Inf tail bucket
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = lock
+        self._samples = []
+
+    def observe(self, value: float):
+        v = float(value)
+        with self._lock:
+            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            self._sum += v
+            self._count += 1
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            if len(self._samples) >= self.MAX_SAMPLES:
+                self._samples[self._count % self.MAX_SAMPLES] = v
+            else:
+                self._samples.append(v)
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def quantile(self, q: float):
+        """Approximate quantile from the retained sample ring."""
+        with self._lock:
+            xs = sorted(self._samples)
+        if not xs:
+            return 0.0
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return xs[idx]
+
+    def _state(self):
+        return {
+            "count": self._count, "sum": self._sum,
+            "min": self._min if self._count else 0.0,
+            "max": self._max if self._count else 0.0,
+            "mean": self._sum / self._count if self._count else 0.0,
+            "p50": self.quantile(0.5), "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+            "buckets": {_fmt_value(b): c for b, c in
+                        zip(list(self._bounds) + [math.inf],
+                            _cumulate(self._counts))},
+        }
+
+
+def _cumulate(counts):
+    out, acc = [], 0
+    for c in counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS,
+                 registry=None):
+        super().__init__(name, help, registry)
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+
+    def _make_child(self, labels):
+        return _HistogramChild(labels, self._bounds, self._lock)
+
+    def observe(self, value: float, **labels):
+        self.labels(**labels).observe(value)
+
+    @property
+    def count(self):
+        return self._default().count
+
+
+class MetricsRegistry:
+    """Named instruments, one namespace per process (or per test)."""
+
+    def __init__(self):
+        # one reentrant lock for the whole registry: child mutations are
+        # single dict/float ops, so contention is negligible and a single
+        # lock keeps snapshot() a consistent cut
+        self._lock = threading.RLock()
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, registry=self, **kw)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{inst.kind}, not {cls.kind}")
+        return inst
+
+    def counter(self, name, help="") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help="") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._instruments.get(name)
+
+    def reset(self):
+        with self._lock:
+            self._instruments.clear()
+
+    # ------------------------------------------------------------ exposition
+    def snapshot(self) -> list[dict]:
+        """One JSON-able record per live series."""
+        out = []
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            for labels, state in inst.collect():
+                rec = {"name": inst.name, "type": inst.kind,
+                       "labels": labels}
+                rec.update(state)
+                out.append(rec)
+        return out
+
+    def export_jsonl(self, path: str, extra: dict | None = None) -> str:
+        """Write ``snapshot()`` as JSONL; ``extra`` keys stamp every line
+        (rank, generation, ...). Atomic via temp-file rename."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        ts = time.time()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for rec in self.snapshot():
+                rec["ts"] = ts
+                if extra:
+                    rec.update(extra)
+                f.write(json.dumps(rec) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            lines.append(f"# HELP {inst.name} {inst.help}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+            for labels, state in inst.collect():
+                lab = _prom_labels(labels)
+                if inst.kind == "histogram":
+                    for le, c in state["buckets"].items():
+                        blab = _prom_labels(dict(labels, le=le))
+                        lines.append(f"{inst.name}_bucket{blab} {c}")
+                    lines.append(f"{inst.name}_sum{lab} "
+                                 f"{_fmt_value(state['sum'])}")
+                    lines.append(f"{inst.name}_count{lab} {state['count']}")
+                else:
+                    lines.append(
+                        f"{inst.name}{lab} {_fmt_value(state['value'])}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    def esc(v):
+        return str(v).replace("\\", r"\\").replace('"', r'\"') \
+            .replace("\n", r"\n")
+    inner = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def counter(name, help="") -> Counter:
+    return _default_registry.counter(name, help)
+
+
+def gauge(name, help="") -> Gauge:
+    return _default_registry.gauge(name, help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS) -> Histogram:
+    return _default_registry.histogram(name, help, buckets=buckets)

@@ -1,0 +1,249 @@
+"""Structured per-run telemetry: per-rank JSONL streams + merged summary.
+
+Layout of a run directory (``PADDLE_TELEMETRY_DIR`` or explicit path)::
+
+    <run_dir>/
+      events.rank0.jsonl        # structured events: {"ts", "rank",
+      events.rank1.jsonl        #   "generation", "event", ...fields};
+                                #   append-mode, so generations accumulate
+      metrics.rank0.gen0.jsonl  # MetricsRegistry.export_jsonl snapshots,
+      metrics.rank1.gen0.jsonl  #   one file per (rank, launch generation)
+      run_summary.json          # merge_run_dir() output (launcher side)
+
+Every worker appends events through its process-local :class:`RunLogger`
+(rank/generation stamped from the PADDLE_* launch contract) and snapshots
+its registry on flush.  The controller — or any post-hoc consumer — calls
+:func:`merge_run_dir` to fold all ranks into one summary: step-time
+histogram stats, collective byte counters, restart counts, peak device
+memory, worker exit codes.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+
+from .metrics import get_registry
+
+
+def _env_rank() -> int:
+    for var in ("PADDLE_TRAINER_ID", "JAX_PROCESS_INDEX", "RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return 0
+
+
+def _env_generation() -> int:
+    return int(os.environ.get("PADDLE_RESTART_COUNT", 0))
+
+
+class RunLogger:
+    """Append structured events for this rank into the run directory."""
+
+    def __init__(self, run_dir: str, rank: int | None = None,
+                 generation: int | None = None, registry=None):
+        self.run_dir = run_dir
+        self.rank = _env_rank() if rank is None else int(rank)
+        self.generation = _env_generation() if generation is None \
+            else int(generation)
+        self._registry = registry or get_registry()
+        self._lock = threading.Lock()
+        os.makedirs(run_dir, exist_ok=True)
+        self._events_path = os.path.join(
+            run_dir, f"events.rank{self.rank}.jsonl")
+        # generation-keyed: an elastically relaunched worker starts a fresh
+        # registry under the same rank — its snapshot must not overwrite
+        # the dead generation's telemetry (merge sums across generations)
+        self._metrics_path = os.path.join(
+            run_dir, f"metrics.rank{self.rank}.gen{self.generation}.jsonl")
+        self._fh = open(self._events_path, "a")
+
+    def log(self, event: str, **fields):
+        rec = {"ts": time.time(), "rank": self.rank,
+               "generation": self.generation, "event": event}
+        rec.update(fields)
+        line = json.dumps(rec)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return rec
+
+    def flush_metrics(self):
+        """Snapshot the registry into this rank's metrics JSONL."""
+        self._registry.export_jsonl(
+            self._metrics_path,
+            extra={"rank": self.rank, "generation": self.generation})
+        return self._metrics_path
+
+    def close(self):
+        try:
+            self.flush_metrics()
+        except Exception:
+            pass
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_run_logger: RunLogger | None = None
+_run_logger_lock = threading.Lock()
+
+
+def get_run_logger(run_dir: str | None = None) -> RunLogger | None:
+    """Process-wide run logger. With no argument, auto-starts from the
+    ``PADDLE_TELEMETRY_DIR`` launch-contract var (None when unset, so
+    instrumentation can no-op cheaply outside telemetry-enabled runs)."""
+    global _run_logger
+    if _run_logger is not None:
+        return _run_logger
+    run_dir = run_dir or os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not run_dir:
+        return None
+    with _run_logger_lock:
+        if _run_logger is None:
+            _run_logger = RunLogger(run_dir)
+            import atexit
+            atexit.register(_run_logger.close)
+    return _run_logger
+
+
+def _read_jsonl(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line from a killed worker
+    except OSError:
+        pass
+    return out
+
+
+def merge_run_dir(run_dir: str, write: bool = True) -> dict:
+    """Fold every rank's JSONL streams into one run summary.
+
+    Returns (and by default writes ``run_summary.json``) with:
+    - ``ranks`` — ranks that reported
+    - ``step_time`` — merged ``train_step_seconds`` histogram stats
+      (count/sum/min/max summed/folded across ranks; ``per_rank`` keeps
+      p50/p95 per ``rank:generation:path`` series, since quantiles from
+      different series cannot be merged)
+    - ``collective_bytes`` / ``collective_calls`` — per-op totals
+    - ``restarts`` — max restart count seen (controller events win)
+    - ``peak_memory_bytes`` — max over ranks of the device peak gauge
+    - ``compile`` — jit compile count + total seconds
+    - ``exit_codes`` / ``events`` — controller lifecycle tallies
+    """
+    summary = {
+        "run_dir": os.path.abspath(run_dir),
+        "ranks": [],
+        "generations": [],
+        "step_time": {"count": 0, "sum_seconds": 0.0, "min_seconds": None,
+                      "max_seconds": None, "per_rank": {}},
+        "tokens_per_sec": {},
+        "collective_bytes": {},
+        "collective_calls": {},
+        "restarts": 0,
+        "peak_memory_bytes": 0,
+        "compile": {"count": 0, "seconds": 0.0},
+        "loss_scale_skips": 0,
+        "exit_codes": {},
+        "events": {},
+    }
+    st = summary["step_time"]
+
+    for path in sorted(glob.glob(os.path.join(run_dir, "metrics.rank*.jsonl"))):
+        m = re.search(r"metrics\.rank(-?\d+)(?:\.gen-?\d+)?\.jsonl$", path)
+        rank = int(m.group(1)) if m else -1
+        if rank not in summary["ranks"]:
+            summary["ranks"].append(rank)
+        for rec in _read_jsonl(path):
+            name = rec.get("name", "")
+            gen = rec.get("generation")
+            if gen is not None and gen not in summary["generations"]:
+                summary["generations"].append(gen)
+            if name == "paddle_train_step_seconds" and \
+                    rec.get("type") == "histogram":
+                st["count"] += rec.get("count", 0)
+                st["sum_seconds"] += rec.get("sum", 0.0)
+                if rec.get("count"):
+                    st["min_seconds"] = rec["min"] if st["min_seconds"] \
+                        is None else min(st["min_seconds"], rec["min"])
+                    st["max_seconds"] = rec["max"] if st["max_seconds"] \
+                        is None else max(st["max_seconds"], rec["max"])
+                    # one entry per (rank, generation, path) series —
+                    # quantiles don't merge, so don't pretend they do
+                    skey = f"{rank}:g{gen if gen is not None else 0}:" \
+                        f"{rec.get('labels', {}).get('path', '?')}"
+                    st["per_rank"][skey] = {
+                        "p50": rec.get("p50"), "p95": rec.get("p95"),
+                        "mean": rec.get("mean"), "count": rec.get("count")}
+            elif name == "paddle_tokens_per_sec":
+                skey = f"{rank}:g{gen if gen is not None else 0}:" \
+                    f"{rec.get('labels', {}).get('path', '?')}"
+                summary["tokens_per_sec"][skey] = rec.get("value")
+            elif name == "paddle_collective_bytes_total":
+                op = rec.get("labels", {}).get("op", "?")
+                summary["collective_bytes"][op] = \
+                    summary["collective_bytes"].get(op, 0) + rec.get("value", 0)
+            elif name == "paddle_collective_calls_total":
+                op = rec.get("labels", {}).get("op", "?")
+                summary["collective_calls"][op] = \
+                    summary["collective_calls"].get(op, 0) + rec.get("value", 0)
+            elif name == "paddle_device_peak_memory_bytes":
+                summary["peak_memory_bytes"] = max(
+                    summary["peak_memory_bytes"], rec.get("value", 0))
+            elif name == "paddle_jit_compile_total":
+                summary["compile"]["count"] += int(rec.get("value", 0))
+            elif name == "paddle_jit_compile_seconds_total":
+                summary["compile"]["seconds"] += rec.get("value", 0.0)
+            elif name == "paddle_loss_scale_skips_total":
+                summary["loss_scale_skips"] += int(rec.get("value", 0))
+            elif name == "paddle_elastic_restarts_total":
+                summary["restarts"] = max(summary["restarts"],
+                                          int(rec.get("value", 0)))
+
+    for path in sorted(glob.glob(os.path.join(run_dir, "events.rank*.jsonl"))):
+        for rec in _read_jsonl(path):
+            ev = rec.get("event", "?")
+            summary["events"][ev] = summary["events"].get(ev, 0) + 1
+            gen = rec.get("generation")
+            if gen is not None and gen not in summary["generations"]:
+                summary["generations"].append(gen)
+            r = rec.get("rank")
+            if r is not None and r not in summary["ranks"]:
+                summary["ranks"].append(r)
+            if ev == "worker_exit":
+                code = str(rec.get("code"))
+                summary["exit_codes"][code] = \
+                    summary["exit_codes"].get(code, 0) + 1
+            elif ev == "relaunch":
+                summary["restarts"] = max(summary["restarts"],
+                                          int(rec.get("restarts", 0)))
+
+    summary["ranks"].sort()
+    summary["generations"].sort()
+    if st["count"]:
+        st["mean_seconds"] = st["sum_seconds"] / st["count"]
+    if write:
+        out = os.path.join(run_dir, "run_summary.json")
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        os.replace(tmp, out)
+    return summary

@@ -1,4 +1,21 @@
-"""paddle.profiler parity (reference: ``python/paddle/profiler/``)."""
+"""paddle.profiler parity (reference: ``python/paddle/profiler/``).
+
+The profiler is the *tracing* half of the observability stack:
+
+- :class:`Profiler` — scheduler-driven record spans; ``export()`` writes
+  chrome://tracing JSON containing the ``RecordEvent`` spans emitted by
+  the instrumented hot paths (``ParallelTrainStep``, the eager
+  collectives) plus ``"ph": "C"`` counter tracks (device memory).
+- :func:`~paddle_tpu.profiler.utils.record_counter` — add a counter
+  sample to the active record span.
+- ``tools/trace_summary.py`` — post-hoc aggregate table over an exported
+  trace (shares ``profiler.profiler.aggregate_events`` with
+  ``Profiler.summary``).
+
+The *metrics* half (Counter/Gauge/Histogram registry, Prometheus/JSONL
+exposition, per-run JSONL telemetry and ``run_summary.json``) lives in
+:mod:`paddle_tpu.observability`; see the README "Observability" section.
+"""
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, make_scheduler,
     export_chrome_tracing, SummaryView,

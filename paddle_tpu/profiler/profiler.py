@@ -80,6 +80,44 @@ def export_chrome_tracing(dir_name: str, worker_name: str = None):
     return handle
 
 
+def _time_scale(time_unit: str):
+    """ns -> requested unit multiplier. Accepts s|ms|us|ns."""
+    table = {"s": (1e-9, "s"), "ms": (1e-6, "ms"),
+             "us": (1e-3, "us"), "ns": (1.0, "ns")}
+    if time_unit not in table:
+        raise ValueError(f"time_unit must be one of {sorted(table)}, "
+                         f"got {time_unit!r}")
+    return table[time_unit]
+
+
+def aggregate_events(name_dur_ns):
+    """Fold (name, duration_ns) pairs into {name: (calls, total_ns)} —
+    shared by ``Profiler.summary`` and ``tools/trace_summary.py``."""
+    agg = defaultdict(lambda: [0, 0.0])
+    for name, dur_ns in name_dur_ns:
+        a = agg[name]
+        a[0] += 1
+        a[1] += dur_ns
+    return {k: (v[0], v[1]) for k, v in agg.items()}
+
+
+def format_agg_table(agg, time_unit="ms", top=None):
+    """Render the aggregate dict as table lines (descending total time)."""
+    scale, unit = _time_scale(time_unit)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    if top is not None:
+        rows = rows[:top]
+    width = max([len(k) for k in agg] + [10]) + 2
+    lines = [f"{'Name':<{width}}{'Calls':>8}{f'Total({unit})':>14}"
+             f"{f'Avg({unit})':>14}",
+             "-" * (width + 36)]
+    for name, (calls, total_ns) in rows:
+        total = total_ns * scale
+        lines.append(f"{name:<{width}}{calls:>8}{total:>14.3f}"
+                     f"{total / calls:>14.3f}")
+    return lines
+
+
 class SummaryView(Enum):
     DeviceView = 0
     OverView = 1
@@ -121,6 +159,7 @@ class Profiler:
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._events = []            # drained host events across record spans
+        self._counters = []          # drained (name, ts_ns, value) samples
         self._jax_trace_dir = None
         self._jax_tracing = False
         self._step_t0 = None
@@ -134,6 +173,16 @@ class Profiler:
         return self
 
     def stop(self):
+        if self._step_t0 is not None:
+            # flush the final in-flight step: without this the last step
+            # between the latest step() and stop() is missing from
+            # summary(). Two non-steps are excluded: a stop() right after
+            # step() (step-at-end-of-loop idiom, sub-0.1ms residue) and
+            # span-only sessions that never called step() at all.
+            dt = time.perf_counter() - self._step_t0
+            if self._step_times and dt >= 1e-4:
+                self._step_times.append(dt)
+            self._step_t0 = None
         if self.current_state in (ProfilerState.RECORD,
                                   ProfilerState.RECORD_AND_RETURN):
             self._end_record()
@@ -182,6 +231,7 @@ class Profiler:
 
     def _end_record(self):
         self._events.extend(_utils._drain_events())
+        self._counters.extend(_utils._drain_counters())
         _utils._set_collecting(False)
         if self._jax_tracing:
             try:
@@ -193,14 +243,25 @@ class Profiler:
 
     # ------------------------------------------------------------- analysis
     def export(self, path: str, format: str = "json"):
-        """Write collected host events as chrome://tracing JSON."""
-        assert format in ("json", "pb"), format
+        """Write collected host events (spans + counter samples) as
+        chrome://tracing JSON."""
+        if format == "pb":
+            raise NotImplementedError(
+                "protobuf export is not implemented on this stack; use "
+                "format='json' (chrome://tracing / perfetto readable)")
+        assert format == "json", format
         events = []
         for name, tid, t0, t1, etype in self._events:
             events.append({
                 "name": name, "ph": "X", "cat": etype,
                 "pid": os.getpid(), "tid": tid,
                 "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,  # µs
+            })
+        for name, ts, value in self._counters:
+            events.append({
+                "name": name, "ph": "C", "cat": "Counter",
+                "pid": os.getpid(), "ts": ts / 1e3,
+                "args": {"value": value},
             })
         payload = {"traceEvents": events,
                    "displayTimeUnit": "ms",
@@ -215,25 +276,24 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        """Print aggregated host-event table + step-time stats; returns the
-        aggregate dict (profiler_statistic.py condensed)."""
-        agg = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_ms]
-        for name, _tid, t0, t1, _etype in self._events:
-            a = agg[name]
-            a[0] += 1
-            a[1] += (t1 - t0) / 1e6
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        width = max([len(k) for k in agg] + [10]) + 2
-        lines = [f"{'Name':<{width}}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}",
-                 "-" * (width + 32)]
-        for name, (calls, total) in rows:
-            lines.append(f"{name:<{width}}{calls:>8}{total:>12.3f}"
-                         f"{total / calls:>12.3f}")
+        """Print aggregated host-event table + step-time stats in the
+        requested ``time_unit`` ('s'|'ms'|'us'|'ns'); returns the aggregate
+        dict (profiler_statistic.py condensed; totals keyed ``total_ms``
+        for stability plus ``total_<unit>`` for the requested unit)."""
+        agg = aggregate_events(
+            (name, t1 - t0) for name, _tid, t0, t1, _etype in self._events)
+        lines = format_agg_table(agg, time_unit=time_unit)
         if self._step_times:
-            st = self._step_times
-            lines.append("-" * (width + 32))
+            scale, unit = _time_scale(time_unit)
+            st = [s * 1e9 * scale for s in self._step_times]  # s -> unit
+            lines.append(lines[1])
             lines.append(
-                f"steps: {len(st)}  avg: {1e3 * sum(st) / len(st):.3f}ms  "
-                f"min: {1e3 * min(st):.3f}ms  max: {1e3 * max(st):.3f}ms")
+                f"steps: {len(st)}  avg: {sum(st) / len(st):.3f}{unit}  "
+                f"min: {min(st):.3f}{unit}  max: {max(st):.3f}{unit}")
         print("\n".join(lines))
-        return {k: {"calls": v[0], "total_ms": v[1]} for k, v in agg.items()}
+        scale, unit = _time_scale(time_unit)
+        # total_ms uses the same expression as the dynamic key so the
+        # time_unit="ms" overwrite is bit-identical, not off by one ulp
+        return {k: {"calls": calls, "total_ms": ns * 1e-6,
+                    f"total_{unit}": ns * scale}
+                for k, (calls, ns) in agg.items()}

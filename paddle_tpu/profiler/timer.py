@@ -17,6 +17,10 @@ class _Benchmark:
         self._steps = 0
         self._samples = 0
         self._elapsed = 0.0
+        # samples/sec and steps/sec need SEPARATE denominators: a loop that
+        # mixes samples-fed and sample-less step() calls must not divide
+        # the fed sample count by wall time that includes unfed steps
+        self._sampled_elapsed = 0.0
 
     def begin(self):
         self._t0 = time.perf_counter()
@@ -26,11 +30,13 @@ class _Benchmark:
             self.begin()
             return
         now = time.perf_counter()
-        self._elapsed += now - self._t0
+        dt = now - self._t0
+        self._elapsed += dt
         self._t0 = now
         self._steps += 1
         if num_samples:
             self._samples += num_samples
+            self._sampled_elapsed += dt
 
     def end(self):
         if self._t0 is not None:
@@ -39,14 +45,17 @@ class _Benchmark:
 
     @property
     def ips(self):
-        """Samples/sec if step() was fed num_samples, else steps/sec."""
-        if self._elapsed == 0:
-            return 0.0
-        n = self._samples if self._samples else self._steps
-        return n / self._elapsed
+        """Samples/sec over the samples-fed steps if any step() was fed
+        num_samples, else steps/sec over all steps."""
+        if self._samples:
+            return self._samples / self._sampled_elapsed \
+                if self._sampled_elapsed else 0.0
+        return self._steps / self._elapsed if self._elapsed else 0.0
 
     def report(self):
-        return {"steps": self._steps, "elapsed_s": self._elapsed,
+        return {"steps": self._steps, "samples": self._samples,
+                "elapsed_s": self._elapsed,
+                "sampled_elapsed_s": self._sampled_elapsed,
                 "ips": self.ips}
 
 

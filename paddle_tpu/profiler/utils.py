@@ -14,6 +14,7 @@ from contextlib import ContextDecorator
 
 _lock = threading.Lock()
 _host_events: list = []          # (name, tid, start_ns, end_ns, event_type)
+_counter_samples: list = []      # (name, ts_ns, value) -> "ph":"C" events
 _collecting = False
 
 
@@ -27,6 +28,24 @@ def _drain_events():
     with _lock:
         ev, _host_events = _host_events, []
     return ev
+
+
+def _drain_counters():
+    global _counter_samples
+    with _lock:
+        cs, _counter_samples = _counter_samples, []
+    return cs
+
+
+def record_counter(name: str, value: float):
+    """Record a chrome-trace counter sample (``"ph": "C"``) — the memory/
+    throughput track alongside the RecordEvent spans. No-op unless a
+    Profiler record span is active, so per-step samplers can call it
+    unconditionally."""
+    if _collecting:
+        with _lock:
+            _counter_samples.append(
+                (name, time.perf_counter_ns(), float(value)))
 
 
 class RecordEvent(ContextDecorator):

@@ -1,0 +1,459 @@
+"""Telemetry subsystem tests: metrics registry (concurrency + exposition
+round-trip), device memory stats, hot-path instrumentation landing in the
+chrome trace, per-rank run telemetry from a real 2-process
+``distributed.spawn`` run merged into one summary, and the profiler
+satellite fixes (final-step flush, pb export, time units, benchmark
+denominators, scheduler edges).
+
+Parity model: the reference has no metrics API to mirror; the profiler
+pieces follow reference unittests/test_profiler.py, the registry follows
+the Prometheus client data model.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt, profiler
+from paddle_tpu.observability import (
+    MetricsRegistry, TelemetryCallback, get_registry, merge_run_dir,
+)
+from paddle_tpu.observability.runlog import RunLogger
+from paddle_tpu.profiler import Profiler, ProfilerState, make_scheduler
+from paddle_tpu.profiler.profiler import aggregate_events, format_agg_table
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh_state():
+    """Earlier suites may leave a global mesh (sometimes without an hcg)
+    behind; these tests build exactly the mesh they need."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    yield
+    mesh_mod._global_mesh, mesh_mod._hcg = saved
+
+
+# ===========================================================================
+# metrics registry
+# ===========================================================================
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5, op="all_reduce")
+    with pytest.raises(ValueError):
+        c.labels().inc(-1)
+    g = reg.gauge("g")
+    g.set(7)
+    g.inc(3, host="w0")
+    h = reg.histogram("h", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = {(r["name"], tuple(sorted(r["labels"].items()))): r
+            for r in reg.snapshot()}
+    assert snap[("c_total", ())]["value"] == 1.0
+    assert snap[("c_total", (("op", "all_reduce"),))]["value"] == 2.5
+    assert snap[("g", ())]["value"] == 7.0
+    assert snap[("g", (("host", "w0"),))]["value"] == 3.0
+    hs = snap[("h", ())]
+    assert hs["count"] == 3 and hs["min"] == 0.05 and hs["max"] == 5.0
+    assert hs["buckets"] == {"0.1": 1, "1": 2, "+Inf": 3}
+
+
+def test_registry_type_conflict_and_reset():
+    reg = MetricsRegistry()
+    reg.counter("m")
+    with pytest.raises(TypeError):
+        reg.gauge("m")
+    reg.reset()
+    reg.gauge("m")  # fine after reset
+
+
+def test_registry_threaded_increments():
+    """Concurrent increments from many threads must not lose updates."""
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total")
+    h = reg.histogram("lat", buckets=(0.5,))
+    n_threads, per_thread = 8, 500
+
+    def work(i):
+        for k in range(per_thread):
+            c.inc()
+            c.inc(1, worker=str(i))
+            h.observe(k % 2)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    total = sum(r["value"] for r in reg.snapshot()
+                if r["name"] == "hits_total" and r["labels"])
+    assert total == n_threads * per_thread
+    assert h.labels()._state()["count"] == n_threads * per_thread
+
+
+def test_prometheus_and_jsonl_round_trip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(3, code="200", path='a"b')
+    reg.gauge("temp").set(36.6)
+    reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.25)
+
+    text = reg.to_prometheus()
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{code="200",path="a\\"b"} 3' in text
+    assert "temp 36.6" in text
+    assert 'lat_seconds_bucket{le="0.1"} 0' in text
+    assert 'lat_seconds_bucket{le="1"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_sum 0.25" in text and "lat_seconds_count 1" in text
+
+    path = str(tmp_path / "snap.jsonl")
+    reg.export_jsonl(path, extra={"rank": 3})
+    recs = [json.loads(l) for l in open(path)]
+    assert all(r["rank"] == 3 and "ts" in r for r in recs)
+    byname = {r["name"]: r for r in recs}
+    assert byname["req_total"]["value"] == 3
+    assert byname["lat_seconds"]["count"] == 1
+    assert byname["lat_seconds"]["p50"] == 0.25
+
+
+# ===========================================================================
+# device memory stats
+# ===========================================================================
+
+def test_device_memory_stats_sees_allocations():
+    from paddle_tpu import device
+    device.reset_max_memory_allocated()
+    base = device.memory_allocated()
+    keep = paddle.to_tensor(np.ones((256, 256), np.float32))
+    st = device.memory_stats()
+    assert st["allocated_bytes"] >= base + 256 * 256 * 4
+    assert device.max_memory_allocated() >= st["allocated_bytes"]
+    assert st["source"] in ("allocator", "live_arrays")
+    del keep
+
+
+# ===========================================================================
+# scheduler edge cases (satellite)
+# ===========================================================================
+
+def test_make_scheduler_skip_first_and_repeat_exhaustion():
+    sched = make_scheduler(closed=0, ready=0, record=2, repeat=2,
+                           skip_first=3)
+    states = [sched(i) for i in range(9)]
+    assert states[:3] == [ProfilerState.CLOSED] * 3          # skip_first
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN      # cycle 1 end
+    assert states[5] == ProfilerState.RECORD
+    assert states[6] == ProfilerState.RECORD_AND_RETURN      # cycle 2 end
+    assert states[7:] == [ProfilerState.CLOSED] * 2          # exhausted
+
+
+def test_make_scheduler_ready_span_transitions():
+    sched = make_scheduler(closed=2, ready=3, record=1, repeat=0)
+    expect = [ProfilerState.CLOSED] * 2 + [ProfilerState.READY] * 3 + \
+        [ProfilerState.RECORD_AND_RETURN]
+    assert [sched(i) for i in range(6)] == expect
+    # repeat=0 cycles forever
+    assert [sched(6 + i) for i in range(6)] == expect
+    assert sched(600 + 5) == ProfilerState.RECORD_AND_RETURN
+
+
+# ===========================================================================
+# profiler satellites: final-step flush, pb export, time units
+# ===========================================================================
+
+def test_profiler_stop_flushes_final_step(capsys):
+    p = Profiler(scheduler=(0, 4), targets=[profiler.ProfilerTarget.CPU])
+    p.start()
+    for _ in range(2):
+        time.sleep(0.002)
+        p.step()
+    time.sleep(0.002)
+    p.stop()  # the in-flight third step must be flushed
+    assert len(p._step_times) == 3
+    assert all(t > 0 for t in p._step_times)
+
+
+def test_profiler_export_pb_raises(tmp_path):
+    p = Profiler(targets=[profiler.ProfilerTarget.CPU])
+    with pytest.raises(NotImplementedError):
+        p.export(str(tmp_path / "t.pb"), format="pb")
+
+
+def test_profiler_summary_honors_time_unit(capsys):
+    p = Profiler(scheduler=(0, 1), targets=[profiler.ProfilerTarget.CPU])
+    p.start()
+    with profiler.RecordEvent("op_x"):
+        time.sleep(0.005)
+    p.step()
+    p.stop()
+    agg_us = p.summary(time_unit="us")
+    out_us = capsys.readouterr().out
+    assert "Total(us)" in out_us
+    agg_ms = p.summary(time_unit="ms")
+    out_ms = capsys.readouterr().out
+    assert "Total(ms)" in out_ms
+    assert agg_us["op_x"]["total_us"] == pytest.approx(
+        agg_ms["op_x"]["total_ms"] * 1e3)
+    assert agg_us["op_x"]["total_ms"] == agg_ms["op_x"]["total_ms"]
+    with pytest.raises(ValueError):
+        p.summary(time_unit="fortnights")
+
+
+def test_benchmark_separate_denominators():
+    """Mixed samples-fed and sample-less step() calls: ips must divide the
+    sample count by only the samples-fed steps' elapsed time (satellite)."""
+    from paddle_tpu.profiler.timer import _Benchmark
+    b = _Benchmark()
+    b.begin()
+    # 2 sample-less steps of ~8ms, then 2 fed steps of ~2ms each
+    for _ in range(2):
+        time.sleep(0.008)
+        b.step()
+    for _ in range(2):
+        time.sleep(0.002)
+        b.step(num_samples=100)
+    r = b.report()
+    assert r["samples"] == 200
+    assert r["sampled_elapsed_s"] < r["elapsed_s"]
+    # correct ips uses the fed-step window only: 200 / ~0.004s >> the
+    # wrong 200 / ~0.020s
+    assert r["ips"] > 200 / r["elapsed_s"] * 2
+    b.reset()
+    assert b.report()["ips"] == 0.0
+
+
+# ===========================================================================
+# instrumented train loop -> chrome trace (spans + memory counters)
+# ===========================================================================
+
+class _TinyMLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 16)
+        self.l2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+
+def _mse(model, x, y):
+    d = model(x) - y
+    return (d * d).mean()
+
+
+def test_train_loop_trace_has_spans_and_memory_counters(tmp_path):
+    """Acceptance: a chrome trace exported from an instrumented train loop
+    contains RecordEvent spans from ParallelTrainStep/collectives AND
+    memory counter ("ph": "C") events."""
+    from paddle_tpu.distributed import all_reduce, mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.train_step import ParallelTrainStep
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    try:
+        mesh_mod._global_mesh, mesh_mod._hcg = None, None
+        hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=1)
+        model = _TinyMLP()
+        step = ParallelTrainStep(
+            model, opt.SGD(learning_rate=0.1,
+                           parameters=model.parameters()),
+            _mse, hcg=hcg)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+
+        p = Profiler(scheduler=(0, 4), targets=[profiler.ProfilerTarget.CPU])
+        p.start()
+        for _ in range(4):  # first call is compile-labeled, not a step
+            step(x, y)
+            t = paddle.to_tensor(np.ones((4, 4), np.float32))
+            all_reduce(t)
+            p.step()
+        p.stop()
+    finally:
+        mesh_mod._global_mesh, mesh_mod._hcg = saved
+
+    path = str(tmp_path / "train.paddle_trace.json")
+    p.export(path)
+    doc = json.load(open(path))
+    spans = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    counters = {e["name"] for e in doc["traceEvents"] if e["ph"] == "C"}
+    assert "ParallelTrainStep.step" in spans
+    assert "collective.all_reduce" in spans
+    assert "device_memory_bytes" in counters
+    cvals = [e["args"]["value"] for e in doc["traceEvents"]
+             if e["ph"] == "C" and e["name"] == "device_memory_bytes"]
+    assert cvals and all(v > 0 for v in cvals)
+
+    # registry side: step histogram + collective byte counters moved
+    snap = get_registry().snapshot()
+    names = {r["name"] for r in snap}
+    assert "paddle_train_step_seconds" in names
+    assert "paddle_collective_bytes_total" in names
+    steps = [r for r in snap if r["name"] == "paddle_train_step_seconds"
+             and r["labels"].get("path") == "parallel"]
+    assert steps and steps[0]["count"] >= 3
+
+    # trace_summary CLI over the same trace (satellite smoke)
+    from tools.trace_summary import summarize
+    lines = summarize(path, top=5)
+    text = "\n".join(lines)
+    assert "ParallelTrainStep.step" in text
+    assert "counter device_memory_bytes" in text
+
+
+def test_trace_summary_shares_aggregation_with_profiler():
+    agg = aggregate_events([("a", 2e6), ("a", 4e6), ("b", 1e6)])
+    assert agg == {"a": (2, 6e6), "b": (1, 1e6)}
+    lines = format_agg_table(agg, time_unit="ms", top=1)
+    assert len(lines) == 3 and "a" in lines[2]  # header, rule, top row
+
+
+# ===========================================================================
+# run telemetry: per-rank JSONL + merged summary from a 2-proc spawn run
+# ===========================================================================
+
+def _telemetry_train_worker(n_steps):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F811
+    import paddle_tpu as paddle  # noqa: F811
+    from paddle_tpu import nn, optimizer as opt  # noqa: F811
+    from paddle_tpu.distributed import all_reduce, mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.train_step import ParallelTrainStep
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.observability.runlog import get_run_logger
+
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=1)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.l1(x)
+
+    model = MLP()
+    step = ParallelTrainStep(
+        model, opt.SGD(learning_rate=0.1, parameters=model.parameters()),
+        lambda m, x, y: (lambda d: (d * d).mean())(m(x) - y), hcg=hcg)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    for _ in range(n_steps):
+        step(x, y)
+    t = paddle.to_tensor(np.ones((16,), np.float32))
+    all_reduce(t)
+
+    logger = get_run_logger()  # from PADDLE_TELEMETRY_DIR (spawn env)
+    assert logger is not None, "telemetry dir not inherited by worker"
+    logger.log("worker_done", steps=n_steps)
+    logger.flush_metrics()
+
+
+def test_spawn_run_writes_per_rank_telemetry_and_merged_summary(tmp_path):
+    """Acceptance: a 2-process distributed.spawn training run writes
+    per-rank JSONL telemetry plus a merged run summary containing the
+    step-time histogram, collective byte counters, restart count, and
+    peak device memory."""
+    import paddle_tpu.distributed as dist
+
+    run_dir = str(tmp_path / "run")
+    os.environ["PADDLE_TELEMETRY_DIR"] = run_dir
+    # workers train independently (own 8-device mesh each); skip the
+    # jax.distributed world bootstrap the spawn env contract triggers
+    os.environ["_PADDLE_TPU_BOOTSTRAPPED"] = "1"
+    try:
+        dist.spawn(_telemetry_train_worker, args=(4,), nprocs=2)
+    finally:
+        os.environ.pop("PADDLE_TELEMETRY_DIR", None)
+        os.environ.pop("_PADDLE_TPU_BOOTSTRAPPED", None)
+
+    for rank in (0, 1):
+        assert os.path.exists(
+            os.path.join(run_dir, f"events.rank{rank}.jsonl"))
+        assert os.path.exists(
+            os.path.join(run_dir, f"metrics.rank{rank}.gen0.jsonl"))
+
+    summary = merge_run_dir(run_dir)
+    assert os.path.exists(os.path.join(run_dir, "run_summary.json"))
+    assert summary["ranks"] == [0, 1]
+    # 4 calls x 2 ranks, minus each rank's compile-labeled first call
+    assert summary["step_time"]["count"] >= 6
+    assert summary["step_time"]["max_seconds"] > 0
+    per_rank = summary["step_time"]["per_rank"]
+    assert {k.split(":")[0] for k in per_rank} == {"0", "1"}
+    assert all(k.endswith(":parallel") for k in per_rank), per_rank
+    assert summary["collective_bytes"].get("all_reduce", 0) > 0
+    assert summary["restarts"] == 0                    # no faults injected
+    assert summary["peak_memory_bytes"] > 0
+    assert summary["events"].get("worker_done") == 2
+
+
+def test_merge_run_dir_restart_and_exit_accounting(tmp_path):
+    """Controller-side events fold into restart counts and exit codes."""
+    run_dir = str(tmp_path)
+    # fresh registry: the process-global one may carry real restart
+    # counters from other suites' elastic tests into the metrics flush
+    with RunLogger(run_dir, rank=-1, generation=0,
+                   registry=MetricsRegistry()) as log:
+        log.log("launch", generation_launched=0)
+        log.log("worker_exit", code=-9, rank_exited=1, generation_exited=0)
+        log.log("relaunch", restarts=2)
+        log.log("worker_exit", code=0, rank_exited=0, generation_exited=2)
+    summary = merge_run_dir(run_dir, write=False)
+    assert summary["restarts"] == 2
+    assert summary["exit_codes"] == {"-9": 1, "0": 1}
+    assert summary["events"]["relaunch"] == 1
+
+
+# ===========================================================================
+# hapi TelemetryCallback
+# ===========================================================================
+
+def test_hapi_fit_with_telemetry_callback(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((16, 8)).astype(np.float32)
+    ys = rng.standard_normal((16, 8)).astype(np.float32)
+    model = Model(_TinyMLP())
+    model.prepare(optimizer=opt.SGD(learning_rate=0.01,
+                                    parameters=model.parameters()),
+                  loss=lambda out, y: (lambda d: (d * d).mean())(out - y))
+    run_dir = str(tmp_path / "fit_run")
+    cb = TelemetryCallback(run_dir=run_dir)
+    model.fit(TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)]),
+              batch_size=4, epochs=2, verbose=0, callbacks=[cb])
+
+    # benchmark timer was reset + fed by the callback
+    rep = profiler.benchmark().report()
+    assert rep["steps"] >= 4 and rep["ips"] > 0
+    # fit-path step series landed in the registry
+    fit_steps = [r for r in get_registry().snapshot()
+                 if r["name"] == "paddle_train_step_seconds"
+                 and r["labels"].get("path") == "fit"]
+    assert fit_steps and fit_steps[0]["count"] >= 8
+    # run dir has events + metrics for this rank
+    events = [json.loads(l) for l in
+              open(os.path.join(run_dir, "events.rank0.jsonl"))]
+    kinds = [e["event"] for e in events]
+    assert "fit_begin" in kinds and "fit_end" in kinds
+    assert kinds.count("epoch_end") == 2
+    assert os.path.exists(os.path.join(run_dir, "metrics.rank0.gen0.jsonl"))

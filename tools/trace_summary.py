@@ -1,0 +1,80 @@
+"""Aggregate-table CLI over an exported ``.paddle_trace.json``.
+
+Post-hoc counterpart of ``Profiler.summary()`` — same aggregation and
+table code (``profiler.profiler.aggregate_events`` / ``format_agg_table``)
+applied to a chrome-trace file instead of a live Profiler, so a trace
+shipped from a training run can be read without rerunning anything.
+
+Usage::
+
+    python tools/trace_summary.py run/host_123.paddle_trace.json
+    python tools/trace_summary.py trace.json --top 20 --unit us
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.profiler.profiler import (  # noqa: E402
+    aggregate_events, format_agg_table,
+)
+
+
+def load_trace(path):
+    """Return (span_events, counter_events) from a chrome-trace JSON."""
+    with open(path) as f:
+        doc = json.load(f)
+    # both chrome-trace container forms: {"traceEvents": [...]} and bare array
+    events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    spans = [e for e in events if e.get("ph") == "X"]
+    counters = [e for e in events if e.get("ph") == "C"]
+    return spans, counters
+
+
+def summarize(path, top=None, time_unit="ms"):
+    """Build the report lines for one trace file."""
+    spans, counters = load_trace(path)
+    # chrome trace ts/dur are µs; the shared aggregator takes ns
+    agg = aggregate_events(
+        (e.get("name", "?"), float(e.get("dur", 0.0)) * 1e3) for e in spans)
+    lines = [f"{path}: {len(spans)} spans, {len(counters)} counter samples"]
+    if agg:
+        lines.extend(format_agg_table(agg, time_unit=time_unit, top=top))
+    else:
+        lines.append("(no span events)")
+    by_counter = {}
+    for e in counters:
+        args = e.get("args") or {}
+        v = args.get("value", next(iter(args.values()), None)) \
+            if args else None
+        if v is None:
+            continue
+        cur = by_counter.setdefault(e.get("name", "?"),
+                                    {"n": 0, "min": v, "max": v, "last": v})
+        cur["n"] += 1
+        cur["min"] = min(cur["min"], v)
+        cur["max"] = max(cur["max"], v)
+        cur["last"] = v
+    for name, c in sorted(by_counter.items()):
+        lines.append(f"counter {name}: n={c['n']} min={c['min']:.0f} "
+                     f"max={c['max']:.0f} last={c['last']:.0f}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="top-N aggregate table over a .paddle_trace.json")
+    ap.add_argument("trace", nargs="+", help="exported chrome-trace file(s)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the N slowest names")
+    ap.add_argument("--unit", default="ms", choices=["s", "ms", "us", "ns"])
+    args = ap.parse_args(argv)
+    for path in args.trace:
+        print("\n".join(summarize(path, top=args.top, time_unit=args.unit)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
