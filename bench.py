@@ -104,6 +104,54 @@ def emit_skip(metric, why):
                       "extras": {"reason": why}}), flush=True)
 
 
+class _PerModelTimeout(Exception):
+    pass
+
+
+def run_with_timeout(name, fn, budget_s):
+    """Run one config under a SIGALRM budget so a single wedged model can
+    no longer starve the rest of the sweep into the driver's rc=124 with
+    zero artifacts (VERDICT r5): every prior config's JSON line is
+    already flushed, the stuck one reports ``*_TIMEOUT``, and the sweep
+    proceeds. No-op when budget<=0 or SIGALRM is unavailable (non-main
+    thread / Windows)."""
+    import signal
+    import threading
+    if budget_s <= 0 or not hasattr(signal, "SIGALRM") or \
+            threading.current_thread() is not threading.main_thread():
+        return fn()
+
+    state = {"result": None, "done": False}
+
+    def on_alarm(signum, frame):
+        # a late alarm delivered after fn() completed (but before the
+        # finally-cancel) must not fabricate a timeout for a finished run
+        if not state["done"]:
+            raise _PerModelTimeout(name)
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(int(budget_s))
+    try:
+        r = fn()
+        # done BEFORE the result store: the only remaining race is the
+        # single instruction between fn's return and this flag, which
+        # SIGALRM cannot be fully excluded from — if it lands there the
+        # worst case is a duplicate *_TIMEOUT line after the real row
+        state["done"] = True
+        state["result"] = r
+    except _PerModelTimeout:
+        print(json.dumps({"metric": f"{name}_TIMEOUT", "value": 0.0,
+                          "unit": "timeout", "vs_baseline": 0.0,
+                          "extras": {"budget_s": budget_s}}), flush=True)
+        print(f"bench: {name} exceeded its {budget_s}s budget — "
+              f"partial results flushed, continuing", file=sys.stderr,
+              flush=True)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    return state["result"]
+
+
 def _probe_backend_subprocess(timeout_s):
     """First TPU contact happens in a THROWAWAY subprocess: on a wedged
     tunnel ``jax.devices()`` can HANG (not raise — observed live, and the
@@ -734,6 +782,8 @@ def bench_gpt_13b_compile(args):
            "--batch", "16", "--seq", "2048", "--n-micro", "16",
            "--schedules", "1f1b", "--remat", "full",
            "--param-dtype", "bfloat16", "--moment-dtype", "bfloat16"]
+    # bounded by its own subprocess timeout (the ~25-min AOT compile is
+    # exempt from the per-model SIGALRM budget — see _config_budget)
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=1500)
     rec = None
     for ln in r.stdout.splitlines():
@@ -776,6 +826,10 @@ def main():
                     help="telemetry smoke run: tiny GPT, few steps — "
                          "verifies the enriched step-time p50/p95 / "
                          "peak-memory / compile-time columns end to end")
+    ap.add_argument("--per-model-timeout", type=int, default=420,
+                    help="SIGALRM budget (seconds) per config; a config "
+                         "over budget emits a *_TIMEOUT line and the "
+                         "sweep continues (0 disables)")
     args = ap.parse_args()
     sys.path.insert(0, ".")
 
@@ -805,8 +859,26 @@ def main():
     global _CPU_SMOKE
     _CPU_SMOKE = devices[0].platform == "cpu"
 
+    # sweep-consistent metric names for single-model mode, so a timeout
+    # line parses the same either way
+    single_names = {"resnet50": "resnet50", "bert": "bert",
+                    "ernie-moe": "ernie_moe", "serving": "serving",
+                    "13b-proxy": "gpt_13b_stage_proxy",
+                    "13b-compile": "gpt_13b_compile"}
+
+    def _config_budget(name):
+        """Per-config SIGALRM budget: the 13B AOT compile legitimately
+        runs ~25 min and is already bounded by its own subprocess
+        timeout (1500s), so it is exempt from the default budget."""
+        if name == "gpt_13b_compile" and args.per_model_timeout:
+            return max(args.per_model_timeout, 1600)
+        return args.per_model_timeout
+
     if args.model in single:
-        return single[args.model](args)
+        name = (f"gpt_{args.config.replace('.', 'p')}"
+                if args.model == "gpt" else single_names[args.model])
+        return run_with_timeout(name, lambda: single[args.model](args),
+                                _config_budget(name))
 
     # default: ALL BASELINE configs, one JSON line each; a failing config
     # reports an error line and the rest still run. The driver records
@@ -833,7 +905,7 @@ def main():
     runs.append(("gpt_345m", lambda: bench_gpt(args, "345m")))
     for name, fn in runs:
         try:
-            fn()
+            run_with_timeout(name, fn, _config_budget(name))
         except Exception as e:  # keep the rest of the sweep alive
             traceback.print_exc(file=sys.stderr)
             print(json.dumps({"metric": f"{name}_ERROR",

@@ -1,0 +1,80 @@
+"""paddle_tpu.analysis — static lint passes over jaxprs, Program DAGs,
+and collective schedules.
+
+The compile-time correctness layer the reference gets from ProgramDesc
+validation and the phi op audit, rebuilt for a trace-and-jit world: any
+``Layer``, ``to_static`` function, ``static.Program``, or fleet train
+step is abstractly evaluated (no device execution) and registered lint
+passes run over the result:
+
+========== =============================================================
+pass       finds
+========== =============================================================
+recompile  Python scalars baked as trace constants (retracing loops),
+           shape-polymorphic call sites, weak-type/promotion drift
+hostsync   ``.numpy()`` / ``.item()`` / ``float()`` on tracers inside
+           jit regions (runtime tracer hooks + a dy2static-aware AST
+           pre-pass)
+collective per-rank collective schedules recorded from abstract traces
+           and diffed — cross-rank divergence (the classic SPMD
+           deadlock) becomes a static diagnostic
+amp        fp16-unsafe ops reached without a cast; redundant
+           up/down-cast pairs in the jaxpr
+deadcode   unreachable ops / unused outputs in the static Program DAG
+========== =============================================================
+
+Surfaces::
+
+    from paddle_tpu.analysis import analyze
+    report = analyze(my_step_fn, jax.ShapeDtypeStruct((8, 128), "int32"))
+    assert report.clean, str(report)
+
+    python tools/check_program.py --model gpt      # CLI over the model zoo
+
+    ParallelTrainStep(model, opt, loss_fn, validate=True)   # lint at build
+
+Findings are emitted as ``analysis_diagnostic`` runlog events and the
+``paddle_analysis_diagnostics_total`` counter (see README
+"Observability"), so CI and dashboards see lint results next to the
+runtime telemetry they prevent.
+"""
+from .core import Diagnostic, Report, get_passes, pass_names, register_pass  # noqa: F401
+from .tracing import AnalysisContext, TraceRecorder  # noqa: F401
+from . import passes  # noqa: F401  (self-registers the built-in passes)
+from .analyzer import ProgramAnalyzer, analyze  # noqa: F401
+
+
+def validate_step_fn(step, target, avals, name=None, world_size=None):
+    """Shared tail of every ``validate=True`` hook: lint ``target``
+    against ``avals``, store the report on ``step.last_validation``, emit
+    runlog events, and warn (never raise — the lint must not block
+    training) when dirty."""
+    import warnings
+
+    report = ProgramAnalyzer(world_size=world_size).analyze(
+        target, *avals, name=name or f"{type(step).__name__}.validate")
+    step.last_validation = report
+    if not report.clean:
+        warnings.warn(
+            f"train-step validation found issues (training continues):\n"
+            f"{report}", stacklevel=3)
+    return report
+
+
+def validate_train_step(step, batch_vals, name=None, world_size=None):
+    """Opt-in ``validate=True`` hook for train-step builders: lint the
+    step's loss function against the first batch's avals right before
+    the expensive compile. Returns the :class:`Report`, also stored as
+    ``step.last_validation``."""
+    import jax
+    import numpy as np
+
+    avals = []
+    for v in batch_vals:
+        v = getattr(v, "_value", v)
+        avals.append(jax.ShapeDtypeStruct(tuple(np.shape(v)),
+                                          np.asarray(v).dtype
+                                          if not hasattr(v, "dtype")
+                                          else v.dtype))
+    return validate_step_fn(step, step, avals, name=name,
+                            world_size=world_size)

@@ -1,0 +1,227 @@
+"""The analyzer: target dispatch + pass orchestration.
+
+``analyze(target, *example_inputs)`` accepts any of:
+
+- a plain **callable** over Tensors (a train-step closure, a loss fn),
+- a **Layer** (its forward is traced),
+- a ``jit.to_static`` **StaticFunction** (underlying fn traced, program
+  cache inspected, original source AST-scanned),
+- a ``static.Program`` (DAG passes + a jaxpr closed over its fetches),
+- a fleet **ParallelTrainStep** (its loss_fn traced on the step's model).
+
+Everything is abstract evaluation — example inputs are shapes/dtypes
+(Tensors and arrays are accepted and converted), nothing executes on a
+device. When the trace issues collectives or reads the process rank, the
+target is re-traced once per simulated rank and the per-rank collective
+schedules handed to the consistency pass.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .core import Report, get_passes
+from .tracing import (AnalysisContext, CollectiveRecord, OpRecord,  # noqa: F401
+                      TraceRecorder, trace_abstract)
+
+
+def _target_name(target, explicit):
+    if explicit:
+        return explicit
+    for attr in ("__name__", "name"):
+        n = getattr(target, attr, None)
+        if isinstance(n, str) and n:
+            return n
+    return type(target).__name__
+
+
+class ProgramAnalyzer:
+    """Configured analyzer: which passes, how many simulated ranks."""
+
+    def __init__(self, passes=None, world_size=None):
+        self._passes = passes
+        self.world_size = world_size
+
+    # ------------------------------------------------------------------
+    def analyze(self, target, *example_inputs, fetch_list=None, name=None,
+                run_dir=None, emit=True) -> Report:
+        ctx = AnalysisContext(target=target,
+                              target_name=_target_name(target, name),
+                              example_inputs=tuple(example_inputs))
+        ctx.world_size = self._resolve_world()
+        fn = self._prepare(ctx, target, fetch_list)
+
+        traceable = fn is not None and (ctx.example_inputs
+                                        or _takes_no_args(fn))
+        if fn is not None and not traceable \
+                and ctx.target_kind not in ("to_static", "program"):
+            # forgetting the avals must not read as a clean pass — only
+            # to_static (cache inspection) and Program (DAG passes) have
+            # a meaningful no-trace mode
+            ctx.trace_error = (
+                "no example inputs provided for a target that requires "
+                "arguments — nothing was traced; pass ShapeDtypeStruct/"
+                "Tensor example inputs to analyze()")
+        if traceable:
+            rec = TraceRecorder(ctx, rank=0)
+            ctx.jaxpr, ctx.trace_error = trace_abstract(
+                fn, ctx.example_inputs, rec)
+            # rank-sensitive targets: re-trace per simulated rank so the
+            # collective pass can diff the schedules
+            if (ctx.rank_sensitive or ctx.ledgers.get(0)) \
+                    and ctx.world_size > 1:
+                for r in range(1, ctx.world_size):
+                    rec_r = TraceRecorder(ctx, rank=r, record_ops=False)
+                    trace_abstract(fn, ctx.example_inputs, rec_r,
+                                   want_jaxpr=False)
+
+        diags = []
+        for p in get_passes(self._passes):
+            diags.extend(p(ctx))
+        sev = {"error": 0, "warning": 1, "info": 2}
+        diags.sort(key=lambda d: (sev.get(d.severity, 3), d.pass_name,
+                                  d.line or 0))
+        report = Report(ctx.target_name, diags, trace_error=ctx.trace_error)
+        if emit:
+            report.emit(run_dir)
+        return report
+
+    # ------------------------------------------------------------------
+    # default cap on simulated ranks: each extra rank is one more full
+    # abstract trace, and divergence is almost always rank-0-vs-rest —
+    # on a 256-process launch an uncapped default would mean 255 extra
+    # traces per process before the first compile. Explicit world_size
+    # overrides (lint a specific topology when you need every rank).
+    MAX_DEFAULT_SIM_RANKS = 4
+
+    def _resolve_world(self):
+        if self.world_size is not None:
+            return max(int(self.world_size), 1)
+        from ..distributed import env as env_mod
+        w = env_mod.get_world_size()
+        # single-process default still simulates a pair so rank-dependent
+        # schedules have a second rank to disagree with
+        return min(max(w, 2), self.MAX_DEFAULT_SIM_RANKS)
+
+    def _prepare(self, ctx, target, fetch_list):
+        """Classify the target; return the traceable fn (or None)."""
+        from ..nn.layer.layers import Layer
+        from ..jit.api import StaticFunction
+        from ..static.program import Program
+
+        if isinstance(target, Program):
+            ctx.target_kind = "program"
+            ctx.program = target
+            ctx.fetches = list(fetch_list or [])
+            self._program_records(ctx, target)
+            return self._program_fn(ctx, target)
+
+        if isinstance(target, StaticFunction):
+            ctx.target_kind = "to_static"
+            ctx.static_function = target
+            origin = getattr(target, "_origin", None)
+            ctx.source_fns = [origin[0] if origin else target._fn]
+            return target._fn
+
+        if isinstance(target, Layer):
+            fwd = type(target).forward
+            inst_fwd = getattr(target, "forward", None)
+            if isinstance(inst_fwd, StaticFunction):  # to_static(Layer)
+                ctx.target_kind = "to_static"
+                ctx.static_function = inst_fwd
+                origin = getattr(inst_fwd, "_origin", None)
+                ctx.source_fns = [origin[0] if origin else fwd]
+                return lambda *a: target(*a)
+            ctx.target_kind = "layer"
+            ctx.source_fns = [fwd]
+            return lambda *a: target(*a)
+
+        # fleet train steps (lazy import: avoid cycles at package import)
+        try:
+            from ..distributed.fleet.train_step import ParallelTrainStep
+        except ImportError:
+            ParallelTrainStep = ()
+        if isinstance(target, ParallelTrainStep):
+            ctx.target_kind = "train_step"
+            ctx.source_fns = [target.loss_fn]
+            model = target.model
+            loss_fn = target.loss_fn
+            return lambda *batch: loss_fn(model, *batch)
+
+        if callable(target):
+            ctx.target_kind = "callable"
+            ctx.source_fns = [target]
+            return target
+
+        raise TypeError(
+            f"cannot analyze {type(target).__name__}: expected a callable, "
+            f"Layer, to_static function, static.Program, or "
+            f"ParallelTrainStep")
+
+    # -- static.Program helpers ----------------------------------------
+    def _program_records(self, ctx, prog):
+        """Synthesize op records from the recorded DAG (name + input
+        avals + the AMP cast baked into the node fn)."""
+        from ..framework.tape import AmpWrappedOp
+        from ..framework.tensor import Tensor
+        for node in prog._nodes:
+            ins = []
+            for a in node.args:
+                if isinstance(a, Tensor):
+                    v = a._value
+                    shape = tuple(getattr(v, "shape", ()) or ())
+                    dt = str(np.dtype(v.dtype)) if hasattr(v, "dtype") \
+                        else type(v).__name__
+                    ins.append(("T", dt, shape))
+                elif isinstance(a, (int, float)) \
+                        and not isinstance(a, bool):
+                    ins.append(("P", type(a).__name__, None))
+                else:
+                    ins.append(("O", type(a).__name__, None))
+            amp_mode = node.fn.mode if isinstance(node.fn, AmpWrappedOp) \
+                else None
+            site = getattr(node, "site", None) or (None, None)
+            ctx.op_records.append(
+                OpRecord(node.name, ins, amp_mode, site[0], site[1]))
+
+    def _program_fn(self, ctx, prog):
+        """Close the DAG into a traceable fn of its feeds so the jaxpr
+        passes (redundant casts) see the program XLA would compile."""
+        roots = list(ctx.fetches)
+        roots += [v for _, v in getattr(prog, "_buffer_updates", [])]
+        roots += [loss for _, loss in getattr(prog, "_optimize_ops", [])]
+        if not roots:
+            return None
+        from ..static.executor import _eval_graph
+        feeds = dict(prog._feeds)
+        names = sorted(feeds)
+        ctx.example_inputs = tuple(
+            jax.ShapeDtypeStruct(tuple(feeds[n]._value.shape),
+                                 feeds[n]._value.dtype) for n in names)
+
+        def fn(*feed_tensors):
+            feed_vals = {n: t._value for n, t in zip(names, feed_tensors)}
+            return _eval_graph(roots, feed_vals, {})
+
+        return fn
+
+
+def _takes_no_args(fn):
+    try:
+        import inspect
+        sig = inspect.signature(fn)
+        return not any(
+            p.default is p.empty
+            and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            for p in sig.parameters.values())
+    except (ValueError, TypeError):
+        return False
+
+
+def analyze(target, *example_inputs, passes=None, world_size=None,
+            fetch_list=None, name=None, run_dir=None) -> Report:
+    """One-call surface: ``analyze(fn_or_layer_or_program, *input_specs)``
+    → :class:`~.core.Report`."""
+    return ProgramAnalyzer(passes=passes, world_size=world_size).analyze(
+        target, *example_inputs, fetch_list=fetch_list, name=name,
+        run_dir=run_dir)

@@ -1,0 +1,169 @@
+"""Diagnostics, reports, and the lint-pass registry.
+
+The reference validates programs at compile time (ProgramDesc sanity
+checks, the phi op audit); this package is the TPU-native analog — a
+pass-based linter over abstract traces (jaxprs), lazy Program DAGs, and
+per-rank collective schedules.  A *pass* is a function ``(ctx) ->
+list[Diagnostic]`` registered with :func:`register_pass`; the analyzer
+(:mod:`.analyzer`) builds the :class:`~.tracing.AnalysisContext` once per
+target and folds every pass's findings into one :class:`Report`.
+
+Severity contract:
+- ``error``   — will fail or deadlock at runtime (host sync inside a jit
+  region, cross-rank collective divergence).
+- ``warning`` — correct but hazardous (recompile storms, fp16-unsafe
+  math, dead ops). ``Report.clean`` is False for errors AND warnings.
+- ``info``    — stylistic/heads-up findings; never fails a clean gate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# stable diagnostic codes (documented in README "Static analysis")
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass
+class Diagnostic:
+    """One finding, anchored to an op and (best effort) a source line."""
+
+    code: str                    # e.g. "PTHS001"
+    pass_name: str               # registered pass that produced it
+    severity: str                # error | warning | info
+    message: str
+    op: str | None = None        # op-name anchor (tape/DAG node name)
+    file: str | None = None      # source anchor
+    line: int | None = None
+    rank: int | None = None      # simulated rank (collective pass)
+    extra: dict = field(default_factory=dict)
+
+    def anchor(self) -> str:
+        parts = []
+        if self.file:
+            parts.append(f"{self.file}:{self.line or 0}")
+        if self.op:
+            parts.append(f"op={self.op}")
+        if self.rank is not None:
+            parts.append(f"rank={self.rank}")
+        return " ".join(parts) or "<no anchor>"
+
+    def __str__(self):
+        return (f"[{self.severity.upper()}] {self.code} ({self.pass_name}) "
+                f"{self.anchor()}: {self.message}")
+
+
+class Report:
+    """All diagnostics for one analyzed target."""
+
+    def __init__(self, target_name: str, diagnostics=None, trace_error=None):
+        self.target_name = target_name
+        self.diagnostics: list[Diagnostic] = list(diagnostics or [])
+        # exception repr when the abstract trace itself failed (the
+        # analyzer degrades to the passes that don't need a trace)
+        self.trace_error = trace_error
+
+    # -- views ----------------------------------------------------------
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def infos(self):
+        return [d for d in self.diagnostics if d.severity == "info"]
+
+    @property
+    def clean(self) -> bool:
+        """No errors, no warnings, AND the abstract trace succeeded
+        (infos don't fail a clean gate). A failed trace means the
+        trace-dependent passes checked nothing — that must not read as
+        a pass."""
+        return (not self.errors and not self.warnings
+                and self.trace_error is None)
+
+    ok = clean
+
+    def by_pass(self, name):
+        return [d for d in self.diagnostics if d.pass_name == name]
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __str__(self):
+        head = (f"Report({self.target_name}): {len(self.errors)} error(s), "
+                f"{len(self.warnings)} warning(s), {len(self.infos)} info(s)")
+        lines = [head]
+        if self.trace_error:
+            lines.append(f"  trace degraded: {self.trace_error}")
+        lines += [f"  {d}" for d in self.diagnostics]
+        return "\n".join(lines)
+
+    # -- observability integration --------------------------------------
+    def emit(self, run_dir: str | None = None):
+        """Publish findings as telemetry: one ``analysis_diagnostic``
+        runlog event per finding (into ``run_dir`` when given, else the
+        process-wide ``PADDLE_TELEMETRY_DIR`` logger when active) plus the
+        ``paddle_analysis_diagnostics_total{pass,severity}`` counter."""
+        from ..observability import counter
+        from ..observability import runlog as runlog_mod
+        c = counter("paddle_analysis_diagnostics_total",
+                    "static-analysis findings by pass/severity")
+        for d in self.diagnostics:
+            c.inc(1.0, **{"pass": d.pass_name, "severity": d.severity})
+        lg = (runlog_mod.RunLogger(run_dir) if run_dir
+              else runlog_mod.get_run_logger())
+        if lg is None:
+            return self
+        try:
+            for d in self.diagnostics:
+                lg.log("analysis_diagnostic", target=self.target_name,
+                       code=d.code, severity=d.severity,
+                       lint_pass=d.pass_name, message=d.message,
+                       op=d.op, file=d.file, line=d.line, sim_rank=d.rank)
+        finally:
+            if run_dir:
+                lg.close()
+        return self
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+_PASS_REGISTRY: dict[str, object] = {}
+
+
+def register_pass(name: str, order: int = 100):
+    """Register ``fn(ctx) -> list[Diagnostic]`` as a named lint pass."""
+
+    def deco(fn):
+        fn._pass_name = name
+        fn._order = order
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_passes(names=None):
+    """Resolve pass names (None = all) into ordered pass callables."""
+    if names is None:
+        sel = list(_PASS_REGISTRY.values())
+    else:
+        unknown = [n for n in names if n not in _PASS_REGISTRY]
+        if unknown:
+            raise KeyError(
+                f"unknown lint pass(es) {unknown}; registered: "
+                f"{sorted(_PASS_REGISTRY)}")
+        sel = [_PASS_REGISTRY[n] for n in names]
+    return sorted(sel, key=lambda f: f._order)
+
+
+def pass_names():
+    return sorted(_PASS_REGISTRY, key=lambda n: _PASS_REGISTRY[n]._order)

@@ -1,0 +1,8 @@
+"""Built-in lint passes. Importing this package registers all of them
+with the :mod:`..core` registry (new passes self-register via
+``@register_pass``)."""
+from . import recompile    # noqa: F401
+from . import hostsync     # noqa: F401
+from . import collective   # noqa: F401
+from . import amp_audit    # noqa: F401
+from . import deadcode     # noqa: F401

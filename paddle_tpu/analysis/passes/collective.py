@@ -1,0 +1,109 @@
+"""Collective-schedule consistency pass.
+
+The analyzer abstract-traces the target once per simulated rank (with
+``env.get_rank`` / ``lax.axis_index`` returning that rank), recording
+every collective — eager API calls and in-jit ``prims`` — in issue
+order. Two checks:
+
+- **lockstep collectives** (all_reduce, all_gather, barrier, ...): SPMD
+  correctness requires every rank to issue the SAME ordered sequence of
+  (op, group, dtype, shape); the first divergence is the classic
+  cross-rank deadlock (cf. EQuARX's XLA collective work), reported as
+  one static diagnostic instead of a hung mesh.
+- **point-to-point** (isend/irecv/send/recv): these are *meant* to
+  differ per rank (pipeline warmup), so they are excluded from the
+  positional diff and matched pairwise instead — every rank r send to
+  peer d needs a rank d receive from peer r with the same dtype/shape.
+  The first unmatched endpoint is the diagnostic (ordering-level p2p
+  deadlocks are out of scope).
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+from ..core import Diagnostic, register_pass
+
+
+@register_pass("collective", order=30)
+def collective_pass(ctx):
+    ledgers = {r: l for r, l in ctx.ledgers.items() if l is not None}
+    if len(ledgers) < 2:
+        return []
+    lockstep = {r: [c for c in l if not c.is_p2p]
+                for r, l in ledgers.items()}
+    out = _lockstep_check(lockstep)
+    if out:
+        return out  # one diagnostic per analysis: report the first wedge
+    return _p2p_check(ledgers)
+
+
+def _lockstep_check(ledgers):
+    base_rank = min(ledgers)
+    base = ledgers[base_rank]
+    for r in sorted(ledgers):
+        if r == base_rank:
+            continue
+        led = ledgers[r]
+        n = min(len(base), len(led))
+        for i in range(n):
+            if base[i].key() != led[i].key():
+                d = led[i]
+                return [Diagnostic(
+                    "PTCC001", "collective", "error",
+                    f"collective schedule diverges at position {i}: rank "
+                    f"{base_rank} issues {base[i]}, rank {r} issues {d} "
+                    f"— mismatched collectives deadlock the mesh (SPMD "
+                    f"requires every rank to issue the same sequence)",
+                    op=d.op, file=d.file, line=d.line, rank=r,
+                    extra={"position": i, "base_rank": base_rank})]
+        if len(base) != len(led):
+            longer, shorter = (base_rank, r) if len(base) > len(led) \
+                else (r, base_rank)
+            extra_rec = (base if len(base) > len(led) else led)[n]
+            return [Diagnostic(
+                "PTCC002", "collective", "error",
+                f"collective count mismatch: rank {longer} issues "
+                f"{max(len(base), len(led))} collectives but rank "
+                f"{shorter} issues {n} — rank {longer}'s {extra_rec} at "
+                f"position {n} has no partner and blocks forever",
+                op=extra_rec.op, file=extra_rec.file, line=extra_rec.line,
+                rank=longer, extra={"position": n})]
+    return []
+
+
+def _p2p_check(ledgers):
+    """Pairwise send/recv matching across the simulated ranks."""
+    sends, recvs = Counter(), Counter()
+    send_recs, recv_recs = {}, {}
+    for r, led in ledgers.items():
+        for c in led:
+            if not c.is_p2p:
+                continue
+            if c.op in ("isend", "send"):
+                k = (r, c.peer, c.dtype, c.shape)
+                sends[k] += 1
+                send_recs.setdefault(k, c)
+            else:
+                k = (c.peer, r, c.dtype, c.shape)
+                recvs[k] += 1
+                recv_recs.setdefault(k, c)
+    for k in sorted(sends, key=repr):
+        if sends[k] != recvs.get(k, 0):
+            c = send_recs[k]
+            src, dst = k[0], k[1]
+            return [Diagnostic(
+                "PTCC003", "collective", "error",
+                f"unmatched p2p: rank {src} sends {sends[k]}x {c} to "
+                f"rank {dst}, which posts {recvs.get(k, 0)} matching "
+                f"receive(s) — the unpaired side blocks forever",
+                op=c.op, file=c.file, line=c.line, rank=src)]
+    for k in sorted(recvs, key=repr):
+        if k not in sends:
+            c = recv_recs[k]
+            return [Diagnostic(
+                "PTCC003", "collective", "error",
+                f"unmatched p2p: rank {k[1]} posts a receive {c} from "
+                f"rank {k[0]}, which never sends a matching message — "
+                f"the receive blocks forever",
+                op=c.op, file=c.file, line=c.line, rank=k[1])]
+    return []

@@ -1,0 +1,104 @@
+"""Dead-code / unused-output pass over the lazy Program DAG.
+
+Reachability seeds: the fetch list, the recorded buffer updates (BN
+running stats), and any ``minimize`` loss. A recorded LazyNode none of
+those can reach is dead weight: it still costs an ``eval_shape`` at
+build and — if a later fetch pulls it in accidentally — compile time.
+Only the *tips* of dead subgraphs are reported (one diagnostic per dead
+chain, with the upstream count), so a dead tower doesn't spam.
+
+- **PTDC001** (warning) — dead op (unreachable from any fetch/root).
+- **PTDC002** (info)    — reachable multi-output op with outputs nothing
+  consumes (aux state the program computes and drops).
+"""
+from __future__ import annotations
+
+from ..core import Diagnostic, register_pass
+
+
+@register_pass("deadcode", order=50)
+def deadcode_pass(ctx):
+    prog = ctx.program
+    if prog is None:
+        return []
+    roots = list(ctx.fetches or [])
+    roots += [v for _, v in getattr(prog, "_buffer_updates", [])]
+    roots += [loss for _, loss in getattr(prog, "_optimize_ops", [])]
+    if not roots:
+        return []  # nothing to be reachable FROM — can't judge deadness
+
+    from ...framework.tensor import Tensor
+
+    reachable: set[int] = set()
+    used_outputs: dict[int, set] = {}
+
+    stack = [t for t in roots if isinstance(t, Tensor)]
+    while stack:  # iterative: program chains can be 1000s of nodes deep
+        t = stack.pop()
+        lz = getattr(t, "_lazy", None)
+        if lz is None or lz[0] == "feed":
+            continue
+        node, idx = lz
+        used_outputs.setdefault(id(node), set()).add(idx)
+        if id(node) in reachable:
+            continue
+        reachable.add(id(node))
+        stack.extend(a for a in node.args if isinstance(a, Tensor))
+
+    # nodes consumed by OTHER dead nodes are interior; report only tips
+    consumed_by_dead: set[int] = set()
+    dead_nodes = [n for n in prog._nodes if id(n) not in reachable]
+    dead_ids = {id(n) for n in dead_nodes}
+    upstream_count: dict[int, int] = {}
+    for n in dead_nodes:
+        for a in n.args:
+            lz = getattr(a, "_lazy", None) if isinstance(a, Tensor) else None
+            if lz is not None and lz[0] != "feed" and id(lz[0]) in dead_ids:
+                consumed_by_dead.add(id(lz[0]))
+
+    out = []
+    for n in dead_nodes:
+        if id(n) in consumed_by_dead:
+            continue
+        # count the dead subtree feeding this tip (best effort)
+        count, stack, seen = 0, [n], set()
+        while stack:
+            m = stack.pop()
+            if id(m) in seen:
+                continue
+            seen.add(id(m))
+            count += 1
+            for a in m.args:
+                lz = getattr(a, "_lazy", None) \
+                    if isinstance(a, Tensor) else None
+                if lz is not None and lz[0] != "feed" \
+                        and id(lz[0]) in dead_ids:
+                    stack.append(lz[0])
+        site = getattr(n, "site", None) or (None, None)
+        out.append(Diagnostic(
+            "PTDC001", "deadcode", "warning",
+            f"dead op '{n.name}': unreachable from any fetch, buffer "
+            f"update, or minimize loss"
+            + (f" ({count - 1} upstream op(s) feed only it)"
+               if count > 1 else "")
+            + " — recorded work the Executor never runs; drop it or "
+              "fetch its output",
+            op=n.name, file=site[0], line=site[1],
+            extra={"dead_subtree_ops": count}))
+
+    for n in prog._nodes:
+        if id(n) not in reachable or n.n_outputs <= 1:
+            continue
+        used = used_outputs.get(id(n), set())
+        # an output may also be consumed by a DEAD node: count those as
+        # unused too, but only report outputs nothing live consumes
+        unused = [i for i in range(n.n_outputs) if i not in used]
+        if unused and len(unused) < n.n_outputs:
+            site = getattr(n, "site", None) or (None, None)
+            out.append(Diagnostic(
+                "PTDC002", "deadcode", "info",
+                f"op '{n.name}' computes {n.n_outputs} outputs but "
+                f"output(s) {unused} are never consumed (aux state "
+                f"computed and dropped)",
+                op=n.name, file=site[0], line=site[1]))
+    return out

@@ -1,0 +1,105 @@
+"""Host-sync pass: device→host round trips inside a jit region.
+
+Two detectors, deduped by call site:
+
+- **runtime** (PTHS001, error) — the tracer hooks in
+  ``framework.tensor`` fired during the abstract trace: ``.numpy()`` /
+  ``.item()`` / ``.tolist()`` / ``float()`` / ``int()`` on a traced
+  Tensor would concretize (crash under jit; force a blocking transfer
+  eagerly). ``bool()`` (PTHS003, warning) is a data-dependent Python
+  branch — dy2static rewrites it under ``to_static``, so it is
+  suppressed for StaticFunction targets.
+- **AST pre-pass** (PTHS002, info) — a dy2static-aware source scan of
+  the target (and its original, pre-transform function when the AST
+  fallback already ran) for ``.numpy()`` / ``.item()`` / ``.tolist()``
+  call sites the trace didn't reach (dead branches, unexecuted paths).
+  Info, not warning: the scan cannot see receiver types (a numpy
+  scalar's ``.item()`` is harmless), so unverified sites must not fail
+  a clean gate — the runtime detector upgrades any site that actually
+  syncs a tracer to an error.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+import textwrap
+
+from ..core import Diagnostic, register_pass
+
+_AST_ATTRS = {"numpy", "item", "tolist"}
+
+_KIND_MSG = {
+    "numpy": ".numpy() on a traced Tensor",
+    "item": ".item() on a traced Tensor",
+    "tolist": ".tolist() on a traced Tensor",
+    "float": "float() on a traced Tensor",
+    "int": "int() on a traced Tensor",
+}
+
+
+@register_pass("hostsync", order=20)
+def hostsync_pass(ctx):
+    out = []
+    seen_sites = set()
+    for hs in ctx.host_syncs:
+        key = (hs.kind, hs.file, hs.line)
+        if key in seen_sites:
+            continue
+        seen_sites.add(key)
+        if hs.kind == "bool":
+            if ctx.static_function is not None:
+                continue  # dy2static rewrites tensor-bool control flow
+            out.append(Diagnostic(
+                "PTHS003", "hostsync", "warning",
+                f"data-dependent Python branch on a traced Tensor "
+                f"(shape {list(hs.shape)}): under jit this is a host "
+                f"sync and retrace per value; use paddle_tpu.jit."
+                f"to_static (dy2static) or ops.where",
+                op="bool", file=hs.file, line=hs.line))
+        else:
+            out.append(Diagnostic(
+                "PTHS001", "hostsync", "error",
+                f"{_KIND_MSG.get(hs.kind, hs.kind)} (shape "
+                f"{list(hs.shape)}, dtype {hs.dtype}) inside the traced "
+                f"region — concretizes the tracer: crashes under jit, "
+                f"and forces a device→host sync on the eager hot path; "
+                f"keep the value on device or move the readback outside "
+                f"the step",
+                op=hs.kind, file=hs.file, line=hs.line))
+    runtime_lines = {(hs.file, hs.line) for hs in ctx.host_syncs}
+    for fn in ctx.source_fns:
+        out.extend(_ast_scan(fn, runtime_lines))
+    return out
+
+
+def _ast_scan(fn, runtime_lines):
+    """Source scan for host-sync attribute calls the trace didn't hit."""
+    fn = inspect.unwrap(fn) if callable(fn) else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        # normpath to match tracing.callsite(), which normalizes the
+        # "/repo/./pkg/..." co_filenames of relative sys.path imports —
+        # otherwise the runtime/AST dedup never matches there
+        fname = os.path.normpath(inspect.getsourcefile(fn) or "<unknown>")
+        base = fn.__code__.co_firstlineno - 1
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, AttributeError, IndentationError):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _AST_ATTRS):
+            continue
+        line = base + node.lineno
+        if (fname, line) in runtime_lines:
+            continue  # the runtime detector already anchored this site
+        out.append(Diagnostic(
+            "PTHS002", "hostsync", "info",
+            f".{node.func.attr}() call site in the traced function "
+            f"source (not reached by the abstract trace — dead branch, "
+            f"unexecuted path, or a non-Tensor receiver): a host sync "
+            f"if it runs on a Tensor inside the jit region",
+            op=node.func.attr, file=fname, line=line))
+    return out

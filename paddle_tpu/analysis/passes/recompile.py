@@ -1,0 +1,144 @@
+"""Recompile-hazard pass.
+
+Three hazards the reference's static-graph world can't have but a
+trace-and-jit world recompiles (or silently degrades) on:
+
+- **PTRC001** — a ``to_static`` program cache holding N entries whose
+  tensor signatures are identical and only Python scalar arguments
+  differ: each distinct scalar was baked as a trace constant and
+  compiled its own program (the classic retracing loop).
+- **PTRC002** — a shape-polymorphic call site: many shape-specialized
+  programs cached for the same function (per-batch retracing; pad or
+  bucket the inputs).
+- **PTRC003** — promotion drift: a float64 value reaching an op (x64
+  leakage recompiles everything downstream at double width on TPU), or a
+  *strong* float32 scalar (np.float32 / 0-d array — unlike weak Python
+  floats, these win type promotion) silently widening a half-precision
+  tensor op to f32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Diagnostic, register_pass
+
+# distinct shape-specialized programs for one function before we call it
+# a retracing storm (2 shapes is routine: e.g. train + drain batch)
+SHAPE_STORM_THRESHOLD = 3
+
+_FLOAT_ORDER = {"float16": 0, "bfloat16": 0, "float32": 1, "float64": 2}
+
+
+def _is_float(dt):
+    return dt in _FLOAT_ORDER
+
+
+@register_pass("recompile", order=10)
+def recompile_pass(ctx):
+    out = []
+    scalar_positions_reported = _cache_checks(ctx, out)
+    _scalar_arg_check(ctx, out, scalar_positions_reported)
+    _promotion_drift_check(ctx, out)
+    return out
+
+
+def _cache_checks(ctx, out):
+    """Inspect a StaticFunction's per-signature program cache."""
+    sf = ctx.static_function
+    reported: set[int] = set()
+    if sf is None or len(getattr(sf, "_cache", {})) <= 1:
+        return reported
+    tensor_sigs, scalar_sigs = set(), set()
+    for key in sf._cache:
+        sig = key[0]
+        tensor_sigs.add(tuple(p for p in sig if p[0] == "T"))
+        scalar_sigs.add(tuple(p for p in sig if p[0] == "S"))
+    n = len(sf._cache)
+    if len(scalar_sigs) > 1 and len(tensor_sigs) == 1:
+        # remember which positional slots are the scalars so the
+        # example-input check doesn't double-report them
+        for key in sf._cache:
+            for i, p in enumerate(key[0]):
+                if p[0] == "S":
+                    reported.add(i)
+        out.append(Diagnostic(
+            "PTRC001", "recompile", "warning",
+            f"{n} programs compiled for identical tensor signatures that "
+            f"differ only in Python scalar arguments — each distinct "
+            f"scalar is baked as a trace constant and retraces; pass it "
+            f"as a Tensor input instead",
+            op=getattr(sf, "__name__", None),
+            extra={"cache_entries": n}))
+    elif len(tensor_sigs) >= SHAPE_STORM_THRESHOLD:
+        shapes = sorted({p[1] for sig in tensor_sigs for p in sig})[:6]
+        out.append(Diagnostic(
+            "PTRC002", "recompile", "warning",
+            f"shape-polymorphic call site: {len(tensor_sigs)} "
+            f"shape-specialized programs cached (seen dims e.g. "
+            f"{shapes}) — this retraces per batch shape; pad or bucket "
+            f"inputs to a fixed set of shapes",
+            op=getattr(sf, "__name__", None),
+            extra={"cache_entries": n}))
+    return reported
+
+
+def _scalar_arg_check(ctx, out, already_reported):
+    """Python float example inputs to a to_static function bake as trace
+    constants — flag prospectively (ints are usually structural: axes,
+    sizes — not flagged)."""
+    if ctx.static_function is None:
+        return
+    for i, a in enumerate(ctx.example_inputs):
+        if i in already_reported:
+            continue
+        if isinstance(a, float):
+            out.append(Diagnostic(
+                "PTRC001", "recompile", "warning",
+                f"argument {i} is a Python float ({a!r}): it is baked "
+                f"into the compiled program as a constant, so every "
+                f"distinct value triggers a full retrace — pass it as a "
+                f"0-d Tensor input",
+                op=getattr(ctx.static_function, "__name__", None)))
+
+
+def _promotion_drift_check(ctx, out):
+    seen = set()
+    for rec in ctx.op_records:
+        t_floats = [(dt, shape) for kind, dt, shape in rec.ins
+                    if kind in ("T", "A") and _is_float(dt)
+                    and shape is not None and len(shape) > 0]
+        s_floats = [(dt, shape) for kind, dt, shape in rec.ins
+                    if kind in ("T", "A") and _is_float(dt)
+                    and shape is not None and len(shape) == 0]
+        f64 = [dt for kind, dt, shape in rec.ins
+               if kind in ("T", "A") and dt == "float64"]
+        key = (rec.name, rec.file, rec.line)
+        if key in seen:
+            continue
+        if f64:
+            seen.add(key)
+            out.append(Diagnostic(
+                "PTRC003", "recompile", "warning",
+                f"float64 input reached op '{rec.name}' — x64 drift "
+                f"widens everything downstream (2x HBM + off the MXU "
+                f"fast path on TPU); cast to float32 at the source",
+                op=rec.name, file=rec.file, line=rec.line))
+            continue
+        if t_floats and s_floats:
+            max_t = max(_FLOAT_ORDER[dt] for dt, _ in t_floats)
+            max_s = max(_FLOAT_ORDER[dt] for dt, _ in s_floats)
+            if max_s > max_t:
+                seen.add(key)
+                wide = max((dt for dt, _ in s_floats),
+                           key=lambda d: _FLOAT_ORDER[d])
+                narrow = max((dt for dt, _ in t_floats),
+                             key=lambda d: _FLOAT_ORDER[d])
+                out.append(Diagnostic(
+                    "PTRC003", "recompile", "warning",
+                    f"promotion drift in op '{rec.name}': a strong "
+                    f"{wide} scalar (np scalar / 0-d array — unlike a "
+                    f"weak Python float) promotes the {narrow} tensor "
+                    f"math to {wide}; use a Python float or cast the "
+                    f"scalar down",
+                    op=rec.name, file=rec.file, line=rec.line))
+    return out

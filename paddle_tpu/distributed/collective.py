@@ -53,6 +53,25 @@ class ReduceOp:
     AVG = "avg"
 
 
+# ---------------------------------------------------------------------------
+# static-analysis recorder (paddle_tpu/analysis): when set, the eager
+# collectives below RECORD (op, group, dtype, shape) into the analyzer's
+# per-rank ledger and return abstractly-correct results without touching
+# devices — so a traced train step yields each rank's ordered collective
+# schedule for the consistency pass. In-function (not monkeypatched) so
+# early `from ... import all_reduce` bindings stay covered.
+# ---------------------------------------------------------------------------
+
+_analysis_recorder = None
+
+
+def _set_analysis_recorder(rec):
+    global _analysis_recorder
+    prev = _analysis_recorder
+    _analysis_recorder = rec
+    return prev
+
+
 _default_group: Group | None = None
 
 
@@ -169,6 +188,9 @@ def _axis0_sharded(v, group):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=False):
+    if _analysis_recorder is not None:
+        return _analysis_recorder.eager_collective("all_reduce", tensor,
+                                                   group)
     group = _get_group(group)
     if group.nranks <= 1:
         return tensor
@@ -219,6 +241,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     `tensor` is sharded over the group axis the result materializes each
     rank's (distinct) shard; a replicated input degenerates to n copies,
     matching the reference where every rank holds the same value."""
+    if _analysis_recorder is not None:
+        outs = _analysis_recorder.eager_gather("all_gather", tensor, group)
+        if tensor_list is not None:
+            tensor_list.clear()
+            tensor_list.extend(outs)
+        return outs
     group = _get_group(group)
     v = unwrap(tensor)
     if group.nranks <= 1:
@@ -302,6 +330,12 @@ def _store_cleanup(st, keys, counter_key, world):
 
 
 def all_gather_object(object_list, obj, group=None):
+    if _analysis_recorder is not None:
+        _analysis_recorder.eager_collective("all_gather_object", None, group)
+        object_list.clear()
+        object_list.extend(
+            [obj] * _analysis_recorder._group_size(group))
+        return
     group = _get_group(group)
     if _multi_process():
         # every process contributes its object through the TCPStore
@@ -332,6 +366,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     which single-controller ranks disagree — a shard_map all_gather picks
     rank src's shard and writes it into every shard, which is exactly the
     reference ProcessGroup broadcast."""
+    if _analysis_recorder is not None:
+        return _analysis_recorder.eager_collective("broadcast", tensor, group)
     group = _get_group(group)
     v = unwrap(tensor)
     if group.nranks <= 1:
@@ -361,6 +397,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    if _analysis_recorder is not None:
+        return _analysis_recorder.eager_collective("reduce", tensor, group)
     # single-controller: the reduced value is a global array visible to all
     # ranks, so reduce ≡ all_reduce (dst selects who *keeps* it in the
     # reference; there is no per-rank storage to differ here)
@@ -373,6 +411,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     Under multi-process launch each process writes tensor_list[its group
     rank]; under pure single-controller SPMD (one process, rank 0) the result
     is chunk 0 — matching the reference where rank r's buffer gets chunk r."""
+    if _analysis_recorder is not None:
+        return _analysis_recorder.eager_collective("scatter", tensor, group)
     group = _get_group(group)
     if tensor_list:
         from . import env as env_mod
@@ -395,6 +435,13 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     replicated over the group's devices (so every rank can read its row),
     keeping outputs composable with each other and with mesh-sharded arrays.
     Compiled code should use prims.all_to_all / the MoE dispatch instead."""
+    if _analysis_recorder is not None:
+        _analysis_recorder.eager_collective(
+            "all_to_all", in_tensor_list[0] if in_tensor_list else None,
+            group)
+        out_tensor_list.clear()
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
     _single_controller_only("all_to_all")
     group = _get_group(group)
     moved = sum(int(getattr(unwrap(t), "nbytes", 0) or 0)
@@ -493,6 +540,10 @@ def isend(tensor, dst=0, group=None):
     mailbox through the rpc agent (ordered per src→dst by sequence
     number). Single-process: only meaningful inside batch_isend_irecv,
     where it pairs with a matching irecv."""
+    if _analysis_recorder is not None:
+        _analysis_recorder.eager_collective("isend", tensor, group,
+                                            peer=dst)
+        return _P2PTask()
     rpc_mod, names = _rpc_world()
     if rpc_mod is None:
         raise RuntimeError(
@@ -516,6 +567,10 @@ def isend(tensor, dst=0, group=None):
 def irecv(tensor, src=0, group=None):
     """Async recv: resolves when rank ``src``'s matching isend lands in
     the mailbox; the value is written into ``tensor`` in place."""
+    if _analysis_recorder is not None:
+        _analysis_recorder.eager_collective("irecv", tensor, group,
+                                            peer=src)
+        return _P2PTask()
     rpc_mod, names = _rpc_world()
     if rpc_mod is None:
         raise RuntimeError(
@@ -593,6 +648,9 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
+    if _analysis_recorder is not None:
+        _analysis_recorder.eager_collective("barrier", None, group)
+        return
     with _traced("barrier", group=group, nbytes=0):
         if _multi_process():
             # real cross-process barrier over the launcher-hosted TCPStore

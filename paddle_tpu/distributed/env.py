@@ -11,9 +11,20 @@ from __future__ import annotations
 import os
 
 
+# static-analysis hook (paddle_tpu/analysis): when set, get_rank returns a
+# SIMULATED rank so the analyzer can abstract-trace a train step once per
+# rank and diff the resulting collective schedules.
+_analysis_rank_hook = None
+
+
 def get_rank(group=None) -> int:
+    # group branch FIRST: get_group_rank() recurses into get_rank(None),
+    # so under analysis the simulated global rank still maps through the
+    # real group-local translation instead of being returned raw
     if group is not None:
         return group.get_group_rank()
+    if _analysis_rank_hook is not None:
+        return _analysis_rank_hook(None)
     for var in ("PADDLE_TRAINER_ID", "JAX_PROCESS_INDEX", "RANK"):
         if var in os.environ:
             return int(os.environ[var])

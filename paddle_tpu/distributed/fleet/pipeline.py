@@ -180,12 +180,15 @@ class PipelineParallel(nn.Layer):
     shard_map GPipe schedule from models/gpt.py instead.
     """
 
-    def __init__(self, layers, hcg, strategy=None):
+    def __init__(self, layers, hcg, strategy=None, validate=False):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
         self._strategy = strategy
         self._step = None
+        # opt-in static lint (analysis pkg) of the pipeline loss at the
+        # first train_batch, before the schedule compiles
+        self._validate = bool(validate)
         self.micro_batches = (strategy.pipeline_configs.accumulate_steps
                               if strategy else 1)
 
@@ -212,7 +215,8 @@ class PipelineParallel(nn.Layer):
                 return loss_fn(out, y) if self._layers._loss_fn else out
 
             self._step = ParallelTrainStep(self._layers, optimizer, full_loss,
-                                           hcg=self._hcg, scaler=scaler)
+                                           hcg=self._hcg, scaler=scaler,
+                                           validate=self._validate)
             # the inner step does the per-step accounting (histogram,
             # tokens/s, memory); label its series as the pipeline path
             self._step.telemetry_path = "pipeline"

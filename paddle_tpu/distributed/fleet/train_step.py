@@ -69,7 +69,7 @@ class ParallelTrainStep:
 
     def __init__(self, model, optimizer, loss_fn, hcg=None, zero_stage=1,
                  batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES,
-                 scaler=None):
+                 scaler=None, validate=False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn  # loss_fn(model, *batch_tensors) -> scalar Tensor
@@ -96,6 +96,10 @@ class ParallelTrainStep:
         # count (B*S for token ids); set flops_per_token for an MFU gauge
         self.flops_per_token = None
         self.telemetry_path = "parallel"
+        # opt-in static lint of the loss fn at first build (analysis pkg);
+        # the report lands in self.last_validation + runlog events
+        self.validate = bool(validate)
+        self.last_validation = None
 
     # ------------------------------------------------------------------
     def _pure_step(self, param_vals, state_vals, buffer_vals, key, lr, scale,
@@ -144,6 +148,12 @@ class ParallelTrainStep:
 
     # ------------------------------------------------------------------
     def _build(self, batch_vals):
+        if self.validate:
+            # abstract lint BEFORE the expensive compile: host syncs /
+            # rank-divergent collectives in the loss fn surface here as
+            # diagnostics instead of XLA errors or mesh deadlocks
+            from ...analysis import validate_train_step
+            validate_train_step(self, batch_vals)
         mesh = self.mesh
         param_vals = [p._value for p in self._params]
         buffer_vals = [b._value for b in self._buffers]

@@ -151,6 +151,24 @@ class AmpWrappedOp:
 
 
 # ---------------------------------------------------------------------------
+# static-analysis hook (paddle_tpu/analysis): when set, every dispatched op
+# reports (name, args, active amp cast) before running — abstract lint
+# traces read pre-promotion dtypes here that the jaxpr can't reconstruct
+# ---------------------------------------------------------------------------
+
+_analysis_hook = None
+
+
+def set_analysis_hook(hook):
+    """Install (or clear with None) the per-op analysis hook; returns the
+    previous hook so guards can nest."""
+    global _analysis_hook
+    prev = _analysis_hook
+    _analysis_hook = hook
+    return prev
+
+
+# ---------------------------------------------------------------------------
 # tape
 # ---------------------------------------------------------------------------
 
@@ -234,6 +252,8 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
         from ..static.program import make_lazy_output
         name = op_name or getattr(fn, "__name__", "op")
         amp_cast = _amp_cast_fn(name)
+        if _analysis_hook is not None:
+            _analysis_hook(name, args, amp_cast)
         if amp_cast is not None:
             # static AMP (reference fluid/contrib/mixed_precision): the
             # white/black-list cast is recorded INSIDE the op, so lazy
@@ -264,6 +284,9 @@ def apply(fn: Callable, *args, op_name: str = None, has_aux: bool = False, **kwa
             vals.append(a)
 
     name = op_name or getattr(fn, "__name__", "op")
+
+    if _analysis_hook is not None:
+        _analysis_hook(name, args, amp_cast)
 
     if not diff_idx:
         out = fn(*vals, **kwargs)

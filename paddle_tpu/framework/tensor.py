@@ -44,6 +44,21 @@ def _as_value(data, dtype=None, place=None):
     return jnp.asarray(arr, dtype=jd)
 
 
+# static-analysis hook (paddle_tpu/analysis): when set, host-interop
+# methods called on a TRACER record a host-sync diagnostic and return a
+# shape-correct dummy instead of raising, so abstract lint traces run to
+# completion. None (the default) keeps the hot path untouched.
+_host_sync_hook = None
+
+
+def _trace_sync(kind, t):
+    """The analysis substitute for a host sync on a tracer, or None when
+    the real (concretizing) path should run."""
+    if _host_sync_hook is not None and isinstance(t._value, jax.core.Tracer):
+        return _host_sync_hook(kind, t)
+    return None
+
+
 class Tensor:
     """paddle.Tensor parity object wrapping a jax.Array / tracer."""
 
@@ -147,26 +162,53 @@ class Tensor:
 
     # -- host interop ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        if _host_sync_hook is not None:
+            sub = _trace_sync("numpy", self)
+            if sub is not None:
+                return sub
         return np.asarray(self._value)
 
     def __array__(self, dtype=None):
+        if _host_sync_hook is not None:
+            sub = _trace_sync("numpy", self)
+            if sub is not None:
+                return sub.astype(dtype) if dtype is not None else sub
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a
 
     def item(self, *idx):
+        if _host_sync_hook is not None:
+            sub = _trace_sync("item", self)
+            if sub is not None:
+                return sub
         v = self._value if not idx else self._value[idx]
         return v.item() if hasattr(v, "item") else np.asarray(v).item()
 
     def tolist(self):
+        if _host_sync_hook is not None:
+            sub = _trace_sync("tolist", self)
+            if sub is not None:
+                return sub
         return np.asarray(self._value).tolist()
 
     def __float__(self):
+        if _host_sync_hook is not None:
+            sub = _trace_sync("float", self)
+            if sub is not None:
+                return sub
         return float(self.item())
 
     def __int__(self):
+        if _host_sync_hook is not None:
+            sub = _trace_sync("int", self)
+            if sub is not None:
+                return sub
         return int(self.item())
 
     def __bool__(self):
+        if _host_sync_hook is not None and \
+                isinstance(self._value, jax.core.Tracer):
+            return _host_sync_hook("bool", self)
         if self.size != 1:
             raise ValueError(
                 "The truth value of a Tensor with more than one element is ambiguous"

@@ -106,8 +106,15 @@ class GShardGate(BaseGate):
             keep = (2.0 * topk_val[:, 1] > u).astype(topk_val.dtype)
             topk_val = ops.stack([topk_val[:, 0], topk_val[:, 1] * keep],
                                  axis=-1)
-        self.set_loss(_load_balance_loss(
-            probs, topk_idx[:, 0], self.tot_expert))
+        if self.training:
+            # aux loss is a training-time regularizer; computing it in
+            # eval is dead work (analysis deadcode pass flags it)
+            self.set_loss(_load_balance_loss(
+                probs, topk_idx[:, 0], self.tot_expert))
+        else:
+            # clear rather than skip: a stale (possibly trace-time)
+            # training loss must not survive into eval consumers
+            self.set_loss(None)
         return topk_val, topk_idx
 
 
@@ -132,6 +139,13 @@ class SwitchGate(BaseGate):
         probs = F.softmax(logits, axis=-1)
         topk_val, topk_idx = ops.topk(
             probs, k=1, axis=-1, largest=True, sorted=True)
-        self.set_loss(_load_balance_loss(
-            probs, topk_idx[:, 0], self.tot_expert))
+        if self.training:
+            # aux loss is a training-time regularizer; computing it in
+            # eval is dead work (analysis deadcode pass flags it)
+            self.set_loss(_load_balance_loss(
+                probs, topk_idx[:, 0], self.tot_expert))
+        else:
+            # clear rather than skip: a stale (possibly trace-time)
+            # training loss must not survive into eval consumers
+            self.set_loss(None)
         return topk_val, topk_idx

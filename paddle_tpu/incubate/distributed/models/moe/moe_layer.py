@@ -237,7 +237,10 @@ class MoELayer(nn.Layer):
 
         val, idx = self.gate(x)
         val = ops.reshape(val, [S, self.top_k])
-        idx = ops.reshape(idx, [S, self.top_k]).astype("int32")
+        # no astype here: _dispatch_indices casts to int32 internally, and
+        # an extra cast would round-trip topk's int64 indices (flagged by
+        # the analysis AMP pass as a redundant cast pair)
+        idx = ops.reshape(idx, [S, self.top_k])
 
         slot_token, comb_idx = apply(
             _dispatch_indices, idx, num_expert=E, capacity=C,

@@ -91,7 +91,8 @@ class Predictor:
     def __init__(self, config: Config, _shared=None):
         self._config = config
         if _shared is not None:  # clone(): share program + weights
-            self._layer, self._input_specs = _shared
+            (self._layer, self._input_specs, self._in_batched,
+             self._out_batched) = _shared
         else:
             path = config.prog_file()
             if path is None or not os.path.exists(path + ".pdmodel"):
@@ -102,6 +103,14 @@ class Predictor:
             with open(path + ".pdmeta", "rb") as f:
                 meta = pickle.load(f)
             self._input_specs = meta["input_specs"]
+            # batched-vs-broadcast classification derived from the
+            # exported program SIGNATURE at save time (jit.save probes
+            # the trace with a bumped batch dim; in_batched records
+            # which inputs the probe bumped, so chunking matches the
+            # probe's assumption exactly); None on old artifacts → fall
+            # back to the runtime leading-dim heuristics
+            self._in_batched = meta.get("in_batched")
+            self._out_batched = meta.get("out_batched")
         self._inputs = [Tensor(f"input_{i}")
                         for i in range(len(self._input_specs))]
         self._outputs = []
@@ -124,7 +133,8 @@ class Predictor:
         (AnalysisPredictor::Clone): handles are per-clone, weights aren't
         duplicated."""
         return Predictor(self._config,
-                         _shared=(self._layer, self._input_specs))
+                         _shared=(self._layer, self._input_specs,
+                                  self._in_batched, self._out_batched))
 
     def _run_bucketed(self, vals):
         """Serve ANY batch size through the fixed-shape program: pad up,
@@ -141,14 +151,20 @@ class Predictor:
 
         def is_batched(i, v):
             # only slice/pad inputs whose exported dim0 IS the batch dim;
-            # non-batched extras (lookup tables, scale vectors) pass as-is
+            # non-batched extras (lookup tables, scale vectors) pass
+            # as-is. Prefer the save-time record of which inputs the
+            # signature probe bumped (kept consistent with out_batched)
+            if not (np.ndim(v) and np.shape(v)[0] == b):
+                return False
+            if self._in_batched is not None \
+                    and i < len(self._in_batched):
+                return bool(self._in_batched[i])
             spec = self._input_specs[i] if i < len(self._input_specs) \
                 else None
             shape = spec[0] if isinstance(spec, (tuple, list)) \
                 else getattr(spec, "shape", None)
-            return (shape is not None and len(shape)
-                    and int(shape[0]) == B0 and np.ndim(v)
-                    and np.shape(v)[0] == b)
+            return bool(shape is not None and len(shape)
+                        and int(shape[0]) == B0)
 
         chunks = []
         out_batched = None
@@ -164,11 +180,20 @@ class Predictor:
             outs = [np.asarray(o.numpy()) if hasattr(o, "numpy")
                     else np.asarray(o) for o in outs]
             if out_batched is None:
-                # outputs whose leading dim is NOT the exported batch
-                # (scalar aggregates, global stats) pass through from one
-                # chunk unsliced instead of being truncated/concatenated
-                out_batched = [o.ndim >= 1 and o.shape[0] == B0
-                               for o in outs]
+                # preferred: the save-time signature probe (an output
+                # whose leading dim merely COINCIDES with the batch size
+                # is correctly classified as broadcast); legacy
+                # artifacts without it fall back to the shape heuristic
+                if self._out_batched is not None \
+                        and len(self._out_batched) == len(outs):
+                    out_batched = list(self._out_batched)
+                else:
+                    # outputs whose leading dim is NOT the exported
+                    # batch (scalar aggregates, global stats) pass
+                    # through from one chunk unsliced instead of being
+                    # truncated/concatenated
+                    out_batched = [o.ndim >= 1 and o.shape[0] == B0
+                                   for o in outs]
                 if not all(out_batched) and b > B0:
                     import warnings
                     warnings.warn(

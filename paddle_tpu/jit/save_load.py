@@ -54,8 +54,9 @@ def save(layer, path, input_spec=None, **configs):
         return out._value if isinstance(out, Tensor) else \
             tuple(o._value for o in out)
 
-    exported = jax_export.export(jax.jit(pure))(
-        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals], *specs)
+    param_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for v in param_vals]
+    exported = jax_export.export(jax.jit(pure))(param_avals, *specs)
     blob = exported.serialize()
 
     d = os.path.dirname(path)
@@ -64,10 +65,52 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     fio.save({k: sd[k] for k in names}, path + ".pdiparams")
+    in_batched, out_batched = _probe_batched(pure, param_avals, specs,
+                                             exported.out_avals)
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump({"param_names": names,
                      "input_specs": [(tuple(s.shape), str(s.dtype))
-                                     for s in specs]}, f)
+                                     for s in specs],
+                     "in_batched": in_batched,
+                     "out_batched": out_batched}, f)
+
+
+def _probe_batched(pure, param_avals, specs, out_avals):
+    """Derive which inputs/outputs actually ride the batch dim from the
+    program SIGNATURE: re-trace abstractly (eval_shape — no execution)
+    with the exported batch dim bumped by one and diff against the
+    export's own ``out_avals``. An output whose leading dim merely
+    *coincides* with the batch size (aggregates, lookup tables) stays
+    put and is correctly classified as broadcast — the shape heuristic
+    the Predictor used to apply at runtime could not tell these apart.
+    Returns (in_batched, out_batched); (None, None) when the function
+    doesn't trace at the bumped batch (shape-specialized internals)."""
+    shapes = [tuple(s.shape) for s in specs]
+    b0 = shapes[0][0] if shapes and len(shapes[0]) else None
+    if not b0:
+        return None, None
+    in_batched = [len(s) >= 1 and s[0] == b0 for s in shapes]
+    try:
+        bumped = [jax.ShapeDtypeStruct((s.shape[0] + 1,) + tuple(s.shape[1:]),
+                                       s.dtype) if batched else s
+                  for s, batched in zip(specs, in_batched)]
+        out1 = jax.eval_shape(pure, param_avals, *bumped)
+        # unbumped shapes come free from the export itself (flat order
+        # matches: jax.export flattens the same output pytree)
+        flat0 = list(out_avals)
+        flat1 = jax.tree_util.tree_leaves(out1)
+        # batched means EXACTLY +1 on the leading dim (the Predictor
+        # slices/concats along dim 0); an output whose batch dependence
+        # lands elsewhere (transposed layouts) must classify broadcast so
+        # chunked serving passes it through with the warning instead of
+        # corrupting it
+        out_batched = [
+            len(a.shape) >= 1
+            and tuple(b.shape) == (a.shape[0] + 1,) + tuple(a.shape[1:])
+            for a, b in zip(flat0, flat1)]
+        return in_batched, out_batched
+    except Exception:
+        return None, None
 
 
 class InputSpec:

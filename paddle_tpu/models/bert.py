@@ -108,13 +108,19 @@ class BertModel(nn.Layer):
         self.pooler = BertPooler(cfg)
         _init_weights(self, cfg.initializer_range)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def encode(self, input_ids, token_type_ids=None, attention_mask=None):
+        """Sequence output only — no pooler. The MLM-loss path uses this
+        so the pooler isn't computed and dropped (dead work the analysis
+        deadcode pass flags)."""
         if attention_mask is not None:
             # [B, S] 1/0 → additive [B, 1, 1, S]
             m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
             attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
         h = self.embeddings(input_ids, token_type_ids)
-        h = self.encoder(h, src_mask=attention_mask)
+        return self.encoder(h, src_mask=attention_mask)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.encode(input_ids, token_type_ids, attention_mask)
         return h, self.pooler(h)
 
 
@@ -154,11 +160,11 @@ class BertForPretraining(nn.Layer):
         never materialized (3.8GB fp32 at B32/S512/V30k) — tokens stream
         through the same remat'ed chunked CE the GPT head uses
         (gpt.vocab_parallel_cross_entropy), with the decoder bias folded
-        in. ignore_index=-100 semantics via the loss mask."""
+        in. ignore_index=-100 semantics via the loss mask. Uses
+        BertModel.encode, so the (unused) pooler is never computed."""
         from .gpt import fused_mlm_cross_entropy
 
-        seq, _pooled = self.bert(input_ids, token_type_ids,
-                                 attention_mask)
+        seq = self.bert.encode(input_ids, token_type_ids, attention_mask)
         cls = self.cls
         h = cls.layer_norm(cls.activation(cls.transform(seq)))
         return fused_mlm_cross_entropy(h, cls.decoder_weight,

@@ -138,15 +138,37 @@ class ErnieMoeForPretraining(nn.Layer):
         return ops.matmul(h, self.decoder_weight, transpose_y=True) \
             + self.decoder_bias
 
+    def gate_aux_loss(self):
+        """Sum of the MoE gates' load-balance losses from the last
+        forward (GShard/Switch aux loss), or None when no gate stashed
+        one (eval mode, or already consumed)."""
+        total = None
+        for sub in self.ernie.sublayers(include_self=True):
+            gate = getattr(sub, "gate", None)
+            if gate is not None and getattr(gate, "has_loss", False):
+                l = gate.get_loss()
+                total = l if total is None else total + l
+        return total
+
     def forward_with_mlm_loss(self, input_ids, masked_lm_labels,
-                              token_type_ids=None, attention_mask=None):
+                              token_type_ids=None, attention_mask=None,
+                              aux_loss_weight=0.01):
         """Fused MLM head + chunked CE (same design as
         bert.py forward_with_mlm_loss): the [B*S, V] fp32 logits buffer
-        never materializes; ignore_index=-100 via the loss mask."""
+        never materializes; ignore_index=-100 via the loss mask. In
+        training mode the gates' load-balance aux loss is added with
+        ``aux_loss_weight`` (GShard §2.2 — without it the router
+        collapses onto few experts; the analysis deadcode pass flagged
+        the previously computed-and-dropped aux loss)."""
         from .gpt import fused_mlm_cross_entropy
 
         h = self.ernie(input_ids, token_type_ids, attention_mask)
         h = self.layer_norm(nn.functional.gelu(self.transform(h)))
-        return fused_mlm_cross_entropy(h, self.decoder_weight,
+        loss = fused_mlm_cross_entropy(h, self.decoder_weight,
                                        self.decoder_bias,
                                        masked_lm_labels)
+        if self.training and aux_loss_weight:
+            aux = self.gate_aux_loss()
+            if aux is not None:
+                loss = loss + aux_loss_weight * aux
+        return loss

@@ -445,7 +445,7 @@ class GPTHybridTrainStep:
                    grad_clip_norm=1.0, remat=True, compute_dtype=None,
                    use_flash=None, virtual_pp_degree=1,
                    pipeline_schedule="gpipe", param_dtype=None,
-                   moment_dtype=None):
+                   moment_dtype=None, validate=False):
         """Shared scalar/spec configuration — the ONLY kwarg-parsing path,
         used by both __init__ (buffers) and abstract() (compile-only), so
         the two can never drift."""
@@ -493,6 +493,11 @@ class GPTHybridTrainStep:
         }
         self._compiled = None
         self._t = 0
+        # opt-in static lint at first call (analysis pkg); the compiled
+        # schedule itself is SPMD-by-construction — the lint covers the
+        # eager model the stacked params came from
+        self.validate = bool(validate)
+        self.last_validation = None
 
     def _finalize_state_specs(self):
         """Moment specs from the (buffer or abstract) param tree."""
@@ -1061,6 +1066,21 @@ class GPTHybridTrainStep:
             else jnp.asarray(labels)
         first_call = self._compiled is None
         if first_call:
+            if self.validate and self.model is not None:
+                # lint the eager model + criterion against this batch's
+                # avals before the expensive hybrid compile
+                from ..analysis import validate_step_fn
+                model = self.model
+                if isinstance(model, GPTForPretraining):
+                    crit = GPTPretrainingCriterion()
+                    fn = lambda i, l: crit(model(i), l)
+                else:  # bare GPTModel: lint the forward only
+                    fn = lambda i, l: model(i)
+                validate_step_fn(
+                    self, fn,
+                    [jax.ShapeDtypeStruct(tuple(ids.shape), ids.dtype),
+                     jax.ShapeDtypeStruct(tuple(labs.shape), labs.dtype)],
+                    name="GPTHybridTrainStep.validate")
             t0 = _time.perf_counter()
             with RecordEvent("GPTHybridTrainStep.build", "Compile"):
                 self._build()

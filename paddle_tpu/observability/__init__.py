@@ -20,6 +20,13 @@ Hot paths emit here by default (``ParallelTrainStep``, ``PipelineParallel``,
 ``distributed.collective``, the elastic launcher); the registry is cheap
 enough to stay always-on — an increment is a dict lookup + float add under
 a lock, far off the device-step critical path.
+
+Static-analysis findings ride the same rails: :mod:`paddle_tpu.analysis`
+(and ``tools/check_program.py``) logs every lint diagnostic as an
+``analysis_diagnostic`` runlog event — ``{code, severity, lint_pass,
+message, file, line, op}`` — into the active run directory, and counts
+them in ``paddle_analysis_diagnostics_total{pass,severity}``, so compile-
+time diagnostics appear next to the runtime telemetry they prevent.
 """
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry,

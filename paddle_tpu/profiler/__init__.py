@@ -15,6 +15,12 @@ The profiler is the *tracing* half of the observability stack:
 The *metrics* half (Counter/Gauge/Histogram registry, Prometheus/JSONL
 exposition, per-run JSONL telemetry and ``run_summary.json``) lives in
 :mod:`paddle_tpu.observability`; see the README "Observability" section.
+
+Compile-time findings join the same streams: :mod:`paddle_tpu.analysis`
+lint diagnostics (host syncs that would stall these traces, recompile
+hazards behind long ``jit build`` spans, rank-divergent collectives) are
+emitted as ``analysis_diagnostic`` runlog events — see README "Static
+analysis".
 """
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, make_scheduler,

@@ -34,7 +34,7 @@ def static_build() -> bool:
 
 class LazyNode:
     __slots__ = ("fn", "args", "kwargs", "out_avals", "name", "n_outputs",
-                 "treedef")
+                 "treedef", "site")
 
     def __init__(self, fn, args, kwargs, out_avals, name, treedef=None):
         self.fn = fn
@@ -44,6 +44,9 @@ class LazyNode:
         self.name = name
         self.n_outputs = len(out_avals)
         self.treedef = treedef
+        # (file, line) of the recording call site — captured only when the
+        # program opted in (static analysis); None keeps build cheap
+        self.site = None
 
 
 def make_placeholder(shape, dtype, lazy, name=None):
@@ -88,7 +91,13 @@ def make_lazy_output(fn, args, kwargs, op_name):
     flat_avals, treedef = jax.tree_util.tree_flatten(out_shape)
     node = LazyNode(fn, list(args), kwargs, flat_avals, op_name)
     node.treedef = treedef
-    default_main_program()._nodes.append(node)
+    prog = default_main_program()
+    if getattr(prog, "_capture_sites", False):
+        # opt-in (tools/check_program, analysis): anchor DAG diagnostics
+        # to the line that recorded the op
+        from ..analysis.tracing import callsite
+        node.site = callsite()
+    prog._nodes.append(node)
     outs = [make_placeholder(av, None, (node, i))
             for i, av in enumerate(flat_avals)]
     return jax.tree_util.tree_unflatten(treedef, outs)
@@ -129,6 +138,9 @@ class Program:
         # buffer ops (BN running mean/var, batch_norm_kernel.cu)
         self._buffer_updates = []
         self.random_seed = 0
+        # static analysis opt-in: record (file, line) per LazyNode so
+        # deadcode/AMP diagnostics anchor to user source
+        self._capture_sites = False
 
     def global_block(self):
         return self

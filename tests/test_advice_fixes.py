@@ -320,6 +320,71 @@ def test_predictor_non_batched_output_passthrough(tmp_path):
     assert np.ndim(agg) == 0 or agg.shape == ()
 
 
+def test_predictor_broadcast_output_with_coincident_batch_dim(tmp_path):
+    """ADVICE r5: output classification comes from the exported program
+    SIGNATURE (jit.save probes the trace with a bumped batch dim), so a
+    broadcast output whose leading dim merely COINCIDES with the
+    exported batch size is no longer sliced/concatenated per chunk."""
+    import pickle
+
+    from paddle_tpu import inference, jit
+    from paddle_tpu.jit.save_load import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 2)
+
+        def forward(self, x):
+            gram = paddle.matmul(self.lin.weight, self.lin.weight,
+                                 transpose_y=True)  # [4,4]: dim0 == B0!
+            return self.lin(x), gram
+
+    net = Net()
+    path = str(tmp_path / "coincident_model")
+    jit.save(net, path, input_spec=[InputSpec([4, 4], "float32")])
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    # the signature probe classified output 1 as broadcast even though
+    # its leading dim equals the exported batch size
+    assert meta["out_batched"] == [True, False]
+    assert meta["in_batched"] == [True]
+
+    pred = inference.create_predictor(inference.Config(path))
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((10, 4)).astype(np.float32)
+    y, gram = pred.run([x])
+    assert y.shape == (10, 2)
+    # old leading-dim heuristic would slice/concat this into (10, 4)
+    assert gram.shape == (4, 4)
+
+
+def test_predictor_probe_requires_leading_batch_dim(tmp_path):
+    """An output whose batch dependence is NOT on dim 0 (transposed
+    layout) must classify as broadcast — the Predictor only knows how to
+    slice/concat along dim 0, so treating it as batched would corrupt
+    it."""
+    import pickle
+
+    from paddle_tpu import jit
+    from paddle_tpu.jit.save_load import InputSpec
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 2)
+
+        def forward(self, x):
+            y = self.lin(x)
+            return y, paddle.transpose(y, [1, 0])  # [2, B]: batch on dim 1
+
+    path = str(tmp_path / "transposed_model")
+    jit.save(Net(), path, input_spec=[InputSpec([4, 4], "float32")])
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["out_batched"] == [True, False]
+
+
 def test_communicator_stop_wedged_thread_raises():
     """ADVICE r4: stop() must not flush concurrently with a wedged send
     thread."""

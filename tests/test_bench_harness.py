@@ -77,3 +77,31 @@ def test_bench_probe_failure_falls_back_to_cpu(monkeypatch):
                         lambda timeout_s: (False, "timeout"))
     devs = bench.acquire_devices(retries=2, wait_s=0.0)
     assert devs is not None and devs[0].platform == "cpu"
+
+
+def test_per_model_timeout_flushes_partial(capsys):
+    """A config over its SIGALRM budget emits one *_TIMEOUT line and
+    returns (the sweep continues) — a single wedged model can no longer
+    turn the whole driver bench into rc=124 with zero artifacts."""
+    import time
+
+    calls = []
+
+    def slow():
+        calls.append("slow")
+        time.sleep(5)
+        calls.append("finished")  # must never happen
+
+    bench.run_with_timeout("cfgx", slow, 1)
+    bench.run_with_timeout("cfgy", lambda: bench.emit_skip("cfgy", "ok"),
+                           30)
+    out = capsys.readouterr().out
+    recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    assert recs[0]["metric"] == "cfgx_TIMEOUT"
+    assert recs[1]["metric"] == "cfgy_SKIPPED"
+    assert calls == ["slow"]
+
+
+def test_per_model_timeout_disabled_runs_to_completion():
+    assert bench.run_with_timeout("cfg", lambda: 42, 0) == 42
+    assert bench.run_with_timeout("cfg", lambda: 7, 30) == 7

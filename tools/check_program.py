@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Static program check: lint models/train steps before they hit XLA.
+
+Runs the :mod:`paddle_tpu.analysis` pass suite (recompile hazards, host
+syncs, collective-schedule consistency, AMP cast audit, dead code) over
+the built-in model zoo — each model is linted TWICE: the eager train-step
+closure (abstract tape trace → jaxpr passes) and the recorded
+``static.Program`` DAG (deadcode + AMP node audit). No device execution:
+tiny configs, abstract shapes only.
+
+Usage::
+
+    python tools/check_program.py                  # all models
+    python tools/check_program.py --model gpt      # one model
+    python tools/check_program.py --json           # machine-readable
+    python tools/check_program.py --errors-only    # warnings don't fail
+
+Exit code: 0 iff every report is CLEAN (no errors, no warnings —
+matching ``Report.clean``; ``--errors-only`` relaxes to errors), 1
+otherwise, 2 on a harness crash. Diagnostics also land in
+runlog (``analysis_diagnostic`` events) when ``PADDLE_TELEMETRY_DIR`` is
+set — the observability docs' diagnostics-as-runlog-events contract.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_platform():
+    """Honor JAX_PLATFORMS even where a sitecustomize force-selects the
+    TPU via jax.config (the env var alone is ignored there)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat.split(",")[0])
+
+
+# ---------------------------------------------------------------------------
+# model-zoo targets (tiny configs — the lint is abstract, keep builds fast)
+# ---------------------------------------------------------------------------
+
+def _lint_static(build, name, world_size=None):
+    """Record ``build()`` into a fresh Program (with per-node source
+    sites) and run the DAG passes over it."""
+    from paddle_tpu import static
+    from paddle_tpu.analysis import ProgramAnalyzer
+    static.enable_static()
+    try:
+        prog = static.Program()
+        prog._capture_sites = True
+        with static.program_guard(prog):
+            fetches = build()
+        return ProgramAnalyzer(world_size=world_size).analyze(
+            prog, fetch_list=list(fetches), name=name)
+    finally:
+        static.disable_static()
+
+
+def lint_gpt(world_size=None):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTModel,
+                                       GPTPretrainingCriterion,
+                                       gpt_tiny_config)
+    paddle.seed(0)
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    crit = GPTPretrainingCriterion()
+    B, S = 2, 16
+    ids = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+        lambda i, l: crit(model(i), l), ids, ids, name="gpt.train_step")]
+
+    def build():
+        fids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        loss = crit(model(fids), labels)
+        return [loss]
+
+    reports.append(_lint_static(build, "gpt.program", world_size))
+    return reports
+
+
+def lint_bert(world_size=None):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.models.bert import (BertForPretraining, BertModel,
+                                        bert_tiny_config)
+    paddle.seed(0)
+    model = BertForPretraining(BertModel(bert_tiny_config()))
+    B, S = 2, 16
+    ids = jax.ShapeDtypeStruct((B, S), jnp.int64)
+    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+        lambda i, l: model.forward_with_mlm_loss(i, l), ids, ids,
+        name="bert.train_step")]
+
+    def build():
+        fids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        return [model.forward_with_mlm_loss(fids, labels)]
+
+    reports.append(_lint_static(build, "bert.program", world_size))
+    return reports
+
+
+def lint_ernie_moe(world_size=None):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.models import (ErnieMoeForPretraining, ErnieMoeModel,
+                                   ernie_moe_tiny_config)
+    paddle.seed(0)
+    model = ErnieMoeForPretraining(
+        ErnieMoeModel(ernie_moe_tiny_config(num_hidden_layers=2)))
+    B, S = 2, 16
+    ids = jax.ShapeDtypeStruct((B, S), jnp.int64)
+    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+        lambda i, l: model.forward_with_mlm_loss(i, l), ids, ids,
+        name="ernie_moe.train_step")]
+
+    def build():
+        fids = static.data("ids", [B, S], "int64")
+        labels = static.data("labels", [B, S], "int64")
+        return [model.forward_with_mlm_loss(fids, labels)]
+
+    reports.append(_lint_static(build, "ernie_moe.program", world_size))
+    return reports
+
+
+MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe}
+
+
+def lint_model(name, world_size=None):
+    """Lint one built-in model; returns [Report, ...] (eager + static)."""
+    return MODELS[name](world_size=world_size)
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static lint over models / train steps / programs")
+    ap.add_argument("--model", default="all",
+                    choices=["all"] + sorted(MODELS))
+    ap.add_argument("--world-size", type=int, default=None,
+                    help="simulated ranks for the collective pass "
+                         "(default: env world size, min 2)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per report")
+    ap.add_argument("--errors-only", action="store_true",
+                    help="exit 0 despite warnings (default: any "
+                         "non-clean report fails, matching Report.clean)")
+    args = ap.parse_args(argv)
+    _force_platform()
+
+    names = sorted(MODELS) if args.model == "all" else [args.model]
+    reports = []
+    for n in names:
+        reports.extend(lint_model(n, world_size=args.world_size))
+
+    failed = False
+    for rep in reports:
+        # a failed trace checked nothing — always a gate failure, even
+        # under --errors-only
+        bad = bool(rep.errors or rep.trace_error) if args.errors_only \
+            else not rep.clean
+        failed = failed or bad
+        if args.json:
+            print(json.dumps({
+                "target": rep.target_name,
+                "clean": rep.clean,
+                "errors": len(rep.errors),
+                "warnings": len(rep.warnings),
+                "infos": len(rep.infos),
+                "trace_error": rep.trace_error,
+                "diagnostics": [
+                    {"code": d.code, "pass": d.pass_name,
+                     "severity": d.severity, "op": d.op, "file": d.file,
+                     "line": d.line, "message": d.message}
+                    for d in rep.diagnostics],
+            }), flush=True)
+        else:
+            print(rep, flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception:  # harness crash ≠ lint failure
+        import traceback
+        traceback.print_exc()
+        sys.exit(2)
