@@ -103,7 +103,7 @@ def __getattr__(name):
 
 
 # save/load + seed surface
-from .framework.io import save, load  # noqa: F401,E402
+from .framework.io import save, load, CheckpointCorruptError  # noqa: F401,E402
 
 # top-level parity aliases (reference python/paddle/__init__.py __all__)
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401

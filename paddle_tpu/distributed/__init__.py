@@ -30,6 +30,7 @@ from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
 from . import fleet_executor  # noqa: F401,E402
 from . import launch  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
 from .parity import (  # noqa: F401,E402
     alltoall, alltoall_single, reduce_scatter, broadcast_object_list,
     scatter_object_list, split, ParallelMode, get_backend, is_available,

@@ -1,0 +1,56 @@
+"""Resilient distributed checkpointing.
+
+Step-granularity, topology-aware checkpoints for preemptible training:
+
+- **sharded** — each rank writes only the shards it owns (ZeRO optimizer
+  partitions, pp-stage params, mp slices); replicated keys are
+  deduplicated by a deterministic owner function (sharded.py);
+- **verified** — a rank-0 ``manifest.json`` (atomic rename, written
+  last) records per-file byte sizes + sha256 and the (dp, pp, mp,
+  sharding) topology; a checkpoint is complete iff its manifest exists
+  (manifest.py);
+- **async** — arrays snapshot to host, a background writer persists them
+  while training continues; the next save joins the previous
+  (async_saver.py);
+- **survivable** — ``load_latest()`` falls back to the newest checkpoint
+  that checksum-verifies; retention GC never deletes the fallback
+  target (manager.py); SIGTERM triggers a synchronous emergency save and
+  a distinct exit code the elastic controller treats as
+  resume-without-penalty (preemption.py); a resume at a different dp
+  degree regathers ZeRO partitions from the manifest's topology metadata
+  (reshard.py).
+
+Quick use::
+
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    mgr = ckpt.CheckpointManager("/data/ckpts", rank=r, world_size=w,
+                                 topology=hcg, keep=3, interval=200)
+    handler = ckpt.install_preemption_handler(
+        mgr, lambda: (train_state(), cur_step))
+    state, step = mgr.load_latest()          # verified resume (or (None, -1))
+    for step in range(step + 1, total):
+        loss = train_step(batch)
+        mgr.maybe_save(train_state, step)    # async, every `interval` steps
+    mgr.wait()                               # join the final save
+"""
+from ...framework.io import CheckpointCorruptError  # noqa: F401
+from .manifest import (  # noqa: F401
+    MANIFEST_NAME, is_complete, read_manifest, verify, write_manifest,
+    sha256_file, normalize_topology,
+)
+from .sharded import save_sharded, load_sharded, plan_shards  # noqa: F401
+from .async_saver import (  # noqa: F401
+    AsyncSaver, snapshot_to_host, state_nbytes,
+)
+from .reshard import (  # noqa: F401
+    merge_partitions, split_partition, reshard_partitioned,
+    gather_partitioned,
+)
+from .state import (  # noqa: F401
+    pack_training_state, unpack_training_state,
+)
+from .manager import CheckpointManager  # noqa: F401
+from .preemption import (  # noqa: F401
+    EMERGENCY_EXIT_CODE, PreemptionHandler, install_preemption_handler,
+)

@@ -1,0 +1,111 @@
+"""Asynchronous checkpoint writer.
+
+The save critical path a training step pays is only the **host
+snapshot** (device → host copy of params/optimizer state); the pickle +
+fsync + rename happens on a background writer thread while the next
+steps run.  One writer, one in-flight save: submitting a new save (or an
+explicit ``wait()``) first joins the previous one, so saves can never
+reorder and a slow filesystem backpressures checkpoint frequency instead
+of accumulating unbounded queued snapshots.
+
+Failures in the background write are NOT swallowed: the stored exception
+re-raises on the next ``submit``/``wait`` — the training loop finds out
+a checkpoint was lost before it trusts one more save interval to it.
+
+Save duration / bytes / in-flight status flow into the observability
+registry (``paddle_checkpoint_*``) and, under a telemetry-enabled
+launch, the per-rank runlog.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ...observability import instrument as _obs
+
+
+def snapshot_to_host(state: dict) -> dict:
+    """Device arrays → host numpy, synchronously.  This is the only part
+    of an async save that blocks the training loop; everything the
+    writer thread later touches is host memory owned by the snapshot, so
+    training may donate/overwrite the live arrays immediately after."""
+    out = {}
+    for k, v in state.items():
+        inner = getattr(v, "_value", v)  # Tensor → jax array
+        if isinstance(inner, np.ndarray):
+            out[k] = inner.copy()  # asarray would ALIAS the caller's buffer
+        elif hasattr(inner, "dtype") and hasattr(inner, "shape"):
+            out[k] = np.asarray(inner)  # device → fresh host buffer
+        else:
+            out[k] = v
+    return out
+
+
+def state_nbytes(state: dict) -> int:
+    return sum(int(v.nbytes) for v in state.values()
+               if hasattr(v, "nbytes"))
+
+
+class AsyncSaver:
+    """One background writer; ``submit`` joins any in-flight save first."""
+
+    def __init__(self, name: str = "checkpoint"):
+        self.name = name
+        self._thread = None
+        self._error = None
+        self._lock = threading.Lock()
+        self.last_save_seconds = None
+        self.saves_submitted = 0
+
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _raise_pending(self):
+        err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                f"{self.name}: previous async save failed") from err
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Join the in-flight save (no-op when idle).  Returns False iff a
+        timeout was given and expired; re-raises a failed save's error."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                return False
+            self._thread = None
+        self._raise_pending()
+        return True
+
+    def submit(self, write_fn, nbytes: int = 0, mode: str = "async"):
+        """Run ``write_fn()`` on the writer thread after joining the
+        previous save.  ``nbytes`` feeds the bytes counter up front (the
+        snapshot size is known before the write finishes)."""
+        with self._lock:
+            self.wait()  # serialize: at most one save in flight
+            self.saves_submitted += 1
+            _obs.checkpoint_in_flight().set(1)
+
+            def run():
+                t0 = time.perf_counter()
+                try:
+                    write_fn()
+                    seconds = time.perf_counter() - t0
+                    self.last_save_seconds = seconds
+                    _obs.record_checkpoint_save(seconds, nbytes, mode=mode)
+                except BaseException as e:  # surfaced on next submit/wait
+                    self._error = e
+                    _obs.checkpoint_saves_counter().inc(mode=mode,
+                                                        result="error")
+                finally:
+                    _obs.checkpoint_in_flight().set(0)
+
+            self._thread = threading.Thread(
+                target=run, name=f"{self.name}-writer", daemon=True)
+            self._thread.start()
+            return self._thread

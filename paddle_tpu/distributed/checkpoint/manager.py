@@ -1,0 +1,262 @@
+"""Step-granularity checkpoint manager: save / verified resume / GC.
+
+Directory layout under the checkpoint root::
+
+    <root>/
+      step_00000100/           # one directory per saved step
+        shard_00000.pdparams   # per-rank shard (atomic rename)
+        shard_00000.meta.json  # per-rank sidecar (sizes + sha256)
+        manifest.json          # rank-0 commit point — written LAST
+      step_00000200/
+      …
+
+Invariants the manager maintains:
+
+- a checkpoint is complete iff its ``manifest.json`` exists (atomic
+  rename commit — see manifest.py);
+- ``load_latest`` walks step dirs newest-first, checksum-verifies each
+  complete one, and falls back to the newest checkpoint that *passes*
+  rather than crashing on a torn/corrupt one;
+- retention GC keeps the last ``keep`` complete checkpoints and never
+  deletes the newest complete one (the fallback target), nor any dir
+  newer than it (a possibly-in-flight save);
+- async saves serialize through one writer (async_saver.py): the next
+  save joins the previous, so the newest manifest always describes fully
+  written bytes.
+
+Env contract (all optional): ``PADDLE_CHECKPOINT_DIR`` (root),
+``PADDLE_CHECKPOINT_KEEP`` (retention, default 3),
+``PADDLE_CHECKPOINT_ASYNC`` (1/0, default 1),
+``PADDLE_CHECKPOINT_INTERVAL`` (steps between ``maybe_save`` saves,
+default 100).
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+
+from ...observability import instrument as _obs
+from ...observability.runlog import get_run_logger
+from . import manifest as manifest_mod
+from .async_saver import AsyncSaver, snapshot_to_host, state_nbytes
+from .sharded import load_sharded, save_sharded
+from .reshard import reshard_partitioned
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class CheckpointManager:
+    def __init__(self, root=None, rank=0, world_size=1, topology=None,
+                 keep=None, async_save=None, interval=None, owner_fn=None,
+                 verify_checksums=True):
+        self.root = root or os.environ.get(
+            "PADDLE_CHECKPOINT_DIR", "/tmp/paddle_tpu_checkpoints")
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.topology = manifest_mod.normalize_topology(topology)
+        self.keep = _env_int("PADDLE_CHECKPOINT_KEEP", 3) \
+            if keep is None else int(keep)
+        self.async_save = bool(_env_int("PADDLE_CHECKPOINT_ASYNC", 1)) \
+            if async_save is None else bool(async_save)
+        self.interval = _env_int("PADDLE_CHECKPOINT_INTERVAL", 100) \
+            if interval is None else int(interval)
+        self.owner_fn = owner_fn
+        self.verify_checksums = bool(verify_checksums)
+        self._saver = AsyncSaver(name=f"ckpt-r{self.rank}")
+        self.last_saved_step = -1
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------- layout
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def steps(self) -> list:
+        """Every step with a directory (complete or torn), ascending."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            m = _STEP_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def complete_steps(self) -> list:
+        return [s for s in self.steps()
+                if manifest_mod.is_complete(self.step_dir(s))]
+
+    def latest_complete_step(self) -> int:
+        steps = self.complete_steps()
+        return steps[-1] if steps else -1
+
+    # --------------------------------------------------------------- save
+    def _log(self, event, **fields):
+        logger = get_run_logger()
+        if logger is not None:
+            logger.log(event, **fields)
+
+    def save(self, state: dict, step: int, partitions=None, blocking=None,
+             mode=None, meta=None):
+        """Checkpoint ``state`` as ``step``.  Async by default: snapshots
+        to host synchronously, persists on the writer thread.  The
+        snapshot means the caller may mutate/donate the live arrays the
+        moment this returns."""
+        if int(step) < 0:
+            # step_-0000001 would never match _STEP_DIR_RE: the save would
+            # "succeed" yet be invisible to load_latest() and GC forever
+            raise ValueError(f"checkpoint step must be >= 0, got {step}")
+        blocking = (not self.async_save) if blocking is None else blocking
+        mode = mode or ("sync" if blocking else "async")
+        snapshot = snapshot_to_host(state)
+        nbytes = state_nbytes(snapshot)
+        ckpt_dir = self.step_dir(step)
+        partitions = dict(partitions or {})
+
+        def write():
+            save_sharded(snapshot, ckpt_dir, step, rank=self.rank,
+                         world_size=self.world_size, topology=self.topology,
+                         partitions=partitions, owner_fn=self.owner_fn,
+                         meta=meta)
+            _obs.checkpoint_saves_counter().inc(mode=mode, result="ok")
+            self._log("checkpoint_save", step=step, bytes=nbytes, mode=mode,
+                      dir=ckpt_dir)
+            if self.rank == 0:
+                self.gc()
+
+        self.last_saved_step = int(step)
+        if blocking:
+            with _obs.timed() as t:
+                write()
+            _obs.record_checkpoint_save(t.seconds, nbytes, mode=mode)
+            return ckpt_dir
+        self._saver.submit(write, nbytes=nbytes, mode=mode)
+        return ckpt_dir
+
+    def maybe_save(self, state_fn, step: int, partitions_fn=None):
+        """Interval-gated save for hot loops: ``state_fn()`` is only
+        called (and only pays the host snapshot) on interval steps."""
+        if self.interval <= 0 or step < 0 or \
+                step == self.last_saved_step or step % self.interval != 0:
+            return None
+        parts = partitions_fn() if partitions_fn else None
+        return self.save(state_fn(), step, partitions=parts)
+
+    def wait(self, timeout=None):
+        """Barrier on the in-flight async save (re-raises its failure)."""
+        return self._saver.wait(timeout)
+
+    @property
+    def save_in_flight(self) -> bool:
+        return self._saver.in_flight
+
+    def emergency_save(self, state: dict, step: int, partitions=None):
+        """Synchronous preemption-path save: joins any in-flight async
+        save first (its manifest must not interleave with ours), then
+        persists before the process exits."""
+        try:
+            self.wait()
+        except RuntimeError:
+            pass  # a failed earlier save must not block the emergency one
+        return self.save(state, step, partitions=partitions, blocking=True,
+                         mode="emergency")
+
+    # --------------------------------------------------------------- load
+    def load_latest(self, reshard_to=None, verify_checksums=None):
+        """Resume state: ``(state, step)`` from the newest checkpoint that
+        verifies, or ``(None, -1)`` when none does.
+
+        Torn dirs (no manifest) are skipped; complete dirs with
+        size/checksum problems are skipped with a ``checkpoint_corrupt``
+        event and a fallback counter bump — resume lands on the newest
+        checkpoint whose every byte matches its manifest.
+
+        ``reshard_to``: ``(new_index, new_num)`` redistributes
+        partitioned keys (ZeRO slices) for a changed dp/sharding degree;
+        ``None`` merges partitions into full arrays.
+        """
+        verify_checksums = self.verify_checksums if verify_checksums is None \
+            else verify_checksums
+        candidates = sorted(self.steps(), reverse=True)
+        first = True
+        for step in candidates:
+            ckpt_dir = self.step_dir(step)
+            manifest = manifest_mod.read_manifest(ckpt_dir)
+            if manifest is None:
+                self._log("checkpoint_skip_torn", step=step, dir=ckpt_dir)
+                first = False  # landing below a torn dir IS a fallback
+                continue
+            problems = manifest_mod.verify(ckpt_dir, manifest,
+                                           checksum=verify_checksums)
+            if problems:
+                _obs.checkpoint_restores_counter().inc(result="corrupt")
+                self._log("checkpoint_corrupt", step=step, dir=ckpt_dir,
+                          problems=problems[:8])
+                first = False
+                continue
+            try:
+                # verify() already digested every shard when checksums are
+                # on — skip the per-file sidecar re-hash inside load
+                state, partitioned = load_sharded(
+                    ckpt_dir, manifest,
+                    verify_checksum=not verify_checksums)
+                if partitioned:
+                    if reshard_to is not None:
+                        new_index, new_num = reshard_to
+                        state.update(reshard_partitioned(
+                            partitioned, new_num, new_index))
+                    else:
+                        from .reshard import gather_partitioned
+                        state.update(gather_partitioned(partitioned))
+            except Exception as e:  # noqa: BLE001 — fall back, don't crash
+                # e.g. a peer's GC removed the dir between verify and load,
+                # or a shard tore after its digest: resume must keep
+                # walking to the next candidate, not abort the relaunch
+                _obs.checkpoint_restores_counter().inc(result="corrupt")
+                self._log("checkpoint_load_failed", step=step,
+                          dir=ckpt_dir, error=repr(e)[:300])
+                first = False
+                continue
+            _obs.checkpoint_restores_counter().inc(
+                result="ok" if first else "fallback")
+            self._log("checkpoint_restore", step=step, dir=ckpt_dir,
+                      fallback=not first,
+                      saved_topology=manifest.get("topology"))
+            return state, step
+        return None, -1
+
+    # ----------------------------------------------------------------- gc
+    def gc(self):
+        """Keep-last-N retention that can never delete the resume target:
+        the newest complete checkpoint (and anything newer, which may be
+        a save in flight) always survives; only *older* checkpoints
+        beyond ``keep`` complete ones — and torn dirs older than the
+        newest complete — are removed."""
+        if self.keep <= 0:
+            return []
+        complete = self.complete_steps()
+        if not complete:
+            return []
+        newest_complete = complete[-1]
+        keepers = set(complete[-self.keep:])
+        removed = []
+        for step in self.steps():
+            if step >= newest_complete or step in keepers:
+                continue
+            path = self.step_dir(step)
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(step)
+        if removed:
+            self._log("checkpoint_gc", removed=removed,
+                      kept=sorted(keepers))
+        return removed

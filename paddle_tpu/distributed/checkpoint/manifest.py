@@ -1,0 +1,191 @@
+"""Checkpoint integrity manifests.
+
+A checkpoint directory is *complete* iff it contains ``manifest.json``.
+Every rank writes only the shard files it owns plus a tiny per-rank
+sidecar (``shard_<rank>.meta.json``) listing what it wrote with byte
+sizes and sha256 digests; rank 0 waits for all sidecars, folds them into
+one manifest (adding the (dp, pp, mp, sharding) topology and the step),
+and commits it **last** via write-to-temp + atomic rename.  A worker
+SIGKILLed at any instant therefore leaves either (a) a previous complete
+checkpoint untouched, or (b) a torn directory with no manifest — which
+``load_latest`` skips — never a silently-corrupt resume point.
+
+Manifest schema (version 1)::
+
+    {
+      "version": 1,
+      "step": 1200,
+      "world_size": 8,
+      "topology": {"dp": 2, "pp": 2, "mp": 2, "sharding": 1},
+      "created": 1754200000.0,
+      "files": {
+        "shard_00000.pdparams": {
+          "bytes": 1048576, "sha256": "…", "rank": 0,
+          "keys": ["linear.weight", "moment1.linear.weight"],
+          "partitions": {"moment1.linear.weight": [0, 0, 2]}
+        }, …
+      },
+      "meta": {…}          # free-form user metadata
+    }
+
+``partitions`` records ZeRO-style dim-0 partitioning per key as
+``[axis, index, num]`` so a resume at a *different* dp/sharding degree
+can gather the saved partitions back into the full array and re-split
+for the new topology (see reshard.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+MANIFEST_NAME = "manifest.json"
+SHARD_META_FMT = "shard_{rank:05d}.meta.json"
+SHARD_FMT = "shard_{rank:05d}.pdparams"
+MANIFEST_VERSION = 1
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def normalize_topology(topology) -> dict:
+    """Accept a dict, an HybridCommunicateGroup, or None → canonical dict."""
+    if topology is None:
+        return {"dp": 1, "pp": 1, "mp": 1, "sharding": 1}
+    if isinstance(topology, dict):
+        out = {"dp": 1, "pp": 1, "mp": 1, "sharding": 1}
+        out.update({k: int(v) for k, v in topology.items()})
+        return out
+    # HybridCommunicateGroup-shaped object
+    return {
+        "dp": int(topology.get_data_parallel_world_size()),
+        "pp": int(topology.get_pipe_parallel_world_size()),
+        "mp": int(topology.get_model_parallel_world_size()),
+        "sharding": int(topology.get_sharding_parallel_world_size()),
+    }
+
+
+def default_save_token() -> str:
+    """Deterministic-across-ranks token distinguishing this save *attempt*
+    from a stale one left in a reused (torn) step dir: the elastic launch
+    generation.  A relaunched worker re-saving the same step carries a new
+    PADDLE_RESTART_COUNT, so rank 0 rejects the dead generation's sidecars
+    instead of committing a manifest over mixed-generation shards."""
+    return os.environ.get("PADDLE_RESTART_COUNT", "0")
+
+
+def write_shard_meta(ckpt_dir: str, rank: int, files: dict,
+                     token: str | None = None):
+    """Per-rank sidecar: {relpath: {bytes, sha256, keys, partitions}}.
+    Atomic (tmp + rename) so rank 0 never reads a half-written sidecar."""
+    path = os.path.join(ckpt_dir, SHARD_META_FMT.format(rank=rank))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"rank": rank, "files": files,
+                   "token": default_save_token() if token is None
+                   else str(token)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def collect_shard_metas(ckpt_dir: str, world_size: int,
+                        timeout: float = 120.0, poll: float = 0.02,
+                        token: str | None = None) -> dict:
+    """Rank 0 waits (bounded) for every rank's sidecar FROM THIS SAVE
+    ATTEMPT (matching ``token``), then merges their file tables.  A stale
+    sidecar from a previous generation's torn save does not satisfy the
+    wait.  Local-filesystem rendezvous — no store round-trips."""
+    token = default_save_token() if token is None else str(token)
+    merged = {}
+    deadline = time.monotonic() + timeout
+    for rank in range(world_size):
+        path = os.path.join(ckpt_dir, SHARD_META_FMT.format(rank=rank))
+        while True:
+            try:
+                with open(path) as f:
+                    meta = json.load(f)
+                if meta.get("token", "0") == token:
+                    break
+            except (OSError, ValueError):
+                pass  # absent or mid-rename: keep polling
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"checkpoint shard meta for rank {rank} (token "
+                    f"{token!r}) not written within {timeout}s ({path})")
+            time.sleep(poll)
+        merged.update(meta["files"])
+    return merged
+
+
+def write_manifest(ckpt_dir: str, files: dict, step: int, world_size: int = 1,
+                   topology=None, meta: dict | None = None) -> dict:
+    """Commit the checkpoint: the manifest rename is the commit point."""
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "step": int(step),
+        "world_size": int(world_size),
+        "topology": normalize_topology(topology),
+        "created": time.time(),
+        "files": files,
+        "meta": meta or {},
+    }
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return manifest
+
+
+def read_manifest(ckpt_dir: str) -> dict | None:
+    """The manifest, or None when the directory is torn/incomplete."""
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_complete(ckpt_dir: str) -> bool:
+    return read_manifest(ckpt_dir) is not None
+
+
+def verify(ckpt_dir: str, manifest: dict | None = None,
+           checksum: bool = True) -> list:
+    """Validate every manifest-listed file; returns a list of problem
+    strings (empty == checkpoint verified).  Size check always runs (it is
+    a stat); the sha256 sweep can be skipped with ``checksum=False`` for
+    very large checkpoints where the caller trusts sizes."""
+    manifest = manifest if manifest is not None else read_manifest(ckpt_dir)
+    if manifest is None:
+        return [f"{ckpt_dir}: no manifest (incomplete checkpoint)"]
+    problems = []
+    for rel, ent in manifest.get("files", {}).items():
+        path = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: missing")
+            continue
+        actual = os.path.getsize(path)
+        if actual != ent["bytes"]:
+            problems.append(
+                f"{rel}: size mismatch (expected {ent['bytes']}, "
+                f"actual {actual})")
+            continue
+        if checksum and ent.get("sha256") and \
+                sha256_file(path) != ent["sha256"]:
+            problems.append(f"{rel}: sha256 mismatch")
+    return problems

@@ -1,0 +1,109 @@
+"""Preemption-triggered emergency checkpointing.
+
+TPU pods get preempted routinely; the runtime's only courtesy is a
+SIGTERM a few seconds before the SIGKILL.  The handler installed here
+turns that grace window into a **synchronous emergency save** (joining
+any in-flight async save first) and then exits with a *distinct* exit
+code — ``EMERGENCY_EXIT_CODE`` — that the ``ElasticRelaunchController``
+recognizes as "state is safe, resume without penalty": the relaunch does
+not count against ``max_restarts``, because a preempted worker is not a
+crashing worker.
+
+Contract summary::
+
+    worker:     SIGTERM → emergency_save(state, step) → exit(75)
+    controller: exit code 75 → relaunch, restarts counter unchanged
+    resume:     load_latest() lands on the emergency checkpoint
+
+The handler is test-friendly: ``_exit`` is a module attribute (monkey-
+patchable), and ``PreemptionHandler.triggered`` records the firing.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+# EX_TEMPFAIL from sysexits.h: "temporary failure, retry" — exactly the
+# semantics the elastic controller applies (resume, no restart penalty).
+EMERGENCY_EXIT_CODE = 75
+
+_exit = os._exit  # patchable exit point (signal-safe; no atexit re-entry)
+
+
+class PreemptionHandler:
+    """SIGTERM → emergency save → exit(EMERGENCY_EXIT_CODE)."""
+
+    def __init__(self, manager, state_fn, exit_code=EMERGENCY_EXIT_CODE,
+                 signals=(signal.SIGTERM,)):
+        self.manager = manager
+        self.state_fn = state_fn  # () -> (state, step) or (state, step, partitions)
+        self.exit_code = int(exit_code)
+        self.signals = tuple(signals)
+        self.triggered = False
+        self._installed = False
+        self._previous = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ install
+    def install(self):
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "preemption handler must be installed from the main thread")
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._previous.clear()
+        self._installed = False
+
+    # ------------------------------------------------------------- handle
+    def _handle(self, signum, frame):
+        with self._lock:
+            if self.triggered:   # second SIGTERM mid-save: keep saving
+                return
+            self.triggered = True
+        from ...observability.runlog import get_run_logger
+        logger = get_run_logger()
+        if logger is not None:
+            logger.log("preemption_signal", signum=int(signum))
+        try:
+            result = self.state_fn()
+            state, step = result[0], result[1]
+            partitions = result[2] if len(result) > 2 else None
+            if int(step) < 0:
+                # preempted before the first step completed: there is no
+                # trained state worth persisting — resume starts fresh
+                if logger is not None:
+                    logger.log("preemption_nothing_to_save", step=int(step))
+            else:
+                self.manager.emergency_save(state, step,
+                                            partitions=partitions)
+                if logger is not None:
+                    logger.log("preemption_saved", step=int(step))
+        except BaseException as e:  # noqa: BLE001 — still exit distinctly
+            if logger is not None:
+                logger.log("preemption_save_failed", error=repr(e)[:300])
+        finally:
+            if logger is not None:
+                try:
+                    logger.close()
+                except Exception:
+                    pass
+            _exit(self.exit_code)
+
+
+def install_preemption_handler(manager, state_fn,
+                               exit_code=EMERGENCY_EXIT_CODE,
+                               signals=(signal.SIGTERM,)):
+    """Arm the emergency-save contract; returns the handler (so callers
+    can ``uninstall()`` it, e.g. between tests)."""
+    return PreemptionHandler(manager, state_fn, exit_code=exit_code,
+                             signals=signals).install()

@@ -1,0 +1,68 @@
+"""Elastic resume at a different parallel degree.
+
+A checkpoint saved at (dp=4, sharding=4) holds each optimizer
+accumulator as 4 dim-0 partitions.  When the elastic controller
+relaunches at dp=2 (a host was preempted away), resume must not crash on
+the degree mismatch: the saved partitions are gathered back into the
+full array from the manifest's ``[axis, index, num]`` tags, then
+re-split for the new degree.  The same machinery handles scale-*up*
+(2 → 4) — gather then split is degree-agnostic.
+
+All numpy: resharding happens on host during load, before arrays are
+device_put onto the new mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def merge_partitions(parts) -> np.ndarray:
+    """[(axis, index, num, value), …] (any order) → the full array."""
+    if not parts:
+        raise ValueError("no partitions to merge")
+    axis, _, num, _ = parts[0]
+    seen = {}
+    for a, idx, n, v in parts:
+        if a != axis or n != num:
+            raise ValueError(
+                f"inconsistent partition tags: ({a},{n}) vs ({axis},{num})")
+        seen[int(idx)] = np.asarray(v)
+    missing = sorted(set(range(num)) - set(seen))
+    if missing:
+        raise ValueError(f"missing partition indices {missing} of {num}")
+    return np.concatenate([seen[i] for i in range(num)], axis=axis)
+
+
+def split_partition(full: np.ndarray, axis: int, num: int) -> list:
+    """Full array → ``num`` equal dim-``axis`` partitions."""
+    full = np.asarray(full)
+    if num <= 1:
+        return [full]
+    if full.shape[axis] % num != 0:
+        raise ValueError(
+            f"dim {axis} of {full.shape} not divisible by {num}")
+    return [np.ascontiguousarray(s)
+            for s in np.split(full, num, axis=axis)]
+
+
+def reshard_partitioned(partitioned: dict, new_num: int,
+                        new_index: int | None = None) -> dict:
+    """Redistribute every partitioned key for the new degree.
+
+    ``partitioned``: {key: [(axis, index, num, value), …]} as returned by
+    ``sharded.load_sharded``.  With ``new_index`` given, returns only the
+    slice the calling rank owns ({key: value}); with ``new_index=None``
+    returns every slice ({key: [value_0 … value_{new_num-1}]}).
+    """
+    out = {}
+    for key, parts in partitioned.items():
+        axis = parts[0][0]
+        full = merge_partitions(parts)
+        slices = split_partition(full, axis, new_num)
+        out[key] = slices[new_index] if new_index is not None else slices
+    return out
+
+
+def gather_partitioned(partitioned: dict) -> dict:
+    """{key: parts} → {key: full array} (degree-1 resume / inspection)."""
+    return {k: merge_partitions(p) for k, p in partitioned.items()}

@@ -1,0 +1,134 @@
+"""Topology-aware sharded checkpoint save/load.
+
+Each rank persists only the state it *owns*:
+
+- replicated keys (plain params under pure dp) are deduplicated by a
+  deterministic owner function — round-robin over the sorted key list by
+  default, so the write load spreads evenly across ranks and no two
+  ranks write the same bytes;
+- partitioned keys (ZeRO optimizer slices, pp-stage params, mp slices)
+  are written by **every** rank holding a partition, tagged in the
+  manifest with ``[axis, index, num]`` so load can reassemble them — at
+  the same degree (each rank reads back its own slice) or a different
+  one (reshard.py gathers + re-splits).
+
+Shard files go through ``framework.io.save`` (atomic rename + sha256
+sidecar), so a kill mid-shard-write can never produce a file the
+manifest acknowledges: the manifest is written only after every rank's
+sidecar reports its finished files.
+"""
+from __future__ import annotations
+
+import os
+
+from ...framework import io as io_mod
+from . import manifest as manifest_mod
+
+
+def default_owner(key: str, rank_count: int, position: int) -> int:
+    """Round-robin owner over the sorted key order."""
+    return position % rank_count
+
+
+def plan_shards(keys, world_size: int, rank: int, owner_fn=None):
+    """The subset of (replicated) ``keys`` this rank writes."""
+    owner_fn = owner_fn or default_owner
+    ordered = sorted(keys)
+    return [k for i, k in enumerate(ordered)
+            if owner_fn(k, world_size, i) == rank]
+
+
+def save_sharded(state: dict, ckpt_dir: str, step: int, rank: int = 0,
+                 world_size: int = 1, topology=None, partitions=None,
+                 owner_fn=None, meta=None, manifest_timeout: float = 120.0,
+                 save_token=None):
+    """Write this rank's shard; rank 0 additionally commits the manifest.
+
+    ``state``: flat dict (key → Tensor / ndarray / picklable).
+    ``partitions``: {key: (axis, index, num)} for keys whose value is a
+    partition of a larger array (this rank's ZeRO slice); such keys are
+    written by every rank that passes them, all others are deduplicated
+    through ``owner_fn``.  ``save_token`` stamps this save attempt's
+    sidecars (defaults to the elastic launch generation) so a re-save
+    into a torn dir can never rendezvous with a dead attempt's leftovers.
+
+    Returns the manifest dict on rank 0, None elsewhere.
+    """
+    partitions = dict(partitions or {})
+    os.makedirs(ckpt_dir, exist_ok=True)
+    # a torn previous attempt may have left OUR sidecar behind; drop it
+    # before the shard write so rank 0 can only ever see fresh metadata
+    stale = os.path.join(ckpt_dir,
+                         manifest_mod.SHARD_META_FMT.format(rank=rank))
+    if os.path.exists(stale):
+        os.unlink(stale)
+    replicated = [k for k in state if k not in partitions]
+    own_keys = set(plan_shards(replicated, world_size, rank, owner_fn))
+    own_keys.update(k for k in partitions if k in state)
+    shard = {k: state[k] for k in state if k in own_keys}
+
+    rel = manifest_mod.SHARD_FMT.format(rank=rank)
+    shard_path = os.path.join(ckpt_dir, rel)
+    files = {}
+    if shard:
+        io_mod.save(shard, shard_path)
+        # io.save already streamed a sha256 into the sidecar — reuse it
+        # instead of re-reading and re-hashing a possibly-multi-GB shard
+        sidecar = io_mod._read_sidecar(shard_path)
+        entry = {
+            "bytes": os.path.getsize(shard_path),
+            "sha256": sidecar[0] if sidecar
+            else manifest_mod.sha256_file(shard_path),
+            "rank": rank,
+            "keys": sorted(shard),
+        }
+        parts = {k: list(map(int, partitions[k])) for k in shard
+                 if k in partitions}
+        if parts:
+            entry["partitions"] = parts
+        files[rel] = entry
+    manifest_mod.write_shard_meta(ckpt_dir, rank, files, token=save_token)
+
+    if rank != 0:
+        return None
+    merged = manifest_mod.collect_shard_metas(
+        ckpt_dir, world_size, timeout=manifest_timeout, token=save_token)
+    return manifest_mod.write_manifest(
+        ckpt_dir, merged, step=step, world_size=world_size,
+        topology=topology, meta=meta)
+
+
+def load_sharded(ckpt_dir: str, manifest: dict | None = None,
+                 return_numpy: bool = True, verify_checksum: bool = True):
+    """Read every manifest-listed shard back into one flat state.
+
+    ``verify_checksum=False`` skips the per-file ``.sha256`` sidecar
+    re-hash — pass it when ``manifest.verify`` already digested every
+    shard (resume otherwise reads each multi-GB file twice).
+
+    Returns ``(state, partitioned)``:
+
+    - ``state``   — {key: value} for replicated keys;
+    - ``partitioned`` — {key: [(axis, index, num, value), …]} for keys
+      the manifest records as partition slices (order unspecified;
+      reshard.py merges/redistributes them).
+    """
+    manifest = manifest if manifest is not None \
+        else manifest_mod.read_manifest(ckpt_dir)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{ckpt_dir}: no manifest — incomplete checkpoint")
+    state, partitioned = {}, {}
+    for rel, ent in manifest.get("files", {}).items():
+        shard = io_mod.load(os.path.join(ckpt_dir, rel),
+                            return_numpy=return_numpy,
+                            verify_checksum=verify_checksum)
+        parts = ent.get("partitions", {})
+        for k, v in shard.items():
+            if k in parts:
+                axis, index, num = parts[k]
+                partitioned.setdefault(k, []).append(
+                    (int(axis), int(index), int(num), v))
+            else:
+                state[k] = v
+    return state, partitioned

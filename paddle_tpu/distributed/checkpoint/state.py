@@ -1,0 +1,83 @@
+"""Structural training-state packing.
+
+The optimizer's own ``state_dict`` keys accumulators by *parameter name*
+(``linear_3.w_0_velocity_0``).  Auto-generated names carry a process-wide
+unique-name counter, so they are NOT stable across rebuilds: a resumed
+process that constructs one extra layer first — or an in-process
+rebuild — silently restores **zero** accumulators (every key misses) and
+the optimizer trajectory diverges from the checkpoint with no error.
+
+``pack_training_state`` therefore keys accumulators **structurally**, by
+the model's ``state_dict`` key for the owning parameter
+(``optacc/velocity/weight``), which depends only on module structure.
+``unpack_training_state`` translates back to whatever names the *current*
+optimizer instance uses before feeding its ``set_state_dict``.
+
+Flat-namespace layout (shards cleanly through sharded.py)::
+
+    model/<structured key>     parameter / buffer value
+    optacc/<acc>/<structured>  optimizer accumulator for that parameter
+    opt/global_step            scalar optimizer state
+    opt/LR_Scheduler           LR scheduler state dict
+"""
+from __future__ import annotations
+
+
+def _param_struct_keys(model) -> dict:
+    """param/buffer id → structured state_dict key."""
+    return {id(v): k for k, v in model.state_dict().items()}
+
+
+def pack_training_state(model, optimizer=None, extra=None) -> dict:
+    """Model + optimizer state as one flat, structurally-keyed dict."""
+    state = {}
+    for k, v in model.state_dict().items():
+        state[f"model/{k}"] = v
+    if optimizer is not None:
+        struct = _param_struct_keys(model)
+        for acc_name, by_pid in optimizer._accumulators.items():
+            for pid, t in by_pid.items():
+                sk = struct.get(pid)
+                if sk is not None:
+                    state[f"optacc/{acc_name}/{sk}"] = t
+        state["opt/global_step"] = int(optimizer._global_step)
+        from ...optimizer.lr import LRScheduler
+        if isinstance(optimizer._learning_rate, LRScheduler):
+            state["opt/LR_Scheduler"] = \
+                optimizer._learning_rate.state_dict()
+    if extra:
+        state.update(extra)
+    return state
+
+
+def unpack_training_state(state: dict, model, optimizer=None) -> dict:
+    """Apply a packed state (values may be numpy — the verified-resume
+    path loads host arrays).  Returns the keys it did not consume (the
+    caller's ``extra`` namespace, e.g. ``train/step_count``)."""
+    model_state = {k[len("model/"):]: v for k, v in state.items()
+                   if k.startswith("model/")}
+    if model_state:
+        model.set_state_dict(model_state)
+    leftover = {k: v for k, v in state.items()
+                if not k.startswith(("model/", "optacc/", "opt/"))}
+    if optimizer is None:
+        return leftover
+    # translate structural accumulator keys to the CURRENT instance's
+    # naming, then reuse the optimizer's own pending-restore machinery
+    # (fills live accumulators now, lazily-created ones on first _acc)
+    by_struct = {k: v for k, v in model.state_dict().items()}
+    translated = {}
+    for k, v in state.items():
+        if not k.startswith("optacc/"):
+            continue
+        _, acc_name, sk = k.split("/", 2)
+        p = by_struct.get(sk)
+        if p is not None:
+            translated[optimizer._state_key(acc_name, p)] = v
+    if "opt/global_step" in state:
+        translated["global_step"] = state["opt/global_step"]
+    if "opt/LR_Scheduler" in state:
+        translated["LR_Scheduler"] = state["opt/LR_Scheduler"]
+    if translated:
+        optimizer.set_state_dict(translated)
+    return leftover

@@ -2,12 +2,17 @@
 
 Drives real failures against a live ``PodLauncher`` pod: SIGKILL (crash),
 SIGSTOP (wedge — process alive but not making progress, the case only lease
-expiry can detect), delayed kills from a timer thread.  Test-harness
+expiry can detect), SIGTERM (the preemption model: grace window then gone),
+delayed kills from a timer thread, and **kill-during-checkpoint-save** —
+a filesystem-triggered kill that fires the instant a checkpoint shard
+starts appearing on disk, the scenario that validates the manifest commit
+protocol (a torn save must never be observed by resume).  Test-harness
 machinery, but shipped in-package so operators can stage game-day drills
 against a staging pod the same way the tests do.
 """
 from __future__ import annotations
 
+import glob as glob_mod
 import os
 import signal
 import threading
@@ -45,6 +50,37 @@ class FaultInjector:
         its heartbeat freezes — exercises lease-expiry detection."""
         return self._send(local_rank, signal.SIGSTOP)
 
+    def preempt(self, local_rank):
+        """SIGTERM one worker — the preemption notice. A worker with the
+        checkpoint preemption handler installed emergency-saves and exits
+        ``EMERGENCY_EXIT_CODE``; the controller resumes without penalty."""
+        return self._send(local_rank, signal.SIGTERM)
+
+    def kill_when_file(self, pattern, local_rank, sig=signal.SIGKILL,
+                       timeout=30.0, poll=0.002):
+        """Arm a watcher thread that kills ``local_rank`` the moment a path
+        matching glob ``pattern`` exists — e.g. a checkpoint shard (or its
+        ``*.tmp.*`` precursor) inside a ``step_*`` dir, so the SIGKILL
+        lands **mid-checkpoint-save**.  Returns the watcher thread; join it
+        to know the kill fired (``thread.fired`` records success)."""
+        def watch():
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if glob_mod.glob(pattern):
+                    try:
+                        self._send(local_rank, sig)
+                        t.fired = True
+                    except (RuntimeError, ProcessLookupError):
+                        pass
+                    return
+                time.sleep(poll)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.fired = False
+        t.start()
+        self._timers.append(t)
+        return t
+
     def resume(self, local_rank):
         return self._send(local_rank, signal.SIGCONT)
 
@@ -64,7 +100,8 @@ class FaultInjector:
 
     def cancel(self):
         for t in self._timers:
-            t.cancel()
+            if hasattr(t, "cancel"):  # Timer; watcher threads just expire
+                t.cancel()
         self._timers.clear()
 
     def last_injection_time(self):

@@ -100,6 +100,73 @@ class ParallelTrainStep:
         # the report lands in self.last_validation + runlog events
         self.validate = bool(validate)
         self.last_validation = None
+        # opt-in resilient checkpointing (distributed/checkpoint): when a
+        # manager is attached, every interval-th step snapshots train state
+        # to host and persists it asynchronously
+        self._ckpt_manager = None
+
+    # ------------------------------------------------------- checkpointing
+    def sync_optimizer_state(self):
+        """Copy the jit-carried accumulator values back into the
+        optimizer's accumulator tensors.  After ``_build`` the compiled
+        step owns the live state in ``_state_vals``; the optimizer-side
+        tensors go stale until this sync, so every state_dict for
+        checkpointing must run it first."""
+        if self._compiled is None or self._state_vals is None:
+            return
+        for (name, pid), v in zip(self.optimizer._jit_state_keys,
+                                  self._state_vals):
+            acc = self.optimizer._accumulators.get(name, {}).get(pid)
+            if acc is not None and v is not None:
+                acc._value = v
+
+    def train_state_dict(self):
+        """Flat checkpointable state: model params/buffers, synced
+        optimizer accumulators (keyed STRUCTURALLY — stable across
+        process restarts and rebuilt models, unlike auto-generated param
+        names), step count, loss scale — the complete resume point."""
+        from ..checkpoint.state import pack_training_state
+        self.sync_optimizer_state()
+        extra = {"train/step_count": int(self._step_count)}
+        if self.scaler is not None:
+            extra["train/loss_scale"] = float(self.scaler._scale)
+        return pack_training_state(self.model, self.optimizer, extra=extra)
+
+    def set_train_state(self, state):
+        """Restore a ``train_state_dict`` snapshot (values may be numpy —
+        the verified-resume path loads host arrays).  Drops the compiled
+        step so the next call re-places restored state onto the mesh with
+        its shardings."""
+        from ..checkpoint.state import unpack_training_state
+        leftover = unpack_training_state(state, self.model, self.optimizer)
+        self._step_count = int(leftover.get("train/step_count", 0))
+        if self.scaler is not None and "train/loss_scale" in leftover:
+            self.scaler._scale = float(leftover["train/loss_scale"])
+        self._compiled = None   # rebuild: restored arrays need re-placing
+        self._state_vals = None
+
+    def attach_checkpoint_manager(self, manager):
+        """Arm interval-gated async checkpointing: each call whose step
+        count hits the manager's interval snapshots ``train_state_dict``
+        (host copy, synchronous) and persists it on the background
+        writer while training continues."""
+        self._ckpt_manager = manager
+        return manager
+
+    def resume_from_checkpoint(self, manager=None, reshard_to=None):
+        """Verified resume: load the newest complete checkpoint (falling
+        back past torn/corrupt ones) into this step.  Returns the restored
+        step count, or -1 when no checkpoint verified."""
+        manager = manager or self._ckpt_manager
+        if manager is None:
+            raise RuntimeError(
+                "no CheckpointManager: pass one or call "
+                "attach_checkpoint_manager first")
+        state, step = manager.load_latest(reshard_to=reshard_to)
+        if state is None:
+            return -1
+        self.set_train_state(state)
+        return self._step_count
 
     # ------------------------------------------------------------------
     def _pure_step(self, param_vals, state_vals, buffer_vals, key, lr, scale,
@@ -271,6 +338,12 @@ class ParallelTrainStep:
             if self.last_found_inf:
                 _obs.found_inf_counter().inc()
                 _obs.skip_counter().inc()
+        # checkpoint AFTER the scaler update: the persisted loss scale must
+        # be the post-step value, or an AMP resume replays the overflow
+        # bookkeeping and diverges from the uninterrupted trajectory
+        if self._ckpt_manager is not None:
+            self._ckpt_manager.maybe_save(self.train_state_dict,
+                                          self._step_count)
         # steady-state host wall time tracks device step time (dispatch is
         # async, but donation throttles the host to one step in flight);
         # the first call is compile-dominated and belongs to the compile

@@ -323,10 +323,21 @@ class ElasticRelaunchController:
 
     def __init__(self, launcher, manager, max_restarts=3, backoff_base=0.5,
                  backoff_cap=8.0, poll_interval=0.2, watch_interval=0.25,
-                 register_pod=False, worker_job_id=None):
+                 register_pod=False, worker_job_id=None,
+                 preemption_exit_codes=None, max_preemption_resumes=64):
         self.launcher = launcher
         self.manager = manager
         self.max_restarts = int(max_restarts)
+        # the emergency-save contract (distributed/checkpoint/preemption.py):
+        # a worker that caught SIGTERM, checkpointed synchronously, and
+        # exited with this code is RESUMED WITHOUT PENALTY — its state is
+        # safe on disk, so the relaunch does not count against max_restarts
+        if preemption_exit_codes is None:
+            from ..checkpoint.preemption import EMERGENCY_EXIT_CODE
+            preemption_exit_codes = {EMERGENCY_EXIT_CODE}
+        self.preemption_exit_codes = set(preemption_exit_codes)
+        self.max_preemption_resumes = int(max_preemption_resumes)
+        self.preemption_resumes = 0
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
         self.poll_interval = poll_interval
@@ -401,16 +412,32 @@ class ElasticRelaunchController:
             self.manager.store.delete_key(
                 f"{self.worker_done_prefix}{host}")
 
-    def _relaunch(self):
+    def _is_preemption(self, st):
+        """True when the observed failure is the emergency-save exit code:
+        every nonzero exit of the generation must be benign (0/None), the
+        preemption code itself, or the SIGTERM our own teardown sends."""
+        if st not in self.preemption_exit_codes:
+            return False
+        benign = {0, None, -signal.SIGTERM} | self.preemption_exit_codes
+        return all(c in benign for c in self.launcher.exit_codes)
+
+    def _relaunch(self, penalty=True):
         self._relaunching = True
         try:
-            self.restarts += 1
-            _obs.restarts_counter().inc()
+            if penalty:
+                self.restarts += 1
+                _obs.restarts_counter().inc()
+                backoff = min(self.backoff_cap,
+                              self.backoff_base * (2 ** (self.restarts - 1)))
+            else:
+                # preemption resume: state is checkpointed, nothing is
+                # crash-looping — respawn after the minimal backoff
+                self.preemption_resumes += 1
+                _obs.preemption_resumes_counter().inc()
+                backoff = self.backoff_base
             self._record("stop", f"restart {self.restarts}")
             self.launcher.stop()
             self._clear_worker_state()
-            backoff = min(self.backoff_cap,
-                          self.backoff_base * (2 ** (self.restarts - 1)))
             time.sleep(backoff)
             self.launcher.launch()
             self._record("relaunch", f"generation {self.launcher.generation}")
@@ -437,6 +464,21 @@ class ElasticRelaunchController:
                     completed = True
                     return 0
                 fault = st is not None or self._fault.is_set()
+                if fault and st is not None and self._is_preemption(st):
+                    # emergency-save contract: the worker checkpointed and
+                    # exited EMERGENCY_EXIT_CODE on SIGTERM — resume without
+                    # burning a restart. Bounded separately so an external
+                    # SIGTERM loop still terminates.
+                    if self.preemption_resumes >= self.max_preemption_resumes:
+                        self._record("abort",
+                                     f"preemption resumes exhausted "
+                                     f"({self.preemption_resumes})")
+                        self.launcher.stop()
+                        return st
+                    self._record("preemption_resume", f"exit={st}")
+                    self._relaunch(penalty=False)
+                    time.sleep(self.poll_interval)
+                    continue
                 if fault:
                     detail = f"exit={st}" if st is not None else "lease"
                     self._record("fault", detail)

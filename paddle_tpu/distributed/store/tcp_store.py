@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import struct
 import subprocess
 import threading
+import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "_tcp_store.so")
@@ -86,6 +88,26 @@ class Store:
 _CMD_SET, _CMD_GET, _CMD_ADD, _CMD_DEL, _CMD_PING, _CMD_GET_NOWAIT, \
     _CMD_LIST = 1, 2, 3, 4, 5, 6, 7
 
+_CMD_NAMES = {_CMD_SET: "set", _CMD_GET: "get", _CMD_ADD: "add",
+              _CMD_DEL: "delete", _CMD_PING: "ping",
+              _CMD_GET_NOWAIT: "get_nowait", _CMD_LIST: "list"}
+
+# client-side transport failures (tcp_store.cpp): -100 connect/send failed,
+# -101/-102 short read (peer reset mid-response). These are the transient
+# errors an elastic relaunch races produce — a controller restarting its
+# store, a worker connecting during endpoint re-exchange — and the ones
+# bounded retry with backoff+jitter absorbs. Server-side statuses (timeout
+# -2 included) are semantic results, never retried.
+_TRANSIENT_STATUS = (-100, -101, -102)
+
+
+def _count_store_retry(op: str):
+    try:
+        from ...observability import instrument as _obs
+        _obs.store_retries_counter().inc(op=op)
+    except Exception:
+        pass  # metrics must never take down store traffic
+
 
 class TCPStore(Store):
     """TCPStore(host, port, is_master, world_size, timeout).
@@ -144,7 +166,7 @@ class TCPStore(Store):
             return status, out.raw[:out_len.value]
         return status, out.raw[:min(out_len.value, cap)]
 
-    def _request(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
+    def _request_once(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
         if cmd == _CMD_GET:
             # blocking GET gets its own short-lived connection so it never
             # holds the shared one (a concurrent set() through this object
@@ -159,6 +181,51 @@ class TCPStore(Store):
                 self._lib.tcp_store_close(fd)
         with self._lock:  # one in-flight request per shared connection
             return self._raw_request(self._fd, cmd, key, val, cap)
+
+    def _reconnect(self):
+        """Replace the shared connection (the old one is poisoned after a
+        reset); best-effort — a failed reconnect surfaces as another
+        transient status on the next attempt."""
+        with self._lock:
+            if self._fd >= 0:
+                try:
+                    self._lib.tcp_store_close(self._fd)
+                except Exception:
+                    pass
+            self._fd = self._lib.tcp_store_connect(
+                self.host.encode(), self.port, int(self.timeout * 1000))
+
+    def _request(self, cmd, key: str, val: bytes = b"", cap=1 << 20):
+        """One store op with bounded retry on transient transport errors.
+
+        Elastic relaunch races (controller restarting, peers reconnecting
+        mid-generation) produce ``ECONNREFUSED``/``ECONNRESET``-class
+        failures that surface here as ``_TRANSIENT_STATUS``; each retry
+        backs off exponentially with jitter (so N relaunched workers don't
+        re-stampede the store in lockstep) and is tallied in
+        ``paddle_store_retries_total``.  ``PADDLE_STORE_RETRIES`` bounds
+        the attempts (default 4; 0 disables).
+        """
+        retries = int(os.environ.get("PADDLE_STORE_RETRIES", 4))
+        base = float(os.environ.get("PADDLE_STORE_RETRY_BASE", 0.05))
+        # ADD is not idempotent: -101/-102 (short read) mean the server may
+        # ALREADY have applied the increment before the reply was cut off —
+        # resending would double-count a barrier/rendezvous counter. Only
+        # -100 (connect/send failed: request never reached the server) is
+        # provably safe to retry for ADD.
+        retryable = (-100,) if cmd == _CMD_ADD else _TRANSIENT_STATUS
+        attempt = 0
+        while True:
+            status, out = self._request_once(cmd, key, val, cap)
+            if status not in retryable or attempt >= retries:
+                return status, out
+            attempt += 1
+            _count_store_retry(_CMD_NAMES.get(cmd, str(cmd)))
+            # full jitter: uniform in (0, backoff] — decorrelates stampedes
+            backoff = min(2.0, base * (2 ** (attempt - 1)))
+            time.sleep(random.uniform(backoff * 0.1, backoff))
+            if cmd != _CMD_GET:  # blocking GET dials fresh per attempt
+                self._reconnect()
 
     def set(self, key, value):
         if isinstance(value, str):

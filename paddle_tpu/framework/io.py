@@ -3,9 +3,18 @@
 Parity: ``/root/reference/python/paddle/framework/io.py:639 save / :881 load`` —
 pickled nested state structures. Tensors serialize as numpy arrays + dtype tag so
 checkpoints are host-portable; bfloat16 round-trips via ml_dtypes.
+
+Integrity: ``save`` writes atomically (write-to-temp + rename) and, by
+default, drops a ``<path>.sha256`` sidecar recording the digest and byte
+size of what it wrote (``PADDLE_CHECKPOINT_CHECKSUM=0`` disables).
+``load`` honors the sidecar when present and raises
+:class:`CheckpointCorruptError` — naming the path and the expected vs
+actual size — on truncated, checksum-mismatched, or unpicklable files
+instead of a bare ``UnpicklingError`` deep in pickle internals.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 
@@ -13,6 +22,44 @@ import numpy as np
 
 from .tensor import Tensor, Parameter
 from ..optimizer.lr import LRScheduler
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity validation at load."""
+
+    def __init__(self, path, reason, expected_bytes=None, actual_bytes=None):
+        self.path = path
+        self.reason = reason
+        self.expected_bytes = expected_bytes
+        self.actual_bytes = actual_bytes
+        size = ""
+        if expected_bytes is not None or actual_bytes is not None:
+            size = (f" (expected {expected_bytes} bytes, "
+                    f"actual {actual_bytes} bytes)")
+        super().__init__(f"corrupt checkpoint {path!r}: {reason}{size}")
+
+
+def _sidecar_path(path):
+    return f"{path}.sha256"
+
+
+def _write_sidecar(path, digest, nbytes):
+    """``<hexdigest> <nbytes>\\n`` — atomic, so the sidecar can never
+    describe a payload it didn't see written."""
+    tmp = f"{_sidecar_path(path)}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{digest} {nbytes}\n")
+    os.replace(tmp, _sidecar_path(path))
+
+
+def _read_sidecar(path):
+    """(digest, nbytes) or None when absent/unparseable."""
+    try:
+        with open(_sidecar_path(path)) as f:
+            parts = f.read().split()
+        return parts[0], int(parts[1])
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 class _TensorPayload:
@@ -57,26 +104,75 @@ def _unpack(obj, return_numpy=False):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+class _HashingWriter:
+    """File-like wrapper digesting exactly the bytes pickle streams out,
+    so the sidecar never needs the whole payload in memory (multi-GB
+    checkpoints would otherwise double their peak host footprint)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha256 = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, b):
+        self.sha256.update(b)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+
+def save(obj, path, protocol=4, checksum=None, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    if checksum is None:
+        checksum = os.environ.get("PADDLE_CHECKPOINT_CHECKSUM", "1") != "0"
     # write-then-rename so a checkpoint is never half-written: a worker
     # SIGKILLed (preemption, elastic relaunch) mid-save must leave the
     # previous checkpoint intact for resume, not a truncated pickle
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            pickle.dump(_pack(obj), f, protocol=protocol)
+            w = _HashingWriter(f)
+            pickle.dump(_pack(obj), w, protocol=protocol)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        # sidecar strictly AFTER the payload rename: a kill in between
+        # leaves a stale sidecar describing the PREVIOUS payload, which can
+        # only fail verification of the file just (re)written — never of an
+        # older, still-good checkpoint a resume would fall back to
+        if checksum:
+            _write_sidecar(path, w.sha256.hexdigest(), w.nbytes)
+        elif os.path.exists(_sidecar_path(path)):
+            os.unlink(_sidecar_path(path))  # don't let a stale one linger
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
-def load(path, return_numpy=False, **configs):
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+def load(path, return_numpy=False, verify_checksum=True, **configs):
+    sidecar = _read_sidecar(path) if verify_checksum else None
+    actual = os.path.getsize(path)  # missing file raises FileNotFoundError
+    if sidecar is not None:
+        digest, nbytes = sidecar
+        if actual != nbytes:
+            raise CheckpointCorruptError(
+                path, "truncated (size differs from .sha256 sidecar)",
+                expected_bytes=nbytes, actual_bytes=actual)
+        h = hashlib.sha256()
+        with open(path, "rb") as f:  # streamed: no whole-payload buffer
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != digest:
+            raise CheckpointCorruptError(
+                path, "sha256 mismatch vs sidecar",
+                expected_bytes=nbytes, actual_bytes=actual)
+    try:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    except Exception as e:  # UnpicklingError, EOFError, ValueError, …
+        raise CheckpointCorruptError(
+            path, f"unpicklable ({type(e).__name__}: {e})",
+            expected_bytes=sidecar[1] if sidecar else None,
+            actual_bytes=actual) from e
     return _unpack(obj, return_numpy=return_numpy)

@@ -5,5 +5,5 @@ from .dynamic_flops import flops  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, CallbackList, ProgBarLogger, ModelCheckpoint, LRScheduler,
-    EarlyStopping,
+    EarlyStopping, ResilientCheckpoint,
 )

@@ -6,14 +6,25 @@ periodic, directory-backed checkpointing keyed by a run id, with epoch-range
 tracking so a restarted job resumes at the crashed epoch. The reference's
 HDFS client becomes the local filesystem (point PADDLE_CHECKPOINT_DIR at a
 mounted share for the multi-node case).
+
+Rebased onto ``distributed/checkpoint`` core: every epoch directory now
+commits through an integrity manifest (per-file sizes + sha256, atomic
+rename written last), and ``restore`` checksum-verifies before trusting
+a directory — falling back to the newest epoch that passes instead of
+crashing on a torn one (a SIGKILL mid-save leaves no manifest; a
+bit-flipped file fails its digest).
 """
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
 
+from ...distributed.checkpoint import manifest as _manifest
+
 _manager = None
+_EPOCH_DIR_RE = re.compile(r"^ckpt_(\d+)$")
 
 
 class _ACPManager:
@@ -47,39 +58,86 @@ class _ACPManager:
     def save_checkpoint(self, epoch):
         from ...framework import io as io_mod
         import shutil
-        # versioned checkpoint dir committed atomically by meta: a crash at
-        # ANY point leaves the previous epoch's directory fully intact
+        # versioned checkpoint dir committed atomically by its manifest: a
+        # crash at ANY point leaves the previous epoch's dir fully intact
         ckpt_dir = os.path.join(self._run_dir(), f"ckpt_{epoch}")
         os.makedirs(ckpt_dir, exist_ok=True)
+        files = {}
         for name, obj in self._objs.items():
-            io_mod.save(obj.state_dict(),
-                        os.path.join(ckpt_dir, f"{name}.pdparams"))
+            rel = f"{name}.pdparams"
+            path = os.path.join(ckpt_dir, rel)
+            io_mod.save(obj.state_dict(), path)
+            files[rel] = {"bytes": os.path.getsize(path),
+                          "sha256": _manifest.sha256_file(path), "rank": 0,
+                          "keys": [name]}
+        _manifest.write_manifest(ckpt_dir, files, step=epoch,
+                                 meta={"run_id": self.run_id})
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "dir": f"ckpt_{epoch}",
                        "time": time.time()}, f)
-        os.replace(tmp, self._meta_path())  # atomic: meta commits the ckpt
+        os.replace(tmp, self._meta_path())  # fast-path pointer, advisory
         # prune superseded checkpoint dirs (keep the committed one)
         for d in os.listdir(self._run_dir()):
             if d.startswith("ckpt_") and d != f"ckpt_{epoch}":
                 shutil.rmtree(os.path.join(self._run_dir(), d),
                               ignore_errors=True)
 
-    def restore(self):
+    def _candidate_dirs(self):
+        """ckpt_<epoch> dirs, newest epoch first; the meta.json pointer
+        (when readable) only prioritizes its target."""
+        run_dir = self._run_dir()
+        epochs = []
+        try:
+            for d in os.listdir(run_dir):
+                m = _EPOCH_DIR_RE.match(d)
+                if m and os.path.isdir(os.path.join(run_dir, d)):
+                    epochs.append(int(m.group(1)))
+        except OSError:
+            return []
+        return sorted(epochs, reverse=True)
+
+    def _restore_dir(self, ckpt_dir):
         from ...framework import io as io_mod
-        if not os.path.exists(self._meta_path()):
-            return -1
-        with open(self._meta_path()) as f:
-            meta = json.load(f)
-        epoch = meta.get("epoch", -1)
-        ckpt_dir = os.path.join(self._run_dir(), meta.get("dir", ""))
-        if epoch < 0 or not os.path.isdir(ckpt_dir):
-            return -1
         for name, obj in self._objs.items():
             path = os.path.join(ckpt_dir, f"{name}.pdparams")
             if os.path.exists(path):
                 obj.set_state_dict(io_mod.load(path))
-        return epoch
+
+    def restore(self):
+        """Restore from the newest *verified* epoch checkpoint.
+
+        The commit point is the manifest: a dir without one (kill
+        mid-save) or one whose files fail size/sha256 validation is
+        skipped, and restore falls back to the next-newest epoch that
+        passes.  Checkpoints written by the pre-manifest release (meta.json
+        was the commit point, no manifest.json anywhere) remain loadable:
+        when NO manifest-committed dir exists at all, the legacy meta.json
+        pointer is honored as before.
+        """
+        candidates = self._candidate_dirs()
+        for epoch in candidates:
+            ckpt_dir = os.path.join(self._run_dir(), f"ckpt_{epoch}")
+            manifest = _manifest.read_manifest(ckpt_dir)
+            if manifest is None or _manifest.verify(ckpt_dir, manifest):
+                continue  # torn or corrupt: try an older epoch
+            self._restore_dir(ckpt_dir)
+            return epoch
+        # legacy run (no manifest anywhere): meta.json is the commit record
+        if not any(_manifest.is_complete(
+                os.path.join(self._run_dir(), f"ckpt_{e}"))
+                for e in candidates) and os.path.exists(self._meta_path()):
+            try:
+                with open(self._meta_path()) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                return -1
+            epoch = meta.get("epoch", -1)
+            ckpt_dir = os.path.join(self._run_dir(), meta.get("dir", ""))
+            if epoch >= 0 and os.path.isdir(ckpt_dir):
+                self._restore_dir(ckpt_dir)
+                return epoch
+        return -1
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, run_id=None,

@@ -21,9 +21,17 @@ paddle_collective_bytes_total         counter    op, group, dtype
 paddle_device_memory_bytes            gauge      —
 paddle_device_peak_memory_bytes       gauge      —
 paddle_elastic_restarts_total         counter    —
+paddle_elastic_preemption_resumes_total counter  —
 paddle_elastic_generation             gauge      —
 paddle_elastic_lease_age_seconds      gauge      host
 paddle_worker_exit_total              counter    code
+paddle_checkpoint_saves_total         counter    mode={async,sync,emergency},
+                                                 result={ok,error}
+paddle_checkpoint_save_seconds        histogram  mode
+paddle_checkpoint_bytes_total         counter    mode
+paddle_checkpoint_in_flight           gauge      —
+paddle_checkpoint_restores_total      counter    result={ok,fallback,corrupt}
+paddle_store_retries_total            counter    op
 ====================================  =========  =============================
 
 Everything here must stay off the device critical path: increments are a
@@ -117,6 +125,49 @@ def worker_exit_counter():
         "paddle_worker_exit_total", "worker exits by code")
 
 
+def preemption_resumes_counter():
+    return get_registry().counter(
+        "paddle_elastic_preemption_resumes_total",
+        "relaunches after a preemption emergency save (exempt from "
+        "max_restarts)")
+
+
+def checkpoint_saves_counter():
+    return get_registry().counter(
+        "paddle_checkpoint_saves_total", "checkpoint save attempts")
+
+
+def checkpoint_save_seconds():
+    return get_registry().histogram(
+        "paddle_checkpoint_save_seconds",
+        "wall-clock seconds persisting one checkpoint",
+        buckets=STEP_BUCKETS)
+
+
+def checkpoint_bytes_counter():
+    return get_registry().counter(
+        "paddle_checkpoint_bytes_total",
+        "bytes of checkpoint state persisted")
+
+
+def checkpoint_in_flight():
+    return get_registry().gauge(
+        "paddle_checkpoint_in_flight",
+        "1 while an async checkpoint write is in progress")
+
+
+def checkpoint_restores_counter():
+    return get_registry().counter(
+        "paddle_checkpoint_restores_total",
+        "checkpoint restore attempts by outcome")
+
+
+def store_retries_counter():
+    return get_registry().counter(
+        "paddle_store_retries_total",
+        "TCPStore client ops retried on transient socket errors")
+
+
 # ---------------------------------------------------------------- recorders
 
 _FLUSH_INTERVAL_S = 5.0
@@ -146,6 +197,22 @@ def record_train_step(seconds: float, tokens: int | None = None,
         if now - _last_flush > _FLUSH_INTERVAL_S:
             _last_flush = now
             logger.flush_metrics()
+
+
+def record_checkpoint_save(seconds: float, nbytes: int, mode: str = "async"):
+    """Per-save accounting (duration histogram + bytes); also snapshots
+    the registry into the rank's runlog so a preempted worker leaves the
+    save telemetry behind."""
+    checkpoint_save_seconds().observe(seconds, mode=mode)
+    if nbytes:
+        checkpoint_bytes_counter().inc(float(nbytes), mode=mode)
+    from .runlog import get_run_logger
+    logger = get_run_logger()
+    if logger is not None:
+        try:
+            logger.flush_metrics()
+        except Exception:
+            pass
 
 
 def record_compile(seconds: float, what: str):

@@ -72,12 +72,17 @@ class Optimizer:
         if key not in d:
             pending = getattr(self, "_pending_state", None)
             restored = None
+            raw = None
             if pending is not None:
                 sk = self._state_key(name, p)
                 if sk in pending:
                     v = pending[sk]
-                    restored = Tensor(v._value if isinstance(v, Tensor)
-                                      else jnp.asarray(v))
+                    # keep the RAW (host) value for the factory and convert
+                    # lazily: _acc may run inside an abstract discovery
+                    # trace, where jnp.asarray would capture a TRACER into
+                    # the factory and poison every later materialization
+                    raw = v._value if isinstance(v, Tensor) else v
+                    restored = Tensor(jnp.asarray(raw))
             # `init` may be a zero-arg factory: compiled steps (ParallelTrainStep,
             # static Executor) discover state under an abstract trace, then call
             # the factory again to materialize the true concrete initial value
@@ -85,7 +90,7 @@ class Optimizer:
             if restored is not None:
                 # checkpoint-restored value IS the initial value for any
                 # compiled step built afterwards
-                factory = lambda r=restored._value: r
+                factory = lambda r=raw: jnp.asarray(r)
             elif callable(init):
                 factory = init
             elif init is None:

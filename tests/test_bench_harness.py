@@ -79,6 +79,44 @@ def test_bench_probe_failure_falls_back_to_cpu(monkeypatch):
     assert devs is not None and devs[0].platform == "cpu"
 
 
+def test_probe_failure_reason_reaches_artifact(monkeypatch, capsys):
+    """CPU fallback must leave WHY in the artifact itself: one
+    backend_probe_FALLBACK info row carrying the probe-failure reasons
+    (the r04/r05 zero-evidence failure mode), plus per-config skip rows
+    naming the reason when even CPU is gone."""
+    monkeypatch.setattr(bench, "_probe_backend_subprocess",
+                        lambda timeout_s: (False, "tunnel hang: timeout"))
+    bench._PROBE_FAILURES.clear()
+    devs = bench.acquire_devices(retries=2, wait_s=0.0)
+    assert devs is not None and devs[0].platform == "cpu"
+    assert len(bench._PROBE_FAILURES) == 2
+    assert "tunnel hang" in bench._PROBE_FAILURES[0]
+    # the main() path turns those reasons into the artifact INFO row
+    monkeypatch.setattr(bench, "acquire_devices",
+                        lambda: devs)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--model", "gpt"])
+    monkeypatch.setattr(bench, "bench_gpt", lambda *a, **k: None)
+    bench.main()
+    out = capsys.readouterr().out
+    recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    info = [r for r in recs if r["metric"] == "backend_probe_FALLBACK"]
+    assert info and "tunnel hang" in info[0]["extras"]["reason"]
+    assert info[0]["extras"]["attempts"] == 2
+
+
+def test_probe_budget_env_tunable(monkeypatch):
+    monkeypatch.setenv("BENCH_PROBE_RETRIES", "5")
+    monkeypatch.setenv("BENCH_PROBE_WAIT_S", "1.5")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "7")
+    assert bench._probe_budget() == (5, 1.5, 7.0)
+    monkeypatch.delenv("BENCH_PROBE_RETRIES")
+    monkeypatch.delenv("BENCH_PROBE_WAIT_S")
+    monkeypatch.delenv("BENCH_PROBE_TIMEOUT_S")
+    retries, wait_s, timeout_s = bench._probe_budget()
+    # short by default: a full failed probe cycle stays ~O(minutes)
+    assert retries * (timeout_s + wait_s) <= 300
+
+
 def test_per_model_timeout_flushes_partial(capsys):
     """A config over its SIGALRM budget emits one *_TIMEOUT line and
     returns (the sweep continues) — a single wedged model can no longer

@@ -106,14 +106,14 @@ def _losses_by_step(path):
 
 
 class _FtHarness:
-    """A 2-worker elastic pod around TRAIN_WORKER."""
+    """A 2-worker elastic pod around TRAIN_WORKER (or a custom script)."""
 
     def __init__(self, tmp_path, steps=8, ttl=1.5, level=1, max_restarts=3,
-                 step_sleep=0.25):
+                 step_sleep=0.25, worker_src=None, extra_env=None):
         self.workdir = tmp_path / "ft"
         self.workdir.mkdir(parents=True, exist_ok=True)
         worker_py = tmp_path / "ft_worker.py"
-        worker_py.write_text(TRAIN_WORKER)
+        worker_py.write_text(worker_src or TRAIN_WORKER)
         self.store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
                               timeout=30)
         store_ep = f"127.0.0.1:{self.store.port}"
@@ -121,6 +121,8 @@ class _FtHarness:
         env["FT_WORKDIR"] = str(self.workdir)
         env["FT_STEPS"] = str(steps)
         env["FT_STEP_SLEEP"] = str(step_sleep)
+        if extra_env:
+            env.update(extra_env)
         self.launcher = PodLauncher(
             [sys.executable, str(worker_py)], nproc=2, job_id="ftjob",
             log_dir=str(tmp_path / "logs"), store=self.store,
@@ -448,3 +450,230 @@ def test_done_marker_distinguishes_clean_exit():
     em2.register()
     em2.exit(completed=False)
     assert em2.done_hosts() == []
+
+
+# ===========================================================================
+# resilient checkpointing under real faults: SIGTERM preemption with
+# emergency save, SIGKILL mid-checkpoint-save with verified fallback
+# ===========================================================================
+CKPT_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, "__REPO__")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["_PADDLE_TPU_BOOTSTRAPPED"] = "1"
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.checkpoint import manifest as manifest_mod
+    from paddle_tpu.distributed.fleet.elastic import (
+        maybe_start_worker_heartbeat,
+    )
+
+    maybe_start_worker_heartbeat()
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    workdir = os.environ["FT_WORKDIR"]
+    steps = int(os.environ.get("FT_STEPS", "8"))
+    step_sleep = float(os.environ.get("FT_STEP_SLEEP", "0.25"))
+    pad_floats = int(os.environ.get("FT_CKPT_PAD_FLOATS", "0"))
+
+    paddle.seed(1234 + rank)
+    net = nn.Linear(4, 1)
+    o = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+    mgr = ckpt.CheckpointManager(
+        os.path.join(workdir, f"ckpt_rank{rank}"), keep=3,
+        async_save=True, interval=1)
+    progress = {"step": -1}
+
+    def state():
+        s = {}
+        for k, v in net.state_dict().items():
+            s["model/" + k] = v
+        for k, v in o.state_dict().items():
+            s["opt/" + k] = v
+        if pad_floats:   # widen the write window for mid-save kills
+            s["pad/bulk"] = np.zeros(pad_floats, np.float32)
+        return s
+
+    ckpt.install_preemption_handler(
+        mgr, lambda: (state(), progress["step"]))
+
+    restored, restored_step = mgr.load_latest()
+    start = 0
+    if restored is not None:
+        # resume must only ever observe a COMPLETE, verified checkpoint
+        problems = manifest_mod.verify(mgr.step_dir(restored_step))
+        net.set_state_dict({k[len("model/"):]: v
+                            for k, v in restored.items()
+                            if k.startswith("model/")})
+        o.set_state_dict({k[len("opt/"):]: v for k, v in restored.items()
+                          if k.startswith("opt/")})
+        start = restored_step + 1
+        with open(os.path.join(workdir, f"resume_rank{rank}.log"),
+                  "a") as f:
+            f.write(f"{restored_step} verify_problems={len(problems)} "
+                    f"gen={os.environ.get('PADDLE_RESTART_COUNT')}\\n")
+    for step in range(start, steps):
+        x = paddle.to_tensor(
+            np.cos(np.arange(8, dtype=np.float32) + step).reshape(2, 4))
+        y = paddle.to_tensor(
+            np.sin(np.arange(2, dtype=np.float32) + step).reshape(2, 1))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        with open(os.path.join(workdir, f"loss_rank{rank}.log"), "a") as f:
+            f.write(f"{step} {float(loss.numpy()):.10f} "
+                    f"gen={os.environ.get('PADDLE_RESTART_COUNT')}\\n")
+        progress["step"] = step   # emergency saves resume AT this step + 1
+        mgr.save(state(), step)   # async: persists while the next step runs
+        time.sleep(step_sleep)
+    mgr.wait()
+    print("TRAIN_DONE", rank, flush=True)
+""").replace("__REPO__", REPO)
+
+
+def _ckpt_harness(tmp_path, **kw):
+    return _FtHarness(tmp_path, worker_src=CKPT_WORKER, **kw)
+
+
+@pytest.fixture(scope="module")
+def ckpt_oracle_losses(tmp_path_factory):
+    """One uninterrupted 6-step CKPT_WORKER run, shared by every chaos
+    test that checks loss-trajectory equivalence against it."""
+    oracle = _ckpt_harness(tmp_path_factory.mktemp("ckpt_oracle"), steps=6,
+                           step_sleep=0.05).start()
+    assert oracle.wait() == 0
+    losses = _losses_by_step(oracle.workdir / "loss_rank1.log")
+    oracle.close()
+    return losses
+
+
+def test_preemption_sigterm_emergency_save_and_penalty_free_resume(
+        tmp_path, ckpt_oracle_losses):
+    """Acceptance: SIGTERM mid-training → emergency checkpoint + exit 75 →
+    controller resumes WITHOUT burning a restart → training continues
+    within one step of the kill point, trajectory-equivalent."""
+    from paddle_tpu.distributed.checkpoint import EMERGENCY_EXIT_CODE
+
+    oracle_losses = ckpt_oracle_losses
+    h = _ckpt_harness(tmp_path / "faulty", steps=6, step_sleep=0.3).start()
+    try:
+        h.wait_for_step(rank=1, step=2)
+        FaultInjector(h.launcher).preempt(1)   # the preemption notice
+        rc = h.wait()
+        assert rc == 0, f"controller failed: rc={rc}"
+
+        # resume-without-penalty: a preemption is not a crash
+        assert h.controller.restarts == 0
+        assert h.controller.preemption_resumes == 1
+        assert any(kind == "preemption_resume"
+                   for (_, kind, _) in h.controller.events)
+        # the preempted worker really exited the emergency-save code
+        codes = [c for (_, kind, detail) in h.controller.events
+                 if kind == "preemption_resume"
+                 for c in [int(detail.split("=")[1])]]
+        assert codes == [EMERGENCY_EXIT_CODE]
+
+        # resume continued within one step of the kill point: generation 1
+        # re-executes at most one already-logged step
+        lines1 = (h.workdir / "loss_rank1.log").read_text().splitlines()
+        gen0_steps = [int(l.split()[0]) for l in lines1 if l.endswith("gen=0")]
+        gen1_steps = [int(l.split()[0]) for l in lines1 if l.endswith("gen=1")]
+        assert gen1_steps, "no second generation ran"
+        assert gen1_steps[0] >= max(gen0_steps), \
+            f"resume lost work: gen0 ended at {max(gen0_steps)}, " \
+            f"gen1 started at {gen1_steps[0]}"
+        # resume log: restored from a checkpoint that verified clean
+        resumes = (h.workdir / "resume_rank1.log").read_text().splitlines()
+        assert resumes and "verify_problems=0" in resumes[0]
+
+        # loss trajectory equivalent to the uninterrupted oracle
+        faulty_losses = _losses_by_step(h.workdir / "loss_rank1.log")
+        assert set(faulty_losses) == set(oracle_losses)
+        for s in oracle_losses:
+            np.testing.assert_allclose(faulty_losses[s], oracle_losses[s],
+                                       rtol=1e-6, err_msg=f"step {s}")
+    finally:
+        h.close()
+
+
+def test_sigkill_mid_checkpoint_save_resumes_from_complete(
+        tmp_path, ckpt_oracle_losses):
+    """Acceptance: SIGKILL landing INSIDE a checkpoint persist (watcher
+    fires the moment the step-3 dir appears, i.e. before its manifest can
+    commit) → resume never observes partial state: it lands on the newest
+    COMPLETE checkpoint, checksum verification passing."""
+    from paddle_tpu.distributed.checkpoint import manifest as manifest_mod
+
+    oracle_losses = ckpt_oracle_losses
+    h = _ckpt_harness(
+        tmp_path / "faulty", steps=6, step_sleep=0.3,
+        # ~8MB checkpoint pad: the persist takes real milliseconds, so the
+        # dir-appearance-triggered SIGKILL reliably lands mid-write
+        extra_env={"FT_CKPT_PAD_FLOATS": str(2_000_000)}).start()
+    try:
+        injector = FaultInjector(h.launcher)
+        target = str(h.workdir / "ckpt_rank1" / "step_00000003")
+        watcher = injector.kill_when_file(target, local_rank=1)
+        rc = h.wait()
+        assert rc == 0, f"controller failed: rc={rc}"
+        watcher.join(timeout=5)
+        assert watcher.fired, "kill never triggered (save not observed)"
+        assert h.launcher.generation >= 1   # a real relaunch happened
+
+        # every complete checkpoint dir verifies end to end
+        ckpt_root = h.workdir / "ckpt_rank1"
+        complete = [d for d in sorted(os.listdir(ckpt_root))
+                    if manifest_mod.is_complete(str(ckpt_root / d))]
+        assert complete
+        for d in complete:
+            assert manifest_mod.verify(str(ckpt_root / d)) == [], d
+
+        # the resumed generation restored a checkpoint that verified clean
+        # and older than the torn one
+        resumes = (h.workdir / "resume_rank1.log").read_text().splitlines()
+        assert resumes
+        restored_step = int(resumes[0].split()[0])
+        assert "verify_problems=0" in resumes[0]
+        assert restored_step <= 3
+
+        # trajectory equivalent to the oracle: partial state never leaked
+        faulty_losses = _losses_by_step(h.workdir / "loss_rank1.log")
+        assert set(faulty_losses) == set(oracle_losses)
+        for s in oracle_losses:
+            np.testing.assert_allclose(faulty_losses[s], oracle_losses[s],
+                                       rtol=1e-6, err_msg=f"step {s}")
+    finally:
+        h.close()
+
+
+@pytest.mark.slow
+def test_repeated_preemption_cycles(tmp_path):
+    """Chaos variant: three preemption cycles in one run — every cycle
+    emergency-saves, resumes penalty-free, and the job still completes
+    with max_restarts untouched."""
+    h = _ckpt_harness(tmp_path, steps=10, step_sleep=0.3,
+                      max_restarts=1).start()
+    try:
+        injector = FaultInjector(h.launcher)
+        for cycle in range(3):
+            target_step = 2 + cycle * 2
+            h.wait_for_step(rank=1, step=target_step, timeout=90)
+            try:
+                injector.preempt(1)
+            except RuntimeError:
+                break   # worker already finished — fine
+            deadline = time.monotonic() + 60
+            while h.controller.preemption_resumes <= cycle and \
+                    time.monotonic() < deadline and h.rc is None:
+                time.sleep(0.05)
+        rc = h.wait(timeout=180)
+        assert rc == 0
+        assert h.controller.restarts <= 1   # preemptions burned no budget
+        assert h.controller.preemption_resumes >= 2
+        losses = _losses_by_step(h.workdir / "loss_rank1.log")
+        assert set(losses) == set(range(10))   # every step accounted for
+    finally:
+        h.close()
