@@ -104,6 +104,60 @@ def emit_skip(metric, why):
                       "extras": {"reason": why}}), flush=True)
 
 
+def emit_predicted_rows(configs=("345m", "1.3b", "13b"), timeout_s=420):
+    """Static cost-model stand-ins for the TPU configs this round can't
+    run: one ``{name}_predicted`` JSON row each (roofline step_ms / MFU +
+    liveness peak-HBM from ``paddle_tpu.analysis``), so a round without a
+    TPU still produces artifact-backed numbers instead of only
+    ``*_SKIPPED`` lines. Trace-only subprocess on a virtual CPU mesh —
+    never touches (or waits on) the TPU. Rows bypass ``emit()`` on
+    purpose: predictions must never enter the vs_baseline denominators
+    or gain the ``_cpu_smoke`` suffix measured rows get."""
+    import subprocess
+    name_of = {"345m": "gpt_345m", "1.3b": "gpt_1p3b", "13b": "gpt_13b"}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis.predict",
+             "--configs", ",".join(configs)],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        lines = r.stdout.splitlines()
+    except Exception as e:
+        print(json.dumps({"metric": "predicted_rows_ERROR", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "extras": {"error": repr(e)[:300]}}), flush=True)
+        return
+    emitted = 0
+    for ln in lines:
+        try:
+            row = json.loads(ln)
+        except ValueError:
+            continue
+        name = name_of.get(row.pop("config", None), None)
+        if name is None:
+            continue
+        emitted += 1
+        if "error" in row:
+            print(json.dumps({"metric": f"{name}_predicted_ERROR",
+                              "value": 0.0, "unit": "error",
+                              "vs_baseline": 0.0, "extras": row}),
+                  flush=True)
+            continue
+        print(json.dumps({
+            "metric": f"{name}_predicted",
+            "value": row.get("predicted_tokens_per_sec_per_chip", 0.0),
+            "unit": "tokens/s/chip (static cost model)",
+            "vs_baseline": 0.0, "extras": row}), flush=True)
+    if not emitted and r.returncode != 0:
+        # the predict child died before printing any JSON — the artifact
+        # must still say so, not silently fall back to *_SKIPPED only
+        print(json.dumps({"metric": "predicted_rows_ERROR", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "extras": {"returncode": r.returncode,
+                                     "stderr": r.stderr[-300:]}}),
+              flush=True)
+
+
 class _PerModelTimeout(Exception):
     pass
 
@@ -290,17 +344,10 @@ class _StepTelemetry:
 
 
 def model_flops_per_token(cfg, seq_len):
-    """Standard 6N + attention estimate (FLOPs/token, fwd+bwd).
-
-    N counts the matmul params: qkv (3H^2) + out (H^2) + mlp (2*H*F) per layer
-    plus the (tied) head V*H and position table.
-    """
-    H, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    per_layer = 4 * H * H + 2 * H * cfg.intermediate_size
-    n_params = V * H + cfg.max_position_embeddings * H + L * per_layer
-    matmul_flops = 6 * n_params  # fwd 2N + bwd 4N
-    attn_flops = 12 * L * H * seq_len  # qk^T + av, fwd+bwd
-    return matmul_flops + attn_flops, n_params
+    """6N + attention FLOPs/token — shared with the static cost model
+    (one formula, one answer for measured AND predicted MFU)."""
+    from paddle_tpu.models.gpt import model_flops_per_token as f
+    return f(cfg, seq_len)
 
 
 def peak_flops_per_chip():
@@ -881,6 +928,9 @@ def main():
         for name in names:
             emit_skip(name, "no jax backend available (TPU and CPU init "
                             f"both failed after retries): {reason}"[:400])
+        # a fresh subprocess may still manage a CPU trace even when this
+        # process's backend is wedged — predictions cost one try
+        emit_predicted_rows()
         return  # exit 0: the harness ran; the environment did not
 
     global _CPU_SMOKE
@@ -913,8 +963,13 @@ def main():
     if args.model in single:
         name = (f"gpt_{args.config.replace('.', 'p')}"
                 if args.model == "gpt" else single_names[args.model])
-        return run_with_timeout(name, lambda: single[args.model](args),
-                                _config_budget(name))
+        rc = run_with_timeout(name, lambda: single[args.model](args),
+                              _config_budget(name))
+        if _CPU_SMOKE:
+            # every TPU config this CPU round skipped still gets an
+            # artifact-backed *_predicted row from the static cost model
+            emit_predicted_rows()
+        return rc
 
     # default: ALL BASELINE configs, one JSON line each; a failing config
     # reports an error line and the rest still run. The driver records
@@ -923,6 +978,10 @@ def main():
     # and last-line parsers see it); the bounded-by-timeout 13B compile
     # probe sits just before it.
     on_cpu = _CPU_SMOKE
+    if on_cpu:
+        # artifact-backed stand-ins for the TPU-only configs, FIRST: the
+        # driver keeps the output tail, truncation eats from the front
+        emit_predicted_rows()
     runs = [("resnet50", lambda: bench_resnet50(args)),
             ("bert", lambda: bench_bert(args)),
             ("ernie_moe", lambda: bench_ernie_moe(args))]

@@ -5,7 +5,11 @@ The compile-time correctness layer the reference gets from ProgramDesc
 validation and the phi op audit, rebuilt for a trace-and-jit world: any
 ``Layer``, ``to_static`` function, ``static.Program``, or fleet train
 step is abstractly evaluated (no device execution) and registered lint
-passes run over the result:
+passes run over the result. Beyond "is this program wrong?", the cost /
+memory passes answer "is this program too slow or too big?" BEFORE the
+first compile: a sharding-aware FLOPs/bytes roofline and a
+liveness-based peak-HBM estimate (cross-checked within ±20% of XLA's
+``memory_analysis()`` on the mem_probe pipeline sweep).
 
 ========== =============================================================
 pass       finds
@@ -21,22 +25,66 @@ collective per-rank collective schedules recorded from abstract traces
 amp        fp16-unsafe ops reached without a cast; redundant
            up/down-cast pairs in the jaxpr
 deadcode   unreachable ops / unused outputs in the static Program DAG
+cost       sharding-aware per-device FLOPs / HBM bytes / ring-model
+           wire bytes rolled into a roofline step time + predicted MFU
+memory     liveness peak-HBM sweep (donation- and remat-aware) gated
+           against the chip budget
+donation   buffer-donation sanitizer over ``donate_argnums`` aliasing
 ========== =============================================================
+
+Diagnostic codes (severity in parentheses):
+
+======= ===============================================================
+code    meaning
+======= ===============================================================
+PTRC001 scalar baked into the trace — retrace loop (warning)
+PTRC002 shape storm: many shapes at one call site (warning)
+PTRC003 f64 / strong-scalar promotion drift (warning)
+PTHS001 host sync on a tracer inside a jit region (error)
+PTHS002 possible host sync in an unexecuted branch (info)
+PTCC001 cross-rank collective schedule divergence (error)
+PTCC002 cross-rank collective count mismatch (error)
+PTCC003 unmatched p2p endpoint (error)
+PTAM001 fp16-unsafe op reached in f16 without a cast (warning)
+PTAM002 redundant up/down-cast pair (info)
+PTDC001 unreachable Program-DAG op subtree (info)
+PTDC002 computed-but-dropped Program output (warning)
+PTCS001 comm-bound step: interconnect time exceeds compute+HBM
+        (warning)
+PTCS002 low arithmetic intensity: step sits under the chip's ridge
+        point (info)
+PTMM001 predicted peak HBM exceeds the budget — OOM before compile
+        (error)
+PTBD001 use-after-donate: donated input read after the jitted call
+        (error)
+PTBD002 donated-but-never-aliased: no matching output, donation is
+        silently dropped (warning)
+PTBD003 donatable-but-not-donated train-step state on the hot path
+        (warning)
+======= ===============================================================
 
 Surfaces::
 
     from paddle_tpu.analysis import analyze
     report = analyze(my_step_fn, jax.ShapeDtypeStruct((8, 128), "int32"))
     assert report.clean, str(report)
+    report.cost.step_ms        # roofline prediction (CostSummary)
+    report.memory.peak_bytes   # liveness peak-HBM (MemoryEstimate)
 
-    python tools/check_program.py --model gpt      # CLI over the model zoo
+    analyze(step_fn, x, hbm_budget_gb=16)   # arm the PTMM001 OOM gate
+
+    python tools/check_program.py --model gpt --hbm-budget-gb 16  # zoo CLI
 
     ParallelTrainStep(model, opt, loss_fn, validate=True)   # lint at build
 
+    python -m paddle_tpu.analysis.predict     # bench-config *_predicted rows
+    python tools/mem_probe.py --compare-static --compute-dtype float32
+
 Findings are emitted as ``analysis_diagnostic`` runlog events and the
-``paddle_analysis_diagnostics_total`` counter (see README
-"Observability"), so CI and dashboards see lint results next to the
-runtime telemetry they prevent.
+``paddle_analysis_diagnostics_total`` counter; cost/memory rollups land
+on the ``paddle_analysis_predicted_{step_ms,peak_hbm_mb,mfu}`` gauges
+(see README "Observability"), so CI and dashboards see lint results and
+predictions next to the runtime telemetry they prevent.
 """
 from .core import Diagnostic, Report, get_passes, pass_names, register_pass  # noqa: F401
 from .tracing import AnalysisContext, TraceRecorder  # noqa: F401

@@ -36,11 +36,14 @@ def _target_name(target, explicit):
 
 
 class ProgramAnalyzer:
-    """Configured analyzer: which passes, how many simulated ranks."""
+    """Configured analyzer: which passes, how many simulated ranks, and
+    (for the cost/memory passes) the HBM budget the OOM gate checks."""
 
-    def __init__(self, passes=None, world_size=None):
+    def __init__(self, passes=None, world_size=None, hbm_budget_gb=None):
         self._passes = passes
         self.world_size = world_size
+        self.hbm_budget_bytes = (float(hbm_budget_gb) * 1024 ** 3
+                                 if hbm_budget_gb else None)
 
     # ------------------------------------------------------------------
     def analyze(self, target, *example_inputs, fetch_list=None, name=None,
@@ -49,6 +52,14 @@ class ProgramAnalyzer:
                               target_name=_target_name(target, name),
                               example_inputs=tuple(example_inputs))
         ctx.world_size = self._resolve_world()
+        ctx.hbm_budget_bytes = self.hbm_budget_bytes
+        try:
+            from ..distributed.mesh import get_global_mesh
+            m = get_global_mesh()
+            if m is not None:
+                ctx.axis_sizes = {k: int(v) for k, v in dict(m.shape).items()}
+        except Exception:
+            pass
         fn = self._prepare(ctx, target, fetch_list)
 
         traceable = fn is not None and (ctx.example_inputs
@@ -82,6 +93,11 @@ class ProgramAnalyzer:
         diags.sort(key=lambda d: (sev.get(d.severity, 3), d.pass_name,
                                   d.line or 0))
         report = Report(ctx.target_name, diags, trace_error=ctx.trace_error)
+        # the cost/memory passes leave their rollups on the context —
+        # surface them on the report so callers (bench.py, mem_probe,
+        # validate=True) can read predictions without re-walking
+        report.cost = ctx.cost_summary
+        report.memory = ctx.memory_estimate
         if emit:
             report.emit(run_dir)
         return report
@@ -143,9 +159,23 @@ class ProgramAnalyzer:
             ParallelTrainStep = ()
         if isinstance(target, ParallelTrainStep):
             ctx.target_kind = "train_step"
+            ctx.train_step = target
             ctx.source_fns = [target.loss_fn]
             model = target.model
             loss_fn = target.loss_fn
+            # the batch is sharded over the data axes — the cost/memory
+            # passes divide per-op work by the same mesh axes the step's
+            # in_shardings will
+            try:
+                mesh = target.mesh
+                ctx.axis_sizes = {k: int(v)
+                                  for k, v in dict(mesh.shape).items()}
+                div = 1
+                for ax in getattr(target, "data_axes", ()):
+                    div *= int(mesh.shape[ax])
+                ctx.in_divisors = [max(div, 1)] * len(ctx.example_inputs)
+            except Exception:
+                pass
             return lambda *batch: loss_fn(model, *batch)
 
         if callable(target):
@@ -219,9 +249,12 @@ def _takes_no_args(fn):
 
 
 def analyze(target, *example_inputs, passes=None, world_size=None,
-            fetch_list=None, name=None, run_dir=None) -> Report:
+            fetch_list=None, name=None, run_dir=None,
+            hbm_budget_gb=None) -> Report:
     """One-call surface: ``analyze(fn_or_layer_or_program, *input_specs)``
-    → :class:`~.core.Report`."""
-    return ProgramAnalyzer(passes=passes, world_size=world_size).analyze(
+    → :class:`~.core.Report`. ``hbm_budget_gb`` arms the PTMM001
+    OOM-before-compile gate (predicted peak vs the chip budget)."""
+    return ProgramAnalyzer(passes=passes, world_size=world_size,
+                           hbm_budget_gb=hbm_budget_gb).analyze(
         target, *example_inputs, fetch_list=fetch_list, name=name,
         run_dir=run_dir)

@@ -61,6 +61,10 @@ class Report:
         # exception repr when the abstract trace itself failed (the
         # analyzer degrades to the passes that don't need a trace)
         self.trace_error = trace_error
+        # rollups from the cost/memory passes (None when those passes
+        # didn't run or had nothing to model): CostSummary / MemoryEstimate
+        self.cost = None
+        self.memory = None
 
     # -- views ----------------------------------------------------------
     @property
@@ -116,6 +120,16 @@ class Report:
                     "static-analysis findings by pass/severity")
         for d in self.diagnostics:
             c.inc(1.0, **{"pass": d.pass_name, "severity": d.severity})
+        # cost/memory predictions ride the dedicated gauges so dashboards
+        # can chart predicted-vs-measured drift per target
+        if self.cost is not None or self.memory is not None:
+            from ..observability.instrument import record_predicted
+            record_predicted(
+                step_ms=(self.cost.step_ms if self.cost else None),
+                mfu=(self.cost.predicted_mfu if self.cost else None),
+                peak_hbm_mb=(self.memory.peak_bytes / 2 ** 20
+                             if self.memory else None),
+                target=self.target_name)
         lg = (runlog_mod.RunLogger(run_dir) if run_dir
               else runlog_mod.get_run_logger())
         if lg is None:

@@ -6,3 +6,6 @@ from . import hostsync     # noqa: F401
 from . import collective   # noqa: F401
 from . import amp_audit    # noqa: F401
 from . import deadcode     # noqa: F401
+from . import cost         # noqa: F401
+from . import memory       # noqa: F401
+from . import donation     # noqa: F401

@@ -1,0 +1,495 @@
+"""Static cost model: sharding-aware FLOPs/bytes over jaxprs + roofline.
+
+The role XLA's analytical cost modeling plays for the compiler, exposed
+as a lint pass: every primitive in the abstract trace is charged FLOPs
+and HBM bytes, sub-jaxprs included (``scan`` multiplies its body by the
+trip count, ``cond`` takes the widest branch), and the totals roll up
+into a roofline step-time / predicted-MFU against the same per-chip peak
+table bench.py measures against (:func:`..observability.instrument
+.chip_specs` — one table, one answer).
+
+Sharding model (per-DEVICE cost, matching the per-chip numbers bench
+emits): every jaxpr var carries a *divisor* — the number of devices its
+data is partitioned over. Analyzer-provided input divisors (from
+PartitionSpecs) propagate through eqns (an op's work divides by the mesh
+axes its output is partitioned over); ``shard_map`` bodies are already
+per-shard, so they count verbatim with divisor 1. Collectives are costed
+by the bidirectional-ring model — an allreduce of ``b`` bytes over ``n``
+ranks moves ``2(n-1)/n × b`` per device on the wire (the EQuARX lens) —
+both for in-jit prims (psum/all_gather/...) and for the eager
+``distributed.collective`` ledger the trace recorded.
+
+Diagnostics:
+
+- **PTCS001** (warning) — comm-bound step: predicted interconnect time
+  exceeds both compute and HBM time. The collective schedule, not the
+  math, sets the step time — re-shard or overlap before burning chips.
+- **PTCS002** (info) — low arithmetic intensity: FLOPs/HBM-byte below
+  the chip's ridge point on a non-trivial program — the MXU waits on
+  HBM; fuse, batch, or cast down.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+
+from ..core import Diagnostic, register_pass
+
+# interchange-format / view ops: zero FLOPs, zero bytes (XLA folds them
+# into layouts or fuses them away entirely)
+_FREE = {
+    "reshape", "squeeze", "expand_dims", "broadcast_in_dim", "iota",
+    "stop_gradient", "copy", "device_put", "sharding_constraint",
+    "transpose", "rev", "bitcast_convert_type", "split", "symbolic_zeros",
+}
+
+# elementwise / cheap ops XLA fuses into their consumers: their outputs
+# never hit HBM as standalone buffers — shared with the liveness memory
+# model (one fusion judgment, one answer)
+_FUSABLE = _FREE | {
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "neg",
+    "sign", "abs", "max", "min", "and", "or", "xor", "not", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic",
+    "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan", "sqrt",
+    "rsqrt", "cbrt", "logistic", "erf", "erfc", "erf_inv", "floor",
+    "ceil", "round", "is_finite", "square",
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "clamp",
+    "convert_element_type", "real", "imag", "conj",
+    "add_any", "pad", "slice", "dynamic_slice", "squeeze",
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "reduce_prod", "argmax", "argmin", "reduce_precision",
+    "nextafter", "atan2", "axis_index", "random_seed", "random_wrap",
+    "random_unwrap", "random_fold_in",
+}
+
+# primitives whose params carry sub-jaxprs the walker recurses into
+# transparently (cost of the call = cost of the body)
+_TRANSPARENT = {
+    "pjit", "closed_call", "core_call", "xla_call", "remat", "remat2",
+    "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr", "name",
+}
+
+# in-jit collective primitives -> wire-byte model over the axis size n,
+# applied to the INPUT avals' bytes b. ring allreduce: reduce-scatter +
+# all-gather = 2(n-1)/n of the payload (input == full payload); scatter
+# phases move (n-1)/n of their full-sized input; all_gather's input is
+# the per-shard payload, so each device receives (n-1) shards; ppermute
+# is one full-payload hop.
+_COLLECTIVES = {
+    "psum": lambda b, n: 2.0 * (n - 1) / n * b,
+    "pmax": lambda b, n: 2.0 * (n - 1) / n * b,
+    "pmin": lambda b, n: 2.0 * (n - 1) / n * b,
+    "all_gather": lambda b, n: (n - 1) * b,
+    "reduce_scatter": lambda b, n: (n - 1) / n * b,
+    "psum_scatter": lambda b, n: (n - 1) / n * b,
+    "all_to_all": lambda b, n: (n - 1) / n * b,
+    "ppermute": lambda b, n: float(b),
+    "pbroadcast": lambda b, n: float(b),
+}
+
+# eager distributed.collective ledger ops -> same ring model (bytes are
+# the recorded payload; gather-shaped ops scale by the group size)
+_EAGER_COLLECTIVES = {
+    "all_reduce": lambda b, n: 2.0 * (n - 1) / n * b,
+    "reduce": lambda b, n: (n - 1) / n * b,
+    "broadcast": lambda b, n: (n - 1) / n * b,
+    "all_gather": lambda b, n: (n - 1) * b,       # payload is per-rank
+    "all_gather_object": lambda b, n: (n - 1) * b,
+    "reduce_scatter": lambda b, n: (n - 1) / n * b,
+    "scatter": lambda b, n: (n - 1) / n * b,
+    "all_to_all": lambda b, n: (n - 1) / n * b,
+    "isend": lambda b, n: float(b),
+    "send": lambda b, n: float(b),
+    "irecv": lambda b, n: float(b),
+    "recv": lambda b, n: float(b),
+    "barrier": lambda b, n: 0.0,
+}
+
+# sustained-MXU efficiency knob: a raw peak-FLOPs roofline predicts 100%
+# MFU, which no real schedule reaches; 0.55 is calibrated against the
+# measured 345M/1.3B rows in BENCH_r0x (50-57% MFU) so predicted and
+# measured step times land in the same regime
+MXU_EFFICIENCY = 0.55
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (PRNG key<fry> etc.) aren't numpy dtypes
+        itemsize = getattr(dtype, "itemsize", 4)
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * itemsize
+    except TypeError:
+        return 0
+
+
+def _nelems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64))
+    except TypeError:
+        return 0
+
+
+@dataclass
+class CostSummary:
+    """Per-device cost rollup + roofline verdict for one analyzed target."""
+
+    flops: float = 0.0            # per-device FLOPs per step
+    hbm_bytes: float = 0.0        # per-device HBM traffic per step
+    comm_bytes: float = 0.0       # per-device wire bytes per step
+    by_prim: dict = field(default_factory=dict)  # name -> [flops, bytes, n]
+    chip: dict = field(default_factory=dict)
+    compute_ms: float = 0.0
+    hbm_ms: float = 0.0
+    comm_ms: float = 0.0
+    step_ms: float = 0.0
+    bound: str = "compute"        # compute | memory | comm
+    predicted_mfu: float = 0.0
+    arithmetic_intensity: float = 0.0
+    ridge: float = 0.0            # chip ridge point, FLOPs per HBM byte
+
+    def finalize(self, chip: dict):
+        self.chip = dict(chip)
+        eff_peak = chip["peak_flops"] * MXU_EFFICIENCY
+        self.compute_ms = 1e3 * self.flops / eff_peak
+        self.hbm_ms = 1e3 * self.hbm_bytes / chip["hbm_bw"]
+        self.comm_ms = 1e3 * self.comm_bytes / chip["ici_bw"]
+        self.step_ms = max(self.compute_ms, self.hbm_ms, self.comm_ms,
+                           1e-9)
+        self.bound = {self.compute_ms: "compute", self.hbm_ms: "memory",
+                      self.comm_ms: "comm"}[
+            max(self.compute_ms, self.hbm_ms, self.comm_ms)]
+        self.predicted_mfu = (self.flops / (self.step_ms / 1e3)
+                              / chip["peak_flops"]) if self.flops else 0.0
+        self.arithmetic_intensity = (self.flops / self.hbm_bytes
+                                     if self.hbm_bytes else 0.0)
+        self.ridge = chip["peak_flops"] / chip["hbm_bw"]
+        return self
+
+    def as_dict(self):
+        return {
+            "flops": self.flops, "hbm_bytes": self.hbm_bytes,
+            "comm_bytes": self.comm_bytes,
+            "compute_ms": round(self.compute_ms, 4),
+            "hbm_ms": round(self.hbm_ms, 4),
+            "comm_ms": round(self.comm_ms, 4),
+            "step_ms": round(self.step_ms, 4), "bound": self.bound,
+            "predicted_mfu": round(self.predicted_mfu, 4),
+            "arithmetic_intensity": round(self.arithmetic_intensity, 2),
+            "chip": self.chip.get("name"),
+        }
+
+
+# ---------------------------------------------------------------------------
+# per-primitive FLOPs (global, pre-division); bytes default to in+out
+# ---------------------------------------------------------------------------
+
+def _dot_general_flops(eqn) -> float:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    batch = math.prod(lhs.shape[i] for i in lb) if lb else 1
+    contract = math.prod(lhs.shape[i] for i in lc) if lc else 1
+    lhs_free = math.prod(
+        d for i, d in enumerate(lhs.shape) if i not in lc and i not in lb)
+    rhs_free = math.prod(
+        d for i, d in enumerate(rhs.shape) if i not in rc and i not in rb)
+    return 2.0 * batch * lhs_free * rhs_free * contract
+
+
+def _conv_flops(eqn) -> float:
+    dn = eqn.params["dimension_numbers"]
+    groups = int(eqn.params.get("feature_group_count", 1) or 1)
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval
+    k_spatial = math.prod(rhs.shape[i] for i in dn.rhs_spec[2:])
+    in_ch = rhs.shape[dn.rhs_spec[1]]  # already per-group
+    del groups  # in_ch from rhs_spec is per-group by construction
+    return 2.0 * math.prod(out.shape) * in_ch * k_spatial
+
+
+def _default_flops(eqn):
+    """Elementwise/reduce fallback: one FLOP per output element (per
+    input element for reductions)."""
+    flops = float(sum(_nelems(v.aval) for v in eqn.outvars))
+    if eqn.primitive.name.startswith("reduce_"):
+        flops = float(sum(_nelems(v.aval) for v in eqn.invars
+                          if hasattr(v.aval, "shape")))
+    return flops
+
+
+def _anchor_bytes(eqn):
+    """HBM traffic of an op that materializes: stream inputs + outputs."""
+    nbytes = sum(_nbytes(v.aval) for v in eqn.invars
+                 if not isinstance(v, jax.core.Literal))
+    nbytes += sum(_nbytes(v.aval) for v in eqn.outvars)
+    return float(nbytes)
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, jax.core.ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, jax.core.Jaxpr):
+                yield x
+            elif isinstance(x, (list, tuple)):
+                stack.extend(x)
+
+
+def _axis_size(axes, axis_sizes, default=1):
+    if axes is None:
+        return default
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    n = 1
+    for a in axes:
+        n *= int(axis_sizes.get(a, default))
+    return max(n, 1)
+
+
+class _JaxprCoster:
+    """One walk = one CostSummary accumulation (global mesh context)."""
+
+    def __init__(self, summary: CostSummary, axis_sizes: dict):
+        self.s = summary
+        self.axis_sizes = dict(axis_sizes or {})
+
+    def charge(self, name, flops, nbytes, comm=0.0):
+        self.s.flops += flops
+        self.s.hbm_bytes += nbytes
+        self.s.comm_bytes += comm
+        rec = self.s.by_prim.setdefault(name, [0.0, 0.0, 0])
+        rec[0] += flops
+        rec[1] += nbytes
+        rec[2] += 1
+
+    # ------------------------------------------------------------------
+    def walk(self, jaxpr, in_divs, mult=1.0):
+        """Accumulate per-device cost of ``jaxpr``; ``in_divs`` maps each
+        invar to the number of devices its data is partitioned over."""
+        div = {}
+        for v, d in zip(jaxpr.invars, in_divs):
+            div[id(v)] = max(int(d or 1), 1)
+        for v in jaxpr.constvars:
+            div[id(v)] = 1
+
+        def dof(v):
+            if isinstance(v, jax.core.Literal):
+                return 1
+            return div.get(id(v), 1)
+
+        # fusion model for HBM traffic: only materialized buffers stream.
+        # An op that fuses (elementwise/reduce glue) charges bytes ONLY
+        # for frame arguments it reads and frame outputs it writes —
+        # those live in HBM no matter how XLA fuses (params read by the
+        # optimizer update, updated state written back); everything else
+        # it touches rides inside a consumer's fused loop for free.
+        frame_in = {id(v) for v in jaxpr.invars}
+        frame_in |= {id(v) for v in jaxpr.constvars}
+        frame_out = {id(v) for v in jaxpr.outvars
+                     if not isinstance(v, jax.core.Literal)}
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            d_out = max([dof(v) for v in eqn.invars] or [1])
+            for v in eqn.outvars:
+                div[id(v)] = d_out
+
+            if name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                length = int(eqn.params.get("length", 1) or 1)
+                self.walk(body, [dof(v) for v in eqn.invars],
+                          mult * length)
+                continue
+            if name == "while":
+                body = eqn.params["body_jaxpr"].jaxpr
+                nc = int(eqn.params.get("cond_nconsts", 0) or 0)
+                self.walk(body, [dof(v) for v in eqn.invars[nc:]], mult)
+                continue
+            if name == "cond":
+                branches = eqn.params["branches"]
+                best = None
+                for br in branches:
+                    probe = CostSummary()
+                    _JaxprCoster(probe, self.axis_sizes).walk(
+                        br.jaxpr, [dof(v) for v in eqn.invars[1:]], mult)
+                    if best is None or probe.flops > best.flops:
+                        best = probe
+                if best is not None:
+                    self.s.flops += best.flops
+                    self.s.hbm_bytes += best.hbm_bytes
+                    self.s.comm_bytes += best.comm_bytes
+                    for k, rec in best.by_prim.items():
+                        acc = self.s.by_prim.setdefault(k, [0.0, 0.0, 0])
+                        acc[0] += rec[0]
+                        acc[1] += rec[1]
+                        acc[2] += rec[2]
+                continue
+            if name == "shard_map":
+                body = eqn.params["jaxpr"]
+                mesh = eqn.params.get("mesh")
+                sizes = dict(self.axis_sizes)
+                if mesh is not None:
+                    sizes.update({k: int(v)
+                                  for k, v in dict(mesh.shape).items()})
+                inner = _JaxprCoster(self.s, sizes)
+                # body shapes are already per-shard: divisor 1 throughout
+                inner.walk(body, [1] * len(body.invars), mult)
+                continue
+            if name in _TRANSPARENT:
+                subs = list(_sub_jaxprs(eqn.params))
+                for sub in subs:
+                    self.walk(sub, [dof(v) for v in eqn.invars], mult)
+                continue
+
+            if name in _COLLECTIVES:
+                axes = eqn.params.get("axes",
+                                      eqn.params.get("axis_name"))
+                n = _axis_size(axes, self.axis_sizes)
+                payload = sum(_nbytes(v.aval) for v in eqn.invars
+                              if not isinstance(v, jax.core.Literal))
+                wire = _COLLECTIVES[name](payload, n) if n > 1 else 0.0
+                # the reduction math itself: one FLOP per element per hop
+                flops = float(sum(_nelems(v.aval) for v in eqn.invars
+                                  if hasattr(v.aval, "shape")))
+                self.charge(name, mult * flops / d_out, 0.0,
+                            comm=mult * wire / d_out)
+                continue
+
+            if name in _FREE:
+                continue
+            if name == "dot_general":
+                flops = _dot_general_flops(eqn)
+                nbytes = _anchor_bytes(eqn)
+            elif name == "conv_general_dilated":
+                flops = _conv_flops(eqn)
+                nbytes = _anchor_bytes(eqn)
+            elif name in _FUSABLE:
+                flops = _default_flops(eqn)
+                nbytes = sum(_nbytes(v.aval) for v in eqn.invars
+                             if not isinstance(v, jax.core.Literal)
+                             and id(v) in frame_in)
+                nbytes += sum(_nbytes(v.aval) for v in eqn.outvars
+                              if id(v) in frame_out)
+            else:
+                subs = list(_sub_jaxprs(eqn.params))
+                if subs:  # opaque higher-order prim (pallas_call, ...)
+                    for sub in subs:
+                        self.walk(sub, [1] * len(sub.invars), mult)
+                    continue
+                flops = _default_flops(eqn)
+                nbytes = _anchor_bytes(eqn)
+            self.charge(name, mult * flops / d_out, mult * nbytes / d_out)
+
+
+def estimate_jaxpr_cost(closed_jaxpr, in_divisors=None, axis_sizes=None,
+                        chip=None) -> CostSummary:
+    """Sharding-aware per-device FLOPs/bytes of one (Closed)Jaxpr, rolled
+    into a roofline :class:`CostSummary`. ``in_divisors`` gives the
+    device-partition count per top-level input (from PartitionSpecs via
+    :func:`spec_divisor`); ``axis_sizes`` names the mesh axes collectives
+    ring over."""
+    from ...observability.instrument import chip_specs
+    jaxpr = (closed_jaxpr.jaxpr
+             if isinstance(closed_jaxpr, jax.core.ClosedJaxpr)
+             else closed_jaxpr)
+    s = CostSummary()
+    divs = list(in_divisors or [])
+    divs += [1] * (len(jaxpr.invars) - len(divs))
+    _JaxprCoster(s, axis_sizes or {}).walk(jaxpr, divs)
+    return s.finalize(chip or chip_specs())
+
+
+def spec_divisor(spec, mesh_shape: dict) -> int:
+    """Number of devices a PartitionSpec splits an array over."""
+    n = 1
+    for part in tuple(spec or ()):
+        if part is None:
+            continue
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            n *= int(mesh_shape.get(ax, 1))
+    return max(n, 1)
+
+
+def eager_collective_cost(ledger, world_size: int) -> float:
+    """Wire bytes of the recorded eager collective schedule (rank 0's
+    ledger), ring-modeled per device."""
+    total = 0.0
+    for rec in ledger or ():
+        fn = _EAGER_COLLECTIVES.get(rec.op)
+        if fn is None or rec.shape is None:
+            continue
+        try:
+            nbytes = (int(np.prod(rec.shape, dtype=np.int64))
+                      * np.dtype(rec.dtype).itemsize)
+        except (TypeError, ValueError):
+            continue
+        total += fn(nbytes, max(int(world_size), 1))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the registered pass
+# ---------------------------------------------------------------------------
+
+# a toy trace's AI is meaningless — only call a step memory-bound when it
+# does real work
+_PTCS002_FLOPS_FLOOR = 1e7
+_PTCS001_COMM_FLOOR = 1 << 20  # 1 MiB on the wire
+
+
+@register_pass("cost", order=60)
+def cost_pass(ctx):
+    ledger = ctx.ledgers.get(0) or []
+    if ctx.jaxpr is None and not ledger:
+        return []
+    from ...observability.instrument import chip_specs
+    chip = getattr(ctx, "chip", None) or chip_specs()
+    axis_sizes = dict(getattr(ctx, "axis_sizes", None) or {})
+    s = CostSummary()
+    if ctx.jaxpr is not None:
+        divs = list(getattr(ctx, "in_divisors", None) or [])
+        jaxpr = ctx.jaxpr.jaxpr
+        divs += [1] * (len(jaxpr.invars) - len(divs))
+        _JaxprCoster(s, axis_sizes).walk(jaxpr, divs)
+    s.comm_bytes += eager_collective_cost(ledger, ctx.world_size)
+    s.finalize(chip)
+    ctx.cost_summary = s
+
+    out = []
+    if (s.bound == "comm" and s.comm_bytes >= _PTCS001_COMM_FLOOR
+            and s.comm_ms > 0):
+        out.append(Diagnostic(
+            "PTCS001", "cost", "warning",
+            f"comm-bound step: predicted interconnect time "
+            f"{s.comm_ms:.3f} ms exceeds compute ({s.compute_ms:.3f} ms) "
+            f"and HBM ({s.hbm_ms:.3f} ms) on {chip.get('name')} — "
+            f"{s.comm_bytes / 2 ** 20:.1f} MiB/device on the wire per "
+            f"step (ring model); re-shard to cut collective payloads or "
+            f"overlap them with compute",
+            extra={"cost": s.as_dict()}))
+    elif (s.flops >= _PTCS002_FLOPS_FLOOR and s.hbm_bytes > 0
+            and s.bound == "memory" and s.arithmetic_intensity < s.ridge):
+        out.append(Diagnostic(
+            "PTCS002", "cost", "info",
+            f"low arithmetic intensity: "
+            f"{s.arithmetic_intensity:.1f} FLOPs/HBM-byte vs the "
+            f"{chip.get('name')} ridge point {s.ridge:.0f} — the step is "
+            f"memory-bound at {s.predicted_mfu:.1%} predicted MFU; fuse "
+            f"elementwise chains, grow the batch, or store in bf16",
+            extra={"cost": s.as_dict()}))
+    return out

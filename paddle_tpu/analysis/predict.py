@@ -1,0 +1,182 @@
+"""Artifact-backed predictions from the static cost & memory model.
+
+The bridge between the pass-level estimators (:mod:`.passes.cost`,
+:mod:`.passes.memory`) and the evidence tooling: ``bench.py`` emits
+``*_predicted`` rows from here when a TPU config can't run (so a round
+without a TPU still produces numbers instead of only ``*_SKIPPED``
+lines), and ``tools/mem_probe.py --compare-static`` prints the
+predicted-vs-XLA peak-memory comparison that keeps the estimator honest.
+
+Everything is abstract: a 13B-scale prediction needs a virtual mesh and
+a trace, never a compile or 52 GB of host RAM.
+"""
+from __future__ import annotations
+
+from .passes.cost import estimate_jaxpr_cost
+from .passes.memory import estimate_jaxpr_peak
+
+
+def predict_hybrid_step(step, batch, seq, chip=None):
+    """Predict one ``GPTHybridTrainStep`` training step on ``chip``
+    (device-kind string, e.g. ``"v5e"``; None = attached device).
+
+    Returns ``{"cost": CostSummary, "memory": MemoryEstimate}`` — the
+    per-device roofline step time / MFU and the liveness peak-HBM
+    estimate, sharded exactly as the step's own in_shardings shard."""
+    from ..observability.instrument import chip_specs
+    jaxpr = step.step_jaxpr(batch, seq)
+    in_divs, donated = step.step_arg_divisors()
+    axis_sizes = {k: int(v) for k, v in dict(step.mesh.shape).items()}
+    cost = estimate_jaxpr_cost(jaxpr, in_divisors=in_divs,
+                               axis_sizes=axis_sizes,
+                               chip=chip_specs(chip))
+    mem = estimate_jaxpr_peak(jaxpr, in_divisors=in_divs, donated=donated)
+    return {"cost": cost, "memory": mem}
+
+
+def predicted_row(step, batch, seq, chip="v5e", flops_per_token=None):
+    """One flat dict for a ``*_predicted`` bench artifact row.
+
+    ``predicted_mfu`` divides the *model* FLOPs/token (the same
+    ``model_flops_per_token`` helper measured rows use — recompute
+    excluded) by the roofline step time, so predicted and measured MFU
+    are directly comparable. Throughput and MFU are per chip: global
+    tokens divide over the step's mesh size."""
+    pred = predict_hybrid_step(step, batch, seq, chip=chip)
+    cost, mem = pred["cost"], pred["memory"]
+    step_s = cost.step_ms / 1e3
+    tokens = batch * seq
+    n_dev = max(int(getattr(step.mesh.devices, "size", 1)), 1)
+    row = {
+        "predicted_step_ms": round(cost.step_ms, 3),
+        "predicted_tokens_per_sec_per_chip": round(
+            tokens / step_s / n_dev, 1),
+        "predicted_peak_hbm_mb": round(mem.peak_bytes / 2 ** 20, 1),
+        "predicted_bound": cost.bound,
+        "chip_assumed": cost.chip.get("name"),
+        "batch": batch, "seq": seq, "n_devices": n_dev,
+        "comm_mb_per_chip": round(cost.comm_bytes / 2 ** 20, 2),
+    }
+    if flops_per_token:
+        row["predicted_mfu"] = round(
+            (tokens / step_s) * flops_per_token
+            / (cost.chip["peak_flops"] * n_dev), 4)
+    else:
+        row["predicted_mfu"] = round(cost.predicted_mfu, 4)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# bench-parity CLI: `python -m paddle_tpu.analysis.predict`
+# ---------------------------------------------------------------------------
+
+# The exact (mesh, batch, seq, remat, dtype) combos bench.py runs on the
+# real chip, so a predicted row stands in for the measured row a
+# TPU-less round skips. 345m/1.3b are the single-chip headline configs;
+# 13b is the mp=4 x pp=4 compile-probe config.
+BENCH_CONFIGS = {
+    "345m": dict(mesh=dict(dp=1, mp=1, pp=1), batch=12, seq=1024,
+                 n_micro=1, remat="dots",
+                 cfg_kw=dict(max_position_embeddings=1024, num_heads=8),
+                 step_kw={}),
+    "1.3b": dict(mesh=dict(dp=1, mp=1, pp=1), batch=6, seq=2048,
+                 n_micro=1, remat=True, cfg_kw={},
+                 step_kw=dict(param_dtype="bfloat16",
+                              moment_dtype="bfloat16")),
+    "13b": dict(mesh=dict(dp=1, mp=4, pp=4), batch=16, seq=2048,
+                n_micro=16, remat=True, cfg_kw={},
+                step_kw=dict(pipeline_schedule="1f1b",
+                             param_dtype="bfloat16",
+                             moment_dtype="bfloat16")),
+}
+
+
+def predict_bench_config(name, chip="v5e"):
+    """Trace bench config ``name`` on the current (virtual) mesh and
+    return its ``*_predicted`` row. Trace only — no compile, no buffers:
+    13B traces in seconds on any host."""
+    from ..distributed import mesh as mesh_mod
+    from ..distributed.mesh import HybridCommunicateGroup
+    from ..models.gpt import (GPTHybridTrainStep, gpt_13b_config,
+                              gpt_1p3b_config, gpt_345m_config,
+                              model_flops_per_token)
+    spec = BENCH_CONFIGS[name]
+    cfg_fn = {"345m": gpt_345m_config, "1.3b": gpt_1p3b_config,
+              "13b": gpt_13b_config}[name]
+    cfg = cfg_fn(**spec["cfg_kw"])
+    saved = (mesh_mod._global_mesh, mesh_mod._hcg)
+    try:
+        mesh_mod._global_mesh, mesh_mod._hcg = None, None
+        hcg = HybridCommunicateGroup(dp_degree=spec["mesh"]["dp"],
+                                     mp_degree=spec["mesh"]["mp"],
+                                     pp_degree=spec["mesh"]["pp"])
+        step = GPTHybridTrainStep.abstract(
+            cfg, hcg, n_micro=spec["n_micro"], remat=spec["remat"],
+            compute_dtype="bfloat16", **spec["step_kw"])
+        batch, seq = spec["batch"], spec["seq"]
+        fpt, n_params = model_flops_per_token(cfg, seq)
+        row = predicted_row(step, batch, seq, chip=chip,
+                            flops_per_token=fpt)
+    finally:
+        # the virtual mesh must not leak into the caller's process-wide
+        # global-mesh/hcg state (in-process bench/test callers)
+        mesh_mod._global_mesh, mesh_mod._hcg = saved
+    row.update(config=name, n_params=n_params,
+               remat=str(spec["remat"]),
+               mesh="x".join(f"{k}{v}" for k, v in spec["mesh"].items()))
+    return row
+
+
+def _main(argv=None):
+    import argparse
+    import json
+    import os
+    import subprocess
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="static cost/memory predictions for the bench "
+                    "configs; one JSON line each (trace-only, any host)")
+    ap.add_argument("--configs", default="345m,1.3b,13b",
+                    help="comma list from {345m,1.3b,13b}")
+    ap.add_argument("--chip", default="v5e")
+    args = ap.parse_args(argv)
+    names = [n for n in args.configs.split(",") if n]
+
+    # default keeps unknown names (typos) on the per-config error-row
+    # path below instead of a bare ValueError before any JSON is printed
+    need = max((spec["mesh"]["dp"] * spec["mesh"]["mp"]
+                * spec["mesh"]["pp"]
+                for n, spec in BENCH_CONFIGS.items() if n in names),
+               default=1)
+    if not os.environ.get("_PREDICT_RESPAWNED"):
+        # virtual CPU mesh: the device count must be forced before the
+        # backend exists, and the real TPU must never be touched
+        env = dict(os.environ)
+        env.update({
+            "_PREDICT_RESPAWNED": "1",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                          + f" --xla_force_host_platform_device_count="
+                            f"{need}").strip(),
+        })
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis.predict"]
+            + (argv if argv is not None else sys.argv[1:]),
+            env=env).returncode
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rc = 0
+    for name in names:
+        try:
+            row = predict_bench_config(name, chip=args.chip)
+        except Exception as e:  # one bad config must not eat the rest
+            row, rc = {"config": name, "error": repr(e)[:300]}, 1
+        print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -132,6 +132,15 @@ class AnalysisContext:
     static_function: object = None  # jit.api.StaticFunction target
     world_size: int = 1
     trace_error: str | None = None
+    # --- cost / memory / donation model inputs & outputs ---
+    in_divisors: list = field(default_factory=list)  # per-invar device split
+    donated_invars: list = field(default_factory=list)  # per-invar donation
+    axis_sizes: dict = field(default_factory=dict)   # mesh axis -> size
+    chip: dict | None = None        # roofline constants override
+    hbm_budget_bytes: float | None = None   # PTMM001 gate
+    train_step: object = None       # fleet train-step target (donation pass)
+    cost_summary: object = None     # set by the cost pass
+    memory_estimate: object = None  # set by the memory pass
 
 
 def _describe_arg(a):

@@ -79,12 +79,14 @@ def _get_group(group) -> Group:
     global _default_group
     if group is not None:
         return group
-    if _default_group is None:
-        mesh = get_global_mesh()
-        if mesh is None:
-            from .mesh import build_mesh, set_global_mesh
-            mesh = build_mesh(dp=len(jax.devices()))
-            set_global_mesh(mesh)
+    mesh = get_global_mesh()
+    if mesh is None:
+        from .mesh import build_mesh, set_global_mesh
+        mesh = build_mesh(dp=len(jax.devices()))
+        set_global_mesh(mesh)
+    # a cached default built against a replaced global mesh (virtual-mesh
+    # tooling, re-init) would silently pin stale ranks — rebuild instead
+    if _default_group is None or _default_group.mesh is not mesh:
         _default_group = Group("dp", mesh)
     return _default_group
 

@@ -69,7 +69,7 @@ class ParallelTrainStep:
 
     def __init__(self, model, optimizer, loss_fn, hcg=None, zero_stage=1,
                  batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES,
-                 scaler=None, validate=False):
+                 scaler=None, validate=False, donate=True):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn  # loss_fn(model, *batch_tensors) -> scalar Tensor
@@ -100,6 +100,10 @@ class ParallelTrainStep:
         # the report lands in self.last_validation + runlog events
         self.validate = bool(validate)
         self.last_validation = None
+        # donate=False is a debugging escape hatch (keeps pre-step buffers
+        # readable at double the HBM); the donation sanitizer flags it on
+        # the hot path (PTBD003) when validate=True
+        self.donate = bool(donate)
         # opt-in resilient checkpointing (distributed/checkpoint): when a
         # manager is attached, every interval-th step snapshots train state
         # to host and persists it asynchronously
@@ -272,7 +276,7 @@ class ParallelTrainStep:
             self._pure_step,
             in_shardings=in_shardings,
             out_shardings=out_shardings,
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1) if self.donate else (),
         )
         # place params/state on the mesh with their shardings
         for p, spec in zip(self._params, p_specs):

@@ -100,6 +100,22 @@ def gpt_13b_config(**kw):
                      max_position_embeddings=2048), kw)
 
 
+def model_flops_per_token(cfg, seq_len):
+    """Standard 6N + attention estimate (FLOPs/token, fwd+bwd).
+
+    N counts the matmul params: qkv (3H^2) + out (H^2) + mlp (2*H*F) per
+    layer plus the (tied) head V*H and position table. Shared by bench.py
+    measured rows and the static cost model's ``*_predicted`` rows, so
+    measured and predicted MFU divide by the same model FLOPs.
+    """
+    H, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    per_layer = 4 * H * H + 2 * H * cfg.intermediate_size
+    n_params = V * H + cfg.max_position_embeddings * H + L * per_layer
+    matmul_flops = 6 * n_params  # fwd 2N + bwd 4N
+    attn_flops = 12 * L * H * seq_len  # qk^T + av, fwd+bwd
+    return matmul_flops + attn_flops, n_params
+
+
 # ---------------------------------------------------------------------------
 # the functional decoder block — single source of truth for both paths
 # ---------------------------------------------------------------------------
@@ -1046,6 +1062,7 @@ class GPTHybridTrainStep:
             new_v = jax.tree.map(lambda o: o[2], out, is_leaf=is_upd)
             return loss, new_params, {"m": new_m, "v": new_v}
 
+        self._step_fn = step  # uncompiled: the static cost model traces it
         self._compiled = jax.jit(
             step,
             in_shardings=(p_sh, {"m": s_sh, "v": s_sh}, data_sh, data_sh,
@@ -1053,6 +1070,42 @@ class GPTHybridTrainStep:
             out_shardings=(ns(P()), p_sh, {"m": s_sh, "v": s_sh}),
             donate_argnums=(0, 1),
         )
+
+    # ------------------------------------------------------------------
+    def step_jaxpr(self, batch, seq):
+        """Abstract jaxpr of the full train step (forward + backward +
+        AdamW) for the static cost/memory model — tracing only: no
+        lowering, no XLA compile, works on abstract() steps."""
+        if self._compiled is None:
+            self._build()
+        ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+        f32 = lambda: jax.ShapeDtypeStruct((), jnp.float32)
+        return jax.make_jaxpr(self._step_fn)(
+            self.params, self.opt_state, ids, ids, f32(), f32())
+
+    def step_arg_divisors(self):
+        """(in_divisors, donated) aligned with :meth:`step_jaxpr`'s
+        flattened invars: device-partition counts from the same
+        PartitionSpecs ``_build`` passes to jit, donation mirroring its
+        ``donate_argnums=(0, 1)``."""
+        from ..analysis.passes.cost import spec_divisor
+        mesh_shape = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+
+        def flat_specs(tree, specs):
+            return jax.tree.structure(tree).flatten_up_to(specs)
+
+        p_divs = [spec_divisor(s, mesh_shape)
+                  for s in flat_specs(self.params, self.param_specs)]
+        s_divs = [spec_divisor(s, mesh_shape)
+                  for s in flat_specs(self.opt_state["m"],
+                                      self.state_specs)]
+        data_div = (mesh_shape.get("dp", 1)
+                    * mesh_shape.get("sharding", 1))
+        in_divisors = (p_divs + s_divs + s_divs
+                       + [data_div, data_div, 1, 1])
+        donated = ([True] * (len(p_divs) + 2 * len(s_divs))
+                   + [False] * 4)
+        return in_divisors, donated
 
     # ------------------------------------------------------------------
     def __call__(self, input_ids, labels):

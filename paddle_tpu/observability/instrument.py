@@ -32,6 +32,9 @@ paddle_checkpoint_bytes_total         counter    mode
 paddle_checkpoint_in_flight           gauge      —
 paddle_checkpoint_restores_total      counter    result={ok,fallback,corrupt}
 paddle_store_retries_total            counter    op
+paddle_analysis_predicted_step_ms     gauge      target
+paddle_analysis_predicted_peak_hbm_mb gauge      target
+paddle_analysis_predicted_mfu         gauge      target
 ====================================  =========  =============================
 
 Everything here must stay off the device critical path: increments are a
@@ -168,6 +171,36 @@ def store_retries_counter():
         "TCPStore client ops retried on transient socket errors")
 
 
+def predicted_step_ms_gauge():
+    return get_registry().gauge(
+        "paddle_analysis_predicted_step_ms",
+        "static-cost-model roofline step time prediction")
+
+
+def predicted_peak_hbm_gauge():
+    return get_registry().gauge(
+        "paddle_analysis_predicted_peak_hbm_mb",
+        "static liveness-model peak HBM prediction")
+
+
+def predicted_mfu_gauge():
+    return get_registry().gauge(
+        "paddle_analysis_predicted_mfu",
+        "static-cost-model MFU prediction vs chip peak")
+
+
+def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,
+                     target="step"):
+    """Publish static-analysis predictions (cost/memory passes) as
+    gauges, so dashboards can chart predicted-vs-measured drift."""
+    if step_ms is not None:
+        predicted_step_ms_gauge().set(float(step_ms), target=target)
+    if peak_hbm_mb is not None:
+        predicted_peak_hbm_gauge().set(float(peak_hbm_mb), target=target)
+    if mfu is not None:
+        predicted_mfu_gauge().set(float(mfu), target=target)
+
+
 # ---------------------------------------------------------------- recorders
 
 _FLUSH_INTERVAL_S = 5.0
@@ -267,22 +300,44 @@ def sample_device_memory(chrome_counter: bool = True) -> dict | None:
     return stats
 
 
+# Chip roofline table (public TPU spec sheets, bf16 peak / HBM / ICI).
+# ``ici_bw`` is the per-chip aggregate interconnect bandwidth the ring
+# collective model divides wire bytes by; ``hbm_gb`` is the per-chip
+# capacity the OOM-before-compile gate defaults to. The cpu row is
+# nominal — it only keeps smoke-run ratios finite, never a baseline.
+CHIP_SPECS = {
+    "v4":  dict(peak_flops=275e12, hbm_bw=1228e9, ici_bw=268e9, hbm_gb=32),
+    "v5p": dict(peak_flops=459e12, hbm_bw=2765e9, ici_bw=540e9, hbm_gb=95),
+    "v5e": dict(peak_flops=197e12, hbm_bw=819e9, ici_bw=186e9, hbm_gb=16),
+    "v5 lite": dict(peak_flops=197e12, hbm_bw=819e9, ici_bw=186e9,
+                    hbm_gb=16),
+    "v6e": dict(peak_flops=918e12, hbm_bw=1640e9, ici_bw=367e9, hbm_gb=32),
+    "v6":  dict(peak_flops=918e12, hbm_bw=1640e9, ici_bw=367e9, hbm_gb=32),
+    "cpu": dict(peak_flops=1e12, hbm_bw=50e9, ici_bw=10e9, hbm_gb=8),
+}
+_DEFAULT_CHIP = "v5p"
+
+
+def chip_specs(kind: str | None = None) -> dict:
+    """Roofline constants for ``kind`` (or the attached device when None):
+    ``{name, peak_flops, hbm_bw, ici_bw, hbm_gb}``. Shared by the MFU
+    gauge, bench.py, and the static cost model, so predicted and measured
+    MFU always divide by the same peak."""
+    if kind is None:
+        import jax
+        d = jax.devices()[0]
+        kind = getattr(d, "device_kind", "") or d.platform
+    kind_l = str(kind).lower()
+    for k, spec in CHIP_SPECS.items():
+        if k in kind_l:
+            return dict(spec, name=k)
+    return dict(CHIP_SPECS[_DEFAULT_CHIP], name=_DEFAULT_CHIP)
+
+
 def peak_flops_per_chip() -> float:
     """bf16 peak for the attached chip; conservative v5p default (the
     table bench.py historically carried, now shared)."""
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    table = {
-        "v5p": 459e12, "v5 lite": 197e12, "v5e": 197e12,
-        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    if d.platform == "cpu":
-        return 1e12  # nominal, keeps MFU finite in CPU smoke runs
-    return 459e12
+    return chip_specs()["peak_flops"]
 
 
 class timed:

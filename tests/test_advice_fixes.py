@@ -491,3 +491,34 @@ def test_bootstrap_guard_blocks_child_processes():
          "print('ok')"],
         env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0 and "ok" in r.stdout, r.stderr[-2000:]
+
+
+def test_graph_table_per_shard_seeds_decorrelate():
+    """ADVICE r5: create_graph_table must fold the shard index into each
+    server's seed — identical streams across shards would correlate the
+    per-shard draws a sampled batch merges."""
+    from paddle_tpu.distributed.ps.service import PsRpcClient
+
+    class _RecordingRpc:
+        def __init__(self):
+            self.calls = []
+
+        def rpc_sync(self, server, fn, args=()):
+            self.calls.append((server, args))
+
+    client = PsRpcClient.__new__(PsRpcClient)
+    client._rpc = _RecordingRpc()
+    client.servers = ["ps0", "ps1", "ps2"]
+    client._kinds = {}
+
+    client.create_graph_table(7, seed=3)
+    seeds = [kw["seed"] for (_, (_tid, kw)) in client._rpc.calls]
+    assert len(seeds) == 3
+    assert len(set(seeds)) == 3, seeds          # pairwise distinct
+    assert seeds == [3, 4, 5]                   # base_seed + shard index
+
+    # the default seed=0 fan-out decorrelates too (the reported case)
+    client._rpc.calls.clear()
+    client.create_graph_table(8)
+    seeds = [kw["seed"] for (_, (_tid, kw)) in client._rpc.calls]
+    assert len(set(seeds)) == 3, seeds

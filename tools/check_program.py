@@ -2,11 +2,17 @@
 """Static program check: lint models/train steps before they hit XLA.
 
 Runs the :mod:`paddle_tpu.analysis` pass suite (recompile hazards, host
-syncs, collective-schedule consistency, AMP cast audit, dead code) over
-the built-in model zoo — each model is linted TWICE: the eager train-step
-closure (abstract tape trace → jaxpr passes) and the recorded
-``static.Program`` DAG (deadcode + AMP node audit). No device execution:
-tiny configs, abstract shapes only.
+syncs, collective-schedule consistency, AMP cast audit, dead code, the
+cost/roofline model, the liveness peak-HBM estimator, and the
+buffer-donation sanitizer) over the built-in model zoo — each model is
+linted TWICE: the eager train-step closure (abstract tape trace → jaxpr
+passes) and the recorded ``static.Program`` DAG (deadcode + AMP node
+audit). No device execution: tiny configs, abstract shapes only.
+
+``--hbm-budget-gb`` (default 16, the chip) arms the PTMM001
+OOM-before-compile gate: a model whose predicted peak HBM exceeds the
+budget — or any PTBD001 use-after-donate — fails the gate even under
+``--errors-only``.
 
 Usage::
 
@@ -44,7 +50,7 @@ def _force_platform():
 # model-zoo targets (tiny configs — the lint is abstract, keep builds fast)
 # ---------------------------------------------------------------------------
 
-def _lint_static(build, name, world_size=None):
+def _lint_static(build, name, world_size=None, hbm_budget_gb=None):
     """Record ``build()`` into a fresh Program (with per-node source
     sites) and run the DAG passes over it."""
     from paddle_tpu import static
@@ -55,13 +61,14 @@ def _lint_static(build, name, world_size=None):
         prog._capture_sites = True
         with static.program_guard(prog):
             fetches = build()
-        return ProgramAnalyzer(world_size=world_size).analyze(
+        return ProgramAnalyzer(
+            world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
             prog, fetch_list=list(fetches), name=name)
     finally:
         static.disable_static()
 
 
-def lint_gpt(world_size=None):
+def lint_gpt(world_size=None, hbm_budget_gb=None):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -76,7 +83,8 @@ def lint_gpt(world_size=None):
     crit = GPTPretrainingCriterion()
     B, S = 2, 16
     ids = jax.ShapeDtypeStruct((B, S), jnp.int32)
-    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+    reports = [ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
         lambda i, l: crit(model(i), l), ids, ids, name="gpt.train_step")]
 
     def build():
@@ -85,11 +93,12 @@ def lint_gpt(world_size=None):
         loss = crit(model(fids), labels)
         return [loss]
 
-    reports.append(_lint_static(build, "gpt.program", world_size))
+    reports.append(_lint_static(build, "gpt.program", world_size,
+                                hbm_budget_gb))
     return reports
 
 
-def lint_bert(world_size=None):
+def lint_bert(world_size=None, hbm_budget_gb=None):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -101,7 +110,8 @@ def lint_bert(world_size=None):
     model = BertForPretraining(BertModel(bert_tiny_config()))
     B, S = 2, 16
     ids = jax.ShapeDtypeStruct((B, S), jnp.int64)
-    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+    reports = [ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
         lambda i, l: model.forward_with_mlm_loss(i, l), ids, ids,
         name="bert.train_step")]
 
@@ -110,11 +120,12 @@ def lint_bert(world_size=None):
         labels = static.data("labels", [B, S], "int64")
         return [model.forward_with_mlm_loss(fids, labels)]
 
-    reports.append(_lint_static(build, "bert.program", world_size))
+    reports.append(_lint_static(build, "bert.program", world_size,
+                                hbm_budget_gb))
     return reports
 
 
-def lint_ernie_moe(world_size=None):
+def lint_ernie_moe(world_size=None, hbm_budget_gb=None):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -127,7 +138,8 @@ def lint_ernie_moe(world_size=None):
         ErnieMoeModel(ernie_moe_tiny_config(num_hidden_layers=2)))
     B, S = 2, 16
     ids = jax.ShapeDtypeStruct((B, S), jnp.int64)
-    reports = [ProgramAnalyzer(world_size=world_size).analyze(
+    reports = [ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
         lambda i, l: model.forward_with_mlm_loss(i, l), ids, ids,
         name="ernie_moe.train_step")]
 
@@ -136,16 +148,17 @@ def lint_ernie_moe(world_size=None):
         labels = static.data("labels", [B, S], "int64")
         return [model.forward_with_mlm_loss(fids, labels)]
 
-    reports.append(_lint_static(build, "ernie_moe.program", world_size))
+    reports.append(_lint_static(build, "ernie_moe.program",
+                                world_size, hbm_budget_gb))
     return reports
 
 
 MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe}
 
 
-def lint_model(name, world_size=None):
+def lint_model(name, world_size=None, hbm_budget_gb=None):
     """Lint one built-in model; returns [Report, ...] (eager + static)."""
-    return MODELS[name](world_size=world_size)
+    return MODELS[name](world_size=world_size, hbm_budget_gb=hbm_budget_gb)
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +172,10 @@ def main(argv=None):
     ap.add_argument("--world-size", type=int, default=None,
                     help="simulated ranks for the collective pass "
                          "(default: env world size, min 2)")
+    ap.add_argument("--hbm-budget-gb", type=float, default=16.0,
+                    help="per-chip HBM budget for the PTMM001 "
+                         "OOM-before-compile gate (default 16, the chip; "
+                         "0 disables)")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line per report")
     ap.add_argument("--errors-only", action="store_true",
@@ -170,7 +187,8 @@ def main(argv=None):
     names = sorted(MODELS) if args.model == "all" else [args.model]
     reports = []
     for n in names:
-        reports.extend(lint_model(n, world_size=args.world_size))
+        reports.extend(lint_model(n, world_size=args.world_size,
+                                  hbm_budget_gb=args.hbm_budget_gb or None))
 
     failed = False
     for rep in reports:

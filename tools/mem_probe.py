@@ -63,7 +63,7 @@ def _maybe_respawn(args):
 
 def probe_one(cfg, hcg, schedule, n_micro, remat, vpp, batch, seq,
               compute_dtype="bfloat16", param_dtype=None,
-              moment_dtype=None):
+              moment_dtype=None, compare_static=False):
     from paddle_tpu.models.gpt import GPTHybridTrainStep
 
     step = GPTHybridTrainStep.abstract(
@@ -88,6 +88,18 @@ def probe_one(cfg, hcg, schedule, n_micro, remat, vpp, batch, seq,
         "peak_hbm_gb": round((ma.argument_size_in_bytes
                               + ma.temp_size_in_bytes) / gb, 4),
     }
+    if compare_static:
+        # predicted-vs-XLA cross-check: the liveness estimator walks the
+        # SAME step's jaxpr (trace only, no second compile) and the
+        # relative error column keeps it honest in CI
+        from paddle_tpu.analysis.predict import predict_hybrid_step
+        pred = predict_hybrid_step(step, batch, seq)
+        p = pred["memory"].peak_bytes
+        x = ma.argument_size_in_bytes + ma.temp_size_in_bytes
+        rec["predicted_peak_gb"] = round(p / gb, 4)
+        rec["predicted_temp_gb"] = round(
+            pred["memory"].temp_peak_bytes / gb, 4)
+        rec["rel_err"] = round((p - x) / x, 4) if x else 0.0
     return rec
 
 
@@ -108,6 +120,15 @@ def main():
     ap.add_argument("--vpp", type=int, default=2)
     ap.add_argument("--param-dtype", default=None)
     ap.add_argument("--moment-dtype", default=None)
+    ap.add_argument("--compute-dtype", default="bfloat16",
+                    help="activation/compute dtype; use float32 for a "
+                         "like-for-like --compare-static run (XLA's CPU "
+                         "backend pads bf16 programs with f32 conversion "
+                         "buffers a TPU never allocates)")
+    ap.add_argument("--compare-static", action="store_true",
+                    help="also run the static liveness peak-HBM "
+                         "estimator (paddle_tpu.analysis) per combo and "
+                         "print predicted_peak_gb + rel_err columns")
     args = ap.parse_args()
 
     rc = _maybe_respawn(args)
@@ -154,8 +175,10 @@ def main():
                 try:
                     rec = probe_one(cfg, hcg, schedule, n_micro, remat,
                                     args.vpp, batch, seq,
+                                    compute_dtype=args.compute_dtype,
                                     param_dtype=args.param_dtype,
-                                    moment_dtype=args.moment_dtype)
+                                    moment_dtype=args.moment_dtype,
+                                    compare_static=args.compare_static)
                 except Exception as e:
                     rec = {"schedule": schedule, "n_micro": n_micro,
                            "remat": str(remat), "error": repr(e)[:200]}
