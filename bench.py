@@ -307,26 +307,39 @@ class _StepTelemetry:
         # (live-array high-water mark resets; allocator peaks are runtime-
         # owned and process-lifetime — on TPU the number is an upper bound)
         device.reset_max_memory_allocated()
-        self._compile_s0, self._coll_bytes0 = self._cums()
+        self._compile_s0, self._coll_bytes0, self._anomalies0, \
+            self._skips0 = self._cums()
 
     @staticmethod
     def _cums():
         from paddle_tpu.observability import get_registry
-        compile_s = coll = 0.0
+        compile_s = coll = anomalies = skips = 0.0
         for rec in get_registry().snapshot():
             if rec["name"] == "paddle_jit_compile_seconds_total":
                 compile_s += rec.get("value", 0.0)
             elif rec["name"] == "paddle_collective_bytes_total":
                 coll += rec.get("value", 0.0)
-        return compile_s, coll
+            elif rec["name"] == "paddle_anomalies_total":
+                anomalies += rec.get("value", 0.0)
+            elif rec["name"] == "paddle_loss_scale_skips_total":
+                skips += rec.get("value", 0.0)
+        return compile_s, coll, anomalies, skips
 
     def extras(self, step_times=None, wall_s=None):
         from paddle_tpu import device
-        compile_s1, coll1 = self._cums()
+        from paddle_tpu.observability.doctor import quick_verdict
+        compile_s1, coll1, anomalies1, skips1 = self._cums()
+        compile_s = compile_s1 - self._compile_s0
         out = {
             "peak_mem_mb": round(device.max_memory_allocated() / 2 ** 20, 1),
-            "compile_s": round(compile_s1 - self._compile_s0, 2),
+            "compile_s": round(compile_s, 2),
             "collective_bytes": int(coll1 - self._coll_bytes0),
+            # the doctor's compact self-diagnosis: a failed round's
+            # artifact says compile-dominated/jittery/anomalous by itself
+            "doctor": quick_verdict(
+                step_times, compile_s=compile_s,
+                anomalies=int(anomalies1 - self._anomalies0),
+                skips=int(skips1 - self._skips0), wall_s=wall_s),
         }
         if step_times:
             st = sorted(step_times)
@@ -729,18 +742,20 @@ def bench_serving(args):
         np.asarray(out.numpy()[0, -1])  # host readback = true barrier
         return (time.perf_counter() - t0) / reps
 
+    telemetry = _StepTelemetry()
     reps = 3
     t_prefill = timed(1, reps)          # prefill + 1 sampled token
     t_full = timed(max_new, reps)       # prefill + max_new tokens
     decode_ms = 1e3 * (t_full - t_prefill) / max(max_new - 1, 1)
     prefill_tps = B * S_prompt / t_prefill
+    tele = telemetry.extras()  # no step loop: doctor sees compile/anomalies
     emit("gpt_345m_prefill_tokens_per_sec_per_chip", prefill_tps,
          "tokens/s/chip",
          {"batch": B, "prompt_len": S_prompt, "ragged": S_prompt % 128 != 0,
-          "reps": reps})
+          "reps": reps, **tele})
     emit("gpt_345m_decode_ms_per_token", decode_ms, "ms/token",
          {"batch": B, "prompt_len": S_prompt, "max_new": max_new,
-          "note": "lower is better; vs_baseline>1 means SLOWER"})
+          "note": "lower is better; vs_baseline>1 means SLOWER", **tele})
 
 
 def bench_gpt_13b_stage_proxy(args):
@@ -818,12 +833,16 @@ def bench_gpt_13b_stage_proxy(args):
     x = jnp.asarray(rng.standard_normal((mb, S, H)).astype(np.float32), bf)
     cot = jnp.ones((mb, S, H), bf)
 
+    telemetry = _StepTelemetry()
     y, blocks, moments = tick(blocks, moments, x, cot)  # compile
     np.asarray(y[0, 0, 0])
     steps = args.steps
+    step_times = []
     t0 = time.perf_counter()
     for _ in range(steps):
+        t1 = time.perf_counter()
         y, blocks, moments = tick(blocks, moments, x, cot)
+        step_times.append(time.perf_counter() - t1)
     np.asarray(y[0, 0, 0])
     dt = time.perf_counter() - t0
 
@@ -840,7 +859,8 @@ def bench_gpt_13b_stage_proxy(args):
           "mesh": "mp4 x pp4 slice", "layers_per_stage": L_stage,
           "micro_batch": mb, "seq": S, "steps": steps,
           "remat": "full", "dtype": "bf16 params+moments",
-          "excludes": "CE head + inter-chip collectives (mid-stage)"})
+          "excludes": "CE head + inter-chip collectives (mid-stage)",
+          **telemetry.extras(step_times, wall_s=dt)})
 
 
 def bench_gpt_13b_compile(args):

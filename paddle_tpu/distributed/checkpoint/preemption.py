@@ -75,6 +75,14 @@ class PreemptionHandler:
         if logger is not None:
             logger.log("preemption_signal", signum=int(signum))
         try:
+            # black box first: the flight ring's recent step records must
+            # survive even if the emergency save below fails (dump is
+            # atomic-rename and never raises)
+            from ...observability.flight import dump_on_preemption
+            dump_on_preemption()
+        except Exception:
+            pass
+        try:
             result = self.state_fn()
             state, step = result[0], result[1]
             partitions = result[2] if len(result) > 2 else None

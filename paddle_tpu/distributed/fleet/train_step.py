@@ -358,7 +358,14 @@ class ParallelTrainStep:
                 tokens=int(np.prod(np.shape(batch_vals[0])))
                 if batch else None,
                 flops_per_token=self.flops_per_token,
-                path=self.telemetry_path)
+                path=self.telemetry_path,
+                # loss stays a device scalar here: the flight recorder /
+                # anomaly monitor resolve it off the hot path
+                loss=loss,
+                found_inf=self.last_found_inf
+                if self.scaler is not None else None,
+                loss_scale=float(self.scaler._scale)
+                if self.scaler is not None else None)
         _obs.sample_device_memory()
         return Tensor(loss)
 

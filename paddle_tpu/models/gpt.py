@@ -1167,7 +1167,7 @@ class GPTHybridTrainStep:
             _obs.record_train_step(
                 _time.perf_counter() - t_step, tokens=int(ids.size),
                 flops_per_token=getattr(self, "flops_per_token", None),
-                path="gpt_hybrid")
+                path="gpt_hybrid", loss=loss)
         _obs.sample_device_memory()
         return Tensor(loss)
 

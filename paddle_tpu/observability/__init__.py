@@ -27,6 +27,23 @@ Static-analysis findings ride the same rails: :mod:`paddle_tpu.analysis`
 message, file, line, op}`` — into the active run directory, and counts
 them in ``paddle_analysis_diagnostics_total{pass,severity}``, so compile-
 time diagnostics appear next to the runtime telemetry they prevent.
+
+The telemetry is also *consumed* in-process (the perf-doctor stack):
+
+- :mod:`.flight` — an always-on ring buffer of recent per-step records
+  that dumps a black box (``flight.rank<k>.<reason>.json``) on anomaly,
+  unhandled exception (``sys.excepthook`` chain), and SIGTERM preemption
+  — a dead run always leaves evidence.
+- :mod:`.anomaly` — rolling robust-z / drift detectors over the step
+  stream (step-time spikes, loss spikes/NaN, MFU drift, memory creep)
+  emitting ``anomaly`` runlog events + ``paddle_anomalies_total{kind}``;
+  cross-rank, ``merge_run_dir`` runs a straggler pass that names the
+  slow rank/generation in ``run_summary.json``.
+- :mod:`.doctor` — predicted-vs-measured roofline reconciliation:
+  attributes the measured−predicted step-time gap across
+  compute/HBM/comm/compile/skips and ranks "why is this run slow"
+  findings (``tools/perf_doctor.py`` is the CLI; ``bench.py`` embeds
+  :func:`doctor.quick_verdict` in every artifact row).
 """
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry,
@@ -34,3 +51,6 @@ from .metrics import (  # noqa: F401
 )
 from .runlog import RunLogger, get_run_logger, merge_run_dir  # noqa: F401
 from .callback import TelemetryCallback  # noqa: F401
+from .flight import FlightRecorder, get_flight_recorder  # noqa: F401
+from .anomaly import StepAnomalyMonitor  # noqa: F401
+from .doctor import diagnose_run_dir, format_report  # noqa: F401

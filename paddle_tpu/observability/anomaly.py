@@ -1,0 +1,263 @@
+"""Online anomaly detection over the per-step telemetry stream.
+
+Rolling **robust-z** detectors (median / MAD — a loss spike must not
+inflate its own threshold the way a mean/stddev window would) watch the
+four quantities the train steps already emit:
+
+- ``step_time_spike`` — one step far above the rolling median
+- ``loss_spike`` / ``loss_nan`` — training-divergence early warning
+- ``mfu_drift``      — sustained throughput decay vs the run's baseline
+- ``memory_creep``   — device memory ratcheting upward (leaked buffers,
+  growing cache) long before the eventual OOM
+- ``loss_scale_thrash`` — AMP overflow burst: ≥4 found-inf skips inside
+  the last 16 steps (healthy dynamic scaling overflows ~once per growth
+  interval, not in runs)
+
+Every firing emits an ``anomaly`` runlog event (``{kind, path, value,
+zscore, step}``), increments ``paddle_anomalies_total{kind, path}``, and
+asks the :mod:`.flight` recorder for a (throttled) dump — so the black
+box is on disk *while the run is still alive*, not only after it dies.
+
+Wiring is central: ``instrument.record_train_step`` feeds the per-path
+monitor, so ``ParallelTrainStep`` (incl. the pipeline path),
+``GPTHybridTrainStep``, and the hapi ``TelemetryCallback`` are all
+covered without per-caller code. Loss values may arrive as device
+scalars; the monitor resolves them with ONE STEP OF LAG (step N's loss
+is read while step N+1 runs), so detection never blocks the dispatch
+pipeline. Set ``PADDLE_ANOMALY_DISABLE=1`` to turn the monitors off.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import os
+import statistics
+import threading
+
+_MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality
+
+
+def _robust_z(value, window):
+    """(value - median) / (scaled MAD), inf-guarded. None when the
+    window is too small to define a baseline."""
+    if len(window) < 2:
+        return None
+    xs = sorted(window)
+    med = statistics.median(xs)
+    mad = statistics.median(abs(x - med) for x in xs)
+    sigma = _MAD_SCALE * mad
+    if sigma <= 0:
+        # a perfectly flat window: any deviation is infinitely many MADs
+        return math.inf if value != med else 0.0
+    return (value - med) / sigma
+
+
+class RollingRobustZ:
+    """Spike detector: flags values whose robust z exceeds ``z_thresh``.
+
+    The window only absorbs NON-anomalous samples, so a burst of spikes
+    cannot talk the detector out of flagging its own tail."""
+
+    def __init__(self, window: int = 64, z_thresh: float = 8.0,
+                 min_samples: int = 8, direction: str = "high"):
+        self.window = collections.deque(maxlen=window)
+        self.z_thresh = float(z_thresh)
+        self.min_samples = int(min_samples)
+        self.direction = direction  # "high" | "low" | "both"
+
+    def observe(self, value: float):
+        """Returns the robust z-score when ``value`` is anomalous, else
+        None (and folds the value into the baseline window)."""
+        v = float(value)
+        z = _robust_z(v, self.window) \
+            if len(self.window) >= self.min_samples else None
+        anomalous = z is not None and (
+            (self.direction in ("high", "both") and z > self.z_thresh)
+            or (self.direction in ("low", "both") and z < -self.z_thresh))
+        if not anomalous:
+            self.window.append(v)
+            return None
+        return z
+
+
+class DriftDetector:
+    """Sustained-drift detector: compares the recent window's median to
+    the run's frozen baseline (median of the first ``baseline_n``
+    samples). Fires when the relative change exceeds ``rel_thresh`` in
+    ``direction`` — creep up (memory) or decay down (MFU)."""
+
+    def __init__(self, baseline_n: int = 16, recent_n: int = 16,
+                 rel_thresh: float = 0.2, direction: str = "up"):
+        self.baseline_n = int(baseline_n)
+        self.recent = collections.deque(maxlen=int(recent_n))
+        self.rel_thresh = float(rel_thresh)
+        self.direction = direction
+        self._baseline_samples = []
+        self._baseline = None
+
+    def observe(self, value: float):
+        """Returns the relative drift when beyond threshold, else None."""
+        v = float(value)
+        if self._baseline is None:
+            self._baseline_samples.append(v)
+            if len(self._baseline_samples) >= self.baseline_n:
+                self._baseline = statistics.median(self._baseline_samples)
+                self._baseline_samples = []
+            return None
+        self.recent.append(v)
+        if len(self.recent) < self.recent.maxlen or not self._baseline:
+            return None
+        drift = (statistics.median(self.recent) - self._baseline) \
+            / abs(self._baseline)
+        if self.direction == "up" and drift > self.rel_thresh:
+            return drift
+        if self.direction == "down" and drift < -self.rel_thresh:
+            return drift
+        return None
+
+
+class StepAnomalyMonitor:
+    """Per-telemetry-path composite monitor over the step stream."""
+
+    def __init__(self, path: str = "parallel", window: int = 64,
+                 z_thresh: float = 8.0, cooldown: int = 16,
+                 dump_on_anomaly: bool = True):
+        self.path = path
+        self.dump_on_anomaly = dump_on_anomaly
+        self.cooldown = int(cooldown)
+        self.step = 0
+        self._step_time = RollingRobustZ(window, z_thresh, direction="high")
+        self._loss = RollingRobustZ(window, z_thresh, direction="high")
+        self._mfu_drift = DriftDetector(direction="down", rel_thresh=0.2)
+        self._mem_creep = DriftDetector(direction="up", rel_thresh=0.15)
+        self._recent_inf = collections.deque(maxlen=16)
+        self._last_fired = {}      # kind -> step (cooldown bookkeeping)
+        self._pending_loss = None  # device scalar from the previous step
+        self._lock = threading.Lock()
+        self.anomalies = []        # recent firings (bounded)
+        self.last_dump_thread = None  # in-flight async flight dump
+
+    # ----------------------------------------------------------- internals
+    def _fire(self, kind, value, score):
+        rec = {"kind": kind, "path": self.path, "step": self.step,
+               "value": value,
+               "score": None if score is None
+               else round(float(score), 3) if math.isfinite(score)
+               else "inf"}
+        self.anomalies.append(rec)
+        del self.anomalies[:-64]
+        from .instrument import anomalies_counter
+        anomalies_counter().inc(kind=kind, path=self.path)
+        from .runlog import get_run_logger
+        logger = get_run_logger()
+        if logger is not None:
+            logger.log("anomaly", **rec)
+        from . import flight
+        recorder = flight.get_flight_recorder()
+        fl = dict(rec)
+        fl["anomaly_kind"] = fl.pop("kind")  # "kind" slot = record type
+        recorder.record("anomaly", **fl)
+        if self.dump_on_anomaly:
+            # off-thread: the dump resolves device scalars (incl. the
+            # just-dispatched step's loss) and must not stall this step
+            t = recorder.dump_async("anomaly")
+            if t is not None:
+                self.last_dump_thread = t
+        return rec
+
+    def _cooled(self, kind):
+        last = self._last_fired.get(kind)
+        if last is not None and self.step - last < self.cooldown:
+            return False
+        self._last_fired[kind] = self.step
+        return True
+
+    @staticmethod
+    def _to_float(v):
+        if v is None:
+            return None
+        try:
+            import numpy as np
+            return float(np.asarray(v).reshape(()))
+        except Exception:
+            return None
+
+    # -------------------------------------------------------------- observe
+    def observe(self, seconds, loss=None, mfu=None, memory_bytes=None,
+                found_inf=None):
+        """Feed one step; returns the list of anomalies fired (often
+        empty). ``loss`` may be a live device scalar — it is resolved on
+        the NEXT call (one step of lag) so this never blocks."""
+        with self._lock:
+            self.step += 1
+            fired = []
+            z = self._step_time.observe(float(seconds))
+            if z is not None and self._cooled("step_time_spike"):
+                fired.append(self._fire("step_time_spike",
+                                        round(float(seconds), 6), z))
+            # previous step's loss is complete by now: resolving it only
+            # waits for a step the device already had to finish
+            prev, self._pending_loss = self._pending_loss, loss
+            lv = self._to_float(prev)
+            if lv is not None:
+                if not math.isfinite(lv):
+                    if self._cooled("loss_nan"):
+                        fired.append(self._fire("loss_nan", repr(lv), None))
+                else:
+                    z = self._loss.observe(lv)
+                    if z is not None and self._cooled("loss_spike"):
+                        fired.append(self._fire("loss_spike",
+                                                round(lv, 6), z))
+            if mfu is not None and mfu > 0:
+                d = self._mfu_drift.observe(float(mfu))
+                if d is not None and self._cooled("mfu_drift"):
+                    fired.append(self._fire("mfu_drift",
+                                            round(float(mfu), 4), d))
+            if memory_bytes:
+                d = self._mem_creep.observe(float(memory_bytes))
+                if d is not None and self._cooled("memory_creep"):
+                    fired.append(self._fire("memory_creep",
+                                            int(memory_bytes), d))
+            if found_inf is not None:
+                self._recent_inf.append(bool(found_inf))
+                n_inf = sum(self._recent_inf)
+                if n_inf >= 4 and self._cooled("loss_scale_thrash"):
+                    fired.append(self._fire(
+                        "loss_scale_thrash", n_inf,
+                        n_inf / len(self._recent_inf)))
+            return fired
+
+    def flush(self):
+        """Resolve and check the final pending loss (end-of-run)."""
+        with self._lock:
+            prev, self._pending_loss = self._pending_loss, None
+            lv = self._to_float(prev)
+            if lv is not None and not math.isfinite(lv) \
+                    and self._cooled("loss_nan"):
+                return [self._fire("loss_nan", repr(lv), None)]
+            return []
+
+
+_monitors: dict[str, StepAnomalyMonitor] = {}
+_monitors_lock = threading.Lock()
+
+
+def monitoring_enabled() -> bool:
+    return os.environ.get("PADDLE_ANOMALY_DISABLE", "") != "1"
+
+
+def get_monitor(path: str = "parallel") -> StepAnomalyMonitor:
+    """Process-wide monitor for one telemetry path (lazily created)."""
+    mon = _monitors.get(path)
+    if mon is None:
+        with _monitors_lock:
+            mon = _monitors.get(path)
+            if mon is None:
+                mon = _monitors[path] = StepAnomalyMonitor(path)
+    return mon
+
+
+def reset_monitors():
+    """Drop every per-path monitor (tests)."""
+    with _monitors_lock:
+        _monitors.clear()

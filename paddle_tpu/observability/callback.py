@@ -75,7 +75,8 @@ class TelemetryCallback:
         dt = time.perf_counter() - self._t0
         bs = self.params.get("batch_size")
         benchmark().step(num_samples=bs)
-        _obs.record_train_step(dt, tokens=bs, path="fit")
+        _obs.record_train_step(dt, tokens=bs, path="fit",
+                               loss=_scalar(logs, "loss"))
         self._seen_steps += 1
         if self.sample_memory and self._seen_steps % self.memory_every == 0:
             _obs.sample_device_memory()

@@ -1,0 +1,395 @@
+"""Perf doctor — predicted-vs-measured roofline reconciliation.
+
+PR 2 made every run *emit* telemetry and PR 5 made every config
+*predictable* (``analysis.predict``'s roofline step_ms / MFU / comm
+bytes); this module closes the loop: given a merged run summary and a
+``*_predicted`` row, it **attributes the measured−predicted step-time
+gap** across the five places a step loses time —
+
+====================  =====================================================
+bucket                source
+====================  =====================================================
+``compile``           jit build/compile seconds amortized per useful step
+``skips``             loss-scale overflow steps (full cost, zero progress)
+``comm``              eager-ledger wire bytes vs the ring model's bytes
+``compute`` / ``hbm`` roofline residual, assigned to the predicted bound
+====================  =====================================================
+
+— and the buckets **sum to the gap exactly** (the residual is a bucket,
+not an apology). On top of the attribution it ranks findings (crashed
+ranks, stragglers named by :func:`.runlog.merge_run_dir`, anomaly
+tallies, torn telemetry, flight-recorder dumps) into the "why is this
+run slow" report ``tools/perf_doctor.py`` prints and ``bench.py`` embeds
+(compactly, via :func:`quick_verdict`) in every artifact row.
+
+Everything here is pure post-hoc arithmetic over JSON — no device, no
+jax import, so the doctor runs anywhere the run dir can be copied.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+
+_BOUND_BUCKET = {"compute": "compute", "memory": "hbm", "comm": "comm"}
+
+
+# ---------------------------------------------------------------------------
+# predicted-row loading
+# ---------------------------------------------------------------------------
+
+_PREDICTED_BASENAMES = ("predicted.json", "predicted_row.json")
+
+
+def _normalize_predicted(row) -> dict | None:
+    if not isinstance(row, dict):
+        return None
+    if "extras" in row and "predicted_step_ms" not in row:
+        row = row["extras"]
+    return row if isinstance(row, dict) and "predicted_step_ms" in row \
+        else None
+
+
+def load_predicted(source) -> dict | None:
+    """A ``*_predicted`` row from: a dict (returned as-is), a JSON file,
+    or a run dir containing ``predicted.json``. Accepts the bare row
+    (``paddle_tpu.analysis.predict`` CLI output), a bench artifact line
+    (``{"metric": ..., "extras": {row}}``), and multi-config predict
+    output — a JSON array or JSONL, one row per line/config, where the
+    FIRST row carrying a prediction wins."""
+    if source is None:
+        return None
+    if isinstance(source, dict):
+        return _normalize_predicted(source)
+    path = source
+    if os.path.isdir(path):
+        for base in _PREDICTED_BASENAMES:
+            cand = os.path.join(path, base)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            return None
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # JSONL from `predict --configs a,b,...` redirected to a file
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = _normalize_predicted(json.loads(line))
+            except ValueError:
+                continue
+            if row is not None:
+                return row
+        return None
+    if isinstance(doc, list):
+        for item in doc:
+            row = _normalize_predicted(item)
+            if row is not None:
+                return row
+        return None
+    return _normalize_predicted(doc)
+
+
+# ---------------------------------------------------------------------------
+# gap attribution
+# ---------------------------------------------------------------------------
+
+def attribute_gap(summary: dict, predicted: dict, chip=None) -> dict | None:
+    """Split measured−predicted per-useful-step time into
+    compute/hbm/comm/compile/skips buckets that sum to the delta.
+
+    Measured step time is the **effective time per useful step**:
+    ``(Σ step seconds + Σ compile seconds) / (steps − skipped)`` — the
+    number a tokens/sec regression actually reflects. Comm uses the
+    eager-collective byte ledger where present; when the run moved no
+    eager bytes (in-jit collectives are invisible to the ledger) the
+    comm bucket is zeroed and the difference rides the roofline
+    residual, noted in ``notes``."""
+    st = summary.get("step_time") or {}
+    steps = int(st.get("count") or 0)
+    if steps <= 0 or not predicted:
+        return None
+    predicted_ms = float(predicted.get("predicted_step_ms") or 0.0)
+    if predicted_ms <= 0:
+        return None
+    from .instrument import chip_specs
+    spec = chip_specs(predicted.get("chip_assumed") or chip or "v5e")
+
+    skips = int(summary.get("loss_scale_skips") or 0)
+    useful = max(steps - skips, 1)
+    sum_s = float(st.get("sum_seconds") or 0.0)
+    compile_s = float((summary.get("compile") or {}).get("seconds") or 0.0)
+    mean_ms = sum_s / steps * 1e3
+    measured_ms = (sum_s + compile_s) / useful * 1e3
+    delta_ms = measured_ms - predicted_ms
+
+    compile_bucket = compile_s / useful * 1e3
+    skip_bucket = mean_ms * skips / useful
+
+    notes = []
+    eager_bytes = float(sum((summary.get("collective_bytes") or {}).values()))
+    pred_comm_ms = (float(predicted.get("comm_mb_per_chip") or 0.0)
+                    * 2 ** 20 / spec["ici_bw"] * 1e3)
+    if eager_bytes > 0:
+        # `steps` is already summed across ranks, so total-bytes/steps IS
+        # the per-chip per-step wire volume — no extra /n_ranks
+        meas_comm_ms = eager_bytes / steps / spec["ici_bw"] * 1e3
+        comm_bucket = meas_comm_ms - pred_comm_ms
+    else:
+        meas_comm_ms = 0.0
+        comm_bucket = 0.0
+        if pred_comm_ms > 0:
+            notes.append(
+                "no eager-ledger collective bytes (in-jit collectives are "
+                "invisible to it); comm deviation rides the roofline "
+                "residual")
+
+    residual = delta_ms - compile_bucket - skip_bucket - comm_bucket
+    bound = str(predicted.get("predicted_bound") or "compute")
+    residual_bucket = _BOUND_BUCKET.get(bound, "compute")
+    buckets = {"compute": 0.0, "hbm": 0.0, "comm": comm_bucket,
+               "compile": compile_bucket, "skips": skip_bucket}
+    buckets[residual_bucket] += residual
+
+    out = {
+        "measured_ms": round(measured_ms, 3),
+        "predicted_ms": round(predicted_ms, 3),
+        "delta_ms": round(delta_ms, 3),
+        "ratio": round(measured_ms / predicted_ms, 3),
+        "buckets": {k: round(v, 3) for k, v in buckets.items()},
+        "residual_assigned_to": residual_bucket,
+        "predicted_bound": bound,
+        "steps": steps, "skipped_steps": skips, "useful_steps": useful,
+        "compile_seconds": round(compile_s, 3),
+        "measured_comm_ms": round(meas_comm_ms, 4),
+        "predicted_comm_ms": round(pred_comm_ms, 4),
+        "chip": spec.get("name"),
+        "notes": notes,
+    }
+
+    # throughput / MFU reconciliation (gauges are last-value-per-series;
+    # average the worker series)
+    tps = [v for v in (summary.get("tokens_per_sec") or {}).values()
+           if isinstance(v, (int, float)) and v > 0]
+    pred_tps = predicted.get("predicted_tokens_per_sec_per_chip")
+    if tps and pred_tps:
+        meas_tps = sum(tps) / len(tps)
+        out["tokens_per_sec"] = {
+            "measured": round(meas_tps, 1), "predicted": round(pred_tps, 1),
+            "ratio": round(meas_tps / pred_tps, 3)}
+    mfus = [v for v in (summary.get("mfu") or {}).values()
+            if isinstance(v, (int, float)) and v > 0]
+    if mfus and predicted.get("predicted_mfu"):
+        meas_mfu = sum(mfus) / len(mfus)
+        out["mfu"] = {"measured": round(meas_mfu, 4),
+                      "predicted": round(float(predicted["predicted_mfu"]),
+                                         4),
+                      "ratio": round(meas_mfu
+                                     / float(predicted["predicted_mfu"]), 3)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+_SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
+
+
+def collect_findings(summary: dict, attribution: dict | None = None,
+                     flight_dumps=()) -> list[dict]:
+    """Ranked ``{severity, kind, detail}`` findings from the summary."""
+    out = []
+
+    def add(severity, kind, detail):
+        out.append({"severity": severity, "kind": kind, "detail": detail})
+
+    bad_exits = {c: n for c, n in (summary.get("exit_codes") or {}).items()
+                 if c not in ("0", "75")}
+    if bad_exits:
+        add("crit", "worker_crash",
+            "worker exit codes " + ", ".join(
+                f"{c} (x{n})" for c, n in sorted(bad_exits.items()))
+            + " — check the flight dump / events for the dying rank")
+    strag = summary.get("straggler")
+    if strag:
+        add("crit", "straggler",
+            f"rank {strag['rank']} (gen {strag['generation']}, "
+            f"path {strag['path']}) runs {strag['skew']}x the fleet median "
+            f"step time ({strag['rank_mean_ms']}ms vs "
+            f"{strag['fleet_median_ms']}ms) — the whole mesh stalls at "
+            f"its pace")
+    anom = summary.get("anomalies") or {}
+    if anom.get("loss_nan"):
+        add("crit", "loss_nan",
+            f"{anom['loss_nan']} non-finite loss step(s) — training is "
+            f"diverging or AMP scale is broken")
+    other = {k: n for k, n in anom.items() if k != "loss_nan" and n}
+    if other:
+        add("warn", "anomalies",
+            "online anomalies: " + ", ".join(
+                f"{k} x{n}" for k, n in sorted(other.items())))
+    for path in flight_dumps:
+        add("warn", "flight_dump",
+            f"flight-recorder dump on disk: {os.path.basename(path)} "
+            f"(last step records of a run that hit trouble)")
+    if summary.get("corrupt_lines"):
+        add("warn", "torn_telemetry",
+            f"{summary['corrupt_lines']} torn/corrupt JSONL line(s) "
+            f"skipped — at least one writer died mid-append")
+    if summary.get("restarts"):
+        add("warn", "restarts",
+            f"{summary['restarts']} elastic relaunch(es) — step series "
+            f"span multiple generations")
+    steps = int((summary.get("step_time") or {}).get("count") or 0)
+    skips = int(summary.get("loss_scale_skips") or 0)
+    if steps and skips and skips / steps > 0.05:
+        add("warn", "loss_scale_skips",
+            f"{skips}/{steps} steps skipped on overflow "
+            f"({100 * skips / steps:.1f}%) — loss scale is thrashing")
+    if attribution:
+        b = attribution["buckets"]
+        top = max(b, key=lambda k: b[k])
+        if attribution["delta_ms"] > 0.05 * attribution["predicted_ms"]:
+            add("warn" if attribution["ratio"] < 2.0 else "crit",
+                "slower_than_roofline",
+                f"measured {attribution['measured_ms']}ms/useful-step is "
+                f"{attribution['ratio']}x the {attribution['predicted_ms']}"
+                f"ms roofline prediction; top contributor: {top} "
+                f"(+{b[top]}ms)")
+        elif attribution["delta_ms"] < -0.2 * attribution["predicted_ms"]:
+            add("info", "faster_than_roofline",
+                f"measured {attribution['ratio']}x predicted — the cost "
+                f"model is conservative for this program")
+        add("info", "bound",
+            f"roofline says this config is {attribution['predicted_bound']}"
+            f"-bound on {attribution['chip']}")
+    out.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# diagnosis + report
+# ---------------------------------------------------------------------------
+
+def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
+                     write_summary: bool = True,
+                     straggler_threshold: float = 1.3) -> dict:
+    """Merge the run dir (straggler pass included), reconcile against
+    the predicted row (auto-discovered from ``<run_dir>/predicted.json``
+    when not given), and return the full doctor report dict."""
+    from .runlog import merge_run_dir
+    summary = merge_run_dir(run_dir, write=write_summary,
+                            straggler_threshold=straggler_threshold)
+    predicted = load_predicted(predicted) or load_predicted(run_dir)
+    attribution = attribute_gap(summary, predicted, chip=chip) \
+        if predicted else None
+    dumps = sorted(glob.glob(os.path.join(run_dir, "flight.rank*.json")))
+    findings = collect_findings(summary, attribution, flight_dumps=dumps)
+    crit = [f for f in findings if f["severity"] == "crit"]
+    if crit:
+        verdict = crit[0]["detail"].split(" — ")[0]
+    elif attribution and attribution["delta_ms"] \
+            > 0.05 * attribution["predicted_ms"]:
+        b = attribution["buckets"]
+        top = max(b, key=lambda k: b[k])
+        verdict = (f"{attribution['ratio']}x the roofline prediction, "
+                   f"dominated by {top}")
+    elif attribution:
+        verdict = (f"healthy: {attribution['ratio']}x the roofline "
+                   f"prediction")
+    elif summary["step_time"]["count"]:
+        verdict = "no predicted row — gap attribution unavailable"
+    else:
+        verdict = "no step telemetry in this run dir"
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "verdict": verdict,
+        "attribution": attribution,
+        "findings": findings,
+        "flight_dumps": dumps,
+        "summary": summary,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human-ranked 'why is this run slow' text."""
+    lines = [f"perf doctor: {report['run_dir']}",
+             f"verdict: {report['verdict']}"]
+    attr = report.get("attribution")
+    if attr:
+        lines.append(
+            f"measured {attr['measured_ms']} ms/useful-step vs predicted "
+            f"{attr['predicted_ms']} ms ({attr['delta_ms']:+} ms, "
+            f"{attr['ratio']}x) over {attr['useful_steps']} useful steps")
+        lines.append("gap attribution (per useful step, sums to the delta):")
+        b = attr["buckets"]
+        total = sum(abs(v) for v in b.values()) or 1.0
+        for k, v in sorted(b.items(), key=lambda kv: -abs(kv[1])):
+            share = 100 * abs(v) / total
+            lines.append(f"  {k:<8} {v:+9.3f} ms  ({share:4.1f}%)")
+        for which in ("tokens_per_sec", "mfu"):
+            if which in attr:
+                r = attr[which]
+                lines.append(
+                    f"{which}: measured {r['measured']} vs predicted "
+                    f"{r['predicted']} ({r['ratio']}x)")
+        for note in attr.get("notes", []):
+            lines.append(f"note: {note}")
+    findings = report.get("findings") or []
+    if findings:
+        lines.append("findings:")
+        for f in findings:
+            lines.append(f"  [{f['severity']}] {f['kind']}: {f['detail']}")
+    else:
+        lines.append("findings: none")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# bench-row verdict
+# ---------------------------------------------------------------------------
+
+def quick_verdict(step_times=None, compile_s=None, anomalies=0,
+                  skips=0, wall_s=None) -> dict:
+    """Compact in-process verdict for a bench artifact row: classifies
+    the measured loop from what the harness already has in hand, so a
+    failed round's artifact carries its own first-order diagnosis."""
+    out = {"anomalies": int(anomalies)}
+    if skips:
+        out["skipped_steps"] = int(skips)
+    if not step_times:
+        out["verdict"] = "no-steps"
+        return out
+    st = sorted(float(t) for t in step_times)
+    if wall_s and sum(st) < 0.8 * wall_s:
+        # per-step times are async dispatch latencies (the device drained
+        # in a trailing sync), not step times — classifying their jitter
+        # or comparing them to compile_s would be meaningless
+        out["verdict"] = "host-async"
+        return out
+    q = lambda p: st[min(len(st) - 1, int(round(p * (len(st) - 1))))]
+    p50, p95 = q(0.5), q(0.95)
+    if compile_s and compile_s > sum(st):
+        out["verdict"] = "compile-dominated"
+        out["compile_s"] = round(float(compile_s), 2)
+    elif p50 > 0 and p95 / p50 > 2.0 and len(st) >= 4:
+        out["verdict"] = "jittery"
+        out["p95_over_p50"] = round(p95 / p50, 2)
+    elif anomalies:
+        out["verdict"] = "anomalous"
+    elif any(not math.isfinite(t) for t in st):
+        out["verdict"] = "broken-timing"
+    else:
+        out["verdict"] = "ok"
+    return out

@@ -1,0 +1,239 @@
+"""Flight recorder — a cheap always-on black box for training runs.
+
+The last two bench rounds died undiagnosed (rc=1, rc=124) because a
+crashed run leaves nothing behind but whatever stderr the driver kept.
+This module keeps a bounded in-memory ring of recent per-step records
+(step time, loss, loss-scale/found-inf, throughput, memory sample,
+cumulative collective bytes) plus compile and annotation events, and
+**dumps it to the run directory** when something goes wrong:
+
+- an online anomaly fires (:mod:`.anomaly` calls :func:`dump`),
+- the process dies on an unhandled exception (``sys.excepthook`` chain,
+  installed by :meth:`FlightRecorder.install`),
+- the pod is preempted (the PR-4 ``PreemptionHandler`` calls
+  :func:`dump_on_preemption` from its SIGTERM grace window).
+
+Recording costs a deque append — device values (the per-step loss is a
+jax scalar) are stored RAW and only resolved to floats at dump time, so
+the hot path never blocks on the device. The ring is process-local and
+always on; dumping needs a directory (the recorder's own, the active
+``RunLogger``'s, or ``PADDLE_TELEMETRY_DIR``) and silently no-ops
+without one.
+
+Dump layout: ``<run_dir>/flight.rank<k>.<reason>.json`` — atomic rename,
+one file per (rank, reason), newest dump wins::
+
+    {"reason": "exception", "ts": ..., "rank": 0, "generation": 0,
+     "exception": "ValueError('boom')", "traceback": "...",
+     "n_records": 128, "records": [{"kind": "step", "step": 41, ...}]}
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback as _tb
+
+DEFAULT_CAPACITY = int(os.environ.get("PADDLE_FLIGHT_CAPACITY", 256))
+# throttle for soft reasons (anomaly storms must not turn the run into
+# an I/O benchmark); hard reasons (exception/preemption) always dump
+_SOFT_DUMP_MIN_INTERVAL_S = float(
+    os.environ.get("PADDLE_FLIGHT_DUMP_INTERVAL_S", 30.0))
+_HARD_REASONS = ("exception", "preemption", "sigterm", "final")
+
+
+def _resolve(v):
+    """Best-effort scalar for a ring value: floats pass through, device
+    scalars are fetched (the run is over by dump time — blocking is
+    fine), anything unconvertible becomes its repr."""
+    if v is None or isinstance(v, (int, float, bool, str)):
+        return v
+    try:
+        import numpy as np
+        a = np.asarray(v)
+        if a.size == 1:
+            return float(a.reshape(()))
+        return repr(v)[:120]
+    except Exception:
+        return repr(v)[:120]
+
+
+class FlightRecorder:
+    """Bounded ring of recent run records with crash-path dumps."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 run_dir: str | None = None):
+        self.capacity = max(int(capacity), 8)
+        self.run_dir = run_dir
+        self._ring = collections.deque(maxlen=self.capacity)
+        # RLock: dump() may re-enter from a SIGTERM handler that
+        # interrupted record()/record_step() on the main thread mid-
+        # critical-section — a plain Lock would deadlock the grace window
+        self._lock = threading.RLock()
+        self._step_seq = 0
+        self._last_soft_dump = 0.0
+        self._installed_excepthook = False
+
+    # ------------------------------------------------------------- record
+    def record(self, kind: str, **fields):
+        """Append one record. Values may be device scalars; nothing is
+        resolved here."""
+        rec = {"ts": time.time(), "kind": kind}
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+        return rec
+
+    def record_step(self, seconds: float, *, loss=None, tokens_per_sec=None,
+                    mfu=None, found_inf=None, loss_scale=None,
+                    memory_bytes=None, collective_bytes=None,
+                    path: str = "parallel", step: int | None = None):
+        """One per-step black-box record (the hot-path entry point)."""
+        with self._lock:
+            self._step_seq += 1
+            n = self._step_seq if step is None else int(step)
+        return self.record(
+            "step", step=n, path=path, seconds=round(float(seconds), 6),
+            loss=loss, tokens_per_sec=tokens_per_sec, mfu=mfu,
+            found_inf=found_inf, loss_scale=loss_scale,
+            memory_bytes=memory_bytes, collective_bytes=collective_bytes)
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._step_seq = 0
+
+    # --------------------------------------------------------------- dump
+    def _soft_throttled(self, reason: str) -> bool:
+        """Consume the soft-reason throttle; hard reasons never throttle."""
+        if reason in _HARD_REASONS:
+            return False
+        now = time.monotonic()
+        if now - self._last_soft_dump < _SOFT_DUMP_MIN_INTERVAL_S:
+            return True
+        self._last_soft_dump = now
+        return False
+
+    def _dump_dir(self, run_dir=None):
+        if run_dir:
+            return run_dir
+        if self.run_dir:
+            return self.run_dir
+        from .runlog import get_run_logger
+        logger = get_run_logger()
+        if logger is not None:
+            return logger.run_dir
+        return os.environ.get("PADDLE_TELEMETRY_DIR") or None
+
+    def dump(self, reason: str, run_dir: str | None = None,
+             exception=None, throttle: bool = True, **extra) -> str | None:
+        """Persist the ring as ``flight.rank<k>.<reason>.json``. Returns
+        the path, or None when no run dir is resolvable or a soft-reason
+        dump is throttled. Never raises (this runs on crash paths)."""
+        try:
+            out_dir = self._dump_dir(run_dir)
+            if not out_dir:
+                return None
+            if throttle and self._soft_throttled(reason):
+                return None
+            from .runlog import _env_generation, _env_rank
+            rank, gen = _env_rank(), _env_generation()
+            records = [{k: _resolve(v) for k, v in rec.items()}
+                       for rec in self.records()]
+            doc = {"reason": reason, "ts": time.time(), "rank": rank,
+                   "generation": gen, "n_records": len(records),
+                   "records": records}
+            if exception is not None:
+                doc["exception"] = repr(exception)[:500]
+            doc.update(extra)
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, f"flight.rank{rank}.{reason}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            from .runlog import get_run_logger
+            logger = get_run_logger()
+            if logger is not None and logger.run_dir == out_dir:
+                logger.log("flight_dump", reason=reason,
+                           n_records=len(records), path=path)
+            return path
+        except Exception:
+            return None
+
+    def dump_async(self, reason: str, **kw) -> threading.Thread | None:
+        """Soft-path dump OFF the calling thread: the throttle gate runs
+        here (cheap), the device-scalar resolution + file write in a
+        daemon thread — so an anomaly firing never stalls the training
+        step that detected it. Returns the thread, or None when
+        throttled."""
+        if self._soft_throttled(reason):
+            return None
+        t = threading.Thread(target=self.dump, args=(reason,),
+                             kwargs=dict(kw, throttle=False),
+                             daemon=True, name="flight-dump")
+        t.start()
+        return t
+
+    # ------------------------------------------------------------ install
+    def install(self, excepthook: bool = True):
+        """Chain this recorder into ``sys.excepthook`` so an unhandled
+        exception leaves a dump before the previous hook (usually the
+        default traceback printer) runs. Idempotent."""
+        if excepthook and not self._installed_excepthook:
+            prev = sys.excepthook
+
+            def hook(exc_type, exc, tb, _prev=prev):
+                try:
+                    # dump through the CURRENT process-wide recorder when
+                    # one exists (tests swap it), else the installer
+                    rec = _recorder or self
+                    rec.dump("exception", exception=exc,
+                             traceback="".join(
+                                 _tb.format_exception(exc_type, exc, tb)
+                             )[-4000:])
+                finally:
+                    _prev(exc_type, exc, tb)
+
+            sys.excepthook = hook
+            self._installed_excepthook = True
+        return self
+
+
+_recorder: FlightRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """Process-wide always-on recorder. First call installs the
+    excepthook chain, so any instrumented process leaves a black box on
+    an unhandled exception."""
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder().install()
+    return _recorder
+
+
+def dump_on_preemption() -> str | None:
+    """SIGTERM-grace-window dump, called by the PR-4 preemption handler
+    (and safe to call from any signal handler: append-only reads, atomic
+    rename, never raises)."""
+    return get_flight_recorder().dump("preemption")
+
+
+def reset_for_tests():
+    """Drop the process-wide recorder (tests only). The excepthook chain
+    installed by a previous recorder stays installed; it dumps through
+    whatever the process-wide recorder is when it fires."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None

@@ -32,6 +32,11 @@ paddle_checkpoint_bytes_total         counter    mode
 paddle_checkpoint_in_flight           gauge      —
 paddle_checkpoint_restores_total      counter    result={ok,fallback,corrupt}
 paddle_store_retries_total            counter    op
+paddle_anomalies_total                counter    kind={step_time_spike,
+                                                 loss_spike,loss_nan,
+                                                 mfu_drift,memory_creep,
+                                                 loss_scale_thrash},
+                                                 path
 paddle_analysis_predicted_step_ms     gauge      target
 paddle_analysis_predicted_peak_hbm_mb gauge      target
 paddle_analysis_predicted_mfu         gauge      target
@@ -171,6 +176,13 @@ def store_retries_counter():
         "TCPStore client ops retried on transient socket errors")
 
 
+def anomalies_counter():
+    return get_registry().counter(
+        "paddle_anomalies_total",
+        "online step anomalies by kind (spikes, NaN loss, MFU drift, "
+        "memory creep)")
+
+
 def predicted_step_ms_gauge():
     return get_registry().gauge(
         "paddle_analysis_predicted_step_ms",
@@ -209,20 +221,37 @@ _last_flush = 0.0
 
 def record_train_step(seconds: float, tokens: int | None = None,
                       flops_per_token: float | None = None,
-                      path: str = "parallel"):
-    """Per-step accounting: step-time histogram + derived throughput/MFU.
-    Under a telemetry-enabled launch (``PADDLE_TELEMETRY_DIR``) this also
+                      path: str = "parallel", loss=None, found_inf=None,
+                      loss_scale=None):
+    """Per-step accounting: step-time histogram + derived throughput/MFU,
+    plus the always-on flight-recorder ring and the online anomaly
+    monitors (``loss`` may be a live device scalar — it is stored raw /
+    resolved with one step of lag, never blocking this path). Under a
+    telemetry-enabled launch (``PADDLE_TELEMETRY_DIR``) this also
     snapshots the registry into the rank's JSONL every few seconds, so a
     SIGKILLed worker still leaves near-current telemetry behind (the
     snapshot write is atomic via rename)."""
     global _last_flush
     step_seconds().observe(seconds, path=path)
+    tps = mfu = None
     if tokens and seconds > 0:
         tps = tokens / seconds
         tokens_per_sec().set(tps, path=path)
         if flops_per_token:
-            train_mfu().set(tps * flops_per_token / peak_flops_per_chip(),
-                            path=path)
+            mfu = tps * flops_per_token / peak_flops_per_chip()
+            train_mfu().set(mfu, path=path)
+    reg = get_registry()
+    mem_gauge = reg.get("paddle_device_memory_bytes")
+    mem = mem_gauge.value if mem_gauge is not None else None
+    from . import anomaly, flight
+    flight.get_flight_recorder().record_step(
+        seconds, loss=loss, tokens_per_sec=tps, mfu=mfu,
+        found_inf=found_inf, loss_scale=loss_scale, memory_bytes=mem,
+        collective_bytes=_collective_bytes_cum(reg), path=path)
+    if anomaly.monitoring_enabled():
+        anomaly.get_monitor(path).observe(
+            seconds, loss=loss, mfu=mfu, memory_bytes=mem,
+            found_inf=found_inf)
     from .runlog import get_run_logger
     logger = get_run_logger()
     if logger is not None:
@@ -230,6 +259,15 @@ def record_train_step(seconds: float, tokens: int | None = None,
         if now - _last_flush > _FLUSH_INTERVAL_S:
             _last_flush = now
             logger.flush_metrics()
+
+
+def _collective_bytes_cum(reg) -> float | None:
+    """Cumulative eager-collective wire bytes (sum over op/group/dtype
+    series) — a handful of dict reads, cheap enough for the step path."""
+    c = reg.get("paddle_collective_bytes_total")
+    if c is None:
+        return None
+    return sum(state["value"] for _, state in c.collect())
 
 
 def record_checkpoint_save(seconds: float, nbytes: int, mode: str = "async"):
@@ -251,6 +289,9 @@ def record_checkpoint_save(seconds: float, nbytes: int, mode: str = "async"):
 def record_compile(seconds: float, what: str):
     compile_counter().inc(what=what)
     compile_seconds().inc(seconds, what=what)
+    from . import flight
+    flight.get_flight_recorder().record(
+        "compile", what=what, seconds=round(float(seconds), 4))
 
 
 def record_collective(op: str, nbytes: int, group=None, dtype=None):

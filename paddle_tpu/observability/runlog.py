@@ -50,7 +50,12 @@ class RunLogger:
         self.generation = _env_generation() if generation is None \
             else int(generation)
         self._registry = registry or get_registry()
-        self._lock = threading.Lock()
+        # RLock: the SIGTERM preemption path logs events from a signal
+        # handler that may have interrupted log() on the main thread
+        # mid-write; a plain Lock would deadlock the grace window. The
+        # worst re-entry artifact is one interleaved/torn line, which
+        # _read_jsonl already tolerates and counts.
+        self._lock = threading.RLock()
         os.makedirs(run_dir, exist_ok=True)
         self._events_path = os.path.join(
             run_dir, f"events.rank{self.rank}.jsonl")
@@ -118,7 +123,12 @@ def get_run_logger(run_dir: str | None = None) -> RunLogger | None:
 
 
 def _read_jsonl(path):
-    out = []
+    """Parse a JSONL stream, tolerating the torn tail line a SIGKILLed
+    writer leaves mid-append. Returns ``(records, n_corrupt)`` — corrupt
+    lines are skipped, never raised, but COUNTED so the merge summary
+    can report that a rank died mid-write instead of silently shortening
+    its series."""
+    out, bad = [], 0
     try:
         with open(path) as f:
             for line in f:
@@ -128,13 +138,70 @@ def _read_jsonl(path):
                 try:
                     out.append(json.loads(line))
                 except ValueError:
-                    continue  # torn tail line from a killed worker
+                    bad += 1  # torn tail line from a killed worker
     except OSError:
         pass
-    return out
+    return out, bad
 
 
-def merge_run_dir(run_dir: str, write: bool = True) -> dict:
+def _straggler_pass(per_rank: dict, threshold: float) -> dict | None:
+    """Cross-rank step-time skew from the per-series stats.
+
+    ``per_rank`` maps ``"rank:g<gen>:<path>"`` series keys to their
+    quantile records. Each worker rank's step time is its count-weighted
+    mean across series (generations/paths); the verdict compares the
+    slowest rank to the FLEET MEDIAN (robust to the straggler itself
+    dragging a mean). A hybrid mesh stalls at the pace of its slowest
+    rank, so skew > ``threshold`` names that rank — and the specific
+    (generation, path) series that is slow, since an elastic relaunch
+    can change which host backs a rank between generations.
+
+    Returns ``{"rank", "generation", "path", "skew",
+    "rank_mean_ms", "fleet_median_ms", "per_rank_mean_ms"}`` or — with
+    fewer than 2 reporting ranks or no skew beyond threshold — None.
+    Controller series (rank -1) never count."""
+    per_rank_stats = {}   # rank -> [sum_weighted_mean, count]
+    worst_series = {}     # rank -> (mean, gen, path)
+    for skey, rec in per_rank.items():
+        try:
+            rank_s, gen_s, path = skey.split(":", 2)
+            rank = int(rank_s)
+            gen = int(gen_s.lstrip("g"))
+        except ValueError:
+            continue
+        mean, count = rec.get("mean"), rec.get("count") or 0
+        if rank < 0 or mean is None or count <= 0:
+            continue
+        agg = per_rank_stats.setdefault(rank, [0.0, 0])
+        agg[0] += mean * count
+        agg[1] += count
+        if rank not in worst_series or mean > worst_series[rank][0]:
+            worst_series[rank] = (mean, gen, path)
+    if len(per_rank_stats) < 2:
+        return None
+    means = {r: s / c for r, (s, c) in per_rank_stats.items()}
+    ordered = sorted(means.values())
+    median = ordered[len(ordered) // 2] if len(ordered) % 2 else \
+        0.5 * (ordered[len(ordered) // 2 - 1] + ordered[len(ordered) // 2])
+    if median <= 0:
+        return None
+    slow_rank = max(means, key=means.get)
+    skew = means[slow_rank] / median
+    if skew < threshold:
+        return None
+    _, gen, path = worst_series[slow_rank]
+    return {
+        "rank": slow_rank, "generation": gen, "path": path,
+        "skew": round(skew, 3),
+        "rank_mean_ms": round(means[slow_rank] * 1e3, 3),
+        "fleet_median_ms": round(median * 1e3, 3),
+        "per_rank_mean_ms": {str(r): round(m * 1e3, 3)
+                             for r, m in sorted(means.items())},
+    }
+
+
+def merge_run_dir(run_dir: str, write: bool = True,
+                  straggler_threshold: float = 1.3) -> dict:
     """Fold every rank's JSONL streams into one run summary.
 
     Returns (and by default writes ``run_summary.json``) with:
@@ -148,6 +215,12 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
     - ``peak_memory_bytes`` — max over ranks of the device peak gauge
     - ``compile`` — jit compile count + total seconds
     - ``exit_codes`` / ``events`` — controller lifecycle tallies
+    - ``corrupt_lines`` — torn/unparseable JSONL lines skipped (a rank
+      killed mid-append leaves exactly one)
+    - ``anomalies`` — per-kind tallies of online ``anomaly`` events
+    - ``straggler`` — cross-rank step-time skew verdict: the slowest
+      rank's mean vs the fleet median; named (rank, generation, skew)
+      when the skew exceeds ``straggler_threshold``, else None
     """
     summary = {
         "run_dir": os.path.abspath(run_dir),
@@ -156,6 +229,7 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
         "step_time": {"count": 0, "sum_seconds": 0.0, "min_seconds": None,
                       "max_seconds": None, "per_rank": {}},
         "tokens_per_sec": {},
+        "mfu": {},
         "collective_bytes": {},
         "collective_calls": {},
         "restarts": 0,
@@ -164,15 +238,22 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
         "loss_scale_skips": 0,
         "exit_codes": {},
         "events": {},
+        "anomalies": {},
+        "corrupt_lines": 0,
+        "straggler": None,
     }
     st = summary["step_time"]
+    counter_anomalies = {}  # rank -> {kind: n} from flushed counter series
+    event_anomalies = {}    # rank -> {kind: n} from synchronous events
 
     for path in sorted(glob.glob(os.path.join(run_dir, "metrics.rank*.jsonl"))):
         m = re.search(r"metrics\.rank(-?\d+)(?:\.gen-?\d+)?\.jsonl$", path)
         rank = int(m.group(1)) if m else -1
         if rank not in summary["ranks"]:
             summary["ranks"].append(rank)
-        for rec in _read_jsonl(path):
+        recs, bad = _read_jsonl(path)
+        summary["corrupt_lines"] += bad
+        for rec in recs:
             name = rec.get("name", "")
             gen = rec.get("generation")
             if gen is not None and gen not in summary["generations"]:
@@ -197,6 +278,14 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
                 skey = f"{rank}:g{gen if gen is not None else 0}:" \
                     f"{rec.get('labels', {}).get('path', '?')}"
                 summary["tokens_per_sec"][skey] = rec.get("value")
+            elif name == "paddle_train_mfu":
+                skey = f"{rank}:g{gen if gen is not None else 0}:" \
+                    f"{rec.get('labels', {}).get('path', '?')}"
+                summary["mfu"][skey] = rec.get("value")
+            elif name == "paddle_anomalies_total":
+                kind = rec.get("labels", {}).get("kind", "?")
+                d = counter_anomalies.setdefault(rank, {})
+                d[kind] = d.get(kind, 0) + int(rec.get("value", 0))
             elif name == "paddle_collective_bytes_total":
                 op = rec.get("labels", {}).get("op", "?")
                 summary["collective_bytes"][op] = \
@@ -219,9 +308,15 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
                                           int(rec.get("value", 0)))
 
     for path in sorted(glob.glob(os.path.join(run_dir, "events.rank*.jsonl"))):
-        for rec in _read_jsonl(path):
+        recs, bad = _read_jsonl(path)
+        summary["corrupt_lines"] += bad
+        for rec in recs:
             ev = rec.get("event", "?")
             summary["events"][ev] = summary["events"].get(ev, 0) + 1
+            if ev == "anomaly":
+                kind = rec.get("kind", "?")
+                d = event_anomalies.setdefault(rec.get("rank", -1), {})
+                d[kind] = d.get(kind, 0) + 1
             gen = rec.get("generation")
             if gen is not None and gen not in summary["generations"]:
                 summary["generations"].append(gen)
@@ -240,6 +335,19 @@ def merge_run_dir(run_dir: str, write: bool = True) -> dict:
     summary["generations"].sort()
     if st["count"]:
         st["mean_seconds"] = st["sum_seconds"] / st["count"]
+    # counters and events record the SAME firings two ways (events are
+    # written synchronously per firing, counters only on the periodic
+    # flush), so per (rank, kind) take the max of the two tallies — never
+    # the sum — and a rank that crashed before its first metrics flush
+    # still contributes through its events stream
+    for rank in set(counter_anomalies) | set(event_anomalies):
+        c = counter_anomalies.get(rank, {})
+        e = event_anomalies.get(rank, {})
+        for kind in set(c) | set(e):
+            summary["anomalies"][kind] = summary["anomalies"].get(kind, 0) \
+                + max(c.get(kind, 0), e.get(kind, 0))
+    summary["straggler"] = _straggler_pass(st["per_rank"],
+                                           straggler_threshold)
     if write:
         out = os.path.join(run_dir, "run_summary.json")
         tmp = f"{out}.tmp.{os.getpid()}"

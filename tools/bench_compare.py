@@ -1,0 +1,177 @@
+"""Diff two ``BENCH_rNN.json`` artifacts, anchored on predicted rows.
+
+The driver's bench rounds run in a container whose CPU allotment varies
+~40% run to run, so raw measured deltas are mostly noise. Two row
+classes therefore get different treatment:
+
+- ``*_predicted`` rows come from the static cost model: **zero run-to-run
+  noise**, so ANY worsening beyond a tight threshold (default 2%) is a
+  real modelled regression — the code got slower/bigger, not the box.
+- measured rows use a wide threshold (default 40%, the observed
+  container variance); additionally, when a measured row has a matching
+  predicted anchor (``gpt_345m_tokens_per_sec_per_chip`` ↔
+  ``gpt_345m_predicted``), the report shows the anchor-normalized ratio
+  (measured / predicted), the number that SHOULD be environment-stable.
+
+Rows whose unit marks them non-metrics (skipped / error / timeout /
+info) are ignored, as are ``*_cpu_smoke`` vs TPU mismatches (a CPU
+fallback round never regresses a TPU number).
+
+Exit codes: 0 = no regressions, 1 = regression(s) beyond threshold,
+2 = artifact unreadable.
+
+Usage::
+
+    python tools/bench_compare.py BENCH_r03.json BENCH_r06.json
+    python tools/bench_compare.py A.json B.json --threshold 0.3 --json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NON_METRIC_UNITS = {"skipped", "error", "timeout", "info"}
+# metrics where a LOWER value is the improvement
+_LOWER_IS_BETTER_MARKERS = ("decode_ms", "peak_hbm", "step_ms", "latency")
+
+
+def load_rows(path) -> dict:
+    """``{metric: row}`` from one driver artifact (``tail`` lines +
+    ``parsed``) or from a bare JSONL of bench rows. Later lines win."""
+    rows = {}
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
+        lines = str(doc.get("tail", "")).splitlines()
+        if isinstance(doc.get("parsed"), dict):
+            lines.append(json.dumps(doc["parsed"]))
+    elif isinstance(doc, list):
+        lines = [json.dumps(r) for r in doc]
+    else:
+        lines = [json.dumps(doc)]
+    for ln in lines:
+        ln = ln.strip()
+        if not (ln.startswith("{") and '"metric"' in ln):
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        metric = rec.get("metric")
+        if not isinstance(metric, str):
+            continue
+        if str(rec.get("unit", "")).lower() in _NON_METRIC_UNITS:
+            continue
+        if metric.endswith(("_SKIPPED", "_ERROR", "_TIMEOUT", "_FALLBACK")):
+            continue
+        if not isinstance(rec.get("value"), (int, float)) \
+                or rec["value"] <= 0:
+            continue
+        rows[metric] = rec
+    return rows
+
+
+def _lower_is_better(metric, row):
+    u = str(row.get("unit", "")).lower()
+    return any(m in metric for m in _LOWER_IS_BETTER_MARKERS) \
+        or u.startswith(("ms", "gib", "gb", "s/"))
+
+
+def _predicted_anchor(metric, rows):
+    """The *_predicted row anchoring a measured metric, if present
+    (gpt_345m_tokens_per_sec_per_chip -> gpt_345m_predicted)."""
+    for cut in ("_tokens_per_sec_per_chip", "_imgs_per_sec_per_chip"):
+        if metric.endswith(cut):
+            return rows.get(metric[: -len(cut)] + "_predicted")
+    return None
+
+
+def compare(rows_a: dict, rows_b: dict, threshold=0.40,
+            predicted_threshold=0.02) -> dict:
+    """Per-metric deltas + regression verdicts between two row maps."""
+    out = {"metrics": [], "regressions": [], "only_a": [], "only_b": []}
+    out["only_a"] = sorted(set(rows_a) - set(rows_b))
+    out["only_b"] = sorted(set(rows_b) - set(rows_a))
+    for metric in sorted(set(rows_a) & set(rows_b)):
+        a, b = rows_a[metric], rows_b[metric]
+        va, vb = float(a["value"]), float(b["value"])
+        change = (vb - va) / va
+        predicted = metric.endswith("_predicted") or "_predicted_" in metric
+        lower_better = _lower_is_better(metric, b)
+        worsening = change > 0 if lower_better else change < 0
+        limit = predicted_threshold if predicted else threshold
+        regression = worsening and abs(change) > limit
+        rec = {
+            "metric": metric, "a": va, "b": vb,
+            "change_pct": round(100 * change, 2),
+            "predicted": predicted, "lower_is_better": lower_better,
+            "regression": regression, "threshold_pct": round(100 * limit, 1),
+        }
+        anchor_a = _predicted_anchor(metric, rows_a)
+        anchor_b = _predicted_anchor(metric, rows_b)
+        if anchor_a and anchor_b and not predicted:
+            # measured/predicted: the environment-independent view —
+            # predicted rows absorb intentional model/config changes
+            na = va / float(anchor_a["value"])
+            nb = vb / float(anchor_b["value"])
+            rec["anchored_ratio_a"] = round(na, 4)
+            rec["anchored_ratio_b"] = round(nb, 4)
+            rec["anchored_change_pct"] = round(100 * (nb - na) / na, 2)
+        out["metrics"].append(rec)
+        if regression:
+            out["regressions"].append(rec)
+    return out
+
+
+def format_table(result) -> str:
+    lines = [f"{'metric':<46} {'A':>12} {'B':>12} {'Δ%':>8}  verdict"]
+    lines.append("-" * len(lines[0]))
+    for rec in result["metrics"]:
+        verdict = "REGRESSION" if rec["regression"] else (
+            "anchor" if rec["predicted"] else "ok")
+        extra = ""
+        if "anchored_change_pct" in rec:
+            extra = f"  (vs-predicted {rec['anchored_change_pct']:+.1f}%)"
+        lines.append(
+            f"{rec['metric']:<46} {rec['a']:>12.1f} {rec['b']:>12.1f} "
+            f"{rec['change_pct']:>+7.1f}%  {verdict}{extra}")
+    for side, label in (("only_a", "only in A"), ("only_b", "only in B")):
+        for m in result[side]:
+            lines.append(f"{m:<46} {label}")
+    n = len(result["regressions"])
+    lines.append(f"{n} regression(s) beyond threshold"
+                 if n else "no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff two bench artifacts; predicted rows are "
+                    "noise-free anchors, exit 1 on regression")
+    ap.add_argument("artifact_a", help="older BENCH_rNN.json")
+    ap.add_argument("artifact_b", help="newer BENCH_rNN.json")
+    ap.add_argument("--threshold", type=float, default=0.40,
+                    help="measured-row regression threshold (fraction; "
+                         "default 0.40 ≈ container CPU variance)")
+    ap.add_argument("--predicted-threshold", type=float, default=0.02,
+                    help="predicted-row regression threshold (fraction)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        rows_a, rows_b = load_rows(args.artifact_a), load_rows(args.artifact_b)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    result = compare(rows_a, rows_b, threshold=args.threshold,
+                     predicted_threshold=args.predicted_threshold)
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(format_table(result))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

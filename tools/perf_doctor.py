@@ -1,0 +1,79 @@
+"""Perf doctor CLI — "why is this run slow?" over a telemetry run dir.
+
+Merges the run dir (per-rank JSONL → ``run_summary.json``, straggler
+pass included), reconciles the measured step time against the static
+cost model's ``*_predicted`` row, and prints the ranked report: gap
+attribution across compute/HBM/comm/compile/skips, the named straggler
+rank, anomaly tallies, crash exit codes, and any flight-recorder dumps
+the run left behind.
+
+Usage::
+
+    python tools/perf_doctor.py <run_dir>
+    python tools/perf_doctor.py <run_dir> --predicted predicted.json
+    python tools/perf_doctor.py <run_dir> --json           # machine-readable
+    python tools/perf_doctor.py <run_dir> --strict         # rc=1 on crit
+
+The predicted row is auto-discovered from ``<run_dir>/predicted.json``
+(drop the output of ``python -m paddle_tpu.analysis.predict`` there);
+without one the doctor still merges, names stragglers, and ranks
+findings — only the roofline attribution is skipped.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="predicted-vs-measured run diagnosis over a telemetry "
+                    "run directory")
+    ap.add_argument("run_dir", help="directory with events.rank*.jsonl / "
+                                    "metrics.rank*.jsonl")
+    ap.add_argument("--predicted", default=None,
+                    help="JSON file with a *_predicted row (default: "
+                         "<run_dir>/predicted.json when present)")
+    ap.add_argument("--chip", default=None,
+                    help="chip kind for comm-bandwidth math when the "
+                         "predicted row names none (default v5e)")
+    ap.add_argument("--straggler-threshold", type=float, default=1.3,
+                    help="min slow-rank/median skew to name a straggler")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not (re)write run_summary.json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any critical finding exists")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        print(f"perf_doctor: not a directory: {args.run_dir}",
+              file=sys.stderr)
+        return 2
+
+    from paddle_tpu.observability.doctor import (diagnose_run_dir,
+                                                 format_report,
+                                                 load_predicted)
+    if args.predicted is not None and load_predicted(args.predicted) is None:
+        print(f"perf_doctor: no *_predicted row loadable from "
+              f"{args.predicted}; falling back to <run_dir>/predicted.json "
+              f"if present", file=sys.stderr)
+    report = diagnose_run_dir(
+        args.run_dir, predicted=args.predicted, chip=args.chip,
+        write_summary=not args.no_write,
+        straggler_threshold=args.straggler_threshold)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    if args.strict and any(f["severity"] == "crit"
+                           for f in report["findings"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

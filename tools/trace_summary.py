@@ -5,10 +5,16 @@ table code (``profiler.profiler.aggregate_events`` / ``format_agg_table``)
 applied to a chrome-trace file instead of a live Profiler, so a trace
 shipped from a training run can be read without rerunning anything.
 
+``--diff A B`` compares two traces (a good round vs a slow one): top-N
+table of per-op-span total-time deltas, sorted by how much each name
+moved — the op-level view the perf doctor's step-level attribution
+points into.
+
 Usage::
 
     python tools/trace_summary.py run/host_123.paddle_trace.json
     python tools/trace_summary.py trace.json --top 20 --unit us
+    python tools/trace_summary.py --diff good.json slow.json --top 15
 """
 import argparse
 import json
@@ -63,14 +69,65 @@ def summarize(path, top=None, time_unit="ms"):
     return lines
 
 
+_UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+def diff_summarize(path_a, path_b, top=None, time_unit="ms"):
+    """Top-N per-span-name deltas (B − A) between two traces, by total
+    time moved; names present in only one trace count from zero."""
+    aggs = []
+    for path in (path_a, path_b):
+        spans, _ = load_trace(path)
+        aggs.append(aggregate_events(
+            (e.get("name", "?"), float(e.get("dur", 0.0)) * 1e3)
+            for e in spans))
+    agg_a, agg_b = aggs
+    div = _UNIT_DIV[time_unit]
+    deltas = []
+    for name in set(agg_a) | set(agg_b):
+        cnt_a, tot_a = agg_a.get(name, (0, 0.0))
+        cnt_b, tot_b = agg_b.get(name, (0, 0.0))
+        deltas.append((name, cnt_a, cnt_b, tot_a / div, tot_b / div,
+                       (tot_b - tot_a) / div))
+    deltas.sort(key=lambda d: -abs(d[5]))
+    if top:
+        dropped = len(deltas) - top
+        deltas = deltas[:top]
+    else:
+        dropped = 0
+    u = time_unit
+    lines = [f"trace diff: A={path_a}  B={path_b}",
+             f"{'name':<44} {'calls A>B':>12} {'total A(' + u + ')':>14} "
+             f"{'total B(' + u + ')':>14} {'Δ(' + u + ')':>12}"]
+    lines.append("-" * len(lines[1]))
+    for name, ca, cb, ta, tb, d in deltas:
+        lines.append(f"{name[:44]:<44} {f'{ca}>{cb}':>12} {ta:>14.3f} "
+                     f"{tb:>14.3f} {d:>+12.3f}")
+    if dropped > 0:
+        lines.append(f"... {dropped} more name(s) below the top-{top} cut")
+    total = sum(d[5] for d in deltas)
+    lines.append(f"net span-time delta (shown rows): {total:+.3f}{u}")
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="top-N aggregate table over a .paddle_trace.json")
+        description="top-N aggregate table over a .paddle_trace.json "
+                    "(or --diff two traces)")
     ap.add_argument("trace", nargs="+", help="exported chrome-trace file(s)")
     ap.add_argument("--top", type=int, default=None,
                     help="show only the N slowest names")
     ap.add_argument("--unit", default="ms", choices=["s", "ms", "us", "ns"])
+    ap.add_argument("--diff", action="store_true",
+                    help="compare exactly two traces: top-N op-span "
+                         "total-time deltas (B − A)")
     args = ap.parse_args(argv)
+    if args.diff:
+        if len(args.trace) != 2:
+            ap.error("--diff takes exactly two trace files")
+        print("\n".join(diff_summarize(args.trace[0], args.trace[1],
+                                       top=args.top, time_unit=args.unit)))
+        return 0
     for path in args.trace:
         print("\n".join(summarize(path, top=args.top, time_unit=args.unit)))
     return 0
