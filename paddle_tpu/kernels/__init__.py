@@ -3,4 +3,14 @@
 Parity: the reference's fused CUDA ops (/root/reference/paddle/fluid/operators/
 fused/: fused_attention_op.cu, fmha_ref.h, fused_feedforward) re-designed as
 Pallas TPU kernels instead of hand-written CUDA.
+
+- :mod:`.flash_attention` — FlashAttention-2 fwd+bwd (MQA/GQA, ragged
+  pad-to-block, and causal **query offsets**: ``q_offset`` places query
+  row i at absolute position ``q_offset + i``, so causal ``sk != sq`` —
+  cached decode, chunked prefill — runs the kernel instead of falling
+  back to XLA).
+- :mod:`.paged_attention` — ragged paged-attention single-token decode
+  over a block KV-cache pool (page-table gather via scalar prefetch;
+  the serving engine's attention core).
+- :mod:`.ring_attention` — sequence-parallel ring attention.
 """

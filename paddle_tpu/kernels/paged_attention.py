@@ -1,0 +1,206 @@
+"""Ragged paged-attention decode kernel (Pallas TPU).
+
+The serving-side counterpart of :mod:`.flash_attention`: one query token
+per sequence attends over that sequence's KV cache stored as fixed-size
+HBM *pages* (PAPERS.md "Ragged Paged Attention"). Live HBM tracks actual
+tokens instead of ``max_position_embeddings`` — the page pool
+(:mod:`paddle_tpu.serving.kv_pool`) hands each sequence a page table and
+this kernel gathers exactly those pages.
+
+Layout contract:
+
+- ``q``        ``[B, num_heads, d]`` — the new token's projected queries.
+- ``k_pages``/``v_pages`` ``[num_pages, page_size, num_kv_heads, d]`` —
+  the pool. Page 0 is the pool's reserved *sink* page (padding page-table
+  entries point at it; it is never read unmasked).
+- ``page_table`` ``[B, pages_per_seq]`` int32 — entry ``j`` is the HBM
+  page holding tokens ``[j*page_size, (j+1)*page_size)`` of sequence
+  ``b``; entries beyond the sequence's pages are sink references.
+- ``seq_lens`` ``[B]`` int32 — true token count per sequence INCLUDING
+  the token being decoded (its K/V must already be written to its page).
+  A zero length marks an idle batch slot: every key is masked and the
+  (finite, garbage) output row is discarded by the caller.
+
+Grid: one step per ``(sequence, kv_head, kv_page_block)`` — the page
+table rides :class:`pltpu.PrefetchScalarGridSpec` scalar prefetch so the
+``k_pages`` BlockSpec index_map can gather the right HBM page into VMEM
+while the online-softmax state (m/l/acc) lives in VMEM scratch, exactly
+the flash-attention streaming scheme but with an indirection per block.
+Fully-padded page blocks (``j*page_size >= seq_len``) early-out.
+
+On CPU the kernel runs in interpreter mode so tier-1 asserts
+paged-decode == XLA reference attention without a TPU; the same
+``pallas_call`` compiles on TPU (x64 disabled around the trace, head_dim
+padded to the 128-lane width — prefer d_head=128 models so the pool
+needs no per-step pad copy).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_NEG_INF = -1e30
+
+# CompilerParams is the jax>=0.6 name; 0.4.x calls it TPUCompilerParams
+_CP = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_ARB3 = _CP(dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _no_x64(fn):
+    from .._jax_compat import enable_x64
+
+    @functools.wraps(fn)
+    def inner(*a, **kw):
+        if _interpret():
+            return fn(*a, **kw)
+        with enable_x64(False):
+            return fn(*a, **kw)
+    return inner
+
+
+def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, page_size, scale):
+    """One (sequence b, kv head h, page block j) step of the online
+    softmax; scratch carries the running (max, denom, weighted-V) state
+    across the innermost page walk."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    sl = sl_ref[b]
+    # ragged early-out: page blocks wholly beyond this sequence's length
+    # (incl. every block of an idle slot, sl == 0) are skipped
+    run = j * np.int32(page_size) < sl
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]            # [g, d] — this kv head's query group
+        k = k_ref[0][:, 0, :]      # [page_size, d]
+        v = v_ref[0][:, 0, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)   # [g, page_size]
+        col = j * np.int32(page_size) + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(col < sl, s, jnp.float32(_NEG_INF))
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = corr * acc_scr[:] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == npg - 1)
+    def _():
+        # idle slots never ran: l == 0 → emit finite garbage, not NaN
+        l = jnp.maximum(l_scr[:], jnp.float32(1e-30))
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@_no_x64
+def _paged_call(q4, k_pages, v_pages, page_table, seq_lens, scale):
+    B, nkv, g, d = q4.shape
+    page_size = k_pages.shape[1]
+    p_max = page_table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, nkv, p_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda b, h, j, pt, sl: (b, h, 0, 0)),
+            # the paged gather: the page table picks which HBM page this
+            # grid step DMAs into VMEM
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda b, h, j, pt, sl: (pt[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda b, h, j, pt, sl: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, d), q4.dtype),
+        compiler_params=_ARB3,
+        interpret=_interpret(),
+    )(page_table, seq_lens, q4, k_pages, v_pages)
+
+
+def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens,
+                           scale=None):
+    """Single-token decode attention over a paged KV cache.
+
+    ``q`` ``[B, num_heads, d]``; pages ``[num_pages, page_size,
+    num_kv_heads, d]`` (num_kv_heads may divide num_heads — MQA/GQA);
+    ``page_table`` ``[B, pages_per_seq]`` int32; ``seq_lens`` ``[B]``
+    int32 true lengths (0 = idle slot). Returns ``[B, num_heads, d]``.
+    """
+    B, nh, d = q.shape
+    nkv = k_pages.shape[2]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} must be a multiple of "
+                         f"num_kv_heads {nkv}")
+    g = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if not _interpret() and d < _LANE:
+        # Mosaic wants full 128 lanes; interpret mode skips the pad (it
+        # would copy the whole pool per step for nothing on CPU)
+        pad = _LANE - d
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, pad)])
+        k_pages = jnp.pad(k_pages, [(0, 0), (0, 0), (0, 0), (0, pad)])
+        v_pages = jnp.pad(v_pages, [(0, 0), (0, 0), (0, 0), (0, pad)])
+    q4 = q.reshape(B, nkv, g, q.shape[-1])
+    out = _paged_call(q4, k_pages, v_pages,
+                      page_table.astype(jnp.int32),
+                      seq_lens.astype(jnp.int32), float(scale))
+    return out.reshape(B, nh, -1)[..., :d]
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
+                              scale=None):
+    """XLA reference: gather the paged KV dense, mask to each sequence's
+    true length, plain softmax attention. The correctness oracle for the
+    kernel and the modelable decode path the static cost pass prices."""
+    B, nh, d = q.shape
+    _, ps, nkv, _ = k_pages.shape
+    g = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    t = page_table.shape[1] * ps
+    k = k_pages[page_table].reshape(B, t, nkv, d)
+    v = v_pages[page_table].reshape(B, t, nkv, d)
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = (jnp.arange(t, dtype=jnp.int32)[None, None, :]
+            < seq_lens.astype(jnp.int32)[:, None, None])
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnt,btnd->bnd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

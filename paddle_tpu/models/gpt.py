@@ -44,7 +44,7 @@ from ..ops._dispatch import apply, unwrap
 __all__ = [
     "GPTConfig", "GPTDecoderLayer", "GPTEmbeddings", "GPTModel",
     "GPTForPretraining", "GPTPretrainingCriterion", "GPTHybridTrainStep",
-    "GPTGenerator",
+    "GPTGenerator", "stack_gpt_weights", "sample_logits",
     "gpt_tiny_config", "gpt_345m_config", "gpt_1p3b_config", "gpt_13b_config",
 ]
 
@@ -1221,12 +1221,44 @@ def gpt_block_decode(p, x_t, k_cache, v_cache, pos, eps):
     return x_t + u @ p["w2"] + p["b2"], k_cache, v_cache
 
 
+def stack_gpt_weights(model) -> dict:
+    """Stack a (built) GPT model's per-layer Parameters into the
+    ``[n_layers, ...]`` decode-side pytree both :class:`GPTGenerator` and
+    the serving engine (:mod:`paddle_tpu.serving`) consume: ``{"blocks":
+    {key: [L, ...]}, "wte", "wpe", "lnf_w", "lnf_b"}``. One stacking,
+    one layout, for every inference path."""
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    return {
+        "blocks": {k: jnp.stack([getattr(l, k)._value
+                                 for l in gpt.layers])
+                   for k in _BLOCK_KEYS},
+        "wte": gpt.embeddings.word_embeddings._value,
+        "wpe": gpt.embeddings.position_embeddings._value,
+        "lnf_w": gpt.lnf_w._value,
+        "lnf_b": gpt.lnf_b._value,
+    }
+
+
+def sample_logits(logits, key, temperature=0.0, top_k=0):
+    """Greedy (temperature<=0, key unused/None-safe) or temperature +
+    optional top-k sampling — shared by GPTGenerator and the serving
+    engine so scheduler-batched decode reproduces sequential decode."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, -1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, -1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 class GPTGenerator:
     """Compiled autoregressive decoder (the serving-side counterpart of
     GPTHybridTrainStep): prefill computes the prompt's KV caches in one
     full-attention pass, then a lax.scan emits tokens one cached step at a
     time — the standard TPU decode loop, one fixed XLA program per
-    (batch, prompt_len, max_new_tokens) signature.
+    (batch, prompt_len, max_new_tokens) signature. For continuous-batching
+    serving over a paged KV pool, see :mod:`paddle_tpu.serving`.
 
     Sampling: greedy (temperature=0) or temperature + optional top-k.
     """
@@ -1237,26 +1269,19 @@ class GPTGenerator:
         self.cfg = gpt.config
         # Pallas flash prefill (None = auto: TPU + gate-friendly prompt)
         self.use_flash = use_flash
-        self.blocks = {k: jnp.stack([getattr(l, k)._value
-                                     for l in gpt.layers])
-                       for k in _BLOCK_KEYS}
-        self.wte = gpt.embeddings.word_embeddings._value
-        self.wpe = gpt.embeddings.position_embeddings._value
-        self.lnf_w = gpt.lnf_w._value
-        self.lnf_b = gpt.lnf_b._value
+        params = stack_gpt_weights(model)
+        self.blocks = params["blocks"]
+        self.wte = params["wte"]
+        self.wpe = params["wpe"]
+        self.lnf_w = params["lnf_w"]
+        self.lnf_b = params["lnf_b"]
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = seed
         self._compiled = {}
 
     def _sample(self, logits, key):
-        if self.temperature <= 0.0:
-            return jnp.argmax(logits, -1)
-        logits = logits / self.temperature
-        if self.top_k > 0:
-            kth = jnp.sort(logits, -1)[..., -self.top_k][..., None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        return jax.random.categorical(key, logits, axis=-1)
+        return sample_logits(logits, key, self.temperature, self.top_k)
 
     def _build(self, B, S_prompt, max_new):
         cfg = self.cfg

@@ -40,7 +40,17 @@ paddle_anomalies_total                counter    kind={step_time_spike,
 paddle_analysis_predicted_step_ms     gauge      target
 paddle_analysis_predicted_peak_hbm_mb gauge      target
 paddle_analysis_predicted_mfu         gauge      target
+paddle_serving_requests_total         counter    event={submitted,admitted,
+                                                 finished,rejected}
+paddle_serving_queue_depth            gauge      —
+paddle_serving_ttft_seconds           histogram  —
+paddle_serving_tokens_out_total       counter    —
+paddle_serving_kv_pages_in_use        gauge      —
 ====================================  =========  =============================
+
+Serving decode steps additionally ride ``record_train_step`` with
+``path="serving"``, so the flight recorder and the online anomaly
+monitors cover the serving engine exactly like training.
 
 Everything here must stay off the device critical path: increments are a
 dict lookup + float add; the memory sampler reads allocator stats (cheap)
@@ -199,6 +209,37 @@ def predicted_mfu_gauge():
     return get_registry().gauge(
         "paddle_analysis_predicted_mfu",
         "static-cost-model MFU prediction vs chip peak")
+
+
+def serving_requests_counter():
+    return get_registry().counter(
+        "paddle_serving_requests_total",
+        "serving requests by lifecycle event")
+
+
+def serving_queue_depth_gauge():
+    return get_registry().gauge(
+        "paddle_serving_queue_depth",
+        "requests waiting for admission to the decode batch")
+
+
+def serving_ttft_histogram():
+    return get_registry().histogram(
+        "paddle_serving_ttft_seconds",
+        "submit-to-first-token latency per admitted request",
+        buckets=STEP_BUCKETS)
+
+
+def serving_tokens_out_counter():
+    return get_registry().counter(
+        "paddle_serving_tokens_out_total",
+        "tokens emitted by the serving engine")
+
+
+def serving_kv_pages_gauge():
+    return get_registry().gauge(
+        "paddle_serving_kv_pages_in_use",
+        "KV-cache pool pages currently allocated to live sequences")
 
 
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,

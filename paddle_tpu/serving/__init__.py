@@ -1,0 +1,55 @@
+"""Ragged paged-attention serving engine.
+
+The "millions of users" runtime: checkpoint-load → paged-KV generator →
+continuous batching, with per-request telemetry. Four pieces:
+
+- :mod:`.kv_pool` — ``PagePool``: the KV cache as fixed-size HBM pages
+  with per-sequence page tables and a free list, so live memory tracks
+  actual tokens (plus fragmentation accounting). Page 0 is the reserved
+  sink for padding writes.
+- :mod:`paddle_tpu.kernels.paged_attention` — the Pallas ragged
+  paged-attention decode kernel: one grid step per (sequence, kv head,
+  KV page block), page table scalar-prefetched so BlockSpecs gather
+  pages from HBM, masked to each sequence's true length; interpret-mode
+  fallback on CPU so tier-1 asserts kernel == XLA reference attention.
+- :mod:`.engine` — ``ServingEngine``: stacked decode weights (shared
+  with ``GPTGenerator``), AOT-compiled prefill programs per
+  prompt-length bucket and decode programs per batch bucket (a shape
+  outside the set RAISES — serving never recompiles), page buffers
+  donated on TPU. ``ServingEngine.from_checkpoint`` wires checkpoint
+  load.
+- :mod:`.scheduler` — ``ContinuousBatchingScheduler``: evict finished /
+  admit queued (with full-completion page reservation, so decode can't
+  OOM the pool) / one bucketed decode step, every tick. Serving steps
+  feed the flight recorder + anomaly monitors (``path="serving"``) and
+  the ``paddle_serving_*`` metric family.
+
+The static gate: ``python tools/check_program.py --model serving`` lints
+the decode step and replays a randomized admission mix through the real
+scheduler (:func:`.scheduler.simulate_decode_signatures`) to prove the
+bucketed shape set is closed — zero retraces for any request mix.
+TPU-less rounds still carry serving numbers via :mod:`.predict`
+(``serving_predicted`` bench row from the PR-5 static cost model over
+the decode jaxpr).
+
+Quickstart::
+
+    from paddle_tpu.serving import ServingEngine, ContinuousBatchingScheduler
+    eng = ServingEngine.from_checkpoint("gpt.pdparams", cfg, page_size=64)
+    sched = ContinuousBatchingScheduler(eng)
+    reqs = [sched.submit(ids, max_new_tokens=64) for ids in prompts]
+    sched.run()          # continuous batching until drained
+    out = reqs[0].output_ids
+"""
+from .kv_pool import PagePool, PagePoolError, PagePoolOOM  # noqa: F401
+from .engine import (EngineShapeError, ServingEngine,  # noqa: F401
+                     decode_step_fn, prefill_fn)
+from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
+                        Request, simulate_decode_signatures)
+
+__all__ = [
+    "PagePool", "PagePoolError", "PagePoolOOM",
+    "ServingEngine", "EngineShapeError",
+    "ContinuousBatchingScheduler", "Request",
+    "simulate_decode_signatures",
+]

@@ -1,0 +1,372 @@
+"""Serving engine: checkpoint → paged-KV generator → continuous batching.
+
+``ServingEngine`` is the deploy-side counterpart of ``GPTHybridTrainStep``
+— it owns
+
+- the stacked decode weights (:func:`~paddle_tpu.models.gpt.
+  stack_gpt_weights`, shared with ``GPTGenerator``),
+- a :class:`~.kv_pool.PagePool` of fixed-size KV pages,
+- one AOT-compiled **prefill** program per prompt-length bucket and one
+  AOT-compiled **decode** program per batch bucket. The bucket sets are
+  closed at construction: serving any request mix reuses these programs
+  — a shape outside the set raises instead of silently recompiling
+  (``tools/check_program.py --model serving`` proves the scheduler never
+  requests one).
+
+Decode math: one token per live sequence per step. Each layer projects
+q/k/v for the new token, scatters k/v into the sequence's current page
+slot, then attends over the page table with the Pallas ragged
+paged-attention kernel (:mod:`paddle_tpu.kernels.paged_attention`; XLA
+reference path on request). Page buffers are donated on TPU, so decode
+updates the pool in place.
+
+Telemetry: every prefill/decode step feeds the metric registry, the
+flight recorder, and the anomaly monitor under ``path="serving"`` (see
+``observability.instrument``), and per-request timing (queue wait, TTFT,
+tokens/s) lands on each finished :class:`~.scheduler.Request`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt import (GPTConfig, _ln, flash_attention_gate, gpt_block,
+                          sample_logits, stack_gpt_weights)
+from ..kernels.paged_attention import (paged_attention_decode,
+                                       paged_attention_reference)
+from .kv_pool import PagePool
+
+__all__ = ["ServingEngine", "EngineShapeError", "decode_step_fn",
+           "prefill_fn"]
+
+
+class EngineShapeError(RuntimeError):
+    """A shape outside the AOT-compiled bucket set was requested. The
+    engine never recompiles at serving time — fix the bucket config."""
+
+
+# ---------------------------------------------------------------------------
+# pure step functions (single source of truth: the engine jits these, the
+# static cost model traces them, the lint analyzes them)
+# ---------------------------------------------------------------------------
+
+def decode_step_fn(params, k_pages, v_pages, tokens, positions, page_table,
+                   seq_lens, key, *, eps, temperature, top_k, use_kernel):
+    """One continuous-batching decode step: for every (possibly idle)
+    batch slot, embed the last token, write its K/V into the slot's
+    current page, attend over the page table, and sample the next token.
+
+    ``tokens``/``positions`` ``[B]`` int32 (position = seq_len-1);
+    ``page_table`` ``[B, pages_per_seq]``; ``seq_lens`` ``[B]`` (0 =
+    idle slot → all writes land in the sink page, output is discarded).
+    Returns ``(k_pages, v_pages, next_tokens)``.
+    """
+    blocks, wte, wpe = params["blocks"], params["wte"], params["wpe"]
+    B = tokens.shape[0]
+    np_, ps = k_pages.shape[1], k_pages.shape[2]
+    pos = jnp.maximum(positions, 0).astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
+    x = wte[tokens][:, None, :] + wpe[pos][:, None, :]
+    # destination page row of the token being decoded (sink for idle)
+    rows = (page_table[jnp.arange(B), pos // ps] * ps + pos % ps)
+    attend = paged_attention_decode if use_kernel \
+        else paged_attention_reference
+
+    def layer(carry, p_kp_vp):
+        (x,) = carry
+        p, kp, vp = p_kp_vp
+        nkv, d = kp.shape[2], kp.shape[3]
+        h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
+        qkv = jnp.einsum("bsh,hknd->bsknd", h, p["wqkv"]) + p["bqkv"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,1,nh,d]
+        kp = kp.reshape(np_ * ps, nkv, d).at[rows].set(
+            k[:, 0]).reshape(np_, ps, nkv, d)
+        vp = vp.reshape(np_ * ps, nkv, d).at[rows].set(
+            v[:, 0]).reshape(np_, ps, nkv, d)
+        attn = attend(q[:, 0], kp, vp, page_table, seq_lens)
+        o = jnp.einsum("bnd,ndh->bh", attn.astype(x.dtype), p["wo"])
+        x = x + o[:, None, :] + p["bo"]
+        h2 = _ln(x, p["ln2_w"], p["ln2_b"], eps)
+        u = jax.nn.gelu(h2 @ p["w1"] + p["b1"], approximate=True)
+        x = x + u @ p["w2"] + p["b2"]
+        return (x,), (kp, vp)
+
+    (x,), (k_pages, v_pages) = jax.lax.scan(
+        layer, (x,), (blocks, k_pages, v_pages))
+    h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = jnp.einsum("bsh,vh->bsv", h, wte)[:, 0]
+    nxt = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
+    return k_pages, v_pages, nxt
+
+
+def prefill_fn(params, k_pages, v_pages, ids, true_len, dest_rows, key, *,
+               eps, temperature, top_k, use_flash):
+    """Prefill one request (batch 1, prompt padded to a bucket length):
+    full causal forward capturing per-layer K/V, scatter the true
+    tokens' K/V into the allocated pages (padding rows → sink page),
+    sample the first output token from position ``true_len - 1``.
+
+    Returns ``(k_pages, v_pages, first_token[1])``.
+    """
+    blocks, wte, wpe = params["blocks"], params["wte"], params["wpe"]
+    s = ids.shape[1]
+    np_, ps = k_pages.shape[1], k_pages.shape[2]
+    h = wte[ids] + wpe[jnp.arange(s)]
+
+    def pre(x, p):
+        out, k, v = gpt_block(p, x, eps, use_flash=use_flash,
+                              return_kv=True)
+        return out, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(pre, h, blocks)  # ks [L, 1, S, nkv, d]
+    L, _, _, nkv, d = ks.shape
+    dest_rows = dest_rows.astype(jnp.int32)
+    k_pages = k_pages.reshape(L, np_ * ps, nkv, d).at[:, dest_rows].set(
+        ks[:, 0]).reshape(k_pages.shape)
+    v_pages = v_pages.reshape(L, np_ * ps, nkv, d).at[:, dest_rows].set(
+        vs[:, 0]).reshape(v_pages.shape)
+    h_last = jax.lax.dynamic_slice_in_dim(
+        h, jnp.maximum(true_len - 1, 0), 1, axis=1)
+    h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
+    logits = jnp.einsum("bsh,vh->bsv", h_last, wte)[:, 0]
+    tok = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
+    return k_pages, v_pages, tok
+
+
+def default_prefill_buckets(page_size, max_seq_len):
+    """Doubling page-multiple prompt buckets covering max_seq_len —
+    small, closed, and every bucket is a whole number of pages."""
+    buckets, b = [], max(int(page_size), 1)
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_seq_len))
+    return tuple(sorted(set(buckets)))
+
+
+# ---------------------------------------------------------------------------
+
+class ServingEngine:
+    """See module docstring. ``model`` is a built GPT model (or anything
+    ``stack_gpt_weights`` accepts); ``config`` its :class:`GPTConfig`
+    (derived from the model when omitted)."""
+
+    def __init__(self, model, config=None, *, page_size=16, num_pages=None,
+                 max_seq_len=None, decode_buckets=(1, 2, 4, 8),
+                 prefill_buckets=None, temperature=0.0, top_k=0, seed=0,
+                 use_flash=None, use_kernel=True, aot=True):
+        gpt = model.gpt if hasattr(model, "gpt") else model
+        self.cfg: GPTConfig = config or gpt.config
+        cfg = self.cfg
+        self.params = stack_gpt_weights(model)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.use_kernel = bool(use_kernel)
+        max_seq_len = int(max_seq_len or cfg.max_position_embeddings)
+        if max_seq_len > cfg.max_position_embeddings:
+            raise ValueError("max_seq_len exceeds the position table")
+        self.decode_buckets = tuple(sorted(set(int(b)
+                                               for b in decode_buckets)))
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets or default_prefill_buckets(
+                page_size, max_seq_len)))))
+        if self.prefill_buckets[-1] < max_seq_len:
+            raise ValueError("largest prefill bucket must cover "
+                             "max_seq_len")
+        pages_per_seq = math.ceil(max_seq_len / page_size)
+        if num_pages is None:
+            # worst case: every slot of the widest bucket at full length,
+            # plus the sink page
+            num_pages = self.decode_buckets[-1] * pages_per_seq + 1
+        self.pool = PagePool(num_pages, page_size,
+                             num_layers=cfg.num_layers,
+                             num_kv_heads=cfg.num_heads,
+                             head_dim=cfg.head_dim,
+                             dtype=self.params["wte"].dtype,
+                             max_seq_len=max_seq_len)
+        self.max_seq_len = max_seq_len
+        self._key = jax.random.key(int(seed))
+        self._calls = 0
+        # donation lets XLA update the pool in place on TPU; the CPU
+        # backend can't donate and would warn on every step
+        donate = jax.default_backend() != "cpu"
+        eps = cfg.layer_norm_epsilon
+        self._decode_jit = jax.jit(
+            functools.partial(decode_step_fn, eps=eps,
+                              temperature=self.temperature,
+                              top_k=self.top_k,
+                              use_kernel=self.use_kernel),
+            donate_argnums=(1, 2) if donate else ())
+        self._prefill_jit = {
+            sb: jax.jit(
+                functools.partial(
+                    prefill_fn, eps=eps, temperature=self.temperature,
+                    top_k=self.top_k,
+                    use_flash=flash_attention_gate(sb, cfg.head_dim,
+                                                   use_flash)),
+                donate_argnums=(1, 2) if donate else ())
+            for sb in self.prefill_buckets}
+        self._decode_exe: dict = {}
+        self._prefill_exe: dict = {}
+        self.compile_s = 0.0
+        if aot:
+            self.compile_buckets()
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def from_checkpoint(cls, path, config: GPTConfig, **kw):
+        """checkpoint-load → engine: ``path`` is a ``paddle.save``d GPT
+        state dict (``GPTForPretraining`` or bare ``GPTModel`` keys)."""
+        from ..framework.io import load as paddle_load
+        from ..models.gpt import GPTForPretraining, GPTModel
+        state = paddle_load(path)
+        model = GPTForPretraining(GPTModel(config))
+        target = model
+        if not any(k.startswith("gpt.") for k in state):
+            target = model.gpt
+        target.set_state_dict(state)
+        return cls(model, config, **kw)
+
+    def compile_buckets(self):
+        """AOT-compile every (prefill, decode) bucket program so no
+        request mix ever compiles at serving time. Records wall time in
+        ``compile_s`` and the jit-compile telemetry counters."""
+        from ..observability.instrument import record_compile
+        t0 = time.perf_counter()
+        p = self.pool
+        kp = jax.ShapeDtypeStruct(p.k_pages.shape, p.k_pages.dtype)
+        params_avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params)
+        key_aval = jax.ShapeDtypeStruct(self._key.shape, self._key.dtype)
+        i32 = jnp.int32
+        for b in self.decode_buckets:
+            if b in self._decode_exe:
+                continue
+            self._decode_exe[b] = self._decode_jit.lower(
+                params_avals, kp, kp,
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                jax.ShapeDtypeStruct((b, p.max_pages_per_seq), i32),
+                jax.ShapeDtypeStruct((b,), i32),
+                key_aval).compile()
+        for sb in self.prefill_buckets:
+            if sb in self._prefill_exe:
+                continue
+            self._prefill_exe[sb] = self._prefill_jit[sb].lower(
+                params_avals, kp, kp,
+                jax.ShapeDtypeStruct((1, sb), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((sb,), i32),
+                key_aval).compile()
+        self.compile_s += time.perf_counter() - t0
+        record_compile(time.perf_counter() - t0, what="serving_buckets")
+
+    def decode_signatures(self) -> set:
+        """The closed set of decode step shapes: {(batch_bucket,
+        pages_per_seq)} — what the recompile lint checks the scheduler
+        against."""
+        return {(b, self.pool.max_pages_per_seq)
+                for b in self.decode_buckets}
+
+    # ------------------------------------------------------------ lookup
+    def _next_key(self):
+        self._calls += 1
+        return jax.random.fold_in(self._key, self._calls)
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        for sb in self.prefill_buckets:
+            if prompt_len <= sb:
+                return sb
+        raise EngineShapeError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}")
+
+    def decode_bucket(self, n_active: int) -> int:
+        for b in self.decode_buckets:
+            if n_active <= b:
+                return b
+        raise EngineShapeError(
+            f"{n_active} active sequences exceed the largest decode "
+            f"bucket {self.decode_buckets[-1]}")
+
+    def _decode_fn(self, bucket):
+        if bucket in self._decode_exe:
+            return self._decode_exe[bucket]
+        if bucket not in self.decode_buckets:
+            raise EngineShapeError(
+                f"decode batch {bucket} is not an AOT bucket "
+                f"{self.decode_buckets}")
+        return self._decode_jit  # aot=False: jit caches per bucket shape
+
+    def _prefill_fn(self, bucket):
+        if bucket in self._prefill_exe:
+            return self._prefill_exe[bucket]
+        if bucket not in self.prefill_buckets:
+            raise EngineShapeError(
+                f"prefill length {bucket} is not an AOT bucket "
+                f"{self.prefill_buckets}")
+        return self._prefill_jit[bucket]
+
+    # ------------------------------------------------------------- steps
+    def prefill(self, seq_id, prompt_ids) -> int:
+        """Allocate pages for ``prompt_ids``, run the bucketed prefill,
+        return the first generated token (int)."""
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        if n + 1 > self.max_seq_len:
+            raise EngineShapeError(
+                f"prompt of {n} tokens leaves no room to decode within "
+                f"max_seq_len {self.max_seq_len}")
+        sb = self.prefill_bucket(n)
+        self.pool.alloc(seq_id, n)
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :n] = prompt
+        rows = self.pool.prefill_rows(seq_id, sb)
+        kp, vp, tok = self._prefill_fn(sb)(
+            self.params, self.pool.k_pages, self.pool.v_pages,
+            jnp.asarray(ids), jnp.asarray(np.int32(n)),
+            jnp.asarray(rows), self._next_key())
+        self.pool.bind(kp, vp)
+        tok = int(np.asarray(tok)[0])
+        self._last_token[seq_id] = tok
+        return tok
+
+    def decode(self, seq_ids, bucket=None):
+        """One decode step for ``seq_ids`` (each already holding its new
+        position via ``pool.extend``), padded to ``bucket`` idle slots.
+        Returns the next token per live sequence (list of ints)."""
+        n = len(seq_ids)
+        bucket = self.decode_bucket(n) if bucket is None else bucket
+        if n > bucket:
+            raise EngineShapeError(f"{n} sequences > bucket {bucket}")
+        slots = list(seq_ids) + [None] * (bucket - n)
+        lens = self.pool.lens_array(slots)
+        table = self.pool.table_array(slots)
+        tokens = np.asarray(
+            [self._last_token.get(sid, 0) for sid in slots], np.int32)
+        positions = np.maximum(lens - 1, 0).astype(np.int32)
+        kp, vp, nxt = self._decode_fn(bucket)(
+            self.params, self.pool.k_pages, self.pool.v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(table), jnp.asarray(lens), self._next_key())
+        self.pool.bind(kp, vp)
+        out = [int(t) for t in np.asarray(nxt)[:n]]
+        for sid, t in zip(seq_ids, out):
+            self._last_token[sid] = t
+        return out
+
+    # engine tracks each sequence's pending (last sampled, not yet
+    # cached) token so scheduler and engine agree on what decodes next
+    @functools.cached_property
+    def _last_token(self) -> dict:
+        return {}
+
+    def release(self, seq_id):
+        self._last_token.pop(seq_id, None)
+        self.pool.free(seq_id)

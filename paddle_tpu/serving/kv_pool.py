@@ -1,0 +1,204 @@
+"""Block KV-cache pool: fixed-size HBM pages + per-sequence page tables.
+
+The serving engine's memory manager. The pool owns two device arrays —
+``k_pages``/``v_pages`` ``[num_layers, num_pages, page_size,
+num_kv_heads, head_dim]`` — and the host-side bookkeeping that maps
+sequences onto them: a free list and one page table (list of page ids)
+per live sequence. Live HBM therefore tracks *actual tokens* (rounded up
+to the page), not ``max_position_embeddings`` — the vLLM/"Ragged Paged
+Attention" scheme.
+
+Page 0 is the reserved **sink** page: padding page-table entries and
+padded prefill rows scatter into it, so every gather/scatter index the
+compiled decode step computes is in-bounds by construction regardless of
+how ragged the batch is. It is never allocated and never read unmasked.
+
+The device arrays are updated *functionally*: the engine passes
+``pool.k_pages`` into its jitted step (donated on TPU), gets the new
+arrays back, and rebinds them via :meth:`bind`. The host bookkeeping
+(``alloc``/``extend``/``free``) is plain Python — a few dict/list ops per
+request per step, never on the device critical path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagePool", "PagePoolError", "PagePoolOOM"]
+
+
+class PagePoolError(RuntimeError):
+    """Bookkeeping misuse: unknown/duplicate sequence, bad token count."""
+
+
+class PagePoolOOM(PagePoolError):
+    """Not enough free pages to satisfy an allocation."""
+
+
+class PagePool:
+    SINK = 0  # reserved padding/garbage page, never allocated
+
+    def __init__(self, num_pages, page_size, num_layers, num_kv_heads,
+                 head_dim, dtype="float32", max_seq_len=None):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (one is the sink)")
+        if page_size < 1:
+            raise ValueError(f"page_size {page_size} must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.max_seq_len = int(max_seq_len) if max_seq_len \
+            else (num_pages - 1) * page_size
+        # every decode shape carries the SAME pages-per-seq width: the
+        # page-table operand is static, only the batch bucket varies
+        self.max_pages_per_seq = max(
+            1, math.ceil(self.max_seq_len / self.page_size))
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_kv_heads, self.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype=dtype)
+        self.v_pages = jnp.zeros(shape, dtype=dtype)
+        # LIFO free list, deterministic: lowest page ids hand out first
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._tables: dict = {}   # seq_id -> [page, ...]
+        self._lens: dict = {}     # seq_id -> true token count
+
+    # ------------------------------------------------------------ sizing
+    def pages_needed(self, n_tokens: int) -> int:
+        return max(1, math.ceil(int(n_tokens) / self.page_size))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def live_tokens(self) -> int:
+        return sum(self._lens.values())
+
+    @property
+    def live_sequences(self) -> int:
+        return len(self._tables)
+
+    def stats(self) -> dict:
+        """Fragmentation accounting: ``utilization`` = live tokens over
+        the token capacity of the pages actually held, so
+        ``internal_fragmentation`` is the share of allocated HBM wasted
+        on partially-filled trailing pages."""
+        cap = self.pages_in_use * self.page_size
+        util = (self.live_tokens / cap) if cap else 1.0
+        itemsize = jnp.zeros((), self.k_pages.dtype).dtype.itemsize
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "pages_in_use": self.pages_in_use,
+            "free_pages": self.free_pages,
+            "live_sequences": self.live_sequences,
+            "live_tokens": self.live_tokens,
+            "capacity_tokens": (self.num_pages - 1) * self.page_size,
+            "utilization": round(util, 4),
+            "internal_fragmentation": round(1.0 - util, 4),
+            "pool_bytes": 2 * int(np.prod(self.k_pages.shape)) * itemsize,
+        }
+
+    # ------------------------------------------------------- bookkeeping
+    def alloc(self, seq_id, n_tokens: int):
+        """Register a new sequence holding ``n_tokens`` and hand it pages."""
+        if seq_id in self._tables:
+            raise PagePoolError(f"sequence {seq_id!r} already allocated")
+        n_tokens = int(n_tokens)
+        if n_tokens < 1:
+            raise PagePoolError(f"n_tokens {n_tokens} must be >= 1")
+        if n_tokens > self.max_seq_len:
+            raise PagePoolError(
+                f"n_tokens {n_tokens} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        need = self.pages_needed(n_tokens)
+        if need > len(self._free):
+            raise PagePoolOOM(
+                f"need {need} pages for {n_tokens} tokens, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = pages
+        self._lens[seq_id] = n_tokens
+        return list(pages)
+
+    def extend(self, seq_id, n_new: int = 1) -> int:
+        """Grow a sequence by ``n_new`` tokens, allocating pages as the
+        length crosses page boundaries. Returns the new length."""
+        if seq_id not in self._tables:
+            raise PagePoolError(f"unknown sequence {seq_id!r}")
+        new_len = self._lens[seq_id] + int(n_new)
+        if new_len > self.max_seq_len:
+            raise PagePoolError(
+                f"sequence {seq_id!r} would exceed max_seq_len "
+                f"{self.max_seq_len}")
+        need = self.pages_needed(new_len) - len(self._tables[seq_id])
+        if need > len(self._free):
+            raise PagePoolOOM(
+                f"sequence {seq_id!r} needs {need} more page(s), "
+                f"{len(self._free)} free")
+        for _ in range(need):
+            self._tables[seq_id].append(self._free.pop())
+        self._lens[seq_id] = new_len
+        return new_len
+
+    def free(self, seq_id):
+        """Return a sequence's pages to the pool."""
+        if seq_id not in self._tables:
+            raise PagePoolError(f"unknown sequence {seq_id!r}")
+        pages = self._tables.pop(seq_id)
+        del self._lens[seq_id]
+        # re-add in reverse so the pool reuses low page ids first again
+        self._free.extend(reversed(pages))
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def table(self, seq_id) -> list:
+        return list(self._tables[seq_id])
+
+    # ---------------------------------------------- device-facing arrays
+    def table_array(self, seq_ids) -> np.ndarray:
+        """Dense int32 page-table batch ``[B, max_pages_per_seq]`` for
+        the decode kernel; missing/short entries point at the sink."""
+        out = np.full((len(seq_ids), self.max_pages_per_seq), self.SINK,
+                      dtype=np.int32)
+        for i, sid in enumerate(seq_ids):
+            pages = self._tables.get(sid)
+            if pages:
+                out[i, :len(pages)] = pages
+        return out
+
+    def lens_array(self, seq_ids) -> np.ndarray:
+        """True lengths ``[B]`` int32 (0 for idle/unknown slots)."""
+        return np.asarray([self._lens.get(sid, 0) for sid in seq_ids],
+                          dtype=np.int32)
+
+    def prefill_rows(self, seq_id, bucket_len: int) -> np.ndarray:
+        """Flattened destination rows ``[bucket_len]`` int32 into the
+        ``[num_pages*page_size]`` page-row view for a prefill scatter:
+        token ``t`` of the sequence lands in its page's slot; padded
+        positions (``t >= seq_len``) land in the sink page."""
+        ps = self.page_size
+        pages = self._tables[seq_id]
+        n = self._lens[seq_id]
+        rows = np.empty(int(bucket_len), dtype=np.int32)
+        for t in range(int(bucket_len)):
+            if t < n:
+                rows[t] = pages[t // ps] * ps + (t % ps)
+            else:
+                rows[t] = self.SINK * ps + (t % ps)
+        return rows
+
+    def bind(self, k_pages, v_pages):
+        """Rebind the device arrays after a functional update (the jitted
+        step returns the new pool contents)."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages

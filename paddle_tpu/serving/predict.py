@@ -1,0 +1,140 @@
+"""``serving_predicted``: static cost-model row for the serving engine.
+
+A TPU-less bench round still owes serving numbers (ROADMAP: every perf
+claim lands in the artifact, measured or ``*_predicted``). This module
+traces the engine's REAL decode step (:func:`..serving.engine.
+decode_step_fn`, XLA-reference attention path so every op is modelable)
+to a jaxpr — abstract shapes only, no weights materialized, no device —
+and prices it with the PR-5 roofline cost model
+(:func:`paddle_tpu.analysis.passes.cost.estimate_jaxpr_cost`).
+
+Decode is one token per live stream per step, so
+
+- ``predicted_tokens_per_sec``   = concurrency / step_time,
+- per-token latency p50 = p95   = step_time (the decode loop is a
+  fixed-shape program; the static model has no jitter term — measured
+  rows carry the real spread).
+
+CLI (bench.py shells out here so a wedged backend can't take the row
+down with it)::
+
+    python -m paddle_tpu.serving.predict --config 345m --concurrency 8
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import math
+import sys
+
+__all__ = ["predicted_serving_row"]
+
+
+def predicted_serving_row(config: str = "345m", concurrency: int = 8,
+                          page_size: int = 64, chip: str = "v5e",
+                          dtype: str = "bfloat16") -> dict:
+    import jax
+    import jax.numpy as jnp
+    from ..analysis.passes.cost import estimate_jaxpr_cost
+    from ..models.gpt import (gpt_13b_config, gpt_1p3b_config,
+                              gpt_345m_config, gpt_tiny_config)
+    from ..observability.instrument import chip_specs
+    from .engine import decode_step_fn
+
+    cfgs = {
+        "tiny": lambda: gpt_tiny_config(),
+        # the bench's TPU-native 345M shape (d_head=128)
+        "345m": lambda: gpt_345m_config(max_position_embeddings=1024,
+                                        num_heads=8),
+        "1.3b": lambda: gpt_1p3b_config(),
+        "13b": lambda: gpt_13b_config(),
+    }
+    cfg = cfgs[config]()
+    L, H, nh, d = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                   cfg.head_dim)
+    V, F = cfg.vocab_size, cfg.intermediate_size
+    B = int(concurrency)
+    ps = int(page_size)
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = B * pages_per_seq + 1
+    wdt = jnp.dtype(dtype)
+    sds = jax.ShapeDtypeStruct
+    params = {
+        "blocks": {
+            "ln1_w": sds((L, H), wdt), "ln1_b": sds((L, H), wdt),
+            "wqkv": sds((L, H, 3, nh, d), wdt),
+            "bqkv": sds((L, 3, nh, d), wdt),
+            "wo": sds((L, nh, d, H), wdt), "bo": sds((L, H), wdt),
+            "ln2_w": sds((L, H), wdt), "ln2_b": sds((L, H), wdt),
+            "w1": sds((L, H, F), wdt), "b1": sds((L, F), wdt),
+            "w2": sds((L, F, H), wdt), "b2": sds((L, H), wdt),
+        },
+        "wte": sds((V, H), wdt),
+        "wpe": sds((cfg.max_position_embeddings, H), wdt),
+        "lnf_w": sds((H,), wdt), "lnf_b": sds((H,), wdt),
+    }
+    kp = sds((L, num_pages, ps, nh, d), wdt)
+    i32 = jnp.int32
+    fn = functools.partial(decode_step_fn, eps=cfg.layer_norm_epsilon,
+                           temperature=0.0, top_k=0, use_kernel=False)
+    closed = jax.make_jaxpr(fn)(
+        params, kp, kp, sds((B,), i32), sds((B,), i32),
+        sds((B, pages_per_seq), i32), sds((B,), i32), None)
+    spec = chip_specs(chip)
+    cost = estimate_jaxpr_cost(closed, chip=spec)
+    step_s = cost.step_ms / 1e3
+    itemsize = jnp.zeros((), wdt).dtype.itemsize
+    pool_bytes = 2 * L * num_pages * ps * nh * d * itemsize
+    return {
+        "config": config,
+        "concurrency": B,
+        "page_size": ps,
+        "pages_per_seq": pages_per_seq,
+        "dtype": dtype,
+        "predicted_decode_step_ms": round(cost.step_ms, 3),
+        "predicted_tokens_per_sec": round(B / step_s, 1) if step_s else 0.0,
+        "predicted_per_token_ms_p50": round(cost.step_ms, 3),
+        "predicted_per_token_ms_p95": round(cost.step_ms, 3),
+        "predicted_bound": cost.bound,
+        "kv_pool_mb": round(pool_bytes / 2 ** 20, 1),
+        "chip_assumed": spec.get("name"),
+    }
+
+
+def _main(argv=None):
+    import os
+    import subprocess
+
+    ap = argparse.ArgumentParser(
+        description="static serving-decode prediction (one JSON row)")
+    ap.add_argument("--config", default="345m",
+                    choices=["tiny", "345m", "1.3b", "13b"])
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--chip", default="v5e")
+    args = ap.parse_args(argv)
+    if not os.environ.get("_PREDICT_RESPAWNED"):
+        # same contract as analysis.predict: force the CPU backend in a
+        # fresh process BEFORE jax initializes — the sitecustomize
+        # force-selects the TPU, and the no-backend bench path calls
+        # this precisely because that TPU is wedged
+        env = dict(os.environ,
+                   _PREDICT_RESPAWNED="1", JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.serving.predict"]
+            + (argv if argv is not None else sys.argv[1:]),
+            env=env).returncode
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        row = predicted_serving_row(args.config, args.concurrency,
+                                    args.page_size, args.chip)
+    except Exception as e:  # noqa: BLE001 — the row must say why
+        row = {"config": args.config, "error": repr(e)[:300]}
+    print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

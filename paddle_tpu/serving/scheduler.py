@@ -1,0 +1,280 @@
+"""Continuous batching: admit/evict every step over bucketed decode shapes.
+
+The scheduler owns the request lifecycle (queued → running → finished)
+and drives the engine one decode step at a time:
+
+1. **evict** — sequences that hit ``max_new_tokens`` (or the optional
+   EOS id) release their pages back to the pool;
+2. **admit** — queued requests prefill (allocating pages) while a free
+   batch slot exists AND the pool can hold the request's *full*
+   completion (prompt + max_new, reserved up front, so a running
+   sequence can never OOM the pool mid-decode);
+3. **decode** — the active set, in deterministic (admission-order) slot
+   order, runs one step of the smallest AOT batch bucket that fits.
+
+Every decode signature the scheduler can ever request is therefore
+``(bucket, pages_per_seq)`` for a configured bucket —
+:func:`simulate_decode_signatures` replays this exact logic (device-free)
+over a randomized admission mix so ``tools/check_program.py`` can prove
+the AOT shape set is closed: zero retraces at serving time.
+
+Telemetry: queue depth / KV pages gauges, request + token counters, a
+TTFT histogram, and per-step ``record_train_step(path="serving")`` so
+serving steps ride the flight recorder and anomaly monitors exactly like
+train steps.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["Request", "ContinuousBatchingScheduler",
+           "simulate_decode_signatures"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                 # [S] int32
+    max_new_tokens: int
+    eos_id: int | None = None
+    submit_time: float = field(default_factory=time.perf_counter)
+    admit_time: float | None = None
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    tokens: list = field(default_factory=list)   # generated ids
+    state: str = "queued"              # queued|running|finished|rejected
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    @property
+    def done(self) -> bool:
+        if len(self.tokens) >= self.max_new_tokens:
+            return True
+        return bool(self.eos_id is not None and self.tokens
+                    and self.tokens[-1] == self.eos_id)
+
+    def summary(self) -> dict:
+        """Per-request serving record (times in seconds)."""
+        queue_wait = (self.admit_time or 0) - self.submit_time \
+            if self.admit_time else None
+        ttft = (self.first_token_time or 0) - self.submit_time \
+            if self.first_token_time else None
+        tps = None
+        if self.finish_time and self.first_token_time \
+                and len(self.tokens) > 1:
+            span = self.finish_time - self.first_token_time
+            if span > 0:
+                tps = (len(self.tokens) - 1) / span
+        return {"rid": self.rid, "state": self.state,
+                "prompt_len": int(self.prompt.shape[0]),
+                "new_tokens": len(self.tokens),
+                "queue_wait_s": queue_wait, "ttft_s": ttft,
+                "decode_tokens_per_sec": tps}
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine, max_queue: int = 1024):
+        self.engine = engine
+        self.buckets = tuple(engine.decode_buckets)
+        self.max_concurrency = self.buckets[-1]
+        self.max_queue = int(max_queue)
+        self._queue: deque = deque()
+        self._running: dict = {}          # rid -> Request, insertion order
+        self._reserved_pages = 0          # pages promised, not yet alloc'd
+        self._rid = itertools.count()
+        self.finished: list = []
+        self.step_times: list = []        # decode-step walltimes (s)
+        self.steps = 0
+
+    # ----------------------------------------------------------- intake
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_id=None) -> Request:
+        from ..observability import instrument as obs
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        r = Request(next(self._rid), prompt, int(max_new_tokens),
+                    eos_id=eos_id)
+        pool = self.engine.pool
+        total = prompt.shape[0] + r.max_new_tokens
+        # max_new >= 1: prefill always emits one token, so total >= n+1
+        # and the engine's prompt-room check can never fire at admission
+        if r.max_new_tokens < 1 or total > pool.max_seq_len \
+                or len(self._queue) >= self.max_queue \
+                or pool.pages_needed(total) > pool.num_pages - 1:
+            r.state = "rejected"
+            obs.serving_requests_counter().inc(event="rejected")
+            return r
+        self._queue.append(r)
+        obs.serving_requests_counter().inc(event="submitted")
+        obs.serving_queue_depth_gauge().set(float(len(self._queue)))
+        return r
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + len(self._running)
+
+    # ------------------------------------------------------------ phases
+    def _completion_pages(self, r: Request) -> int:
+        return self.engine.pool.pages_needed(
+            int(r.prompt.shape[0]) + r.max_new_tokens)
+
+    def _evict_finished(self):
+        from ..observability import instrument as obs
+        for rid in [rid for rid, r in self._running.items() if r.done]:
+            r = self._running.pop(rid)
+            held = len(self.engine.pool.table(rid))
+            self._reserved_pages -= self._completion_pages(r) - held
+            self.engine.release(rid)
+            r.state = "finished"
+            r.finish_time = time.perf_counter()
+            self.finished.append(r)
+            obs.serving_requests_counter().inc(event="finished")
+
+    def _admit(self):
+        from ..observability import instrument as obs
+        pool = self.engine.pool
+        while self._queue and len(self._running) < self.max_concurrency:
+            r = self._queue[0]
+            need = self._completion_pages(r)
+            if pool.free_pages - self._reserved_pages < need:
+                break  # head-of-line: keep arrival order deterministic
+            self._queue.popleft()
+            r.admit_time = time.perf_counter()
+            tok = self.engine.prefill(r.rid, r.prompt)
+            self._reserved_pages += need - len(pool.table(r.rid))
+            r.tokens.append(tok)
+            r.state = "running"
+            r.first_token_time = time.perf_counter()
+            self._running[r.rid] = r
+            obs.serving_requests_counter().inc(event="admitted")
+            obs.serving_ttft_histogram().observe(
+                r.first_token_time - r.submit_time)
+            obs.serving_tokens_out_counter().inc()
+
+    def step(self) -> bool:
+        """One scheduler tick (evict → admit → one bucketed decode step).
+        Returns False when idle (nothing queued or running)."""
+        from ..observability import instrument as obs
+        self._evict_finished()
+        self._admit()
+        obs.serving_queue_depth_gauge().set(float(len(self._queue)))
+        obs.serving_kv_pages_gauge().set(
+            float(self.engine.pool.pages_in_use))
+        # admission may have finished short requests (max_new=1)
+        active = [r for r in self._running.values() if not r.done]
+        if not active:
+            return bool(self._queue or self._running)
+        t0 = time.perf_counter()
+        # ONE bucket-selection implementation: the engine's (raises
+        # EngineShapeError on overflow, same as every other shape gate)
+        bucket = self.engine.decode_bucket(len(active))
+        pool = self.engine.pool
+        for r in active:
+            held = len(pool.table(r.rid))
+            pool.extend(r.rid, 1)
+            self._reserved_pages -= len(pool.table(r.rid)) - held
+        toks = self.engine.decode([r.rid for r in active], bucket)
+        for r, t in zip(active, toks):
+            r.tokens.append(t)
+        dt = time.perf_counter() - t0
+        self.steps += 1
+        self.step_times.append(dt)
+        obs.serving_tokens_out_counter().inc(float(len(active)))
+        # serving steps feed the flight recorder + anomaly monitors the
+        # same way train steps do
+        obs.record_train_step(dt, tokens=len(active), path="serving")
+        return True
+
+    def run(self, max_steps: int | None = None) -> list:
+        """Drive until drained (or ``max_steps``); returns the finished
+        requests in completion order."""
+        n = 0
+        while self.pending:
+            if max_steps is not None and n >= max_steps:
+                break
+            self.step()
+            n += 1
+        self._evict_finished()
+        return self.finished
+
+
+# ---------------------------------------------------------------------------
+# static bucket-closure proof (device-free)
+# ---------------------------------------------------------------------------
+
+class _ShapeProbeEngine:
+    """Engine stand-in for :func:`simulate_decode_signatures`: real
+    :class:`~.kv_pool.PagePool` bookkeeping and bucket tables, but
+    prefill/decode only record the shapes they were asked for. Must
+    mirror the real engine's interface the scheduler touches."""
+
+    def __init__(self, decode_buckets, prefill_buckets, page_size,
+                 num_pages, max_seq_len):
+        from .kv_pool import PagePool
+        self.decode_buckets = tuple(sorted(set(decode_buckets)))
+        self.prefill_buckets = tuple(sorted(set(prefill_buckets)))
+        self.pool = PagePool(num_pages, page_size, num_layers=1,
+                             num_kv_heads=1, head_dim=1,
+                             max_seq_len=max_seq_len)
+        self.decode_signatures_used: set = set()
+        self.prefill_signatures_used: set = set()
+
+    def prefill(self, seq_id, prompt_ids):
+        n = int(np.asarray(prompt_ids).reshape(-1).shape[0])
+        from .engine import ServingEngine
+        sb = ServingEngine.prefill_bucket(self, n)
+        self.pool.alloc(seq_id, n)
+        self.prefill_signatures_used.add((1, sb))
+        return 0
+
+    def prefill_bucket(self, n):  # same lookup the real engine uses
+        from .engine import ServingEngine
+        return ServingEngine.prefill_bucket(self, n)
+
+    def decode_bucket(self, n):
+        from .engine import ServingEngine
+        return ServingEngine.decode_bucket(self, n)
+
+    def decode(self, seq_ids, bucket):
+        self.decode_signatures_used.add(
+            (int(bucket), self.pool.max_pages_per_seq))
+        return [0] * len(seq_ids)
+
+    def release(self, seq_id):
+        self.pool.free(seq_id)
+
+
+def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
+                               num_pages, max_seq_len, n_requests=200,
+                               seed=0, arrival_p=0.35):
+    """Replay the REAL scheduler over a randomized admission mix (ragged
+    prompt lengths, random completion budgets, bursty arrivals) with a
+    shape-probe engine. Returns ``(decode_sigs_used, prefill_sigs_used,
+    allowed_decode_sigs, allowed_prefill_sigs)`` — the recompile lint
+    proves ``used ⊆ allowed``: the AOT bucket set is closed and no
+    request mix can retrace at serving time."""
+    rng = np.random.default_rng(seed)
+    eng = _ShapeProbeEngine(decode_buckets, prefill_buckets, page_size,
+                            num_pages, max_seq_len)
+    sched = ContinuousBatchingScheduler(eng)
+    submitted = 0
+    while submitted < n_requests or sched.pending:
+        while submitted < n_requests and rng.random() < arrival_p:
+            s = int(rng.integers(1, max_seq_len))
+            new = int(rng.integers(1, max(2, max_seq_len - s + 1)))
+            sched.submit(np.zeros(s, np.int32), new)
+            submitted += 1
+        if sched.pending:
+            sched.step()
+    pages_per_seq = eng.pool.max_pages_per_seq
+    allowed_decode = {(b, pages_per_seq) for b in eng.decode_buckets}
+    allowed_prefill = {(1, sb) for sb in eng.prefill_buckets}
+    return (eng.decode_signatures_used, eng.prefill_signatures_used,
+            allowed_decode, allowed_prefill)

@@ -66,6 +66,8 @@ def test_bench_skip_lines_when_no_backend(monkeypatch, capsys):
     monkeypatch.setattr(bench, "acquire_devices", lambda: None)
     monkeypatch.setattr(bench, "emit_predicted_rows",
                         lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "emit_serving_predicted_row",
+                        lambda *a, **kw: None)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     bench.main()
     out = capsys.readouterr().out
@@ -86,9 +88,13 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
     recs = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
     predicted = [r for r in recs if r["metric"].endswith("_predicted")]
     assert {r["metric"] for r in predicted} == {
-        "gpt_345m_predicted", "gpt_1p3b_predicted", "gpt_13b_predicted"}
+        "gpt_345m_predicted", "gpt_1p3b_predicted", "gpt_13b_predicted",
+        "serving_predicted"}
     for r in predicted:
-        assert r["extras"]["predicted_peak_hbm_mb"] > 0
+        if r["metric"] == "serving_predicted":
+            assert r["extras"]["predicted_tokens_per_sec"] > 0
+        else:
+            assert r["extras"]["predicted_peak_hbm_mb"] > 0
 
 
 def test_bench_probe_failure_falls_back_to_cpu(monkeypatch):

@@ -205,3 +205,100 @@ def test_supported_gate_ragged():
     # but mostly-padding shapes stay on XLA
     assert not supported((2, 10, 4, 64))
     assert not supported((2, 256, 4, 64), (2, 10, 4, 64), (2, 10, 4, 64))
+
+
+# ------------------------------------------------- causal query offset
+# (cached decode / chunked prefill: rows offset+i attend keys <= offset+i)
+
+def _causal_offset_ref(q, k, v, offset):
+    d = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+    row = offset + jnp.arange(q.shape[1])[:, None]
+    col = jnp.arange(k.shape[1])[None, :]
+    s = jnp.where(row >= col, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@pytest.mark.parametrize("sq,sk,off", [(128, 384, 256),   # aligned chunk
+                                       (100, 300, 137),   # ragged both
+                                       (130, 391, 200)])
+def test_flash_causal_offset_matches_ref(sq, sk, off):
+    """Causal sk != sq with a query offset — the shape that used to be
+    rejected (cached decode fell back to XLA)."""
+    bn, d = 2, 64
+    q = _rand((bn, sq, d), seed=50)
+    k = _rand((bn, sk, d), seed=51)
+    v = _rand((bn, sk, d), seed=52)
+    out = flash_attention(q, k, v, causal=True, q_offset=off)
+    ref = _causal_offset_ref(q, k, v, off)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_flash_causal_offset_backward_matches_ref():
+    bn, sq, sk, off, d = 2, 128, 320, 150, 64
+    q = _rand((bn, sq, d), seed=60)
+    k = _rand((bn, sk, d), seed=61)
+    v = _rand((bn, sk, d), seed=62)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(
+            flash_attention(q, k, v, causal=True, q_offset=off)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(_causal_offset_ref(q, k, v, off)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} offset")
+
+
+def test_flash_causal_offset_zero_equals_classic():
+    """q_offset=0 at sq == sk is exactly the classic causal kernel."""
+    bn, s, d = 2, 256, 64
+    q, k, v = (_rand((bn, s, d), seed=70 + i) for i in range(3))
+    a = flash_attention(q, k, v, causal=True)
+    b_ = flash_attention(q, k, v, causal=True, q_offset=0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_flash_causal_offset_bshd_and_gate():
+    """bshd layout pass-through + supported() accepts offset shapes."""
+    b, sq, sk, off, n, d = 1, 128, 256, 128, 2, 64
+    q = _rand((b, sq, n, d), seed=80)
+    k = _rand((b, sk, n, d), seed=81)
+    v = _rand((b, sk, n, d), seed=82)
+    out = flash_attention_bshd(q, k, v, causal=True, q_offset=off)
+    e = lambda t: t.transpose(0, 2, 1, 3).reshape(-1, t.shape[1], d)
+    ref = _causal_offset_ref(e(q), e(k), e(v), off)
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3).reshape(-1, sq, d)),
+        np.asarray(ref), rtol=3e-4, atol=3e-4)
+    assert supported((2, 256, 4, 64), (2, 512, 4, 64), (2, 512, 4, 64),
+                     causal=True, q_offset=256)
+    # offsets past the key horizon or negative stay rejected — the gate
+    # must match exactly what the wrapper accepts
+    assert not supported((2, 256, 4, 64), (2, 512, 4, 64),
+                         (2, 512, 4, 64), causal=True, q_offset=300)
+    assert not supported((2, 256, 4, 64), (2, 512, 4, 64),
+                         (2, 512, 4, 64), causal=True, q_offset=-8)
+    # equal lengths leave no room for a nonzero offset (wrapper raises)
+    assert not supported((2, 256, 4, 64), (2, 256, 4, 64),
+                         (2, 256, 4, 64), causal=True, q_offset=300)
+    assert not supported((2, 256, 4, 64), causal=True, q_offset=1)
+    assert supported((2, 256, 4, 64), causal=True, q_offset=0)
+    # offset without causal: the wrapper raises, the gate says no
+    assert not supported((2, 256, 4, 64), (2, 512, 4, 64),
+                         (2, 512, 4, 64), q_offset=128)
+    # and the wrapper itself rejects out-of-range / misused offsets
+    with pytest.raises(ValueError):
+        flash_attention(_rand((2, 256, 64)), _rand((2, 512, 64)),
+                        _rand((2, 512, 64)), causal=True, q_offset=300)
+    with pytest.raises(ValueError):  # offset without causal would be a
+        flash_attention(_rand((2, 256, 64)), _rand((2, 512, 64)),  # no-op
+                        _rand((2, 512, 64)), q_offset=128)

@@ -153,7 +153,87 @@ def lint_ernie_moe(world_size=None, hbm_budget_gb=None):
     return reports
 
 
-MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe}
+def lint_serving(world_size=None, hbm_budget_gb=None):
+    """Serving decode gate: (1) the pass suite over the engine's decode
+    step (collective schedule stays clean — no rank-divergent ops hide
+    in the serving path), and (2) the recompile proof — replay a
+    randomized admission mix through the REAL continuous-batching
+    scheduler (device-free shape probe) and require every decode/prefill
+    signature to fall inside the engine's AOT bucket set: a shape
+    outside the set would retrace per request mix at serving time
+    (PTRC002-class), and the engine would raise on it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.analysis.core import Diagnostic, Report
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTModel,
+                                       gpt_tiny_config)
+    from paddle_tpu.ops._dispatch import unwrap
+    from paddle_tpu.serving import ServingEngine, simulate_decode_signatures
+    from paddle_tpu.serving.engine import decode_step_fn
+    import functools
+
+    paddle.seed(0)
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    # aot=False: the lint is abstract — no bucket programs compile here
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2, 4),
+                        aot=False)
+    pool = eng.pool
+    bucket = eng.decode_buckets[-1]
+    fn = functools.partial(decode_step_fn, eps=cfg.layer_norm_epsilon,
+                           temperature=0.0, top_k=0, use_kernel=False)
+
+    def decode(kp, vp, tokens, positions, table, lens):
+        # analyzer hands Tensor-wrapped tracers; the decode step is pure
+        # jax — unwrap at the boundary (key=None: greedy)
+        a = [unwrap(t) for t in (kp, vp, tokens, positions, table, lens)]
+        return fn(eng.params, *a, None)
+
+    i32 = jnp.int32
+    kp = jax.ShapeDtypeStruct(pool.k_pages.shape, pool.k_pages.dtype)
+    reports = [ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
+        decode, kp, kp,
+        jax.ShapeDtypeStruct((bucket,), i32),
+        jax.ShapeDtypeStruct((bucket,), i32),
+        jax.ShapeDtypeStruct((bucket, pool.max_pages_per_seq), i32),
+        jax.ShapeDtypeStruct((bucket,), i32),
+        name="serving.decode_step")]
+
+    used_d, used_p, ok_d, ok_p = simulate_decode_signatures(
+        eng.decode_buckets, eng.prefill_buckets, pool.page_size,
+        pool.num_pages, eng.max_seq_len, n_requests=200, seed=0)
+    diags = []
+    if ok_d != eng.decode_signatures():
+        # the closure proof is only a proof if the probe's allowed set
+        # IS the set the real engine AOT-compiles
+        diags.append(Diagnostic(
+            "PTRC002", "recompile", "error",
+            f"shape-probe allowed set {sorted(ok_d)} drifted from the "
+            f"engine's AOT decode signatures "
+            f"{sorted(eng.decode_signatures())}",
+            op="serving.decode"))
+    for used, ok, what in ((used_d, ok_d, "decode"),
+                           (used_p, ok_p, "prefill")):
+        escaped = sorted(used - ok)
+        if escaped:
+            diags.append(Diagnostic(
+                "PTRC002", "recompile", "error",
+                f"serving {what} requested shape(s) {escaped} outside "
+                f"the AOT bucket set {sorted(ok)} — every such shape "
+                f"retraces at serving time; widen the bucket config",
+                op=f"serving.{what}"))
+    rep = Report("serving.decode_buckets", diags)
+    rep.emit()
+    reports.append(rep)
+    return reports
+
+
+MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe,
+          "serving": lint_serving}
 
 
 def lint_model(name, world_size=None, hbm_budget_gb=None):
