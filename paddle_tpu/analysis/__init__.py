@@ -53,6 +53,11 @@ PTCS001 comm-bound step: interconnect time exceeds compute+HBM
         (warning)
 PTCS002 low arithmetic intensity: step sits under the chip's ridge
         point (info)
+PTCS003 compression would flip the bound: int8 wire (compressed
+        collectives) would make the comm-bound step compute/HBM-bound
+        — the what-if PTCS001 carries, promoted to its own finding;
+        ``distributed.auto_enable_compression(report)`` acts on it
+        (info)
 PTMM001 predicted peak HBM exceeds the budget — OOM before compile
         (error)
 PTBD001 use-after-donate: donated input read after the jitted call

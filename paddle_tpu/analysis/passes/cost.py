@@ -19,14 +19,28 @@ ranks moves ``2(n-1)/n × b`` per device on the wire (the EQuARX lens) —
 both for in-jit prims (psum/all_gather/...) and for the eager
 ``distributed.collective`` ledger the trace recorded.
 
+Wire-dtype model (EQuARX): every collective is priced at its payload's
+wire bytes — compressed collectives (int8 avals in the jaxpr, or eager
+ledger records carrying ``wire_dtype``) automatically cost less, and a
+``wire_dtype=`` override re-prices the WHOLE schedule at that dtype so
+"what would int8 wire save" is a pure function of the trace. The
+summary always carries the int8 what-if (``comm_bytes_int8`` /
+``comm_ms_int8`` / ``bound_if_int8``), which PTCS001 reports and
+``distributed.auto_enable_compression`` consumes.
+
 Diagnostics:
 
 - **PTCS001** (warning) — comm-bound step: predicted interconnect time
   exceeds both compute and HBM time. The collective schedule, not the
   math, sets the step time — re-shard or overlap before burning chips.
+  Carries the int8-compression what-if in ``extra["whatif_int8"]``.
 - **PTCS002** (info) — low arithmetic intensity: FLOPs/HBM-byte below
   the chip's ridge point on a non-trivial program — the MXU waits on
   HBM; fuse, batch, or cast down.
+- **PTCS003** (info) — compression would flip the bound: the step is
+  comm-bound at the current wire dtype but int8-compressed collectives
+  (``new_group(compress="int8")`` / ``prims.c_*_q``) would make it
+  compute- or HBM-bound — the cheapest predicted win on the table.
 """
 from __future__ import annotations
 
@@ -109,6 +123,23 @@ _EAGER_COLLECTIVES = {
     "barrier": lambda b, n: 0.0,
 }
 
+def _compressed_nbytes(nbytes, itemsize, wire_dtype):
+    """Wire bytes of a logical payload under int8/bf16 compression —
+    shared with :mod:`paddle_tpu.distributed.compress` (one formula,
+    one answer)."""
+    from ...distributed.compress import compressed_nbytes
+    return compressed_nbytes(nbytes, itemsize, wire_dtype)
+
+
+def _floating_dtype(dtype) -> bool:
+    """Mirror of the runtime's ``wire_for_dtype`` float-only rule, so
+    the what-if never promises savings on integer/bool payloads the
+    compressed path will refuse to quantize. String-based so bfloat16
+    (not a numpy-native dtype) classifies correctly."""
+    s = str(dtype)
+    return "float" in s or s.startswith("bf")
+
+
 # sustained-MXU efficiency knob: a raw peak-FLOPs roofline predicts 100%
 # MFU, which no real schedule reaches; 0.55 is calibrated against the
 # measured 345M/1.3B rows in BENCH_r0x (50-57% MFU) so predicted and
@@ -149,13 +180,17 @@ class CostSummary:
     flops: float = 0.0            # per-device FLOPs per step
     hbm_bytes: float = 0.0        # per-device HBM traffic per step
     comm_bytes: float = 0.0       # per-device wire bytes per step
+    comm_bytes_int8: float = 0.0  # what-if: same schedule, int8 wire
+    wire_dtype: str | None = None  # forced wire dtype, if any
     by_prim: dict = field(default_factory=dict)  # name -> [flops, bytes, n]
     chip: dict = field(default_factory=dict)
     compute_ms: float = 0.0
     hbm_ms: float = 0.0
     comm_ms: float = 0.0
+    comm_ms_int8: float = 0.0
     step_ms: float = 0.0
     bound: str = "compute"        # compute | memory | comm
+    bound_if_int8: str = "compute"
     predicted_mfu: float = 0.0
     arithmetic_intensity: float = 0.0
     ridge: float = 0.0            # chip ridge point, FLOPs per HBM byte
@@ -171,6 +206,12 @@ class CostSummary:
         self.bound = {self.compute_ms: "compute", self.hbm_ms: "memory",
                       self.comm_ms: "comm"}[
             max(self.compute_ms, self.hbm_ms, self.comm_ms)]
+        # the compression what-if: identical schedule, int8 wire
+        self.comm_ms_int8 = 1e3 * self.comm_bytes_int8 / chip["ici_bw"]
+        self.bound_if_int8 = {
+            self.compute_ms: "compute", self.hbm_ms: "memory",
+            self.comm_ms_int8: "comm"}[
+            max(self.compute_ms, self.hbm_ms, self.comm_ms_int8)]
         self.predicted_mfu = (self.flops / (self.step_ms / 1e3)
                               / chip["peak_flops"]) if self.flops else 0.0
         self.arithmetic_intensity = (self.flops / self.hbm_bytes
@@ -178,14 +219,26 @@ class CostSummary:
         self.ridge = chip["peak_flops"] / chip["hbm_bw"]
         return self
 
+    @property
+    def int8_wire_reduction(self):
+        """Predicted wire-bytes reduction of int8 compression (>= 1)."""
+        if not self.comm_bytes or not self.comm_bytes_int8:
+            return 1.0
+        return self.comm_bytes / self.comm_bytes_int8
+
     def as_dict(self):
         return {
             "flops": self.flops, "hbm_bytes": self.hbm_bytes,
             "comm_bytes": self.comm_bytes,
+            "comm_bytes_int8": self.comm_bytes_int8,
+            "int8_wire_reduction": round(self.int8_wire_reduction, 3),
+            "wire_dtype": self.wire_dtype,
             "compute_ms": round(self.compute_ms, 4),
             "hbm_ms": round(self.hbm_ms, 4),
             "comm_ms": round(self.comm_ms, 4),
+            "comm_ms_int8": round(self.comm_ms_int8, 4),
             "step_ms": round(self.step_ms, 4), "bound": self.bound,
+            "bound_if_int8": self.bound_if_int8,
             "predicted_mfu": round(self.predicted_mfu, 4),
             "arithmetic_intensity": round(self.arithmetic_intensity, 2),
             "chip": self.chip.get("name"),
@@ -229,14 +282,6 @@ def _default_flops(eqn):
     return flops
 
 
-def _anchor_bytes(eqn):
-    """HBM traffic of an op that materializes: stream inputs + outputs."""
-    nbytes = sum(_nbytes(v.aval) for v in eqn.invars
-                 if not isinstance(v, jax.core.Literal))
-    nbytes += sum(_nbytes(v.aval) for v in eqn.outvars)
-    return float(nbytes)
-
-
 def _sub_jaxprs(params):
     for v in params.values():
         stack = [v]
@@ -262,16 +307,32 @@ def _axis_size(axes, axis_sizes, default=1):
 
 
 class _JaxprCoster:
-    """One walk = one CostSummary accumulation (global mesh context)."""
+    """One walk = one CostSummary accumulation (global mesh context).
+    ``wire_dtype`` forces every collective's payload onto that wire
+    (the what-if re-pricing knob); int8 what-if bytes are accumulated
+    alongside the actual bytes either way."""
 
-    def __init__(self, summary: CostSummary, axis_sizes: dict):
+    def __init__(self, summary: CostSummary, axis_sizes: dict,
+                 wire_dtype=None):
         self.s = summary
         self.axis_sizes = dict(axis_sizes or {})
+        self.wire_dtype = wire_dtype
+        # storage-aware operand bytes: a convert_element_type fuses into
+        # its consumer's HBM read, so a matmul fed by convert(int8->bf16)
+        # streams the int8 buffer, not a materialized bf16 copy — this
+        # map remembers the narrower storage behind view/convert chains
+        self._storage: dict = {}
 
-    def charge(self, name, flops, nbytes, comm=0.0):
+    def _sbytes(self, v):
+        """HBM bytes behind ``v``: its aval size, unless it is a fused
+        view/convert of a narrower stored buffer."""
+        return self._storage.get(id(v), _nbytes(v.aval))
+
+    def charge(self, name, flops, nbytes, comm=0.0, comm_int8=None):
         self.s.flops += flops
         self.s.hbm_bytes += nbytes
         self.s.comm_bytes += comm
+        self.s.comm_bytes_int8 += comm if comm_int8 is None else comm_int8
         rec = self.s.by_prim.setdefault(name, [0.0, 0.0, 0])
         rec[0] += flops
         rec[1] += nbytes
@@ -309,6 +370,17 @@ class _JaxprCoster:
             for v in eqn.outvars:
                 div[id(v)] = d_out
 
+            # narrow-storage propagation: converts remember the stored
+            # width they stream from; free view ops pass it through
+            if name in ("convert_element_type",) or name in _FREE:
+                ins = [v for v in eqn.invars
+                       if not isinstance(v, jax.core.Literal)]
+                if ins and eqn.outvars:
+                    sb = min(self._sbytes(ins[0]),
+                             _nbytes(eqn.outvars[0].aval))
+                    if sb < _nbytes(eqn.outvars[0].aval):
+                        self._storage[id(eqn.outvars[0])] = sb
+
             if name == "scan":
                 body = eqn.params["jaxpr"].jaxpr
                 length = int(eqn.params.get("length", 1) or 1)
@@ -325,7 +397,8 @@ class _JaxprCoster:
                 best = None
                 for br in branches:
                     probe = CostSummary()
-                    _JaxprCoster(probe, self.axis_sizes).walk(
+                    _JaxprCoster(probe, self.axis_sizes,
+                                 self.wire_dtype).walk(
                         br.jaxpr, [dof(v) for v in eqn.invars[1:]], mult)
                     if best is None or probe.flops > best.flops:
                         best = probe
@@ -333,6 +406,7 @@ class _JaxprCoster:
                     self.s.flops += best.flops
                     self.s.hbm_bytes += best.hbm_bytes
                     self.s.comm_bytes += best.comm_bytes
+                    self.s.comm_bytes_int8 += best.comm_bytes_int8
                     for k, rec in best.by_prim.items():
                         acc = self.s.by_prim.setdefault(k, [0.0, 0.0, 0])
                         acc[0] += rec[0]
@@ -346,7 +420,7 @@ class _JaxprCoster:
                 if mesh is not None:
                     sizes.update({k: int(v)
                                   for k, v in dict(mesh.shape).items()})
-                inner = _JaxprCoster(self.s, sizes)
+                inner = _JaxprCoster(self.s, sizes, self.wire_dtype)
                 # body shapes are already per-shard: divisor 1 throughout
                 inner.walk(body, [1] * len(body.invars), mult)
                 continue
@@ -360,24 +434,48 @@ class _JaxprCoster:
                 axes = eqn.params.get("axes",
                                       eqn.params.get("axis_name"))
                 n = _axis_size(axes, self.axis_sizes)
-                payload = sum(_nbytes(v.aval) for v in eqn.invars
-                              if not isinstance(v, jax.core.Literal))
-                wire = _COLLECTIVES[name](payload, n) if n > 1 else 0.0
+                # PER-OPERAND pricing: integer/bool operands are exact
+                # by contract (the runtime refuses to compress them),
+                # and an operand that is ALREADY int8 (a compressed
+                # collective's own shards) cannot shrink further — each
+                # operand compresses, or not, at its own width
+                wire_payload = payload_i8 = 0.0
+                for v in eqn.invars:
+                    if isinstance(v, jax.core.Literal):
+                        continue
+                    b = _nbytes(v.aval)
+                    dt = getattr(v.aval, "dtype", None)
+                    fl = _floating_dtype(dt)
+                    try:
+                        ib = np.dtype(dt).itemsize
+                    except TypeError:
+                        ib = 4
+                    wire_payload += _compressed_nbytes(
+                        b, ib, self.wire_dtype) \
+                        if self.wire_dtype and fl else b
+                    payload_i8 += _compressed_nbytes(b, ib, "int8") \
+                        if fl else b
+                if n > 1:
+                    wire = _COLLECTIVES[name](wire_payload, n)
+                    wire_i8 = _COLLECTIVES[name](payload_i8, n)
+                else:
+                    wire = wire_i8 = 0.0
                 # the reduction math itself: one FLOP per element per hop
                 flops = float(sum(_nelems(v.aval) for v in eqn.invars
                                   if hasattr(v.aval, "shape")))
                 self.charge(name, mult * flops / d_out, 0.0,
-                            comm=mult * wire / d_out)
+                            comm=mult * wire / d_out,
+                            comm_int8=mult * wire_i8 / d_out)
                 continue
 
             if name in _FREE:
                 continue
             if name == "dot_general":
                 flops = _dot_general_flops(eqn)
-                nbytes = _anchor_bytes(eqn)
+                nbytes = self._anchor_bytes(eqn)
             elif name == "conv_general_dilated":
                 flops = _conv_flops(eqn)
-                nbytes = _anchor_bytes(eqn)
+                nbytes = self._anchor_bytes(eqn)
             elif name in _FUSABLE:
                 flops = _default_flops(eqn)
                 nbytes = sum(_nbytes(v.aval) for v in eqn.invars
@@ -392,25 +490,38 @@ class _JaxprCoster:
                         self.walk(sub, [1] * len(sub.invars), mult)
                     continue
                 flops = _default_flops(eqn)
-                nbytes = _anchor_bytes(eqn)
+                nbytes = self._anchor_bytes(eqn)
             self.charge(name, mult * flops / d_out, mult * nbytes / d_out)
+
+    def _anchor_bytes(self, eqn):
+        """HBM traffic of an op that materializes: stream inputs (at
+        their STORED width — fused converts read the narrow buffer) +
+        outputs."""
+        nbytes = sum(self._sbytes(v) for v in eqn.invars
+                     if not isinstance(v, jax.core.Literal))
+        nbytes += sum(_nbytes(v.aval) for v in eqn.outvars)
+        return float(nbytes)
 
 
 def estimate_jaxpr_cost(closed_jaxpr, in_divisors=None, axis_sizes=None,
-                        chip=None) -> CostSummary:
+                        chip=None, wire_dtype=None) -> CostSummary:
     """Sharding-aware per-device FLOPs/bytes of one (Closed)Jaxpr, rolled
     into a roofline :class:`CostSummary`. ``in_divisors`` gives the
     device-partition count per top-level input (from PartitionSpecs via
     :func:`spec_divisor`); ``axis_sizes`` names the mesh axes collectives
-    ring over."""
+    ring over; ``wire_dtype`` re-prices every collective at that wire
+    (int8/bf16) — predicted wire-bytes reduction as a first-class
+    output (``summary.comm_bytes`` vs an uncompressed run, or just read
+    ``summary.int8_wire_reduction``)."""
     from ...observability.instrument import chip_specs
     jaxpr = (closed_jaxpr.jaxpr
              if isinstance(closed_jaxpr, jax.core.ClosedJaxpr)
              else closed_jaxpr)
     s = CostSummary()
+    s.wire_dtype = wire_dtype
     divs = list(in_divisors or [])
     divs += [1] * (len(jaxpr.invars) - len(divs))
-    _JaxprCoster(s, axis_sizes or {}).walk(jaxpr, divs)
+    _JaxprCoster(s, axis_sizes or {}, wire_dtype).walk(jaxpr, divs)
     return s.finalize(chip or chip_specs())
 
 
@@ -425,19 +536,25 @@ def spec_divisor(spec, mesh_shape: dict) -> int:
     return max(n, 1)
 
 
-def eager_collective_cost(ledger, world_size: int) -> float:
+def eager_collective_cost(ledger, world_size: int,
+                          wire_dtype=None) -> float:
     """Wire bytes of the recorded eager collective schedule (rank 0's
-    ledger), ring-modeled per device."""
+    ledger), ring-modeled per device. Each record's own ``wire_dtype``
+    (compressed groups) prices its compressed payload; ``wire_dtype=``
+    forces the WHOLE schedule onto one wire — the what-if knob."""
     total = 0.0
     for rec in ledger or ():
         fn = _EAGER_COLLECTIVES.get(rec.op)
         if fn is None or rec.shape is None:
             continue
         try:
-            nbytes = (int(np.prod(rec.shape, dtype=np.int64))
-                      * np.dtype(rec.dtype).itemsize)
+            itemsize = np.dtype(rec.dtype).itemsize
+            nbytes = (int(np.prod(rec.shape, dtype=np.int64)) * itemsize)
         except (TypeError, ValueError):
             continue
+        wire = wire_dtype or getattr(rec, "wire_dtype", None)
+        if wire and _floating_dtype(rec.dtype):
+            nbytes = _compressed_nbytes(nbytes, itemsize, wire)
         total += fn(nbytes, max(int(world_size), 1))
     return total
 
@@ -467,21 +584,43 @@ def cost_pass(ctx):
         divs += [1] * (len(jaxpr.invars) - len(divs))
         _JaxprCoster(s, axis_sizes).walk(jaxpr, divs)
     s.comm_bytes += eager_collective_cost(ledger, ctx.world_size)
+    s.comm_bytes_int8 += eager_collective_cost(ledger, ctx.world_size,
+                                               wire_dtype="int8")
     s.finalize(chip)
     ctx.cost_summary = s
 
     out = []
     if (s.bound == "comm" and s.comm_bytes >= _PTCS001_COMM_FLOOR
             and s.comm_ms > 0):
+        whatif = {
+            "comm_bytes_int8": s.comm_bytes_int8,
+            "comm_ms_int8": round(s.comm_ms_int8, 4),
+            "wire_reduction": round(s.int8_wire_reduction, 3),
+            "bound_if_int8": s.bound_if_int8,
+        }
         out.append(Diagnostic(
             "PTCS001", "cost", "warning",
             f"comm-bound step: predicted interconnect time "
             f"{s.comm_ms:.3f} ms exceeds compute ({s.compute_ms:.3f} ms) "
             f"and HBM ({s.hbm_ms:.3f} ms) on {chip.get('name')} — "
             f"{s.comm_bytes / 2 ** 20:.1f} MiB/device on the wire per "
-            f"step (ring model); re-shard to cut collective payloads or "
-            f"overlap them with compute",
-            extra={"cost": s.as_dict()}))
+            f"step (ring model); re-shard to cut collective payloads, "
+            f"overlap them with compute, or compress the wire (what-if: "
+            f"int8 cuts wire bytes {s.int8_wire_reduction:.2f}x to "
+            f"{s.comm_ms_int8:.3f} ms -> {s.bound_if_int8}-bound)",
+            extra={"cost": s.as_dict(), "whatif_int8": whatif}))
+        if s.bound_if_int8 != "comm":
+            out.append(Diagnostic(
+                "PTCS003", "cost", "info",
+                f"compression would flip the bound: int8-compressed "
+                f"collectives (new_group(compress='int8') / "
+                f"prims.c_*_q) cut predicted comm time "
+                f"{s.comm_ms:.3f} -> {s.comm_ms_int8:.3f} ms, making "
+                f"the step {s.bound_if_int8}-bound "
+                f"({s.int8_wire_reduction:.2f}x fewer wire bytes); "
+                f"distributed.auto_enable_compression(report) turns "
+                f"this on",
+                extra={"whatif_int8": whatif}))
     elif (s.flops >= _PTCS002_FLOPS_FLOOR and s.hbm_bytes > 0
             and s.bound == "memory" and s.arithmetic_intensity < s.ridge):
         out.append(Diagnostic(

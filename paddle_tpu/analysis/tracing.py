@@ -94,6 +94,12 @@ class CollectiveRecord:
     file: str | None
     line: int | None
     peer: int | None = None   # p2p ops: dst (isend/send) / src (irecv/recv)
+    # wire compression (int8/bf16) — METADATA, deliberately excluded from
+    # key(): a compressed all_reduce and its uncompressed twin are the
+    # SAME logical collective, so rank branches that differ only in
+    # compression must not read as PTCC schedule divergence. The cost
+    # pass reads it to price the compressed wire bytes.
+    wire_dtype: str | None = None
 
     # p2p ops are point-to-point, not SPMD-lockstep: the consistency pass
     # matches them pairwise instead of positionally
@@ -215,7 +221,7 @@ class TraceRecorder:
         return self.rank
 
     # -- eager collective hooks (distributed/collective.py) -------------
-    def _record(self, op, v=None, group=None, peer=None):
+    def _record(self, op, v=None, group=None, peer=None, wire_dtype=None):
         file, line = callsite()
         dtype = shape = None
         if v is not None and hasattr(v, "_value"):
@@ -223,18 +229,20 @@ class TraceRecorder:
         if v is not None and hasattr(v, "dtype"):
             dtype, shape = str(np.dtype(v.dtype)), tuple(np.shape(v))
         rec = CollectiveRecord(op, _group_desc(group), dtype, shape,
-                               file, line, peer=peer)
+                               file, line, peer=peer,
+                               wire_dtype=wire_dtype)
         self.ledger.append(rec)
         return rec
 
-    def eager_collective(self, op, tensor=None, group=None, peer=None):
+    def eager_collective(self, op, tensor=None, group=None, peer=None,
+                         wire_dtype=None):
         """Record one eager collective; result is the input unchanged
         (abstract semantics: same shape/dtype on every rank)."""
-        self._record(op, tensor, group, peer=peer)
+        self._record(op, tensor, group, peer=peer, wire_dtype=wire_dtype)
         return tensor
 
-    def eager_gather(self, op, tensor, group=None):
-        self._record(op, tensor, group)
+    def eager_gather(self, op, tensor, group=None, wire_dtype=None):
+        self._record(op, tensor, group, wire_dtype=wire_dtype)
         n = self._group_size(group)
         return [tensor] * n
 
@@ -260,14 +268,29 @@ class TraceRecorder:
 
     def record_prim(self, name, x=None, axis_name=None, *args, **kw):
         """Record an in-jit collective prim and return an abstractly
-        shape-correct stand-in (no mesh axis needed)."""
+        shape-correct stand-in (no mesh axis needed). Compressed
+        variants (``*_q``) record under their base op name — wire dtype
+        is metadata, not collective identity — so compressed and
+        uncompressed schedules compare equal in the PTCC passes."""
         n = self._axis_size(axis_name)
         if name == "axis_index":
             self.ctx.rank_sensitive = True
             return jnp.asarray(self.rank % max(n, 1), jnp.int32)
         if name == "axis_size":
             return n
-        self._record(name, x, group=f"axis:{axis_name}")
+        wire = None
+        if name.endswith("_q"):
+            name = name[:-2]
+            wire = kw.pop("wire", "int8")
+        self._record(name, x, group=f"axis:{axis_name}", wire_dtype=wire)
+        if name == "c_allreduce_sum" and (
+                kw.get("residual") is not None
+                or kw.get("error_feedback")):
+            # EF form returns (reduced, new_residual)
+            res = kw.get("residual")
+            if res is None:
+                res = jnp.zeros(x.shape, jnp.float32)
+            return x, res
 
         def arg(pos, key, default):
             if key in kw:
@@ -314,6 +337,11 @@ _PRIM_NAMES = (
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min", "c_allgather",
     "c_reducescatter", "c_concat", "c_split", "c_broadcast", "all_to_all",
     "ppermute", "axis_index", "axis_size",
+    # compressed variants: recorded under their base op name (wire dtype
+    # is metadata), so mixing compressed/uncompressed never lints as
+    # schedule divergence
+    "c_allreduce_sum_q", "c_allgather_q", "c_reducescatter_q",
+    "all_to_all_q",
 )
 
 

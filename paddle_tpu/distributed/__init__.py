@@ -12,10 +12,11 @@ from .mesh import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, all_gather_object, broadcast, reduce,
-    scatter, all_to_all, send, recv, barrier, new_group, is_initialized,
-    destroy_process_group, wait, prims,
-    P2POp, batch_isend_irecv, isend, irecv,
+    scatter, all_to_all, reduce_scatter, send, recv, barrier, new_group,
+    is_initialized, destroy_process_group, wait, prims,
+    auto_enable_compression, P2POp, batch_isend_irecv, isend, irecv,
 )
+from . import compress  # noqa: F401
 from .parallel import init_parallel_env, DataParallel, spawn  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import fleet  # noqa: F401
@@ -32,7 +33,7 @@ from . import launch  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .parity import (  # noqa: F401,E402
-    alltoall, alltoall_single, reduce_scatter, broadcast_object_list,
+    alltoall, alltoall_single, broadcast_object_list,
     scatter_object_list, split, ParallelMode, get_backend, is_available,
     gloo_init_parallel_env, gloo_barrier, gloo_release,
     ProbabilityEntry, CountFilterEntry, ShowClickEntry,

@@ -87,13 +87,24 @@ class Group:
 
     _next_gid = 0
 
-    def __init__(self, axis_name, mesh=None, ranks=None, backend="xla"):
+    def __init__(self, axis_name, mesh=None, ranks=None, backend="xla",
+                 compress=None):
         self.axis_name = axis_name  # str or tuple[str]
         self.mesh = mesh if mesh is not None else _global_mesh
         self.backend = backend
         self.id = Group._next_gid
         Group._next_gid += 1
         self._ranks = ranks
+        # wire compression for this group's eager collectives:
+        # None (off) | "int8" | "bf16" | "auto" (module default — see
+        # distributed.compress). Collectives quantize -> collect ->
+        # dequantize so payload bytes on the interconnect shrink ~4x/2x.
+        # Validated HERE so a typo fails at the misconfiguration site,
+        # not at the first collective over the group.
+        if compress is not None and compress != "auto":
+            from .compress import _norm_wire
+            compress = _norm_wire(compress)
+        self.compress = compress
 
     @property
     def nranks(self):

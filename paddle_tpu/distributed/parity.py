@@ -75,31 +75,11 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    """Reduce the list across ranks, keep this rank's chunk
-    (communication/reduce_scatter.py). Every rank holds a tensor_list;
-    the lists are reduced element-wise across ranks and rank r receives
-    reduced list[r]. Single-controller: all ranks share this process's
-    tensor_list, so the cross-rank reduction of entry r is nranks×list[r]
-    (SUM) / list[r] (MAX/MIN) / list[r] (AVG); this rank keeps the entry
-    indexed by its group rank — compiled code uses prims.c_reducescatter
-    for the mesh version."""
-    from .collective import _single_controller_only
-    _single_controller_only("reduce_scatter")
-    group = _get_group(group)
-    from . import env as env_mod
-    r = group.get_group_rank(env_mod.get_rank())
-    if r < 0:
-        return tensor  # this process is not a member of the group
-    v = unwrap(tensor_list[r])
-    n = group.nranks
-    if op in (ReduceOp.MAX, ReduceOp.MIN, ReduceOp.AVG):
-        reduced = v  # all ranks contribute the same value
-    elif op == ReduceOp.PROD:
-        reduced = v ** n
-    else:  # SUM
-        reduced = v * n
-    tensor._inplace_assign(Tensor(jnp.asarray(reduced)))
-    return tensor
+    """Moved to :func:`paddle_tpu.distributed.collective.reduce_scatter`
+    — a real mesh ``psum_scatter`` with ledger/telemetry wiring and
+    optional wire compression; this shim keeps the old import path."""
+    from .collective import reduce_scatter as _rs
+    return _rs(tensor, tensor_list, op=op, group=group, sync_op=sync_op)
 
 
 def broadcast_object_list(object_list, src=0, group=None):

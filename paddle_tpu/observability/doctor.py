@@ -12,6 +12,10 @@ bucket                source
 ``compile``           jit build/compile seconds amortized per useful step
 ``skips``             loss-scale overflow steps (full cost, zero progress)
 ``comm``              eager-ledger wire bytes vs the ring model's bytes
+                      (compressed collectives record their COMPRESSED
+                      payloads into the ledger, so the bucket
+                      reconciles post-compression without special
+                      cases)
 ``compute`` / ``hbm`` roofline residual, assigned to the predicted bound
 ====================  =====================================================
 

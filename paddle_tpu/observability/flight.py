@@ -90,8 +90,11 @@ class FlightRecorder:
     def record_step(self, seconds: float, *, loss=None, tokens_per_sec=None,
                     mfu=None, found_inf=None, loss_scale=None,
                     memory_bytes=None, collective_bytes=None,
-                    path: str = "parallel", step: int | None = None):
-        """One per-step black-box record (the hot-path entry point)."""
+                    wire_dtype=None, path: str = "parallel",
+                    step: int | None = None):
+        """One per-step black-box record (the hot-path entry point).
+        ``wire_dtype`` tags the record with the collective wire dtype in
+        effect (int8/bf16 when compressed collectives ran, else None)."""
         with self._lock:
             self._step_seq += 1
             n = self._step_seq if step is None else int(step)
@@ -99,7 +102,8 @@ class FlightRecorder:
             "step", step=n, path=path, seconds=round(float(seconds), 6),
             loss=loss, tokens_per_sec=tokens_per_sec, mfu=mfu,
             found_inf=found_inf, loss_scale=loss_scale,
-            memory_bytes=memory_bytes, collective_bytes=collective_bytes)
+            memory_bytes=memory_bytes, collective_bytes=collective_bytes,
+            wire_dtype=wire_dtype)
 
     def records(self):
         with self._lock:

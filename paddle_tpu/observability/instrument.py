@@ -18,6 +18,9 @@ paddle_jit_compile_total              counter    what
 paddle_jit_compile_seconds_total      counter    what
 paddle_collective_calls_total         counter    op, group, dtype
 paddle_collective_bytes_total         counter    op, group, dtype
+paddle_collective_compressed_bytes_total counter op, group,
+                                                 wire={int8,bf16}
+paddle_collective_compression_ratio   gauge      op, group
 paddle_device_memory_bytes            gauge      —
 paddle_device_peak_memory_bytes       gauge      —
 paddle_elastic_restarts_total         counter    —
@@ -119,7 +122,20 @@ def collective_bytes():
     return get_registry().counter(
         "paddle_collective_bytes_total",
         "bytes moved through eager collective ops (payload size x ranks "
-        "for gather-shaped ops)")
+        "for gather-shaped ops; WIRE bytes for compressed ops)")
+
+
+def collective_compressed_bytes():
+    return get_registry().counter(
+        "paddle_collective_compressed_bytes_total",
+        "wire bytes moved by compressed collectives, by wire dtype")
+
+
+def collective_compression_ratio():
+    return get_registry().gauge(
+        "paddle_collective_compression_ratio",
+        "logical/wire byte ratio of the last compressed collective per "
+        "op (≈3.9x for f32→int8 with 256-chunk scales, ≈2x for bf16)")
 
 
 def restarts_counter():
@@ -272,7 +288,12 @@ def record_train_step(seconds: float, tokens: int | None = None,
     snapshots the registry into the rank's JSONL every few seconds, so a
     SIGKILLed worker still leaves near-current telemetry behind (the
     snapshot write is atomic via rename)."""
-    global _last_flush
+    global _last_flush, _last_wire_dtype
+    # consume the wire tag: it means "a compressed collective ran since
+    # the PREVIOUS step record", not "compression was ever on" — a step
+    # with no compressed traffic must record wire_dtype=None
+    wire = _last_wire_dtype
+    _last_wire_dtype = None
     step_seconds().observe(seconds, path=path)
     tps = mfu = None
     if tokens and seconds > 0:
@@ -288,7 +309,8 @@ def record_train_step(seconds: float, tokens: int | None = None,
     flight.get_flight_recorder().record_step(
         seconds, loss=loss, tokens_per_sec=tps, mfu=mfu,
         found_inf=found_inf, loss_scale=loss_scale, memory_bytes=mem,
-        collective_bytes=_collective_bytes_cum(reg), path=path)
+        collective_bytes=_collective_bytes_cum(reg),
+        wire_dtype=wire, path=path)
     if anomaly.monitoring_enabled():
         anomaly.get_monitor(path).observe(
             seconds, loss=loss, mfu=mfu, memory_bytes=mem,
@@ -335,13 +357,35 @@ def record_compile(seconds: float, what: str):
         "compile", what=what, seconds=round(float(seconds), 4))
 
 
-def record_collective(op: str, nbytes: int, group=None, dtype=None):
+_last_wire_dtype = None  # most recent compressed wire dtype (flight tag)
+
+
+def record_collective(op: str, nbytes: int, group=None, dtype=None,
+                      wire_dtype=None, wire_nbytes=None):
+    """Account one eager collective. ``nbytes`` is the LOGICAL payload;
+    for a compressed op, ``wire_nbytes`` is what actually crosses the
+    interconnect — the bytes-moved counter records wire bytes (so the
+    perf doctor's comm bucket reconciles post-compression), while the
+    compressed-bytes counter and compression-ratio gauge carry the
+    compression view by wire dtype."""
+    global _last_wire_dtype
     labels = {"op": op,
               "group": str(getattr(group, "axis_name", group or "world")),
               "dtype": str(dtype)}
     collective_calls().inc(**labels)
-    if nbytes:
-        collective_bytes().inc(float(nbytes), **labels)
+    moved = wire_nbytes if wire_nbytes is not None else nbytes
+    if moved:
+        collective_bytes().inc(float(moved), **labels)
+    if wire_dtype and wire_nbytes is not None:
+        _last_wire_dtype = str(wire_dtype)
+        collective_compressed_bytes().inc(
+            float(wire_nbytes), op=op, group=labels["group"],
+            wire=str(wire_dtype))
+        if nbytes:
+            collective_compression_ratio().set(
+                float(nbytes) / max(float(wire_nbytes), 1.0),
+                op=op, group=labels["group"])
+
 
 
 _LIVE_ARRAY_SAMPLE_EVERY = 10

@@ -10,4 +10,7 @@ from .quanters import (  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .functional import fake_quant_dequant_abs_max  # noqa: F401
-from .export import save_quantized_model, Int8DeployLayer  # noqa: F401
+from .export import (  # noqa: F401
+    save_quantized_model, Int8DeployLayer, quantize_stacked_gpt_weights,
+    dequantize_stacked_weight,
+)

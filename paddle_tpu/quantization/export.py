@@ -25,7 +25,8 @@ from ..ops._dispatch import unwrap
 from .functional import fake_quant_dequant_abs_max
 from .qat import ConvertedLayer, QuantedWrapper
 
-__all__ = ["save_quantized_model", "Int8DeployLayer"]
+__all__ = ["save_quantized_model", "Int8DeployLayer",
+           "quantize_stacked_gpt_weights", "dequantize_stacked_weight"]
 
 
 class Int8DeployLayer(nn.Layer):
@@ -124,6 +125,80 @@ def _weight_axis(inner):
     # Linear weight [in, out] -> out channels axis 1; Conv2D
     # [out, in, kh, kw] -> axis 0 (reference channel_wise_abs_max axes)
     return 1 if isinstance(inner, nn.Linear) else 0
+
+
+# ---------------------------------------------------------------------------
+# stacked decode weights (serving engine) — weight-only int8, per-channel
+# ---------------------------------------------------------------------------
+
+# per-OUTPUT-channel scales: the quantized axes are the CONTRACTION dims
+# of each decode matmul, so the scale can be applied to the matmul
+# OUTPUT (y = (x @ q) * s) — the int8 weight feeds the MXU directly and
+# the per-channel multiply fuses into the epilogue. Leading dim is the
+# stacked layer axis L (kept un-reduced so every layer quantizes
+# independently). wte/wpe reduce their hidden dim: scales are per row
+# (token / position / vocab logit channel), which serves both the
+# embedding gather and the logits matmul.
+_STACKED_REDUCE_AXES = {
+    "wqkv": (1,),      # [L, H, 3, nh, d] -> s [L, 3, nh, d]
+    "wo":   (1, 2),    # [L, nh, d, H]    -> s [L, H]
+    "w1":   (1,),      # [L, H, F]        -> s [L, F]
+    "w2":   (1,),      # [L, F, H]        -> s [L, H]
+}
+_EMB_KEYS = ("wte", "wpe")   # [rows, H] -> s [rows]
+
+
+def _quantize_channelwise(w, axes, bits=8):
+    w = np.asarray(w, np.float32)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = np.abs(w).max(axis=axes, keepdims=True) / qmax
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -qmax - 1, qmax).astype(np.int8)
+    return q, np.squeeze(scale, axis=axes)
+
+
+def quantize_stacked_gpt_weights(params, bits=8):
+    """Quantize a :func:`~paddle_tpu.models.gpt.stack_gpt_weights` pytree
+    to weight-only int8 with per-channel scales: every matmul weight
+    (``wqkv``/``wo``/``w1``/``w2`` per stacked layer, plus ``wte``/
+    ``wpe``) becomes ``{"q": int8, "s": float32}``; biases and
+    layer-norm params stay float. The serving engine's decode matmuls
+    then run int8-storage x bf16-activation with the scale applied to
+    the matmul output (exact for per-output-channel scales)."""
+    import jax.numpy as jnp
+    out = {"blocks": {}}
+    for k, v in params["blocks"].items():
+        axes = _STACKED_REDUCE_AXES.get(k)
+        if axes is None:
+            out["blocks"][k] = v
+            continue
+        q, s = _quantize_channelwise(np.asarray(v), axes, bits)
+        out["blocks"][k] = {"q": jnp.asarray(q), "s": jnp.asarray(s)}
+    for k, v in params.items():
+        if k == "blocks":
+            continue
+        if k in _EMB_KEYS:
+            q, s = _quantize_channelwise(np.asarray(v), (1,), bits)
+            out[k] = {"q": jnp.asarray(q), "s": jnp.asarray(s)}
+        else:
+            out[k] = v
+    return out
+
+
+def dequantize_stacked_weight(w, dtype=None):
+    """Materialize one quantized leaf back to float (reference path /
+    tests); non-quantized leaves pass through. The reduced (contraction)
+    axes are always contiguous starting at axis 1 in the stacked layout
+    (axis 0 is the layer/row dim), so the scale broadcast shape is
+    ``s.shape[:1] + (1,) * n_reduced + s.shape[1:]``."""
+    import jax.numpy as jnp
+    if not (isinstance(w, dict) and "q" in w):
+        return w if dtype is None else w.astype(dtype)
+    q, s = w["q"], w["s"]
+    n_reduced = q.ndim - s.ndim
+    bshape = tuple(s.shape[:1]) + (1,) * n_reduced + tuple(s.shape[1:])
+    out = q.astype(jnp.float32) * s.reshape(bshape)
+    return out.astype(dtype) if dtype is not None else out
 
 
 def save_quantized_model(model, path, input_spec=None, weight_bits=8,

@@ -55,8 +55,56 @@ class EngineShapeError(RuntimeError):
 # static cost model traces them, the lint analyzes them)
 # ---------------------------------------------------------------------------
 
+def _is_quant(w):
+    """A weight-only-int8 leaf from ``quantization.export.
+    quantize_stacked_gpt_weights``: ``{"q": int8, "s": f32}``."""
+    return isinstance(w, dict) and "q" in w
+
+
+def _mm(expr, x, w, dt):
+    """Post-scaled einsum: the int8 weight feeds the matmul directly
+    (int8-storage x ``dt``-activation — the convert rides the MXU feed)
+    and the per-output-channel scale multiplies the RESULT, which is
+    exact because contraction never mixes output channels."""
+    if not _is_quant(w):
+        return jnp.einsum(expr, x, w)
+    y = jnp.einsum(expr, x, w["q"].astype(dt))
+    return (y * w["s"].astype(dt)).astype(dt)
+
+
+def _emb(w, idx, dt):
+    """Embedding-row gather with per-row dequantization."""
+    if not _is_quant(w):
+        return w[idx]
+    return (w["q"][idx].astype(dt) * w["s"][idx][..., None].astype(dt))
+
+
+def _dequant_block(p, dt):
+    """Materialize one (per-layer) block's quantized weights back to
+    ``dt`` — the prefill path runs the standard ``gpt_block`` on it, one
+    layer at a time inside the scan, so only a single layer's float
+    weights ever exist transiently. Inside the scan the stacked layer
+    dim is already sliced off, so the reduced (contraction) axes are the
+    LEADING ``q.ndim - s.ndim`` axes of each leaf."""
+    def dq(w):
+        if not _is_quant(w):
+            return w
+        q, s = w["q"], w["s"]
+        bshape = (1,) * (q.ndim - s.ndim) + tuple(s.shape)
+        return (q.astype(jnp.float32) * s.reshape(bshape)).astype(dt)
+    return {k: dq(v) for k, v in p.items()}
+
+
+def _compute_dtype(params, compute_dtype):
+    if compute_dtype is not None:
+        return jnp.dtype(compute_dtype)
+    wte = params["wte"]
+    return wte["s"].dtype if _is_quant(wte) else wte.dtype
+
+
 def decode_step_fn(params, k_pages, v_pages, tokens, positions, page_table,
-                   seq_lens, key, *, eps, temperature, top_k, use_kernel):
+                   seq_lens, key, *, eps, temperature, top_k, use_kernel,
+                   compute_dtype=None):
     """One continuous-batching decode step: for every (possibly idle)
     batch slot, embed the last token, write its K/V into the slot's
     current page, attend over the page table, and sample the next token.
@@ -65,14 +113,22 @@ def decode_step_fn(params, k_pages, v_pages, tokens, positions, page_table,
     ``page_table`` ``[B, pages_per_seq]``; ``seq_lens`` ``[B]`` (0 =
     idle slot → all writes land in the sink page, output is discarded).
     Returns ``(k_pages, v_pages, next_tokens)``.
+
+    ``params`` may carry weight-only-int8 leaves (``{"q", "s"}`` from
+    ``quantize_stacked_gpt_weights``): the decode matmuls then run the
+    int8 weight straight into the einsum (storage stays int8 in HBM —
+    decode is weight-bandwidth-bound, so this is the ~2x/4x read win)
+    and apply the per-output-channel scale to the result.
     """
     blocks, wte, wpe = params["blocks"], params["wte"], params["wpe"]
+    dt = _compute_dtype(params, compute_dtype)
     B = tokens.shape[0]
     np_, ps = k_pages.shape[1], k_pages.shape[2]
     pos = jnp.maximum(positions, 0).astype(jnp.int32)
     page_table = page_table.astype(jnp.int32)
     seq_lens = seq_lens.astype(jnp.int32)
-    x = wte[tokens][:, None, :] + wpe[pos][:, None, :]
+    x = _emb(wte, tokens, dt)[:, None, :] + _emb(wpe, pos, dt)[:, None, :]
+    x = x.astype(dt)
     # destination page row of the token being decoded (sink for idle)
     rows = (page_table[jnp.arange(B), pos // ps] * ps + pos % ps)
     attend = paged_attention_decode if use_kernel \
@@ -83,45 +139,52 @@ def decode_step_fn(params, k_pages, v_pages, tokens, positions, page_table,
         p, kp, vp = p_kp_vp
         nkv, d = kp.shape[2], kp.shape[3]
         h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
-        qkv = jnp.einsum("bsh,hknd->bsknd", h, p["wqkv"]) + p["bqkv"]
+        qkv = _mm("bsh,hknd->bsknd", h, p["wqkv"], dt) + p["bqkv"]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,1,nh,d]
         kp = kp.reshape(np_ * ps, nkv, d).at[rows].set(
-            k[:, 0]).reshape(np_, ps, nkv, d)
+            k[:, 0].astype(kp.dtype)).reshape(np_, ps, nkv, d)
         vp = vp.reshape(np_ * ps, nkv, d).at[rows].set(
-            v[:, 0]).reshape(np_, ps, nkv, d)
+            v[:, 0].astype(vp.dtype)).reshape(np_, ps, nkv, d)
         attn = attend(q[:, 0], kp, vp, page_table, seq_lens)
-        o = jnp.einsum("bnd,ndh->bh", attn.astype(x.dtype), p["wo"])
+        o = _mm("bnd,ndh->bh", attn.astype(x.dtype), p["wo"], dt)
         x = x + o[:, None, :] + p["bo"]
         h2 = _ln(x, p["ln2_w"], p["ln2_b"], eps)
-        u = jax.nn.gelu(h2 @ p["w1"] + p["b1"], approximate=True)
-        x = x + u @ p["w2"] + p["b2"]
+        u = jax.nn.gelu(_mm("bsh,hf->bsf", h2, p["w1"], dt) + p["b1"],
+                        approximate=True)
+        x = x + _mm("bsf,fh->bsh", u, p["w2"], dt) + p["b2"]
         return (x,), (kp, vp)
 
     (x,), (k_pages, v_pages) = jax.lax.scan(
         layer, (x,), (blocks, k_pages, v_pages))
     h = _ln(x, params["lnf_w"], params["lnf_b"], eps)
-    logits = jnp.einsum("bsh,vh->bsv", h, wte)[:, 0]
+    logits = _mm("bsh,vh->bsv", h, wte, dt)[:, 0]
     nxt = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
     return k_pages, v_pages, nxt
 
 
 def prefill_fn(params, k_pages, v_pages, ids, true_len, dest_rows, key, *,
-               eps, temperature, top_k, use_flash):
+               eps, temperature, top_k, use_flash, compute_dtype=None):
     """Prefill one request (batch 1, prompt padded to a bucket length):
     full causal forward capturing per-layer K/V, scatter the true
     tokens' K/V into the allocated pages (padding rows → sink page),
     sample the first output token from position ``true_len - 1``.
 
     Returns ``(k_pages, v_pages, first_token[1])``.
+
+    Quantized params are dequantized per layer INSIDE the scan (one
+    layer of float weights transient at a time), then ride the standard
+    ``gpt_block`` — prefill is compute-bound, so int8 storage still
+    saves HBM residency without a bespoke kernel path.
     """
     blocks, wte, wpe = params["blocks"], params["wte"], params["wpe"]
+    dt = _compute_dtype(params, compute_dtype)
     s = ids.shape[1]
     np_, ps = k_pages.shape[1], k_pages.shape[2]
-    h = wte[ids] + wpe[jnp.arange(s)]
+    h = (_emb(wte, ids, dt) + _emb(wpe, jnp.arange(s), dt)).astype(dt)
 
     def pre(x, p):
-        out, k, v = gpt_block(p, x, eps, use_flash=use_flash,
-                              return_kv=True)
+        out, k, v = gpt_block(_dequant_block(p, dt), x, eps,
+                              use_flash=use_flash, return_kv=True)
         return out, (k, v)
 
     h, (ks, vs) = jax.lax.scan(pre, h, blocks)  # ks [L, 1, S, nkv, d]
@@ -134,7 +197,7 @@ def prefill_fn(params, k_pages, v_pages, ids, true_len, dest_rows, key, *,
     h_last = jax.lax.dynamic_slice_in_dim(
         h, jnp.maximum(true_len - 1, 0), 1, axis=1)
     h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
-    logits = jnp.einsum("bsh,vh->bsv", h_last, wte)[:, 0]
+    logits = _mm("bsh,vh->bsv", h_last, wte, dt)[:, 0]
     tok = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
     return k_pages, v_pages, tok
 
@@ -160,11 +223,24 @@ class ServingEngine:
     def __init__(self, model, config=None, *, page_size=16, num_pages=None,
                  max_seq_len=None, decode_buckets=(1, 2, 4, 8),
                  prefill_buckets=None, temperature=0.0, top_k=0, seed=0,
-                 use_flash=None, use_kernel=True, aot=True):
+                 use_flash=None, use_kernel=True, aot=True, quantize=None):
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.cfg: GPTConfig = config or gpt.config
         cfg = self.cfg
         self.params = stack_gpt_weights(model)
+        # serving-side weight dtype: quantize="int8" stores every decode
+        # matmul weight as int8 + per-channel f32 scales (the
+        # quantization/export.py deploy scheme routed into the engine) —
+        # HBM-resident weights shrink ~4x (f32) / ~2x (bf16) and the
+        # memory-bound decode loop streams int8
+        self.compute_dtype = self.params["wte"].dtype
+        self.quantize = quantize
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(
+                    f"quantize={quantize!r}: only 'int8' is supported")
+            from ..quantization.export import quantize_stacked_gpt_weights
+            self.params = quantize_stacked_gpt_weights(self.params)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.use_kernel = bool(use_kernel)
@@ -188,7 +264,7 @@ class ServingEngine:
                              num_layers=cfg.num_layers,
                              num_kv_heads=cfg.num_heads,
                              head_dim=cfg.head_dim,
-                             dtype=self.params["wte"].dtype,
+                             dtype=self.compute_dtype,
                              max_seq_len=max_seq_len)
         self.max_seq_len = max_seq_len
         self._key = jax.random.key(int(seed))
@@ -197,11 +273,13 @@ class ServingEngine:
         # backend can't donate and would warn on every step
         donate = jax.default_backend() != "cpu"
         eps = cfg.layer_norm_epsilon
+        cdt = str(np.dtype(self.compute_dtype))
         self._decode_jit = jax.jit(
             functools.partial(decode_step_fn, eps=eps,
                               temperature=self.temperature,
                               top_k=self.top_k,
-                              use_kernel=self.use_kernel),
+                              use_kernel=self.use_kernel,
+                              compute_dtype=cdt),
             donate_argnums=(1, 2) if donate else ())
         self._prefill_jit = {
             sb: jax.jit(
@@ -209,7 +287,8 @@ class ServingEngine:
                     prefill_fn, eps=eps, temperature=self.temperature,
                     top_k=self.top_k,
                     use_flash=flash_attention_gate(sb, cfg.head_dim,
-                                                   use_flash)),
+                                                   use_flash),
+                    compute_dtype=cdt),
                 donate_argnums=(1, 2) if donate else ())
             for sb in self.prefill_buckets}
         self._decode_exe: dict = {}
@@ -222,7 +301,9 @@ class ServingEngine:
     @classmethod
     def from_checkpoint(cls, path, config: GPTConfig, **kw):
         """checkpoint-load → engine: ``path`` is a ``paddle.save``d GPT
-        state dict (``GPTForPretraining`` or bare ``GPTModel`` keys)."""
+        state dict (``GPTForPretraining`` or bare ``GPTModel`` keys).
+        ``quantize="int8"`` serves the checkpoint with weight-only-int8
+        decode matmuls (per-channel scales, kernel==reference parity)."""
         from ..framework.io import load as paddle_load
         from ..models.gpt import GPTForPretraining, GPTModel
         state = paddle_load(path)
@@ -266,6 +347,14 @@ class ServingEngine:
                 key_aval).compile()
         self.compile_s += time.perf_counter() - t0
         record_compile(time.perf_counter() - t0, what="serving_buckets")
+
+    def weight_bytes(self) -> int:
+        """HBM-resident bytes of the stacked decode weights (int8 +
+        scales when ``quantize="int8"``) — the number the memory-bound
+        decode roofline streams per step."""
+        return int(sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(self.params)))
 
     def decode_signatures(self) -> set:
         """The closed set of decode step shapes: {(batch_bucket,

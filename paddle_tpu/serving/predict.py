@@ -33,7 +33,8 @@ __all__ = ["predicted_serving_row"]
 
 def predicted_serving_row(config: str = "345m", concurrency: int = 8,
                           page_size: int = 64, chip: str = "v5e",
-                          dtype: str = "bfloat16") -> dict:
+                          dtype: str = "bfloat16",
+                          quantize: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     from ..analysis.passes.cost import estimate_jaxpr_cost
@@ -60,24 +61,37 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
     num_pages = B * pages_per_seq + 1
     wdt = jnp.dtype(dtype)
     sds = jax.ShapeDtypeStruct
+    i8, f32 = jnp.int8, jnp.float32
+
+    def w(shape, s_shape=None):
+        """One weight aval — quantized form (int8 q + f32 per-channel
+        scales, exactly what ``quantize_stacked_gpt_weights`` emits)
+        when ``quantize="int8"``, so the cost model prices the real
+        int8-storage decode program."""
+        if quantize == "int8" and s_shape is not None:
+            return {"q": sds(shape, i8), "s": sds(s_shape, f32)}
+        return sds(shape, wdt)
+
     params = {
         "blocks": {
             "ln1_w": sds((L, H), wdt), "ln1_b": sds((L, H), wdt),
-            "wqkv": sds((L, H, 3, nh, d), wdt),
+            "wqkv": w((L, H, 3, nh, d), (L, 3, nh, d)),
             "bqkv": sds((L, 3, nh, d), wdt),
-            "wo": sds((L, nh, d, H), wdt), "bo": sds((L, H), wdt),
+            "wo": w((L, nh, d, H), (L, H)), "bo": sds((L, H), wdt),
             "ln2_w": sds((L, H), wdt), "ln2_b": sds((L, H), wdt),
-            "w1": sds((L, H, F), wdt), "b1": sds((L, F), wdt),
-            "w2": sds((L, F, H), wdt), "b2": sds((L, H), wdt),
+            "w1": w((L, H, F), (L, F)), "b1": sds((L, F), wdt),
+            "w2": w((L, F, H), (L, H)), "b2": sds((L, H), wdt),
         },
-        "wte": sds((V, H), wdt),
-        "wpe": sds((cfg.max_position_embeddings, H), wdt),
+        "wte": w((V, H), (V,)),
+        "wpe": w((cfg.max_position_embeddings, H),
+                 (cfg.max_position_embeddings,)),
         "lnf_w": sds((H,), wdt), "lnf_b": sds((H,), wdt),
     }
     kp = sds((L, num_pages, ps, nh, d), wdt)
     i32 = jnp.int32
     fn = functools.partial(decode_step_fn, eps=cfg.layer_norm_epsilon,
-                           temperature=0.0, top_k=0, use_kernel=False)
+                           temperature=0.0, top_k=0, use_kernel=False,
+                           compute_dtype=dtype)
     closed = jax.make_jaxpr(fn)(
         params, kp, kp, sds((B,), i32), sds((B,), i32),
         sds((B, pages_per_seq), i32), sds((B,), i32), None)
@@ -86,12 +100,21 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
     step_s = cost.step_ms / 1e3
     itemsize = jnp.zeros((), wdt).dtype.itemsize
     pool_bytes = 2 * L * num_pages * ps * nh * d * itemsize
+
+    def _aval_bytes(t):
+        import numpy as _np
+        return int(_np.prod(t.shape, dtype=_np.int64)
+                   * _np.dtype(t.dtype).itemsize)
+    weight_bytes = sum(_aval_bytes(t)
+                       for t in jax.tree_util.tree_leaves(params))
     return {
         "config": config,
         "concurrency": B,
         "page_size": ps,
         "pages_per_seq": pages_per_seq,
         "dtype": dtype,
+        "quantize": quantize,
+        "weights_mb": round(weight_bytes / 2 ** 20, 1),
         "predicted_decode_step_ms": round(cost.step_ms, 3),
         "predicted_tokens_per_sec": round(B / step_s, 1) if step_s else 0.0,
         "predicted_per_token_ms_p50": round(cost.step_ms, 3),
@@ -113,6 +136,9 @@ def _main(argv=None):
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--chip", default="v5e")
+    ap.add_argument("--quantize", default=None, choices=[None, "int8"],
+                    help="price the weight-only-int8 decode program "
+                         "(serving engine quantize='int8')")
     args = ap.parse_args(argv)
     if not os.environ.get("_PREDICT_RESPAWNED"):
         # same contract as analysis.predict: force the CPU backend in a
@@ -129,7 +155,8 @@ def _main(argv=None):
     jax.config.update("jax_platforms", "cpu")
     try:
         row = predicted_serving_row(args.config, args.concurrency,
-                                    args.page_size, args.chip)
+                                    args.page_size, args.chip,
+                                    quantize=args.quantize)
     except Exception as e:  # noqa: BLE001 — the row must say why
         row = {"config": args.config, "error": repr(e)[:300]}
     print(json.dumps(row), flush=True)

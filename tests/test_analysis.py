@@ -128,6 +128,43 @@ def test_rank_dependent_collective_count_mismatch():
     assert len(cc) == 1 and cc[0].code == "PTCC002", str(rep)
 
 
+def test_compressed_vs_uncompressed_same_collective_lints_clean():
+    """Seeded fixture, direction 1: rank branches that differ ONLY in
+    wire compression are the SAME logical collective — no false
+    deadlock diagnostic (wire dtype is CollectiveRecord metadata,
+    excluded from key())."""
+    def step(x):
+        if dist.get_rank() == 0:
+            dist.all_reduce(x, compress="int8")
+            dist.reduce_scatter(x, None, compress="int8")
+            dist.prims.c_allreduce_sum_q(x, "dp", wire="int8")
+        else:
+            dist.all_reduce(x)
+            dist.reduce_scatter(x, None)
+            dist.prims.c_allreduce_sum(x, "dp")
+        return x
+
+    rep = ProgramAnalyzer(world_size=2).analyze(step,
+                                                SDS((8, 4), jnp.float32))
+    assert not rep.by_pass("collective"), str(rep)
+
+
+def test_compressed_op_does_not_mask_real_divergence():
+    """Seeded fixture, direction 2: a GENUINE schedule divergence stays
+    flagged even when the diverging op is compressed."""
+    def step(x):
+        if dist.get_rank() == 0:
+            dist.all_reduce(x, compress="int8")
+        else:
+            dist.barrier()
+        return x
+
+    rep = ProgramAnalyzer(world_size=2).analyze(step,
+                                                SDS((8, 4), jnp.float32))
+    cc = rep.by_pass("collective")
+    assert len(cc) == 1 and cc[0].code == "PTCC001", str(rep)
+
+
 def test_matched_p2p_pipeline_pattern_lints_clean():
     """Rank-branched send/recv pairs are point-to-point, not lockstep —
     the pipeline-warmup pattern must NOT be flagged as divergence."""

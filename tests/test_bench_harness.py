@@ -68,6 +68,8 @@ def test_bench_skip_lines_when_no_backend(monkeypatch, capsys):
                         lambda *a, **kw: None)
     monkeypatch.setattr(bench, "emit_serving_predicted_row",
                         lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "emit_collective_compression_predicted",
+                        lambda *a, **kw: None)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     bench.main()
     out = capsys.readouterr().out
@@ -89,9 +91,14 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
     predicted = [r for r in recs if r["metric"].endswith("_predicted")]
     assert {r["metric"] for r in predicted} == {
         "gpt_345m_predicted", "gpt_1p3b_predicted", "gpt_13b_predicted",
-        "serving_predicted"}
+        "serving_predicted", "serving_int8_predicted",
+        "collective_compression_predicted"}
     for r in predicted:
-        if r["metric"] == "serving_predicted":
+        if r["metric"] == "collective_compression_predicted":
+            # the acceptance anchor: int8 all_reduce wire-bytes
+            # reduction on the GPT grad-sync config >= 1.8x
+            assert r["value"] >= 1.8
+        elif r["metric"].startswith("serving"):
             assert r["extras"]["predicted_tokens_per_sec"] > 0
         else:
             assert r["extras"]["predicted_peak_hbm_mb"] > 0

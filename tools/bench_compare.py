@@ -79,9 +79,23 @@ def _lower_is_better(metric, row):
         or u.startswith(("ms", "gib", "gb", "s/"))
 
 
+# measured metric -> its predicted anchor, where the suffix rule below
+# doesn't apply (serving + quantized-collective rows)
+_ANCHOR_MAP = {
+    "serving_engine_tokens_per_sec": "serving_predicted",
+    "serving_engine_int8_tokens_per_sec": "serving_int8_predicted",
+    "collective_compression": "collective_compression_predicted",
+}
+
+
 def _predicted_anchor(metric, rows):
     """The *_predicted row anchoring a measured metric, if present
-    (gpt_345m_tokens_per_sec_per_chip -> gpt_345m_predicted)."""
+    (gpt_345m_tokens_per_sec_per_chip -> gpt_345m_predicted;
+    serving/collective rows via the explicit map)."""
+    base = metric[:-len("_cpu_smoke")] if metric.endswith("_cpu_smoke") \
+        else metric
+    if base in _ANCHOR_MAP:
+        return rows.get(_ANCHOR_MAP[base])
     for cut in ("_tokens_per_sec_per_chip", "_imgs_per_sec_per_chip"):
         if metric.endswith(cut):
             return rows.get(metric[: -len(cut)] + "_predicted")

@@ -232,8 +232,72 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
     return reports
 
 
+def lint_collectives(world_size=None, hbm_budget_gb=None):
+    """Compressed-collective gate, seeded both ways:
+
+    (1) a schedule where ranks differ ONLY in wire compression
+    (rank 0 int8-compressed all_reduce/reduce_scatter + in-jit ``_q``
+    prims, rank 1 uncompressed) must lint CLEAN — the PTCC passes key
+    collectives on (op, group, dtype, shape) with wire dtype as
+    metadata, so compression never reads as schedule divergence
+    (false deadlock);
+
+    (2) a schedule with a GENUINE divergence hidden behind a compressed
+    op (rank 0 compressed all_reduce, rank 1 barrier) must still raise
+    PTCC001 — compression must not mask real deadlocks. The gate FAILS
+    if either direction misbehaves."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.analysis.core import Diagnostic, Report
+
+    ws = world_size or 2
+    SDS = jax.ShapeDtypeStruct
+
+    def mixed_compression(x):
+        if dist.get_rank() == 0:
+            dist.all_reduce(x, compress="int8")
+            dist.reduce_scatter(x, None, compress="int8")
+            dist.prims.c_allreduce_sum_q(x, "dp", wire="int8")
+        else:
+            dist.all_reduce(x)
+            dist.reduce_scatter(x, None)
+            dist.prims.c_allreduce_sum(x, "dp")
+        return x
+
+    reports = [ProgramAnalyzer(
+        world_size=ws, hbm_budget_gb=hbm_budget_gb).analyze(
+        mixed_compression, SDS((8, 4), jnp.float32),
+        name="collectives.mixed_compression")]
+
+    def seeded_divergence(x):
+        if dist.get_rank() == 0:
+            dist.all_reduce(x, compress="int8")
+        else:
+            dist.barrier()
+        return x
+
+    probe = ProgramAnalyzer(world_size=ws).analyze(
+        seeded_divergence, SDS((8, 4), jnp.float32),
+        name="collectives.seeded_divergence", emit=False)
+    diags = []
+    if not any(d.code in ("PTCC001", "PTCC002")
+               for d in probe.diagnostics):
+        diags.append(Diagnostic(
+            "PTCC001", "collective", "error",
+            "seeded compressed-vs-barrier divergence was NOT flagged — "
+            "the compressed-collective lint lost the deadlock signal "
+            "(wire compression must be metadata, not identity)",
+            op="all_reduce"))
+    rep = Report("collectives.divergence_still_caught", diags)
+    rep.emit()
+    reports.append(rep)
+    return reports
+
+
 MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe,
-          "serving": lint_serving}
+          "serving": lint_serving, "collectives": lint_collectives}
 
 
 def lint_model(name, world_size=None, hbm_budget_gb=None):
