@@ -1020,9 +1020,19 @@ def bench_serving_engine(args, model, cfg, on_cpu):
         dt = time.perf_counter() - t0
         new_tokens = sum(len(r.tokens) for r in finished)
         tps = new_tokens / dt if dt > 0 else 0.0
+        from paddle_tpu.observability.reqtrace import quantile as pq
         st = sorted(sched.step_times) or [0.0]
-        q = lambda p: st[min(len(st) - 1, int(round(p * (len(st) - 1))))]
+        q = lambda p: pq(st, p)
         ttfts = [r.summary()["ttft_s"] for r in finished]
+        # request-scoped percentiles from the per-request records (NOT
+        # step walltimes): queue wait across requests, per-token tail
+        # pooled over every request's decode-tick samples
+        recs = sched.request_records()
+        qw = sorted(r["queue_wait_s"] for r in recs
+                    if r.get("queue_wait_s") is not None)
+        tok_samples = sorted(s for r in finished
+                             for s in (r.trace.token_samples
+                                       if r.trace is not None else []))
         emit(metric, tps, "tokens/s (decode, continuous batching"
              + (", int8 weights" if quantize else "") + ")", {
                  "concurrent_streams": n_streams,
@@ -1030,6 +1040,9 @@ def bench_serving_engine(args, model, cfg, on_cpu):
                  "new_tokens": new_tokens,
                  "per_token_ms_p50": round(1e3 * q(0.50), 2),
                  "per_token_ms_p95": round(1e3 * q(0.95), 2),
+                 "per_token_ms_p99": round(1e3 * pq(tok_samples, 0.99), 2),
+                 "queue_wait_ms_p50": round(1e3 * pq(qw, 0.50), 2),
+                 "queue_wait_ms_p95": round(1e3 * pq(qw, 0.95), 2),
                  "ttft_s_mean": round(float(np.mean(ttfts)), 4),
                  "page_size": page_size,
                  "decode_buckets": list(buckets),

@@ -44,6 +44,25 @@ The telemetry is also *consumed* in-process (the perf-doctor stack):
   compute/HBM/comm/compile/skips and ranks "why is this run slow"
   findings (``tools/perf_doctor.py`` is the CLI; ``bench.py`` embeds
   :func:`doctor.quick_verdict` in every artifact row).
+
+Serving observability (request-scoped, PR 10) rides the same rails:
+
+- :mod:`.reqtrace` — per-request lifecycle traces (queued / prefill /
+  per-token decode spans) streamed as ``requests.jsonl`` into the run
+  dir, exportable to chrome trace, folded into
+  ``run_summary.json["serving"]`` percentiles by ``merge_run_dir``.
+- :mod:`.slo` — rolling SLO guardrails (TTFT p95, per-token p99,
+  queue-wait p95) with burn-rate accounting and goodput; a violation
+  emits an anomaly-style event, bumps
+  ``paddle_serving_slo_violations_total{slo}``, and leaves a throttled
+  flight dump naming the offending rids.
+- :mod:`.httpd` — a stdlib HTTP thread serving ``/metrics`` (Prometheus
+  text), ``/healthz``, and ``/status`` (live queue/pool/SLO JSON);
+  attach via ``ContinuousBatchingScheduler.serve_http()``.
+- :func:`.doctor.attribute_serving_gap` — measured-vs-predicted
+  per-output-token reconciliation (queue/prefill/compile/decode buckets
+  summing exactly to the delta), printed by ``tools/perf_doctor.py``
+  for any run dir carrying request records.
 """
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry,
@@ -52,5 +71,10 @@ from .metrics import (  # noqa: F401
 from .runlog import RunLogger, get_run_logger, merge_run_dir  # noqa: F401
 from .callback import TelemetryCallback  # noqa: F401
 from .flight import FlightRecorder, get_flight_recorder  # noqa: F401
-from .anomaly import StepAnomalyMonitor  # noqa: F401
-from .doctor import diagnose_run_dir, format_report  # noqa: F401
+from .anomaly import StepAnomalyMonitor, last_anomaly  # noqa: F401
+from .doctor import (diagnose_run_dir, format_report,  # noqa: F401
+                     attribute_serving_gap)
+from .reqtrace import (RequestTrace, export_chrome_trace,  # noqa: F401
+                       fold_request_records)
+from .slo import SLOConfig, SLOTracker  # noqa: F401
+from .httpd import ServingStatusServer  # noqa: F401

@@ -33,6 +33,7 @@ import math
 import os
 import statistics
 import threading
+import time
 
 _MAD_SCALE = 1.4826  # MAD -> stddev-equivalent under normality
 
@@ -140,7 +141,7 @@ class StepAnomalyMonitor:
     # ----------------------------------------------------------- internals
     def _fire(self, kind, value, score):
         rec = {"kind": kind, "path": self.path, "step": self.step,
-               "value": value,
+               "value": value, "ts": time.time(),
                "score": None if score is None
                else round(float(score), 3) if math.isfinite(score)
                else "inf"}
@@ -255,6 +256,22 @@ def get_monitor(path: str = "parallel") -> StepAnomalyMonitor:
             if mon is None:
                 mon = _monitors[path] = StepAnomalyMonitor(path)
     return mon
+
+
+def last_anomaly(path: str | None = None) -> dict | None:
+    """The most recent anomaly any live monitor fired (optionally
+    restricted to one telemetry path) — what ``/status`` surfaces as
+    ``last_anomaly``. None while the run is quiet."""
+    with _monitors_lock:
+        monitors = [m for p, m in _monitors.items()
+                    if path is None or p == path]
+    best = None
+    for mon in monitors:
+        if mon.anomalies:
+            rec = mon.anomalies[-1]
+            if best is None or rec.get("ts", 0) > best.get("ts", 0):
+                best = rec
+    return best
 
 
 def reset_monitors():

@@ -26,6 +26,17 @@ tallies, torn telemetry, flight-recorder dumps) into the "why is this
 run slow" report ``tools/perf_doctor.py`` prints and ``bench.py`` embeds
 (compactly, via :func:`quick_verdict`) in every artifact row.
 
+**Serving runs** get the same treatment at the granularity operators
+page on — the request. :func:`attribute_serving_gap` reconciles the
+measured per-output-token latency (from the run's ``requests.jsonl``
+records, folded by ``merge_run_dir`` into ``summary["serving"]``)
+against the ``serving_predicted`` row's decode roofline, splitting the
+delta into ``queue`` / ``prefill`` / ``compile`` / ``decode`` buckets
+that **sum to it exactly** (decode carries the roofline residual —
+same contract as the training attribution), and the findings rank SLO
+violations, reject storms, and goodput loss alongside the training
+diagnoses.
+
 Everything here is pure post-hoc arithmetic over JSON — no device, no
 jax import, so the doctor runs anywhere the run dir can be copied.
 """
@@ -55,26 +66,25 @@ def _normalize_predicted(row) -> dict | None:
         else None
 
 
-def load_predicted(source) -> dict | None:
-    """A ``*_predicted`` row from: a dict (returned as-is), a JSON file,
-    or a run dir containing ``predicted.json``. Accepts the bare row
-    (``paddle_tpu.analysis.predict`` CLI output), a bench artifact line
-    (``{"metric": ..., "extras": {row}}``), and multi-config predict
-    output — a JSON array or JSONL, one row per line/config, where the
-    FIRST row carrying a prediction wins."""
+def _load_first_row(source, normalize, basenames) -> dict | None:
+    """Shared predicted-row loader: ``source`` may be a dict (normalized
+    as-is), a JSON/JSONL file, or a run dir searched for ``basenames``
+    (first file carrying a normalizable row wins). Files may hold a bare
+    row, a bench-artifact line, a JSON array, or JSONL (one row per
+    config) — the FIRST row ``normalize`` accepts wins."""
     if source is None:
         return None
     if isinstance(source, dict):
-        return _normalize_predicted(source)
+        return normalize(source)
     path = source
     if os.path.isdir(path):
-        for base in _PREDICTED_BASENAMES:
+        for base in basenames:
             cand = os.path.join(path, base)
             if os.path.exists(cand):
-                path = cand
-                break
-        else:
-            return None
+                row = _load_first_row(cand, normalize, basenames)
+                if row is not None:
+                    return row
+        return None
     try:
         with open(path) as f:
             text = f.read()
@@ -89,7 +99,7 @@ def load_predicted(source) -> dict | None:
             if not line:
                 continue
             try:
-                row = _normalize_predicted(json.loads(line))
+                row = normalize(json.loads(line))
             except ValueError:
                 continue
             if row is not None:
@@ -97,11 +107,49 @@ def load_predicted(source) -> dict | None:
         return None
     if isinstance(doc, list):
         for item in doc:
-            row = _normalize_predicted(item)
+            row = normalize(item)
             if row is not None:
                 return row
         return None
-    return _normalize_predicted(doc)
+    return normalize(doc)
+
+
+def load_predicted(source) -> dict | None:
+    """A ``*_predicted`` row from: a dict (returned as-is), a JSON file,
+    or a run dir containing ``predicted.json``. Accepts the bare row
+    (``paddle_tpu.analysis.predict`` CLI output), a bench artifact line
+    (``{"metric": ..., "extras": {row}}``), and multi-config predict
+    output — a JSON array or JSONL, one row per line/config, where the
+    FIRST row carrying a prediction wins."""
+    return _load_first_row(source, _normalize_predicted,
+                           _PREDICTED_BASENAMES)
+
+
+def _normalize_serving_predicted(row) -> dict | None:
+    """A ``serving_predicted`` row (``paddle_tpu.serving.predict``
+    output, bare or wrapped in a bench-artifact line)."""
+    if not isinstance(row, dict):
+        return None
+    if "extras" in row and "predicted_decode_step_ms" not in row:
+        row = row["extras"]
+    if not isinstance(row, dict):
+        return None
+    return row if ("predicted_decode_step_ms" in row
+                   or "predicted_per_token_ms_p50" in row) else None
+
+
+_SERVING_PREDICTED_BASENAMES = ("serving_predicted.json",) \
+    + _PREDICTED_BASENAMES
+
+
+def load_serving_predicted(source) -> dict | None:
+    """Like :func:`load_predicted` but for the serving decode roofline
+    row (``predicted_decode_step_ms`` / ``predicted_per_token_ms_p50``);
+    a run dir is searched for ``serving_predicted.json`` first, then the
+    shared ``predicted.json`` (one file can carry both rows as a JSON
+    array / JSONL — each loader picks the first row of its kind)."""
+    return _load_first_row(source, _normalize_serving_predicted,
+                           _SERVING_PREDICTED_BASENAMES)
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +251,83 @@ def attribute_gap(summary: dict, predicted: dict, chip=None) -> dict | None:
     return out
 
 
+def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
+    """Split the measured−predicted **per-output-token** latency into
+    queue/prefill/compile/decode buckets that sum to the delta.
+
+    Measured per-token time is the *effective time per emitted token*
+    end to end: ``(Σ finished-request wall seconds + Σ compile seconds)
+    / Σ output tokens`` — the number a request-latency SLO actually
+    reflects (at continuous-batching concurrency every live stream gets
+    one token per decode step, so the predicted decode step time IS the
+    predicted per-token latency). Buckets:
+
+    ==========  ============================================================
+    ``queue``   submit→admit wait, amortized per token
+    ``prefill`` measured prefill walltime per token
+    ``compile`` AOT bucket-compile seconds amortized per token
+    ``decode``  everything else — decode slower than the roofline plus
+                scheduler overhead (the residual is a bucket, not an
+                apology; same contract as the training attribution)
+    ==========  ============================================================
+    """
+    sv = summary.get("serving") or {}
+    tokens = int(sv.get("new_tokens_total") or 0)
+    if tokens <= 0 or not predicted:
+        return None
+    predicted_ms = float(predicted.get("predicted_per_token_ms_p50")
+                         or predicted.get("predicted_decode_step_ms")
+                         or 0.0)
+    if predicted_ms <= 0:
+        return None
+    total_s = float(sv.get("request_seconds_total") or 0.0)
+    compile_s = float((summary.get("compile") or {}).get("seconds") or 0.0)
+    measured_ms = (total_s + compile_s) / tokens * 1e3
+    delta_ms = measured_ms - predicted_ms
+    queue_b = float(sv.get("queue_wait_seconds_total") or 0.0) \
+        / tokens * 1e3
+    prefill_b = float(sv.get("prefill_seconds_total") or 0.0) \
+        / tokens * 1e3
+    compile_b = compile_s / tokens * 1e3
+    decode_b = delta_ms - queue_b - prefill_b - compile_b
+    buckets = {"queue": queue_b, "prefill": prefill_b,
+               "compile": compile_b, "decode": decode_b}
+    out = {
+        "measured_ms": round(measured_ms, 3),
+        "predicted_ms": round(predicted_ms, 3),
+        "delta_ms": round(delta_ms, 3),
+        "ratio": round(measured_ms / predicted_ms, 3),
+        "buckets": {k: round(v, 3) for k, v in buckets.items()},
+        "residual_assigned_to": "decode",
+        "requests": int(sv.get("finished") or 0),
+        "tokens": tokens,
+        "compile_seconds": round(compile_s, 3),
+        "notes": [],
+    }
+    # per-token percentile reconciliation (decode ticks only, no queue)
+    pt = sv.get("per_token_s") or {}
+    for q in ("p50", "p95"):
+        meas = pt.get(q)
+        pred = predicted.get(f"predicted_per_token_ms_{q}")
+        if isinstance(meas, (int, float)) and pred:
+            out.setdefault("per_token_ms", {})[q] = {
+                "measured": round(1e3 * meas, 3),
+                "predicted": round(float(pred), 3),
+                "ratio": round(1e3 * meas / float(pred), 3)}
+    pred_tps = predicted.get("predicted_tokens_per_sec")
+    if pred_tps and total_s > 0:
+        # request-seconds overlap under concurrency, so this measured
+        # rate is a LOWER bound on engine throughput — noted, not hidden
+        out["tokens_per_sec"] = {
+            "measured_request_rate": round(tokens / total_s, 1),
+            "predicted": round(float(pred_tps), 1)}
+        out["notes"].append(
+            "measured_request_rate divides tokens by summed per-request "
+            "wall seconds (streams overlap, so engine throughput is "
+            "higher at concurrency > 1)")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # findings
 # ---------------------------------------------------------------------------
@@ -211,7 +336,8 @@ _SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
 
 
 def collect_findings(summary: dict, attribution: dict | None = None,
-                     flight_dumps=()) -> list[dict]:
+                     flight_dumps=(),
+                     serving_attribution: dict | None = None) -> list[dict]:
     """Ranked ``{severity, kind, detail}`` findings from the summary."""
     out = []
 
@@ -278,6 +404,45 @@ def collect_findings(summary: dict, attribution: dict | None = None,
         add("info", "bound",
             f"roofline says this config is {attribution['predicted_bound']}"
             f"-bound on {attribution['chip']}")
+
+    # ----------------------------------------------------------- serving
+    sv = summary.get("serving") or {}
+    viol = {k: n for k, n in (sv.get("slo_violations") or {}).items() if n}
+    if viol:
+        add("crit", "slo_violations",
+            "serving SLO violations: " + ", ".join(
+                f"{k} x{int(n)}" for k, n in sorted(viol.items()))
+            + " — flight.rank*.slo.json names the offending rids")
+    slo = sv.get("slo") or {}
+    gf = slo.get("goodput_fraction")
+    if gf is not None and gf < 0.95 and slo.get("missed"):
+        add("warn", "goodput",
+            f"only {100 * gf:.1f}% of served tokens came from requests "
+            f"that met the SLO ({slo['missed']} request(s) missed)")
+    n_req = int(sv.get("requests") or 0)
+    n_rej = int(sv.get("rejected") or 0)
+    if n_rej:
+        detail = "requests rejected at submit: " + ", ".join(
+            f"{k} x{int(n)}" for k, n in
+            sorted((sv.get("reject_reasons") or {}).items()))
+        add("warn" if n_req and n_rej / n_req > 0.05 else "info",
+            "rejected_requests", detail)
+    if serving_attribution:
+        b = serving_attribution["buckets"]
+        top = max(b, key=lambda k: b[k])
+        if serving_attribution["delta_ms"] \
+                > 0.05 * serving_attribution["predicted_ms"]:
+            add("warn" if serving_attribution["ratio"] < 2.0 else "crit",
+                "serving_slower_than_roofline",
+                f"measured {serving_attribution['measured_ms']}ms/output-"
+                f"token is {serving_attribution['ratio']}x the "
+                f"{serving_attribution['predicted_ms']}ms decode roofline; "
+                f"top contributor: {top} (+{b[top]}ms)")
+        elif serving_attribution["delta_ms"] \
+                < -0.2 * serving_attribution["predicted_ms"]:
+            add("info", "serving_faster_than_roofline",
+                f"measured {serving_attribution['ratio']}x predicted — "
+                f"the serving cost model is conservative here")
     out.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
     return out
 
@@ -295,11 +460,16 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
     from .runlog import merge_run_dir
     summary = merge_run_dir(run_dir, write=write_summary,
                             straggler_threshold=straggler_threshold)
-    predicted = load_predicted(predicted) or load_predicted(run_dir)
+    pred_source = predicted
+    predicted = load_predicted(pred_source) or load_predicted(run_dir)
     attribution = attribute_gap(summary, predicted, chip=chip) \
         if predicted else None
+    serving_predicted = load_serving_predicted(pred_source) \
+        or load_serving_predicted(run_dir)
+    serving_attribution = attribute_serving_gap(summary, serving_predicted)
     dumps = sorted(glob.glob(os.path.join(run_dir, "flight.rank*.json")))
-    findings = collect_findings(summary, attribution, flight_dumps=dumps)
+    findings = collect_findings(summary, attribution, flight_dumps=dumps,
+                                serving_attribution=serving_attribution)
     crit = [f for f in findings if f["severity"] == "crit"]
     if crit:
         verdict = crit[0]["detail"].split(" — ")[0]
@@ -312,6 +482,18 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
     elif attribution:
         verdict = (f"healthy: {attribution['ratio']}x the roofline "
                    f"prediction")
+    elif serving_attribution and serving_attribution["delta_ms"] \
+            > 0.05 * serving_attribution["predicted_ms"]:
+        b = serving_attribution["buckets"]
+        top = max(b, key=lambda k: b[k])
+        verdict = (f"serving: {serving_attribution['ratio']}x the "
+                   f"per-token roofline, dominated by {top}")
+    elif serving_attribution:
+        verdict = (f"serving healthy: {serving_attribution['ratio']}x "
+                   f"the per-token roofline")
+    elif summary.get("serving"):
+        verdict = "serving run; no serving_predicted row — per-token " \
+                  "gap attribution unavailable"
     elif summary["step_time"]["count"]:
         verdict = "no predicted row — gap attribution unavailable"
     else:
@@ -320,6 +502,7 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
         "run_dir": os.path.abspath(run_dir),
         "verdict": verdict,
         "attribution": attribution,
+        "serving_attribution": serving_attribution,
         "findings": findings,
         "flight_dumps": dumps,
         "summary": summary,
@@ -350,6 +533,44 @@ def format_report(report: dict) -> str:
                     f"{r['predicted']} ({r['ratio']}x)")
         for note in attr.get("notes", []):
             lines.append(f"note: {note}")
+    sattr = report.get("serving_attribution")
+    sv = (report.get("summary") or {}).get("serving") or {}
+    if sattr:
+        lines.append(
+            f"serving: measured {sattr['measured_ms']} ms/output-token vs "
+            f"predicted {sattr['predicted_ms']} ms "
+            f"({sattr['delta_ms']:+} ms, {sattr['ratio']}x) over "
+            f"{sattr['requests']} requests / {sattr['tokens']} tokens")
+        lines.append("serving gap attribution (per output token, sums to "
+                     "the delta):")
+        b = sattr["buckets"]
+        total = sum(abs(v) for v in b.values()) or 1.0
+        for k, v in sorted(b.items(), key=lambda kv: -abs(kv[1])):
+            share = 100 * abs(v) / total
+            lines.append(f"  {k:<8} {v:+9.3f} ms  ({share:4.1f}%)")
+        for note in sattr.get("notes", []):
+            lines.append(f"note: {note}")
+    if sv:
+        def pcts(key, scale=1e3, unit="ms"):
+            p = sv.get(key) or {}
+            if not p:
+                return "n/a"
+            return (f"p50 {p['p50'] * scale:.2f}{unit} / "
+                    f"p95 {p['p95'] * scale:.2f}{unit} / "
+                    f"p99 {p['p99'] * scale:.2f}{unit}")
+        lines.append(
+            f"serving requests: {sv.get('finished', 0)} finished, "
+            f"{sv.get('rejected', 0)} rejected; "
+            f"queue-wait {pcts('queue_wait_s')}; "
+            f"ttft {pcts('ttft_s')}; per-token {pcts('per_token_s')}")
+        slo = sv.get("slo") or {}
+        if slo:
+            gf = slo.get("goodput_fraction")
+            lines.append(
+                f"serving SLO: {slo.get('met', 0)} met / "
+                f"{slo.get('missed', 0)} missed, goodput "
+                f"{slo.get('goodput_tokens', 0)} tokens"
+                + (f" ({100 * gf:.1f}%)" if gf is not None else ""))
     findings = report.get("findings") or []
     if findings:
         lines.append("findings:")

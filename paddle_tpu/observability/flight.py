@@ -8,6 +8,8 @@ cumulative collective bytes) plus compile and annotation events, and
 **dumps it to the run directory** when something goes wrong:
 
 - an online anomaly fires (:mod:`.anomaly` calls :func:`dump`),
+- a serving SLO window is violated (:mod:`.slo` dumps reason ``slo``
+  with the offending request ids — soft-throttled per reason),
 - the process dies on an unhandled exception (``sys.excepthook`` chain,
   installed by :meth:`FlightRecorder.install`),
 - the pod is preempted (the PR-4 ``PreemptionHandler`` calls
@@ -74,7 +76,7 @@ class FlightRecorder:
         # critical-section — a plain Lock would deadlock the grace window
         self._lock = threading.RLock()
         self._step_seq = 0
-        self._last_soft_dump = 0.0
+        self._last_soft_dump: dict = {}   # reason -> last dump monotonic
         self._installed_excepthook = False
 
     # ------------------------------------------------------------- record
@@ -116,13 +118,16 @@ class FlightRecorder:
 
     # --------------------------------------------------------------- dump
     def _soft_throttled(self, reason: str) -> bool:
-        """Consume the soft-reason throttle; hard reasons never throttle."""
+        """Consume the soft-reason throttle; hard reasons never
+        throttle. The throttle is PER REASON: an anomaly storm must not
+        starve the SLO violation's black box (and vice versa)."""
         if reason in _HARD_REASONS:
             return False
         now = time.monotonic()
-        if now - self._last_soft_dump < _SOFT_DUMP_MIN_INTERVAL_S:
+        if now - self._last_soft_dump.get(reason, -1e18) \
+                < _SOFT_DUMP_MIN_INTERVAL_S:
             return True
-        self._last_soft_dump = now
+        self._last_soft_dump[reason] = now
         return False
 
     def _dump_dir(self, run_dir=None):

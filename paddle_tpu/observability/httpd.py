@@ -1,0 +1,150 @@
+"""Live telemetry exposition: /metrics, /healthz, /status over HTTP.
+
+A stdlib-``http.server`` thread attachable to the serving stack (or any
+process with a metrics registry) — no new dependencies, nothing on the
+hot path. Routes:
+
+- ``/metrics``  — the registry's Prometheus text exposition 0.0.4
+  (what a Prometheus scraper or ``curl`` reads mid-run),
+- ``/healthz``  — 200 ``ok`` while the status provider reports healthy,
+  503 naming ``last_error`` once the serving loop has died on an engine
+  failure (the liveness probe contract),
+- ``/status``   — a JSON snapshot from the status provider: queue depth,
+  active/finished/rejected counts, KV-pool utilization + fragmentation,
+  SLO burn rates, last anomaly (see
+  ``ContinuousBatchingScheduler.status``).
+
+Usage::
+
+    sched = ContinuousBatchingScheduler(engine, slo={...})
+    srv = sched.serve_http(port=0)          # 0 = ephemeral port
+    print(srv.url)                          # http://127.0.0.1:<port>
+    ...
+    srv.close()                             # joins the thread, frees the socket
+
+or standalone over just the registry (no serving state)::
+
+    from paddle_tpu.observability.httpd import ServingStatusServer
+    srv = ServingStatusServer()             # /metrics + /healthz only
+
+The server is a daemon ``ThreadingHTTPServer`` — concurrent scrapes each
+get their own handler thread, and the registry's locking makes every
+``/metrics`` body a consistent cut. ``close()`` is idempotent and leaves
+no thread or socket behind (tier-1 asserts this).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["ServingStatusServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance injects these via the class-factory below
+    server_version = "paddle-tpu-observability/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code: int, body: str, ctype: str):
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 — stdlib contract
+        owner: ServingStatusServer = self.server.owner  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, owner.registry.to_prometheus(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                healthy, detail = owner.health()
+                self._send(200 if healthy else 503,
+                           "ok\n" if healthy else f"unhealthy: {detail}\n",
+                           "text/plain; charset=utf-8")
+            elif path == "/status":
+                self._send(200, json.dumps(owner.status(), sort_keys=True,
+                                           default=str) + "\n",
+                           "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain; charset=utf-8")
+        except Exception as e:  # a broken provider must not kill the thread
+            try:
+                self._send(500, f"error: {e!r}\n",
+                           "text/plain; charset=utf-8")
+            except Exception:
+                pass
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class ServingStatusServer:
+    """Daemon HTTP thread exposing /metrics, /healthz, /status.
+
+    ``status_fn`` returns the ``/status`` JSON dict; when it carries
+    ``{"healthy": False, "last_error": ...}`` the ``/healthz`` probe
+    flips to 503. Without a provider the server is registry-only
+    (``/status`` serves a minimal snapshot, ``/healthz`` is always ok).
+    """
+
+    def __init__(self, status_fn=None, registry=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        from .metrics import get_registry
+        self.registry = registry or get_registry()
+        self._status_fn = status_fn
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"obs-http-{self.port}")
+        self._closed = False
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------- views
+    def status(self) -> dict:
+        """The provider's snapshot (consistency is the provider's job —
+        the scheduler's status() holds its own lock); a raising provider
+        surfaces as the handler's 500 / an unhealthy probe."""
+        if self._status_fn is None:
+            return {"healthy": True, "serving": None}
+        return self._status_fn()
+
+    def health(self) -> tuple:
+        """(healthy, detail) from the status provider."""
+        try:
+            st = self.status()
+        except Exception as e:
+            return False, repr(e)[:200]
+        if not isinstance(st, dict):
+            return True, ""
+        healthy = st.get("healthy", True)
+        return bool(healthy), str(st.get("last_error") or "")[:200]
+
+    # ---------------------------------------------------------- shutdown
+    def close(self):
+        """Stop serving, join the thread, release the socket.
+        Idempotent — safe from tests, atexit, and __del__ alike."""
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -44,16 +44,28 @@ paddle_analysis_predicted_step_ms     gauge      target
 paddle_analysis_predicted_peak_hbm_mb gauge      target
 paddle_analysis_predicted_mfu         gauge      target
 paddle_serving_requests_total         counter    event={submitted,admitted,
-                                                 finished,rejected}
+                                                 finished,rejected};
+                                                 rejected also carries
+                                                 reason={max_new<1,too_long,
+                                                 queue_full,pool_too_small}
 paddle_serving_queue_depth            gauge      —
 paddle_serving_ttft_seconds           histogram  —
+paddle_serving_queue_wait_seconds     histogram  —
+paddle_serving_prefill_seconds        histogram  —
+paddle_serving_per_token_seconds      histogram  —
 paddle_serving_tokens_out_total       counter    —
 paddle_serving_kv_pages_in_use        gauge      —
+paddle_serving_slo_violations_total   counter    slo={ttft_p95,per_token_p99,
+                                                 queue_wait_p95}
+paddle_serving_slo_burn_rate          gauge      slo
+paddle_serving_goodput_tokens_total   counter    —
 ====================================  =========  =============================
 
 Serving decode steps additionally ride ``record_train_step`` with
-``path="serving"``, so the flight recorder and the online anomaly
-monitors cover the serving engine exactly like training.
+``path="serving"`` (and timed prefills with ``path="serving_prefill"``),
+so the flight recorder and the online anomaly monitors cover the serving
+engine exactly like training. Request-scoped serving telemetry (per-
+request spans, SLO windows) lives in :mod:`.reqtrace` / :mod:`.slo`.
 
 Everything here must stay off the device critical path: increments are a
 dict lookup + float add; the memory sampler reads allocator stats (cheap)
@@ -256,6 +268,46 @@ def serving_kv_pages_gauge():
     return get_registry().gauge(
         "paddle_serving_kv_pages_in_use",
         "KV-cache pool pages currently allocated to live sequences")
+
+
+def serving_queue_wait_histogram():
+    return get_registry().histogram(
+        "paddle_serving_queue_wait_seconds",
+        "submit-to-admission wait per admitted request",
+        buckets=STEP_BUCKETS)
+
+
+def serving_prefill_histogram():
+    return get_registry().histogram(
+        "paddle_serving_prefill_seconds",
+        "wall-clock seconds per request prefill (page alloc + bucketed "
+        "forward + first sampled token)", buckets=STEP_BUCKETS)
+
+
+def serving_per_token_histogram():
+    return get_registry().histogram(
+        "paddle_serving_per_token_seconds",
+        "decode-tick latency per emitted token (one observation per "
+        "active request per step)", buckets=STEP_BUCKETS)
+
+
+def serving_slo_violations():
+    return get_registry().counter(
+        "paddle_serving_slo_violations_total",
+        "rolling-window SLO violations by target (see observability.slo)")
+
+
+def serving_slo_burn_rate_gauge():
+    return get_registry().gauge(
+        "paddle_serving_slo_burn_rate",
+        "error-budget burn rate per SLO (1.0 = burning exactly at "
+        "budget)")
+
+
+def serving_goodput_tokens_counter():
+    return get_registry().counter(
+        "paddle_serving_goodput_tokens_total",
+        "tokens from requests that met every configured SLO target")
 
 
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,

@@ -1,0 +1,299 @@
+"""Per-request serving traces: lifecycle spans, JSONL records, chrome export.
+
+Aggregate serving telemetry (counters, one TTFT histogram) cannot answer
+the question operators actually page on — *which requests blew their
+latency budget, and where did the time go*. This module is the
+request-scoped layer under ``serving.scheduler``:
+
+- :class:`RequestTrace` — carried on every scheduler ``Request``; records
+  one span per lifecycle phase (``queued`` → ``prefill`` → ``decode``,
+  or a terminal ``rejected``) on the scheduler's monotonic clock, plus
+  per-token decode-tick samples (each decode step appends its walltime
+  for every token it emitted — the per-token latency distribution the
+  SLO tracker and bench percentiles are sourced from).
+- :func:`request_record` — the one-line-per-request JSONL schema the
+  scheduler streams into ``<run_dir>/requests.jsonl`` (via
+  ``RunLogger.log_request``) at each request's terminal event::
+
+      {"event": "request", "rid": 3, "generation": 0,
+       "state": "finished", "reject_reason": null,
+       "prompt_len": 17, "new_tokens": 7, "submit_ts": <epoch>,
+       "queue_wait_s": ..., "prefill_s": ..., "ttft_s": ...,
+       "decode_s": ..., "total_s": ..., "slo_met": true,
+       "per_token_s": {"count", "mean", "p50", "p95", "p99", "max"},
+       "spans": [{"phase": "queued", "t0_s": 0.0, "dur_s": ...}, ...]}
+
+- :func:`fold_request_records` — per-request percentiles (queue wait,
+  TTFT, time-per-output-token, tokens) across a run's records; the
+  shape ``runlog.merge_run_dir`` folds into ``run_summary.json
+  ["serving"]`` and the perf doctor's serving attribution consumes.
+- :func:`chrome_trace_events` / :func:`export_chrome_trace` — the same
+  records as a chrome trace (one ``"ph": "X"`` span per phase,
+  ``tid`` = rid), readable by ``tools/trace_summary.py`` and
+  ``chrome://tracing``.
+
+CLI::
+
+    python -m paddle_tpu.observability.reqtrace <run_dir> -o trace.json
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+__all__ = ["RequestTrace", "request_record", "fold_request_records",
+           "load_request_records", "chrome_trace_events",
+           "export_chrome_trace", "quantile"]
+
+# per-token sample ring cap: a 1M-token stream must not grow a trace
+# unboundedly; percentiles over the last N samples are what SLO windows
+# read anyway
+MAX_TOKEN_SAMPLES = 4096
+
+
+class RequestTrace:
+    """Lifecycle spans + per-token samples for one serving request.
+
+    Span times ride the caller's monotonic clock (``time.perf_counter``
+    — the scheduler's request timestamps); ``submit_epoch`` anchors the
+    trace on the wall clock so cross-process chrome exports line up."""
+
+    __slots__ = ("rid", "generation", "submit_epoch", "_t0", "spans",
+                 "token_samples", "_dropped_samples")
+
+    def __init__(self, rid, t0, generation: int | None = None):
+        from .runlog import _env_generation
+        self.rid = rid
+        self.generation = _env_generation() if generation is None \
+            else int(generation)
+        self.submit_epoch = time.time()
+        self._t0 = float(t0)
+        self.spans: list = []          # {"phase", "t0_s", "dur_s", ...}
+        self.token_samples: list = []  # decode-tick seconds per token
+        self._dropped_samples = 0
+
+    def span(self, phase: str, t_start: float, t_end: float, **meta):
+        """Record one closed lifecycle span (times on the trace clock)."""
+        rec = {"phase": phase, "t0_s": round(t_start - self._t0, 6),
+               "dur_s": round(max(t_end - t_start, 0.0), 6)}
+        if meta:
+            rec.update(meta)
+        self.spans.append(rec)
+        return rec
+
+    def add_token(self, seconds: float):
+        """Fold one decode tick into the per-token sample series (ring
+        overwrite past the cap: oldest sample evicted first)."""
+        if len(self.token_samples) < MAX_TOKEN_SAMPLES:
+            self.token_samples.append(float(seconds))
+        else:
+            self.token_samples[self._dropped_samples
+                               % MAX_TOKEN_SAMPLES] = float(seconds)
+            self._dropped_samples += 1
+
+    def per_token_stats(self) -> dict | None:
+        return _pcts(self.token_samples)
+
+
+def quantile(sorted_xs, q: float) -> float:
+    """Nearest-rank quantile over an already-SORTED sample list (0.0
+    when empty) — the ONE index formula every serving consumer (fold,
+    SLO windows, bench percentile columns) shares."""
+    if not sorted_xs:
+        return 0.0
+    return sorted_xs[min(len(sorted_xs) - 1,
+                         int(round(q * (len(sorted_xs) - 1))))]
+
+
+def _pcts(xs) -> dict | None:
+    """{count, mean, p50, p95, p99, max} over a sample list (None when
+    empty) — the one percentile shape every serving consumer reads."""
+    xs = sorted(float(x) for x in xs)
+    if not xs:
+        return None
+    return {"count": len(xs), "mean": round(sum(xs) / len(xs), 6),
+            "p50": round(quantile(xs, 0.50), 6),
+            "p95": round(quantile(xs, 0.95), 6),
+            "p99": round(quantile(xs, 0.99), 6), "max": round(xs[-1], 6)}
+
+
+def request_record(summary: dict, trace: RequestTrace | None = None) -> dict:
+    """One ``requests.jsonl`` line from a request summary (+ its trace).
+
+    ``summary`` is ``serving.scheduler.Request.summary()``; everything
+    here is plain JSON scalars — the record must survive a torn-append
+    reader and a rankless post-hoc merge."""
+    rec = {"event": "request", "ts": time.time()}
+    rec.update(summary)
+    if trace is not None:
+        rec.setdefault("rid", trace.rid)
+        rec["generation"] = trace.generation
+        rec["submit_ts"] = round(trace.submit_epoch, 6)
+        rec["spans"] = list(trace.spans)
+        if trace.token_samples and "per_token_s" not in rec:
+            rec["per_token_s"] = trace.per_token_stats()
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# run-level folding (merge_run_dir / perf doctor input)
+# ---------------------------------------------------------------------------
+
+def load_request_records(run_dir: str):
+    """All ``requests*.jsonl`` records in a run dir → (records,
+    n_corrupt); torn tail lines are skipped and counted, same contract
+    as the metrics/event streams."""
+    from .runlog import _read_jsonl
+    records, bad = [], 0
+    for path in sorted(glob.glob(os.path.join(run_dir, "requests*.jsonl"))):
+        recs, nb = _read_jsonl(path)
+        bad += nb
+        records.extend(r for r in recs if r.get("event") == "request")
+    return records, bad
+
+
+def fold_request_records(records) -> dict | None:
+    """Per-request percentiles across one run's request records.
+
+    Returns the ``run_summary.json["serving"]`` shape: counts by state,
+    rejects by reason, {queue_wait, ttft, per-token, tokens} percentiles
+    over *per-request* values, and the totals the doctor's serving gap
+    attribution divides (request seconds, queue/prefill seconds, output
+    tokens). None when there are no request records."""
+    records = [r for r in records if isinstance(r, dict)]
+    if not records:
+        return None
+    finished = [r for r in records if r.get("state") == "finished"]
+    rejected = [r for r in records if r.get("state") == "rejected"]
+    reject_reasons: dict = {}
+    for r in rejected:
+        reason = str(r.get("reject_reason") or "?")
+        reject_reasons[reason] = reject_reasons.get(reason, 0) + 1
+
+    def vals(key):
+        return [r[key] for r in finished
+                if isinstance(r.get(key), (int, float))]
+
+    per_token = []
+    for r in finished:
+        pt = r.get("per_token_s") or {}
+        if isinstance(pt.get("mean"), (int, float)):
+            per_token.append(pt["mean"])
+        elif isinstance(r.get("decode_s"), (int, float)) \
+                and (r.get("new_tokens") or 0) > 1:
+            per_token.append(r["decode_s"] / (r["new_tokens"] - 1))
+    tokens = [int(r.get("new_tokens") or 0) for r in finished]
+    slo_met = [r.get("slo_met") for r in finished
+               if r.get("slo_met") is not None]
+    out = {
+        "requests": len(records),
+        "finished": len(finished),
+        "rejected": sum(reject_reasons.values()),
+        "reject_reasons": reject_reasons,
+        "new_tokens_total": sum(tokens),
+        "request_seconds_total": round(sum(vals("total_s")), 6),
+        "queue_wait_seconds_total": round(sum(vals("queue_wait_s")), 6),
+        "prefill_seconds_total": round(sum(vals("prefill_s")), 6),
+        "decode_seconds_total": round(sum(vals("decode_s")), 6),
+        "queue_wait_s": _pcts(vals("queue_wait_s")),
+        "ttft_s": _pcts(vals("ttft_s")),
+        "per_token_s": _pcts(per_token),
+        "tokens": _pcts(tokens),
+    }
+    if slo_met:
+        met_tokens = sum(int(r.get("new_tokens") or 0) for r in finished
+                         if r.get("slo_met"))
+        total = out["new_tokens_total"]
+        out["slo"] = {"met": sum(bool(m) for m in slo_met),
+                      "missed": sum(not m for m in slo_met),
+                      "goodput_tokens": met_tokens,
+                      "goodput_fraction": round(met_tokens / total, 4)
+                      if total else None}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export
+# ---------------------------------------------------------------------------
+
+def chrome_trace_events(records) -> dict:
+    """Request records → ``{"traceEvents": [...]}``: one ``"ph": "X"``
+    span per lifecycle phase, ``tid`` = rid, ``pid`` = rank (when the
+    record carries one), µs timestamps rebased to the earliest submit.
+    The span *names* are the phases, so ``tools/trace_summary.py``'s
+    aggregate table reads directly as time-per-phase."""
+    records = [r for r in records
+               if isinstance(r, dict) and r.get("spans") is not None]
+    if not records:
+        return {"traceEvents": []}
+    base = min(float(r.get("submit_ts") or 0.0) for r in records)
+    events = []
+    for r in records:
+        t0 = (float(r.get("submit_ts") or base) - base) * 1e6
+        rid = r.get("rid", 0)
+        pid = int(r.get("rank") or 0)
+        for sp in r["spans"]:
+            args = {k: v for k, v in sp.items()
+                    if k not in ("phase", "t0_s", "dur_s")}
+            args.update({"rid": rid, "state": r.get("state")})
+            events.append({
+                "ph": "X", "cat": "serving",
+                "name": str(sp.get("phase", "?")),
+                "pid": pid, "tid": rid,
+                "ts": round(t0 + float(sp.get("t0_s") or 0.0) * 1e6, 3),
+                "dur": round(float(sp.get("dur_s") or 0.0) * 1e6, 3),
+                "args": args,
+            })
+        pt = r.get("per_token_s")
+        if pt:  # counter sample: per-token latency over wall time
+            events.append({
+                "ph": "C", "cat": "serving", "name": "per_token_ms",
+                "pid": pid, "tid": 0,
+                "ts": round(t0 + float(r.get("total_s") or 0.0) * 1e6, 3),
+                "args": {"value": round(1e3 * float(pt["mean"]), 4)},
+            })
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events}
+
+
+def export_chrome_trace(source, out_path: str) -> str:
+    """Write a chrome trace from ``source`` — a run dir (its
+    ``requests*.jsonl`` streams) or an iterable of request records."""
+    if isinstance(source, str):
+        records, _ = load_request_records(source)
+    else:
+        records = list(source)
+    doc = chrome_trace_events(records)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def _main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="per-request serving trace → chrome trace / summary")
+    ap.add_argument("run_dir", help="run dir holding requests*.jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="chrome-trace output path (default: "
+                         "<run_dir>/requests_trace.json)")
+    args = ap.parse_args(argv)
+    records, bad = load_request_records(args.run_dir)
+    if not records:
+        print(f"reqtrace: no request records under {args.run_dir}")
+        return 1
+    out = args.out or os.path.join(args.run_dir, "requests_trace.json")
+    export_chrome_trace(records, out)
+    folded = fold_request_records(records)
+    print(json.dumps({"chrome_trace": out, "corrupt_lines": bad,
+                      "serving": folded}, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main(sys.argv[1:]))

@@ -8,6 +8,8 @@ Layout of a run directory (``PADDLE_TELEMETRY_DIR`` or explicit path)::
                                 #   append-mode, so generations accumulate
       metrics.rank0.gen0.jsonl  # MetricsRegistry.export_jsonl snapshots,
       metrics.rank1.gen0.jsonl  #   one file per (rank, launch generation)
+      requests.jsonl            # serving: one terminal record per request
+                                #   (reqtrace.request_record schema)
       run_summary.json          # merge_run_dir() output (launcher side)
 
 Every worker appends events through its process-local :class:`RunLogger`
@@ -65,6 +67,11 @@ class RunLogger:
         self._metrics_path = os.path.join(
             run_dir, f"metrics.rank{self.rank}.gen{self.generation}.jsonl")
         self._fh = open(self._events_path, "a")
+        # serving request stream (reqtrace.request_record lines); one
+        # shared file — serving is one scheduler process per engine, and
+        # every record is rank/generation-stamped anyway
+        self._requests_path = os.path.join(run_dir, "requests.jsonl")
+        self._requests_fh = None   # opened lazily on first request
 
     def log(self, event: str, **fields):
         rec = {"ts": time.time(), "rank": self.rank,
@@ -74,6 +81,21 @@ class RunLogger:
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
+        return rec
+
+    def log_request(self, record: dict):
+        """Append one per-request serving record (see
+        :func:`.reqtrace.request_record`) to ``requests.jsonl``."""
+        rec = dict(record)
+        rec.setdefault("ts", time.time())
+        rec.setdefault("rank", self.rank)
+        rec.setdefault("generation", self.generation)
+        line = json.dumps(rec)
+        with self._lock:
+            if self._requests_fh is None:
+                self._requests_fh = open(self._requests_path, "a")
+            self._requests_fh.write(line + "\n")
+            self._requests_fh.flush()
         return rec
 
     def flush_metrics(self):
@@ -91,6 +113,9 @@ class RunLogger:
         with self._lock:
             if not self._fh.closed:
                 self._fh.close()
+            if self._requests_fh is not None \
+                    and not self._requests_fh.closed:
+                self._requests_fh.close()
 
     def __enter__(self):
         return self
@@ -218,6 +243,11 @@ def merge_run_dir(run_dir: str, write: bool = True,
     - ``corrupt_lines`` — torn/unparseable JSONL lines skipped (a rank
       killed mid-append leaves exactly one)
     - ``anomalies`` — per-kind tallies of online ``anomaly`` events
+      (SLO violations ride this stream as ``slo_*`` kinds)
+    - ``serving`` — per-request percentiles folded from
+      ``requests*.jsonl`` (queue wait, TTFT, time-per-output-token,
+      tokens; see :func:`.reqtrace.fold_request_records`) plus
+      ``slo_violations`` counter tallies; None for non-serving runs
     - ``straggler`` — cross-rank step-time skew verdict: the slowest
       rank's mean vs the fleet median; named (rank, generation, skew)
       when the skew exceeds ``straggler_threshold``, else None
@@ -241,10 +271,17 @@ def merge_run_dir(run_dir: str, write: bool = True,
         "anomalies": {},
         "corrupt_lines": 0,
         "straggler": None,
+        "serving": None,
     }
     st = summary["step_time"]
     counter_anomalies = {}  # rank -> {kind: n} from flushed counter series
     event_anomalies = {}    # rank -> {kind: n} from synchronous events
+    # SLO violations are double-recorded like anomalies: a synchronous
+    # "anomaly" event per firing plus the periodically-flushed counter —
+    # tally both per rank and take the max, so a run that died before
+    # its next metrics flush still reports the violations it logged
+    counter_slo = {}        # rank -> {slo: n}
+    event_slo = {}          # rank -> {slo: n}
 
     for path in sorted(glob.glob(os.path.join(run_dir, "metrics.rank*.jsonl"))):
         m = re.search(r"metrics\.rank(-?\d+)(?:\.gen-?\d+)?\.jsonl$", path)
@@ -306,6 +343,10 @@ def merge_run_dir(run_dir: str, write: bool = True,
             elif name == "paddle_elastic_restarts_total":
                 summary["restarts"] = max(summary["restarts"],
                                           int(rec.get("value", 0)))
+            elif name == "paddle_serving_slo_violations_total":
+                slo = rec.get("labels", {}).get("slo", "?")
+                d = counter_slo.setdefault(rank, {})
+                d[slo] = d.get(slo, 0) + int(rec.get("value", 0))
 
     for path in sorted(glob.glob(os.path.join(run_dir, "events.rank*.jsonl"))):
         recs, bad = _read_jsonl(path)
@@ -317,6 +358,10 @@ def merge_run_dir(run_dir: str, write: bool = True,
                 kind = rec.get("kind", "?")
                 d = event_anomalies.setdefault(rec.get("rank", -1), {})
                 d[kind] = d.get(kind, 0) + 1
+                if kind.startswith("slo_"):
+                    slo = rec.get("slo") or kind[len("slo_"):]
+                    d = event_slo.setdefault(rec.get("rank", -1), {})
+                    d[slo] = d.get(slo, 0) + 1
             gen = rec.get("generation")
             if gen is not None and gen not in summary["generations"]:
                 summary["generations"].append(gen)
@@ -346,6 +391,22 @@ def merge_run_dir(run_dir: str, write: bool = True,
         for kind in set(c) | set(e):
             summary["anomalies"][kind] = summary["anomalies"].get(kind, 0) \
                 + max(c.get(kind, 0), e.get(kind, 0))
+    # serving: per-request percentiles from the requests.jsonl stream(s)
+    slo_violations: dict = {}
+    for rank in set(counter_slo) | set(event_slo):
+        c, e = counter_slo.get(rank, {}), event_slo.get(rank, {})
+        for slo in set(c) | set(e):
+            slo_violations[slo] = slo_violations.get(slo, 0) \
+                + max(c.get(slo, 0), e.get(slo, 0))
+    from .reqtrace import fold_request_records, load_request_records
+    req_records, req_bad = load_request_records(run_dir)
+    summary["corrupt_lines"] += req_bad
+    serving = fold_request_records(req_records)
+    if serving is not None or slo_violations:
+        serving = serving or {}
+        serving["slo_violations"] = slo_violations
+        summary["serving"] = serving
+
     summary["straggler"] = _straggler_pass(st["per_rank"],
                                            straggler_threshold)
     if write:

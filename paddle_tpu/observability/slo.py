@@ -1,0 +1,265 @@
+"""Serving SLO guardrails: rolling targets, burn rates, goodput.
+
+The serving engine's aggregate metrics say *how fast* the run is; this
+module says whether it is *meeting its promises*. An :class:`SLOTracker`
+(attached by ``ContinuousBatchingScheduler(slo=...)``) watches the
+per-request stream against configurable targets over rolling windows:
+
+- ``ttft_p95``     — submit→first-token latency, p95 over the last
+  ``window`` admitted requests vs ``SLOConfig.ttft_p95_s``
+- ``per_token_p99`` — decode-tick latency, p99 over the last
+  ``token_window`` emitted tokens vs ``SLOConfig.per_token_p99_s``
+- ``queue_wait_p95`` — submit→admit wait vs ``SLOConfig.queue_wait_p95_s``
+
+**Burn rate** (SRE error-budget accounting): a pXX target implies an
+error budget of ``1 - XX/100`` — the fraction of samples *allowed* over
+the target. The burn rate is the observed over-target fraction divided
+by that budget: 1.0 = burning exactly at budget, 2.0 = the budget is
+gone in half the window. Burn rates are exported continuously as
+``paddle_serving_slo_burn_rate{slo}`` and surfaced on ``/status``.
+
+**Violation** = the windowed percentile itself exceeds the target (with
+enough samples). Each firing — per-SLO cooldown so a bad minute is one
+page, not a storm —
+
+- emits an ``anomaly``-style runlog event (``kind="slo_<name>"``, same
+  stream the training anomaly monitors write, so ``merge_run_dir`` and
+  the perf doctor tally it with zero new plumbing),
+- increments ``paddle_serving_slo_violations_total{slo}`` (and the
+  shared ``paddle_anomalies_total{kind, path="serving"}``),
+- asks the flight recorder for a throttled ``slo`` dump **naming the
+  offending rids** — a bad serving window always leaves a black box
+  that says which requests blew the budget.
+
+**Goodput** = tokens from requests that met every configured target
+(``paddle_serving_goodput_tokens_total``); the scheduler stamps each
+finished request's ``slo_met`` into its ``requests.jsonl`` record.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["SLOConfig", "SLOTracker"]
+
+
+@dataclass
+class SLOConfig:
+    """Serving latency targets (seconds; ``None`` disables a target)."""
+    ttft_p95_s: float | None = None
+    per_token_p99_s: float | None = None
+    queue_wait_p95_s: float | None = None
+    window: int = 64            # rolling request window (ttft/queue-wait)
+    token_window: int = 512     # rolling emitted-token window
+    min_requests: int = 8       # samples before a request-SLO can fire
+    min_tokens: int = 32        # samples before the token-SLO can fire
+    cooldown_s: float = 5.0     # per-SLO refire floor
+    max_named_rids: int = 16    # offending rids carried per violation
+
+    def targets(self) -> dict:
+        out = {}
+        if self.ttft_p95_s is not None:
+            out["ttft_p95"] = float(self.ttft_p95_s)
+        if self.per_token_p99_s is not None:
+            out["per_token_p99"] = float(self.per_token_p99_s)
+        if self.queue_wait_p95_s is not None:
+            out["queue_wait_p95"] = float(self.queue_wait_p95_s)
+        return out
+
+
+# the percentile each SLO name is judged at (=> its error budget)
+_SLO_Q = {"ttft_p95": 0.95, "per_token_p99": 0.99, "queue_wait_p95": 0.95}
+
+
+class SLOTracker:
+    """Rolling SLO evaluation over the per-request serving stream."""
+
+    def __init__(self, config: SLOConfig | dict | None = None, *,
+                 path: str = "serving"):
+        if isinstance(config, dict):
+            config = SLOConfig(**config)
+        self.config = config or SLOConfig()
+        self.path = path
+        self._targets = self.config.targets()
+        self._lock = threading.Lock()
+        # per-SLO rolling (rid, value) windows
+        self._windows = {
+            "ttft_p95": collections.deque(maxlen=self.config.window),
+            "queue_wait_p95": collections.deque(maxlen=self.config.window),
+            "per_token_p99": collections.deque(
+                maxlen=self.config.token_window),
+        }
+        self._last_fired: dict = {}     # slo -> monotonic ts
+        self.violations: list = []      # recent firings (bounded)
+        self.total_tokens = 0
+        self.goodput_tokens = 0
+        self.requests_met = 0
+        self.requests_missed = 0
+        self.last_dump_thread = None    # in-flight async flight dump
+
+    # ------------------------------------------------------------ intake
+    def observe_tokens(self, rids, seconds: float):
+        """One decode tick: every rid in the batch emitted one token that
+        took ``seconds``."""
+        if "per_token_p99" not in self._targets:
+            return []
+        with self._lock:
+            w = self._windows["per_token_p99"]
+            for rid in rids:
+                w.append((rid, float(seconds)))
+            return self._check("per_token_p99", self.config.min_tokens)
+
+    def observe_admission(self, rid, ttft_s=None, queue_wait_s=None):
+        """Feed the request-level windows at ADMISSION — the moment TTFT
+        and queue wait are final — and run their checks, so a queue
+        stall pages during the incident, not minutes later when the
+        request finally finishes (or never, if the run dies first)."""
+        fired = []
+        with self._lock:
+            if ttft_s is not None:
+                self._windows["ttft_p95"].append((rid, float(ttft_s)))
+            if queue_wait_s is not None:
+                self._windows["queue_wait_p95"].append(
+                    (rid, float(queue_wait_s)))
+            for slo in ("ttft_p95", "queue_wait_p95"):
+                if slo in self._targets:
+                    fired.extend(self._check(slo,
+                                             self.config.min_requests))
+        return fired
+
+    def observe_request(self, summary: dict) -> bool | None:
+        """One finished request (its ``Request.summary()``): goodput
+        accounting against the per-request values. The rolling windows
+        were already fed at admission (:meth:`observe_admission`) and
+        per decode tick (:meth:`observe_tokens`). Returns whether the
+        request met every configured target (None when no target had a
+        value to judge)."""
+        ttft = summary.get("ttft_s")
+        queue_wait = summary.get("queue_wait_s")
+        per_token = (summary.get("per_token_s") or {}).get("p99")
+        new_tokens = int(summary.get("new_tokens") or 0)
+        with self._lock:
+            met = None
+            checks = {"ttft_p95": ttft, "queue_wait_p95": queue_wait,
+                      "per_token_p99": per_token}
+            for slo, target in self._targets.items():
+                v = checks.get(slo)
+                if v is None:
+                    continue
+                ok = float(v) <= target
+                met = ok if met is None else (met and ok)
+            self.total_tokens += new_tokens
+            if met:
+                self.goodput_tokens += new_tokens
+                self.requests_met += 1
+            elif met is not None:
+                self.requests_missed += 1
+        if met and new_tokens:
+            from .instrument import serving_goodput_tokens_counter
+            serving_goodput_tokens_counter().inc(float(new_tokens))
+        return met
+
+    # ------------------------------------------------------------ checks
+    def _burn_rate(self, slo: str) -> float | None:
+        """Observed over-target fraction / error budget (lock held)."""
+        target = self._targets.get(slo)
+        w = self._windows[slo]
+        if target is None or not w:
+            return None
+        over = sum(1 for _, v in w if v > target)
+        budget = 1.0 - _SLO_Q[slo]
+        return (over / len(w)) / budget
+
+    def _check(self, slo: str, min_samples: int):
+        """Evaluate one SLO window (lock held); fire on breach."""
+        target = self._targets.get(slo)
+        w = self._windows[slo]
+        if target is None or len(w) < min_samples:
+            return []
+        burn = self._burn_rate(slo)
+        from .instrument import serving_slo_burn_rate_gauge
+        from .reqtrace import quantile
+        serving_slo_burn_rate_gauge().set(round(burn, 4), slo=slo)
+        measured = quantile(sorted(v for _, v in w), _SLO_Q[slo])
+        if measured <= target:
+            return []
+        now = time.monotonic()
+        last = self._last_fired.get(slo)
+        if last is not None and now - last < self.config.cooldown_s:
+            return []
+        self._last_fired[slo] = now
+        # worst offenders first, deduped, capped — the rids the flight
+        # dump and the runlog event NAME
+        worst = sorted(((v, rid) for rid, v in w if v > target),
+                       reverse=True)
+        rids, seen = [], set()
+        for v, rid in worst:
+            if rid in seen:
+                continue
+            seen.add(rid)
+            rids.append(rid)
+            if len(rids) >= self.config.max_named_rids:
+                break
+        return [self._fire(slo, measured, target, burn, rids)]
+
+    def _fire(self, slo: str, measured: float, target: float,
+              burn: float, rids) -> dict:
+        rec = {"kind": f"slo_{slo}", "path": self.path, "slo": slo,
+               "measured_s": round(float(measured), 9),
+               "target_s": round(float(target), 9),
+               "burn_rate": round(float(burn), 3),
+               "offending_rids": list(rids),
+               "ts": time.time()}
+        self.violations.append(rec)
+        del self.violations[:-64]
+        from .instrument import anomalies_counter, serving_slo_violations
+        serving_slo_violations().inc(slo=slo)
+        anomalies_counter().inc(kind=rec["kind"], path=self.path)
+        from .runlog import get_run_logger
+        logger = get_run_logger()
+        if logger is not None:
+            logger.log("anomaly", **rec)
+        from . import flight
+        recorder = flight.get_flight_recorder()
+        fl = dict(rec)
+        fl["anomaly_kind"] = fl.pop("kind")   # "kind" slot = record type
+        recorder.record("anomaly", **fl)
+        # throttled black box naming the offending rids, off-thread so a
+        # violation never stalls the decode loop that detected it
+        t = recorder.dump_async("slo", slo=slo,
+                                measured_s=rec["measured_s"],
+                                target_s=rec["target_s"],
+                                burn_rate=rec["burn_rate"],
+                                offending_rids=list(rids))
+        if t is not None:
+            self.last_dump_thread = t
+        return rec
+
+    # ---------------------------------------------------------- exposure
+    def burn_rates(self) -> dict:
+        with self._lock:
+            return {slo: round(self._burn_rate(slo), 4)
+                    for slo in self._targets
+                    if self._burn_rate(slo) is not None}
+
+    def snapshot(self) -> dict:
+        """JSON view for ``/status`` and the scheduler's run record."""
+        with self._lock:
+            burn = {slo: round(b, 4) for slo in self._targets
+                    if (b := self._burn_rate(slo)) is not None}
+            return {
+                "targets_s": dict(self._targets),
+                "burn_rates": burn,
+                "violations": len(self.violations),
+                "last_violation": self.violations[-1]
+                if self.violations else None,
+                "requests_met": self.requests_met,
+                "requests_missed": self.requests_missed,
+                "goodput_tokens": self.goodput_tokens,
+                "total_tokens": self.total_tokens,
+                "goodput_fraction": round(
+                    self.goodput_tokens / self.total_tokens, 4)
+                if self.total_tokens else None,
+            }

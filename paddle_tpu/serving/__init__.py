@@ -21,8 +21,19 @@ continuous batching, with per-request telemetry. Four pieces:
 - :mod:`.scheduler` — ``ContinuousBatchingScheduler``: evict finished /
   admit queued (with full-completion page reservation, so decode can't
   OOM the pool) / one bucketed decode step, every tick. Serving steps
-  feed the flight recorder + anomaly monitors (``path="serving"``) and
-  the ``paddle_serving_*`` metric family.
+  feed the flight recorder + anomaly monitors (``path="serving"``, timed
+  prefills ``path="serving_prefill"``) and the ``paddle_serving_*``
+  metric family.
+
+Request-scoped observability (see ``paddle_tpu.observability``): every
+``Request`` carries a ``reqtrace.RequestTrace`` (lifecycle spans +
+per-token samples, streamed to ``requests.jsonl`` / chrome trace);
+``ContinuousBatchingScheduler(slo=...)`` attaches ``slo.SLOTracker``
+guardrails (TTFT p95 / per-token p99 / queue-wait p95, burn rates,
+goodput, flight dumps naming offending rids); ``scheduler.serve_http()``
+exposes live ``/metrics`` + ``/healthz`` + ``/status``; and
+``tools/perf_doctor.py <run_dir>`` prints the per-output-token
+measured-vs-predicted attribution for any serving run dir.
 
 The static gate: ``python tools/check_program.py --model serving`` lints
 the decode step and replays a randomized admission mix through the real

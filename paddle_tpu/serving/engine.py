@@ -23,7 +23,11 @@ updates the pool in place.
 Telemetry: every prefill/decode step feeds the metric registry, the
 flight recorder, and the anomaly monitor under ``path="serving"`` (see
 ``observability.instrument``), and per-request timing (queue wait, TTFT,
-tokens/s) lands on each finished :class:`~.scheduler.Request`.
+tokens/s, per-token samples) lands on each finished
+:class:`~.scheduler.Request` via its ``observability.reqtrace.
+RequestTrace``. :meth:`ServingEngine.status` is the engine-side slice of
+the scheduler's live ``/status`` endpoint (weights, buckets, compile
+time, pool utilization/fragmentation).
 """
 from __future__ import annotations
 
@@ -362,6 +366,21 @@ class ServingEngine:
         against."""
         return {(b, self.pool.max_pages_per_seq)
                 for b in self.decode_buckets}
+
+    def status(self) -> dict:
+        """Engine-side JSON snapshot for the live ``/status`` endpoint:
+        weight/pool sizing, bucket sets, compile accounting."""
+        return {
+            "compute_dtype": str(np.dtype(self.compute_dtype)),
+            "quantize": self.quantize,
+            "weights_mb": round(self.weight_bytes() / 2 ** 20, 2),
+            "decode_buckets": list(self.decode_buckets),
+            "prefill_buckets": list(self.prefill_buckets),
+            "max_seq_len": self.max_seq_len,
+            "compile_s": round(self.compile_s, 3),
+            "aot_programs": len(self._decode_exe) + len(self._prefill_exe),
+            "pool": self.pool.stats(),
+        }
 
     # ------------------------------------------------------------ lookup
     def _next_key(self):

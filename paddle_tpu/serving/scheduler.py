@@ -18,14 +18,29 @@ Every decode signature the scheduler can ever request is therefore
 over a randomized admission mix so ``tools/check_program.py`` can prove
 the AOT shape set is closed: zero retraces at serving time.
 
-Telemetry: queue depth / KV pages gauges, request + token counters, a
-TTFT histogram, and per-step ``record_train_step(path="serving")`` so
-serving steps ride the flight recorder and anomaly monitors exactly like
-train steps.
+Telemetry — aggregate AND request-scoped:
+
+- queue depth / KV pages gauges, request + token counters, TTFT /
+  queue-wait / prefill / per-token histograms; decode steps ride
+  ``record_train_step(path="serving")`` and timed prefills
+  ``path="serving_prefill"``, so both feed the flight recorder and the
+  online anomaly monitors exactly like train steps;
+- every ``Request`` carries a :class:`~paddle_tpu.observability.
+  reqtrace.RequestTrace` (one span per lifecycle phase, per-token
+  decode samples); terminal records stream to ``requests.jsonl`` in the
+  active run dir and export to chrome trace;
+- an optional :class:`~paddle_tpu.observability.slo.SLOTracker`
+  (``slo=...``) enforces TTFT / per-token / queue-wait targets with
+  burn-rate accounting, violation events, and flight dumps naming the
+  offending rids;
+- :meth:`ContinuousBatchingScheduler.serve_http` exposes ``/metrics``,
+  ``/healthz`` (flips unhealthy after an engine failure), and
+  ``/status`` (queue/pool/SLO snapshot) on a stdlib HTTP thread.
 """
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -46,8 +61,13 @@ class Request:
     admit_time: float | None = None
     first_token_time: float | None = None
     finish_time: float | None = None
+    prefill_s: float | None = None     # measured prefill walltime
     tokens: list = field(default_factory=list)   # generated ids
     state: str = "queued"              # queued|running|finished|rejected
+    reject_reason: str | None = None   # max_new<1|too_long|queue_full|
+    #                                    pool_too_small
+    slo_met: bool | None = None        # stamped at finish by the tracker
+    trace: object = None               # observability.reqtrace.RequestTrace
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -62,26 +82,39 @@ class Request:
                     and self.tokens[-1] == self.eos_id)
 
     def summary(self) -> dict:
-        """Per-request serving record (times in seconds)."""
-        queue_wait = (self.admit_time or 0) - self.submit_time \
-            if self.admit_time else None
-        ttft = (self.first_token_time or 0) - self.submit_time \
-            if self.first_token_time else None
-        tps = None
-        if self.finish_time and self.first_token_time \
-                and len(self.tokens) > 1:
-            span = self.finish_time - self.first_token_time
-            if span > 0:
-                tps = (len(self.tokens) - 1) / span
-        return {"rid": self.rid, "state": self.state,
-                "prompt_len": int(self.prompt.shape[0]),
-                "new_tokens": len(self.tokens),
-                "queue_wait_s": queue_wait, "ttft_s": ttft,
-                "decode_tokens_per_sec": tps}
+        """Per-request serving record (times in seconds). ``is not
+        None`` guards throughout: a monotonic clock CAN legitimately
+        read 0.0, so truthiness would misreport a real timestamp as
+        missing."""
+        queue_wait = ttft = decode_s = total_s = tps = None
+        if self.admit_time is not None:
+            queue_wait = self.admit_time - self.submit_time
+        if self.first_token_time is not None:
+            ttft = self.first_token_time - self.submit_time
+        if self.finish_time is not None:
+            total_s = self.finish_time - self.submit_time
+            if self.first_token_time is not None:
+                decode_s = self.finish_time - self.first_token_time
+        if decode_s is not None and decode_s > 0 and len(self.tokens) > 1:
+            tps = (len(self.tokens) - 1) / decode_s
+        out = {"rid": self.rid, "state": self.state,
+               "reject_reason": self.reject_reason,
+               "prompt_len": int(self.prompt.shape[0]),
+               "new_tokens": len(self.tokens),
+               "queue_wait_s": queue_wait, "ttft_s": ttft,
+               "prefill_s": self.prefill_s,
+               "decode_s": decode_s, "total_s": total_s,
+               "decode_tokens_per_sec": tps,
+               "slo_met": self.slo_met}
+        if self.trace is not None and self.trace.token_samples:
+            out["per_token_s"] = self.trace.per_token_stats()
+        return out
 
 
 class ContinuousBatchingScheduler:
-    def __init__(self, engine, max_queue: int = 1024):
+    def __init__(self, engine, max_queue: int = 1024, slo=None,
+                 max_retained: int = 4096):
+        from ..observability.slo import SLOConfig, SLOTracker
         self.engine = engine
         self.buckets = tuple(engine.decode_buckets)
         self.max_concurrency = self.buckets[-1]
@@ -90,31 +123,67 @@ class ContinuousBatchingScheduler:
         self._running: dict = {}          # rid -> Request, insertion order
         self._reserved_pages = 0          # pages promised, not yet alloc'd
         self._rid = itertools.count()
+        # terminal Request objects kept in memory for run()/bench/status
+        # consumers, bounded to the most recent max_retained per list —
+        # a long-lived server must not grow without limit (the durable
+        # per-request record is the requests.jsonl stream)
+        self.max_retained = int(max_retained)
         self.finished: list = []
+        self.rejected: list = []
         self.step_times: list = []        # decode-step walltimes (s)
         self.steps = 0
+        self.slo = None
+        if slo is not None:
+            self.slo = slo if isinstance(slo, SLOTracker) \
+                else SLOTracker(slo if isinstance(slo, (SLOConfig, dict))
+                                else SLOConfig())
+        self.healthy = True
+        self.last_error: str | None = None
+        # one coarse lock makes /status (and concurrent submit) a
+        # consistent cut of queue/pool state; step() holds it for the
+        # tick, so a scrape waits at most one decode step
+        self._lock = threading.Lock()
+        self._start_ts = time.time()
 
     # ----------------------------------------------------------- intake
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_id=None) -> Request:
         from ..observability import instrument as obs
+        from ..observability.reqtrace import RequestTrace
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        r = Request(next(self._rid), prompt, int(max_new_tokens),
-                    eos_id=eos_id)
-        pool = self.engine.pool
-        total = prompt.shape[0] + r.max_new_tokens
-        # max_new >= 1: prefill always emits one token, so total >= n+1
-        # and the engine's prompt-room check can never fire at admission
-        if r.max_new_tokens < 1 or total > pool.max_seq_len \
-                or len(self._queue) >= self.max_queue \
-                or pool.pages_needed(total) > pool.num_pages - 1:
-            r.state = "rejected"
-            obs.serving_requests_counter().inc(event="rejected")
+        with self._lock:
+            r = Request(next(self._rid), prompt, int(max_new_tokens),
+                        eos_id=eos_id)
+            r.trace = RequestTrace(r.rid, r.submit_time)
+            pool = self.engine.pool
+            total = prompt.shape[0] + r.max_new_tokens
+            # max_new >= 1: prefill always emits one token, so total >=
+            # n+1 and the engine's prompt-room check can never fire at
+            # admission
+            reason = None
+            if r.max_new_tokens < 1:
+                reason = "max_new<1"
+            elif total > pool.max_seq_len:
+                reason = "too_long"
+            elif len(self._queue) >= self.max_queue:
+                reason = "queue_full"
+            elif pool.pages_needed(total) > pool.num_pages - 1:
+                reason = "pool_too_small"
+            if reason is not None:
+                r.state = "rejected"
+                r.reject_reason = reason
+                r.trace.span("rejected", r.submit_time,
+                             time.perf_counter(), reason=reason)
+                self.rejected.append(r)
+                del self.rejected[:-self.max_retained]
+                obs.serving_requests_counter().inc(event="rejected",
+                                                   reason=reason)
+                self._log_request(r)
+                return r
+            self._queue.append(r)
+            obs.serving_requests_counter().inc(event="submitted")
+            obs.serving_queue_depth_gauge().set(float(len(self._queue)))
             return r
-        self._queue.append(r)
-        obs.serving_requests_counter().inc(event="submitted")
-        obs.serving_queue_depth_gauge().set(float(len(self._queue)))
-        return r
 
     @property
     def pending(self) -> int:
@@ -125,6 +194,18 @@ class ContinuousBatchingScheduler:
         return self.engine.pool.pages_needed(
             int(r.prompt.shape[0]) + r.max_new_tokens)
 
+    def _log_request(self, r: Request):
+        """Stream a request's terminal record to requests.jsonl (no-op
+        outside a telemetry-enabled run)."""
+        from ..observability.reqtrace import request_record
+        from ..observability.runlog import get_run_logger
+        logger = get_run_logger()
+        if logger is not None:
+            try:
+                logger.log_request(request_record(r.summary(), r.trace))
+            except Exception:
+                pass  # telemetry must never take the serving loop down
+
     def _evict_finished(self):
         from ..observability import instrument as obs
         for rid in [rid for rid, r in self._running.items() if r.done]:
@@ -134,8 +215,15 @@ class ContinuousBatchingScheduler:
             self.engine.release(rid)
             r.state = "finished"
             r.finish_time = time.perf_counter()
+            if r.trace is not None and r.first_token_time is not None:
+                r.trace.span("decode", r.first_token_time, r.finish_time,
+                             tokens=max(len(r.tokens) - 1, 0))
+            if self.slo is not None:
+                r.slo_met = self.slo.observe_request(r.summary())
             self.finished.append(r)
+            del self.finished[:-self.max_retained]
             obs.serving_requests_counter().inc(event="finished")
+            self._log_request(r)
 
     def _admit(self):
         from ..observability import instrument as obs
@@ -147,20 +235,57 @@ class ContinuousBatchingScheduler:
                 break  # head-of-line: keep arrival order deterministic
             self._queue.popleft()
             r.admit_time = time.perf_counter()
+            # the prefill IS part of the serving hot path: time it, so
+            # it reaches the histogram, the flight recorder, and the
+            # anomaly monitors (path="serving_prefill") — invisible
+            # prefill cost was the old blind spot
             tok = self.engine.prefill(r.rid, r.prompt)
+            t_done = time.perf_counter()
+            r.prefill_s = t_done - r.admit_time
             self._reserved_pages += need - len(pool.table(r.rid))
             r.tokens.append(tok)
             r.state = "running"
-            r.first_token_time = time.perf_counter()
+            r.first_token_time = t_done
             self._running[r.rid] = r
+            if r.trace is not None:
+                r.trace.span("queued", r.submit_time, r.admit_time)
+                r.trace.span("prefill", r.admit_time, t_done,
+                             prompt_len=int(r.prompt.shape[0]))
             obs.serving_requests_counter().inc(event="admitted")
+            obs.serving_queue_wait_histogram().observe(
+                r.admit_time - r.submit_time)
+            obs.serving_prefill_histogram().observe(r.prefill_s)
             obs.serving_ttft_histogram().observe(
                 r.first_token_time - r.submit_time)
             obs.serving_tokens_out_counter().inc()
+            obs.record_train_step(r.prefill_s,
+                                  tokens=int(r.prompt.shape[0]),
+                                  path="serving_prefill")
+            if self.slo is not None:
+                # ttft/queue-wait are final NOW — the guardrail windows
+                # must see a stall at admission, not at completion
+                self.slo.observe_admission(
+                    r.rid, ttft_s=r.first_token_time - r.submit_time,
+                    queue_wait_s=r.admit_time - r.submit_time)
 
     def step(self) -> bool:
         """One scheduler tick (evict → admit → one bucketed decode step).
-        Returns False when idle (nothing queued or running)."""
+        Returns False when idle (nothing queued or running). An engine
+        failure marks the scheduler unhealthy (``/healthz`` → 503) and
+        re-raises."""
+        try:
+            with self._lock:
+                return self._step_locked()
+        except Exception as e:
+            self.healthy = False
+            self.last_error = repr(e)[:300]
+            from ..observability.runlog import get_run_logger
+            logger = get_run_logger()
+            if logger is not None:
+                logger.log("serving_engine_error", error=self.last_error)
+            raise
+
+    def _step_locked(self) -> bool:
         from ..observability import instrument as obs
         self._evict_finished()
         self._admit()
@@ -181,9 +306,15 @@ class ContinuousBatchingScheduler:
             pool.extend(r.rid, 1)
             self._reserved_pages -= len(pool.table(r.rid)) - held
         toks = self.engine.decode([r.rid for r in active], bucket)
+        dt = time.perf_counter() - t0
+        per_token = obs.serving_per_token_histogram()
         for r, t in zip(active, toks):
             r.tokens.append(t)
-        dt = time.perf_counter() - t0
+            if r.trace is not None:
+                r.trace.add_token(dt)
+            per_token.observe(dt)
+        if self.slo is not None:
+            self.slo.observe_tokens([r.rid for r in active], dt)
         self.steps += 1
         self.step_times.append(dt)
         obs.serving_tokens_out_counter().inc(float(len(active)))
@@ -194,15 +325,57 @@ class ContinuousBatchingScheduler:
 
     def run(self, max_steps: int | None = None) -> list:
         """Drive until drained (or ``max_steps``); returns the finished
-        requests in completion order."""
+        requests in completion order (the most recent ``max_retained``
+        of them — older ones live on only in ``requests.jsonl``)."""
         n = 0
         while self.pending:
             if max_steps is not None and n >= max_steps:
                 break
             self.step()
             n += 1
-        self._evict_finished()
+        with self._lock:
+            self._evict_finished()
         return self.finished
+
+    # ------------------------------------------------------- observability
+    def request_records(self) -> list:
+        """Terminal per-request summaries (finished + rejected) — the
+        records bench percentiles and post-hoc analysis read."""
+        with self._lock:
+            return [r.summary() for r in self.finished + self.rejected]
+
+    def status(self) -> dict:
+        """JSON snapshot for the ``/status`` endpoint: queue and request
+        counts, KV-pool utilization/fragmentation, SLO burn rates, last
+        anomaly, engine shape/compile info."""
+        with self._lock:
+            st = {
+                "healthy": self.healthy,
+                "last_error": self.last_error,
+                "ts": time.time(),
+                "uptime_s": round(time.time() - self._start_ts, 3),
+                "queue_depth": len(self._queue),
+                "running": len(self._running),
+                "finished": len(self.finished),
+                "rejected": len(self.rejected),
+                "steps": self.steps,
+                "kv_pool": self.engine.pool.stats(),
+                "decode_buckets": list(self.buckets),
+                "slo": self.slo.snapshot() if self.slo is not None
+                else None,
+            }
+            if hasattr(self.engine, "status"):
+                st["engine"] = self.engine.status()
+        from ..observability import anomaly
+        st["last_anomaly"] = anomaly.last_anomaly()
+        return st
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the live /metrics + /healthz + /status endpoint on a
+        daemon thread; returns the server (``.url``, ``.close()``)."""
+        from ..observability.httpd import ServingStatusServer
+        return ServingStatusServer(status_fn=self.status, host=host,
+                                   port=port)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,605 @@
+"""Serving observability: per-request traces (requests.jsonl + chrome
+export), SLO guardrails (violation event + counter + flight dump naming
+rids), the live /metrics //healthz //status endpoint, and the perf
+doctor's serving gap attribution over the checked-in fixture.
+
+Acceptance (ISSUE 10): an induced SLO violation in a real scheduler run
+produces the violation event, the counter increment, and a flight dump
+naming offending rids; /metrics and /status serve correct data under
+concurrent scrapes mid-run; the doctor's serving buckets sum exactly to
+the measured-vs-predicted per-token delta on the fixture."""
+import json
+import os
+import shutil
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import anomaly, doctor, flight
+from paddle_tpu.observability import runlog
+from paddle_tpu.observability.reqtrace import (RequestTrace,
+                                               export_chrome_trace,
+                                               fold_request_records)
+from paddle_tpu.observability.slo import SLOConfig, SLOTracker
+from paddle_tpu.serving import ContinuousBatchingScheduler, ServingEngine
+from paddle_tpu.serving.scheduler import Request, _ShapeProbeEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "serving_doctor_run")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability_state(tmp_path, monkeypatch):
+    """Per-test isolation of the process-global recorder / monitors /
+    run logger; a tmp run dir catches every stream."""
+    monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path / "run"))
+    monkeypatch.setattr(runlog, "_run_logger", None)
+    flight.reset_for_tests()
+    anomaly.reset_monitors()
+    yield
+    logger = runlog._run_logger
+    if logger is not None:
+        logger.close()
+    monkeypatch.setattr(runlog, "_run_logger", None)
+    flight.reset_for_tests()
+    anomaly.reset_monitors()
+
+
+def _counter_value(name, **labels):
+    from paddle_tpu.observability import get_registry
+    inst = get_registry().get(name)
+    if inst is None:
+        return 0.0
+    total = 0.0
+    for lab, state in inst.collect():
+        if all(lab.get(k) == v for k, v in labels.items()):
+            total += state.get("value", state.get("count", 0.0))
+    return total
+
+
+def _probe_sched(max_queue=1024, slo=None, num_pages=40, max_seq_len=64):
+    """Real scheduler over the device-free shape-probe engine."""
+    eng = _ShapeProbeEngine(decode_buckets=(1, 2, 4),
+                            prefill_buckets=(8, 64), page_size=8,
+                            num_pages=num_pages, max_seq_len=max_seq_len)
+    return ContinuousBatchingScheduler(eng, max_queue=max_queue, slo=slo)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTModel,
+                                       gpt_tiny_config)
+    paddle.seed(0)
+    cfg = gpt_tiny_config()
+    model = GPTForPretraining(GPTModel(cfg))
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                        aot=False)
+    return eng, cfg
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (s,)).astype(np.int32)
+            for s in lens]
+
+
+# ===========================================================================
+# Request summary fixes + reject reasons (satellites 1-2)
+# ===========================================================================
+
+def test_request_summary_zero_clock_is_not_missing():
+    """A monotonic clock reading 0.0 is a real timestamp; the old
+    truthiness checks reported queue_wait/ttft as None for it."""
+    r = Request(rid=0, prompt=np.zeros(4, np.int32), max_new_tokens=2,
+                submit_time=0.0)
+    r.admit_time = 0.0          # same-instant admission, legal
+    r.first_token_time = 0.0
+    r.finish_time = 0.5
+    r.tokens = [1, 2]
+    s = r.summary()
+    assert s["queue_wait_s"] == 0.0
+    assert s["ttft_s"] == 0.0
+    assert s["decode_s"] == 0.5 and s["total_s"] == 0.5
+    assert s["decode_tokens_per_sec"] == pytest.approx(2.0)
+    assert s["reject_reason"] is None and s["slo_met"] is None
+
+
+def test_reject_reasons_and_counter_labels():
+    base = {r: _counter_value("paddle_serving_requests_total",
+                              event="rejected", reason=r)
+            for r in ("max_new<1", "too_long", "queue_full",
+                      "pool_too_small")}
+    sched = _probe_sched(num_pages=5, max_seq_len=64)
+    cases = [
+        (np.zeros(8, np.int32), 0, "max_new<1"),
+        (np.zeros(60, np.int32), 10, "too_long"),
+        (np.zeros(40, np.int32), 8, "pool_too_small"),  # 6 pages > 4
+    ]
+    for prompt, max_new, want in cases:
+        r = sched.submit(prompt, max_new)
+        assert r.state == "rejected" and r.reject_reason == want
+        assert r.summary()["reject_reason"] == want
+    full = _probe_sched(max_queue=0)
+    r = full.submit(np.zeros(8, np.int32), 4)
+    assert r.reject_reason == "queue_full"
+    for reason in ("max_new<1", "too_long", "queue_full",
+                   "pool_too_small"):
+        assert _counter_value("paddle_serving_requests_total",
+                              event="rejected", reason=reason) \
+            == base[reason] + 1
+    # rejects are terminal records too
+    assert len(sched.rejected) == 3
+    assert {rec["reject_reason"] for rec in sched.request_records()} \
+        == {"max_new<1", "too_long", "pool_too_small"}
+
+
+def test_prefill_is_timed_and_reaches_flight_and_histogram(tiny_engine):
+    """Satellite 1: prefill cost is no longer invisible — it lands in
+    paddle_serving_prefill_seconds AND the flight recorder / anomaly
+    path under path="serving_prefill"."""
+    from paddle_tpu.observability import get_registry
+    eng, cfg = tiny_engine
+    hist = get_registry().histogram("paddle_serving_prefill_seconds")
+    base = hist.count
+    sched = ContinuousBatchingScheduler(eng)
+    reqs = [sched.submit(p, max_new_tokens=3)
+            for p in _prompts(cfg, (5, 9), seed=1)]
+    sched.run()
+    assert hist.count == base + 2
+    for r in reqs:
+        assert r.prefill_s is not None and r.prefill_s > 0
+        assert r.summary()["prefill_s"] == r.prefill_s
+    prefill_steps = [rec for rec in flight.get_flight_recorder().records()
+                     if rec.get("kind") == "step"
+                     and rec.get("path") == "serving_prefill"]
+    assert len(prefill_steps) >= 2
+    # decode step walltimes stay prefill-free (bench reads them as
+    # per-token latencies)
+    assert len(sched.step_times) == sched.steps
+
+
+# ===========================================================================
+# per-request traces: spans, requests.jsonl, chrome export
+# ===========================================================================
+
+def test_trace_spans_and_requests_jsonl_stream(tmp_path, tiny_engine):
+    eng, cfg = tiny_engine
+    run_dir = os.environ["PADDLE_TELEMETRY_DIR"]
+    sched = ContinuousBatchingScheduler(eng)
+    reqs = [sched.submit(p, max_new_tokens=4)
+            for p in _prompts(cfg, (5, 11), seed=2)]
+    sched.submit(np.zeros(200, np.int32), 4)      # rejected: too_long
+    sched.run()
+    for r in reqs:
+        phases = [sp["phase"] for sp in r.trace.spans]
+        assert phases == ["queued", "prefill", "decode"]
+        assert len(r.trace.token_samples) == 3    # 4 tokens, 1st = prefill
+    recs, bad = runlog._read_jsonl(os.path.join(run_dir, "requests.jsonl"))
+    assert bad == 0 and len(recs) == 3
+    by_state = {}
+    for rec in recs:
+        by_state.setdefault(rec["state"], []).append(rec)
+    assert len(by_state["finished"]) == 2
+    assert by_state["rejected"][0]["reject_reason"] == "too_long"
+    fin = by_state["finished"][0]
+    assert fin["queue_wait_s"] >= 0 and fin["ttft_s"] > 0
+    assert fin["per_token_s"]["count"] == 3
+    assert fin["spans"][0]["phase"] == "queued"
+    # chrome export is readable by tools/trace_summary.py
+    out = export_chrome_trace(run_dir, str(tmp_path / "req_trace.json"))
+    import sys
+    sys.path.insert(0, REPO)
+    from tools.trace_summary import summarize
+    text = "\n".join(summarize(out))
+    for phase in ("queued", "prefill", "decode", "rejected"):
+        assert phase in text
+    doc = json.load(open(out))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} \
+        == {"queued", "prefill", "decode", "rejected"}
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans)
+
+
+def test_merge_run_dir_folds_per_request_percentiles(tmp_path):
+    run_dir = str(tmp_path / "fold")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "requests.jsonl"), "w") as f:
+        for i in range(10):
+            f.write(json.dumps({
+                "event": "request", "rid": i, "state": "finished",
+                "reject_reason": None, "prompt_len": 8, "new_tokens": 5,
+                "queue_wait_s": 0.01 * i, "ttft_s": 0.05 + 0.01 * i,
+                "prefill_s": 0.04, "decode_s": 0.08,
+                "total_s": 0.13 + 0.01 * i, "slo_met": i < 8,
+                "per_token_s": {"count": 4, "mean": 0.02, "p50": 0.02,
+                                "p95": 0.02, "p99": 0.02, "max": 0.02},
+            }) + "\n")
+        f.write(json.dumps({"event": "request", "rid": 10,
+                            "state": "rejected",
+                            "reject_reason": "queue_full",
+                            "new_tokens": 0}) + "\n")
+        f.write('{"torn')
+    summary = runlog.merge_run_dir(run_dir, write=False)
+    sv = summary["serving"]
+    assert summary["corrupt_lines"] == 1
+    assert sv["requests"] == 11 and sv["finished"] == 10
+    assert sv["reject_reasons"] == {"queue_full": 1}
+    assert sv["new_tokens_total"] == 50
+    assert sv["queue_wait_s"]["p50"] == pytest.approx(0.04)  # idx round(4.5)=4
+    assert sv["ttft_s"]["max"] == pytest.approx(0.14)
+    assert sv["per_token_s"]["p99"] == pytest.approx(0.02)
+    assert sv["tokens"]["mean"] == 5.0
+    assert sv["slo"] == {"met": 8, "missed": 2, "goodput_tokens": 40,
+                         "goodput_fraction": 0.8}
+    assert fold_request_records([]) is None
+
+
+# ===========================================================================
+# SLO guardrails
+# ===========================================================================
+
+def test_slo_violation_event_counter_and_flight_dump_name_rids(
+        monkeypatch, tiny_engine):
+    """ACCEPTANCE: an induced SLO violation in a real scheduler run
+    produces the anomaly-style event, the counter increment, and a
+    flight dump naming the offending rids."""
+    monkeypatch.setattr(flight, "_SOFT_DUMP_MIN_INTERVAL_S", 0.0)
+    eng, cfg = tiny_engine
+    run_dir = os.environ["PADDLE_TELEMETRY_DIR"]
+    base_v = _counter_value("paddle_serving_slo_violations_total",
+                            slo="ttft_p95")
+    base_a = _counter_value("paddle_anomalies_total", kind="slo_ttft_p95")
+    sched = ContinuousBatchingScheduler(
+        eng, slo={"ttft_p95_s": 1e-9, "min_requests": 2,
+                  "cooldown_s": 0.0})
+    reqs = [sched.submit(p, max_new_tokens=3)
+            for p in _prompts(cfg, (5, 9, 7), seed=3)]
+    sched.run()
+    assert all(r.state == "finished" for r in reqs)
+    # the impossible target means no request met SLO
+    assert all(r.slo_met is False for r in reqs)
+    assert _counter_value("paddle_serving_slo_violations_total",
+                          slo="ttft_p95") > base_v
+    assert _counter_value("paddle_anomalies_total",
+                          kind="slo_ttft_p95") > base_a
+    events, _ = runlog._read_jsonl(
+        os.path.join(run_dir, "events.rank0.jsonl"))
+    viol = [e for e in events if e.get("event") == "anomaly"
+            and e.get("kind") == "slo_ttft_p95"]
+    assert viol and viol[0]["target_s"] == pytest.approx(1e-9)
+    assert viol[0]["offending_rids"]
+    if sched.slo.last_dump_thread is not None:
+        sched.slo.last_dump_thread.join(timeout=30)
+    dump_path = os.path.join(run_dir, "flight.rank0.slo.json")
+    assert os.path.exists(dump_path), "SLO violation must leave a black box"
+    doc = json.load(open(dump_path))
+    assert doc["slo"] == "ttft_p95"
+    assert set(doc["offending_rids"]) <= {r.rid for r in reqs}
+    assert doc["offending_rids"], "the dump must NAME the offending rids"
+    # the finished records carry slo_met for goodput audits
+    recs, _ = runlog._read_jsonl(os.path.join(run_dir, "requests.jsonl"))
+    assert all(rec["slo_met"] is False for rec in recs)
+
+
+def test_slo_goodput_and_burn_rate_accounting():
+    base = _counter_value("paddle_serving_goodput_tokens_total")
+    tracker = SLOTracker(SLOConfig(ttft_p95_s=1.0, min_requests=4,
+                                   cooldown_s=0.0))
+    for rid in range(8):
+        assert tracker.observe_admission(rid, ttft_s=0.1,
+                                         queue_wait_s=0.01) == []
+        met = tracker.observe_request(
+            {"rid": rid, "ttft_s": 0.1, "new_tokens": 10})
+        assert met is True
+    snap = tracker.snapshot()
+    assert snap["goodput_tokens"] == 80 and snap["requests_met"] == 8
+    assert snap["goodput_fraction"] == 1.0
+    assert snap["burn_rates"]["ttft_p95"] == 0.0
+    assert snap["violations"] == 0
+    assert _counter_value("paddle_serving_goodput_tokens_total") \
+        == base + 80
+    # one outlier in 9 samples is 11% over target — past the 5% error
+    # budget — and it fires at ADMISSION (the incident moment), before
+    # the slow request ever finishes
+    fired = tracker.observe_admission(99, ttft_s=5.0)
+    assert [f["slo"] for f in fired] == ["ttft_p95"]
+    assert fired[0]["offending_rids"] == [99]
+    tracker.observe_request({"rid": 99, "ttft_s": 5.0, "new_tokens": 10})
+    snap = tracker.snapshot()
+    assert snap["requests_missed"] == 1
+    assert snap["burn_rates"]["ttft_p95"] > 1.0
+    assert snap["violations"] == 1
+    assert snap["last_violation"]["offending_rids"] == [99]
+
+
+def test_slo_per_token_window_fires_on_slow_ticks(monkeypatch):
+    monkeypatch.setattr(flight, "_SOFT_DUMP_MIN_INTERVAL_S", 0.0)
+    tracker = SLOTracker(SLOConfig(per_token_p99_s=0.01, min_tokens=8,
+                                   cooldown_s=0.0))
+    for _ in range(8):
+        assert tracker.observe_tokens([0, 1], 0.001) == []
+    fired = tracker.observe_tokens([2, 3], 0.5)
+    assert [f["slo"] for f in fired] == ["per_token_p99"]
+    assert set(fired[0]["offending_rids"]) == {2, 3}
+    assert fired[0]["burn_rate"] > 1.0
+
+
+def test_merge_slo_violations_from_events_when_counters_never_flushed(
+        tmp_path):
+    """A run killed before its next metrics flush still reports the SLO
+    violations it logged synchronously — max(counter, events) per rank,
+    same contract as the anomaly tallies."""
+    run_dir = str(tmp_path / "crashed")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "events.rank0.jsonl"), "w") as f:
+        for _ in range(2):
+            f.write(json.dumps({"ts": 1.0, "rank": 0, "generation": 0,
+                                "event": "anomaly",
+                                "kind": "slo_ttft_p95",
+                                "slo": "ttft_p95",
+                                "offending_rids": [3]}) + "\n")
+    summary = runlog.merge_run_dir(run_dir, write=False)
+    assert summary["serving"]["slo_violations"] == {"ttft_p95": 2}
+    # with the counter ALSO flushed for the same firings: no double count
+    with open(os.path.join(run_dir, "metrics.rank0.gen0.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "name": "paddle_serving_slo_violations_total",
+            "type": "counter", "labels": {"slo": "ttft_p95"}, "value": 2,
+            "rank": 0, "generation": 0}) + "\n")
+    summary = runlog.merge_run_dir(run_dir, write=False)
+    assert summary["serving"]["slo_violations"] == {"ttft_p95": 2}
+
+
+def test_scheduler_bounds_retained_terminal_requests():
+    sched = _probe_sched(num_pages=400, max_seq_len=64)
+    sched.max_retained = 5
+    for _ in range(12):
+        sched.submit(np.zeros(8, np.int32), 2)
+        sched.run()
+    assert len(sched.finished) == 5
+    full = _probe_sched(max_queue=0)
+    full.max_retained = 3
+    for _ in range(9):
+        full.submit(np.zeros(8, np.int32), 2)
+    assert len(full.rejected) == 3
+
+
+# ===========================================================================
+# HTTP endpoint: /metrics, /status, /healthz, shutdown
+# ===========================================================================
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_http_concurrent_metrics_scrapes_mid_run():
+    """ACCEPTANCE: concurrent /metrics scrapes during an active
+    scheduler run return consistent text expo."""
+    sched = _probe_sched(num_pages=200, max_seq_len=64)
+    srv = sched.serve_http()
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(40):
+            sched.submit(np.zeros(int(rng.integers(1, 40)), np.int32),
+                         int(rng.integers(1, 8)))
+        results, errors = [], []
+
+        def scrape():
+            try:
+                for _ in range(10):
+                    code, body = _get(srv.url + "/metrics")
+                    results.append((code, body))
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=scrape) for _ in range(4)]
+        for t in threads:
+            t.start()
+        while sched.pending:
+            sched.step()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert len(results) == 40
+        for code, body in results:
+            assert code == 200
+            # parseable, consistent expo: every sample line is
+            # "name{labels} value" with a float value
+            for line in body.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                float(line.rsplit(" ", 1)[1])
+            assert "paddle_serving_requests_total" in body
+    finally:
+        srv.close()
+
+
+def test_http_status_matches_scheduler_and_pool_state(tiny_engine):
+    eng, cfg = tiny_engine
+    sched = ContinuousBatchingScheduler(
+        eng, slo={"ttft_p95_s": 60.0, "per_token_p99_s": 60.0})
+    srv = sched.serve_http()
+    try:
+        reqs = [sched.submit(p, max_new_tokens=3)
+                for p in _prompts(cfg, (5, 9), seed=4)]
+        sched.submit(np.zeros(300, np.int32), 4)   # rejected
+        sched.run()
+        code, body = _get(srv.url + "/status")
+        assert code == 200
+        st = json.loads(body)
+        assert st["healthy"] is True and st["last_error"] is None
+        assert st["queue_depth"] == 0 and st["running"] == 0
+        assert st["finished"] == len(sched.finished) == 2
+        assert st["rejected"] == 1
+        assert st["steps"] == sched.steps
+        assert st["kv_pool"] == eng.pool.stats()
+        assert st["kv_pool"]["pages_in_use"] == 0
+        assert "internal_fragmentation" in st["kv_pool"]
+        assert st["engine"]["decode_buckets"] == [1, 2]
+        assert st["slo"]["targets_s"] == {"ttft_p95": 60.0,
+                                          "per_token_p99": 60.0}
+        assert st["slo"]["goodput_tokens"] == \
+            sum(len(r.tokens) for r in reqs)
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and body.strip() == "ok"
+        code, _ = _get(srv.url + "/metrics")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_http_healthz_flips_unhealthy_on_engine_failure(monkeypatch):
+    sched = _probe_sched()
+    srv = sched.serve_http()
+    try:
+        sched.submit(np.zeros(8, np.int32), 4)
+
+        def boom(seq_ids, bucket):
+            raise RuntimeError("injected engine failure")
+
+        monkeypatch.setattr(sched.engine, "decode", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            sched.step()
+        assert sched.healthy is False
+        assert "injected engine failure" in sched.last_error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/healthz")
+        assert ei.value.code == 503
+        assert "injected engine failure" in ei.value.read().decode()
+        # /status still serves, and says why
+        st = json.loads(_get(srv.url + "/status")[1])
+        assert st["healthy"] is False
+        assert "injected" in st["last_error"]
+    finally:
+        srv.close()
+
+
+def test_http_clean_shutdown_no_leaked_thread_or_socket():
+    sched = _probe_sched()
+    srv = sched.serve_http()
+    url, port = srv.url, srv.port
+    assert _get(url + "/healthz")[0] == 200
+    thread = srv._thread
+    srv.close()
+    srv.close()                      # idempotent
+    assert not thread.is_alive()
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(url + "/healthz", timeout=2)
+    # the port is actually free again: a new server can bind it
+    from paddle_tpu.observability.httpd import ServingStatusServer
+    srv2 = ServingStatusServer(port=port)
+    try:
+        assert _get(srv2.url + "/metrics")[0] == 200
+    finally:
+        srv2.close()
+
+
+# ===========================================================================
+# perf doctor: serving gap attribution
+# ===========================================================================
+
+def test_serving_attribution_buckets_sum_exactly():
+    summary = {
+        "serving": {"finished": 8, "requests": 9, "rejected": 1,
+                    "new_tokens_total": 512,
+                    "request_seconds_total": 10.24,   # 20 ms/token
+                    "queue_wait_seconds_total": 1.024,
+                    "prefill_seconds_total": 0.512,
+                    "per_token_s": {"p50": 0.012, "p95": 0.014}},
+        "compile": {"count": 1, "seconds": 2.56},
+    }
+    pred = {"predicted_decode_step_ms": 9.0,
+            "predicted_per_token_ms_p50": 9.0,
+            "predicted_per_token_ms_p95": 9.5,
+            "predicted_tokens_per_sec": 888.9}
+    attr = doctor.attribute_serving_gap(summary, pred)
+    assert attr["measured_ms"] == pytest.approx(25.0)    # +compile 5ms
+    assert attr["predicted_ms"] == 9.0
+    assert sum(attr["buckets"].values()) == pytest.approx(
+        attr["delta_ms"], abs=0.01)
+    assert attr["buckets"]["queue"] == pytest.approx(2.0)
+    assert attr["buckets"]["prefill"] == pytest.approx(1.0)
+    assert attr["buckets"]["compile"] == pytest.approx(5.0)
+    assert attr["buckets"]["decode"] == pytest.approx(
+        attr["delta_ms"] - 8.0, abs=0.01)
+    assert attr["per_token_ms"]["p50"]["measured"] == 12.0
+    assert attr["per_token_ms"]["p95"]["ratio"] == pytest.approx(
+        14.0 / 9.5, abs=0.01)
+    # missing inputs degrade to None, never raise
+    assert doctor.attribute_serving_gap({}, pred) is None
+    assert doctor.attribute_serving_gap(summary, None) is None
+    assert doctor.attribute_serving_gap(summary, {"other": 1}) is None
+
+
+def test_doctor_serving_fixture_buckets_sum_and_findings(tmp_path):
+    """ACCEPTANCE: on the checked-in serving fixture the doctor's
+    queue/prefill/compile/decode buckets sum exactly to the measured-vs-
+    predicted per-token delta; SLO violation + reject findings rank."""
+    run_dir = str(tmp_path / "run")
+    shutil.copytree(FIXTURE, run_dir)
+    report = doctor.diagnose_run_dir(run_dir)
+    sattr = report["serving_attribution"]
+    assert sattr is not None
+    assert sum(sattr["buckets"].values()) == pytest.approx(
+        sattr["delta_ms"], abs=0.01)
+    assert set(sattr["buckets"]) == {"queue", "prefill", "compile",
+                                     "decode"}
+    assert sattr["tokens"] == 512 and sattr["requests"] == 8
+    # compile dominates this fixture (22.4s AOT builds over 512 tokens)
+    assert max(sattr["buckets"], key=lambda k: sattr["buckets"][k]) \
+        == "compile"
+    kinds = {f["kind"]: f for f in report["findings"]}
+    assert "slo_violations" in kinds
+    assert "ttft_p95 x1" in kinds["slo_violations"]["detail"]
+    assert "rejected_requests" in kinds
+    assert "serving_slower_than_roofline" in kinds
+    assert "goodput" in kinds          # 320/512 tokens = 62.5% < 95%
+    assert "62.5%" in kinds["goodput"]["detail"]
+    text = doctor.format_report(report)
+    assert "serving gap attribution" in text
+    assert "ms/output-token" in text and "goodput" in text
+    sv = report["summary"]["serving"]
+    assert sv["slo_violations"] == {"ttft_p95": 1}
+    assert sv["slo"]["goodput_tokens"] == 320
+
+
+def test_perf_doctor_cli_serving_fixture_gate(tmp_path, capsys):
+    """Tier-1 gate: `tools/perf_doctor.py <fixture> --no-write` exits 0,
+    prints the serving section, and leaves the fixture untouched."""
+    from tools.perf_doctor import main as doctor_main
+    assert doctor_main([FIXTURE, "--no-write"]) == 0
+    out = capsys.readouterr().out
+    assert "serving gap attribution" in out
+    assert "slo_violations" in out
+    assert not os.path.exists(os.path.join(FIXTURE, "run_summary.json"))
+    # --json carries the serving attribution machine-readably
+    assert doctor_main([FIXTURE, "--no-write", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serving_attribution"]["tokens"] == 512
+    assert doc["summary"]["serving"]["finished"] == 8
+
+
+def test_scheduler_status_without_http(tiny_engine):
+    """status() is usable directly (no server) and safe mid-lifecycle."""
+    eng, cfg = tiny_engine
+    sched = ContinuousBatchingScheduler(eng)
+    st = sched.status()
+    assert st["healthy"] and st["queue_depth"] == 0
+    assert st["finished"] == 0 and st["slo"] is None
+    (p,) = _prompts(cfg, (6,), seed=5)
+    sched.submit(p, max_new_tokens=2)
+    st = sched.status()
+    assert st["queue_depth"] == 1
+    sched.run()
+    st = sched.status()
+    assert st["finished"] == 1 and st["kv_pool"]["pages_in_use"] == 0
+    assert st["engine"]["aot_programs"] == 0     # aot=False engine
+    assert st["uptime_s"] >= 0

@@ -7,6 +7,15 @@ attribution across compute/HBM/comm/compile/skips, the named straggler
 rank, anomaly tallies, crash exit codes, and any flight-recorder dumps
 the run left behind.
 
+Serving run dirs (those carrying a ``requests.jsonl`` stream) get a
+serving section on top: per-request queue-wait/TTFT/per-token
+percentiles, SLO violation + goodput findings, and — against a
+``serving_predicted`` row (``python -m paddle_tpu.serving.predict``,
+auto-discovered from ``<run_dir>/serving_predicted.json`` or the shared
+``predicted.json``) — a measured-vs-predicted **per-output-token**
+attribution whose queue/prefill/compile/decode buckets sum exactly to
+the delta.
+
 Usage::
 
     python tools/perf_doctor.py <run_dir>
