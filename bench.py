@@ -701,21 +701,27 @@ def bench_gpt(args, config_name=None):
          })
 
 
-def emit_serving_predicted_row(timeout_s=180, quantize=None):
-    """``serving_predicted`` (or ``serving_int8_predicted`` with
-    ``quantize="int8"``): static cost-model decode row (tok/s at N
-    concurrent streams + per-token latency) from the PR-5 roofline over
-    the engine's decode jaxpr, so a TPU-less round still carries serving
-    numbers. Trace-only subprocess; bypasses ``emit()`` like the other
+def emit_serving_predicted_row(timeout_s=180, quantize=None, mode=None):
+    """``serving_predicted`` (``serving_int8_predicted`` with
+    ``quantize="int8"``; ``serving_shared_prefix_predicted`` /
+    ``serving_disagg_predicted`` with ``mode=``): static cost-model
+    serving rows from the PR-5 roofline over the engine's REAL traced
+    programs, so a TPU-less round still carries serving numbers — incl.
+    the prefix-cache goodput/TTFT anchor and the disaggregated-split
+    anchor. Trace-only subprocess; bypasses ``emit()`` like the other
     ``*_predicted`` rows (never a vs_baseline denominator, never
     ``_cpu_smoke``-suffixed)."""
     import subprocess
-    metric = "serving_int8_predicted" if quantize else "serving_predicted"
+    metric = {"shared_prefix": "serving_shared_prefix_predicted",
+              "disagg": "serving_disagg_predicted"}.get(
+        mode, "serving_int8_predicted" if quantize
+        else "serving_predicted")
     try:
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.serving.predict",
              "--config", "345m", "--concurrency", "8"]
-            + (["--quantize", quantize] if quantize else []),
+            + (["--quantize", quantize] if quantize else [])
+            + (["--mode", mode] if mode else []),
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         row = None
@@ -749,7 +755,9 @@ def emit_serving_predicted_row(timeout_s=180, quantize=None):
         "metric": metric,
         "value": row.get("predicted_tokens_per_sec", 0.0),
         "unit": "tokens/s (static cost model, continuous batching"
-                + (", int8 weights" if quantize else "") + ")",
+                + (", int8 weights" if quantize else "")
+                + (", prefix cache" if mode == "shared_prefix" else "")
+                + (", disaggregated" if mode == "disagg" else "") + ")",
         "vs_baseline": 0.0, "extras": row}), flush=True)
 
 
@@ -969,12 +977,148 @@ def bench_serving(args):
           "note": "lower is better; vs_baseline>1 means SLOWER", **tele})
 
     bench_serving_engine(args, model, cfg, on_cpu)
+    bench_serving_shared_prefix(args, model, cfg, on_cpu)
     if on_cpu:
         # the measured rows above are _cpu_smoke; the artifact still owes
-        # TPU-comparable serving numbers — the static cost model's, fp
-        # and int8
+        # TPU-comparable serving numbers — the static cost model's, fp,
+        # int8, prefix-cache and disaggregated-split anchors
         emit_serving_predicted_row()
         emit_serving_predicted_row(quantize="int8")
+        emit_serving_predicted_row(mode="shared_prefix")
+        emit_serving_predicted_row(mode="disagg")
+
+
+def bench_serving_shared_prefix(args, model, cfg, on_cpu):
+    """``serving_shared_prefix`` row: the prefix-cache + chunked-prefill
+    engine on a shared-prefix workload (the millions-of-users shape:
+    one system prompt, many suffixes), vs the PR 8 engine on the SAME
+    workload. Value = end-to-end goodput tokens/s with the cache; the
+    extras carry the baseline, the TTFT split, pool stats proving page
+    reuse (>0 shared pages, hit rate), the SLO verdict under the load,
+    and the chunked-prefill stall bound (per-token p99 under a
+    long-prompt+decode mix, chunked vs not)."""
+    from paddle_tpu.observability.reqtrace import quantile as pq
+    from paddle_tpu.observability.slo import SLOConfig
+    from paddle_tpu.serving import ContinuousBatchingScheduler, ServingEngine
+    from paddle_tpu.serving.prefix_cache import make_shared_prefix_workload
+
+    if on_cpu:
+        n_req, prefix_len, suffix_len, max_new = 6, 48, 8, 4
+        page_size, chunk, buckets = 8, 16, (1, 2, 4, 8)
+        slo_cfg = SLOConfig(ttft_p95_s=30.0, per_token_p99_s=30.0,
+                            queue_wait_p95_s=30.0)
+    else:
+        n_req, prefix_len, suffix_len, max_new = 8, 768, 128, 64
+        page_size, chunk, buckets = 64, 256, (1, 2, 4, 8)
+        slo_cfg = SLOConfig()
+    prompts = make_shared_prefix_workload(
+        cfg.vocab_size, n_req, prefix_len, suffix_len, seed=2)
+
+    def run_one(prefix_cache):
+        engine = ServingEngine(model, cfg, page_size=page_size,
+                               decode_buckets=buckets, temperature=0.0,
+                               prefix_cache=prefix_cache,
+                               prefill_chunk=chunk if prefix_cache
+                               else None)
+        # whole-prompt budget: this row measures CACHING, not the
+        # stall bound (stall_mix below measures that) — throttling
+        # prefill to one chunk/tick would only blur the TTFT delta
+        sched = ContinuousBatchingScheduler(
+            engine, slo=slo_cfg,
+            prefill_token_budget=prefix_len + suffix_len)
+        t0 = time.perf_counter()
+        for p in prompts:
+            sched.submit(p, max_new_tokens=max_new)
+        max_shared = 0
+        while sched.pending:
+            sched.step()
+            max_shared = max(max_shared,
+                             engine.pool.stats()["pages_shared"])
+        finished = sched.finished
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in finished)
+        ttfts = [r.summary()["ttft_s"] for r in finished]
+        pool = engine.pool.stats()
+        pool["max_pages_shared_in_flight"] = max_shared
+        return {
+            "tps": toks / dt if dt > 0 else 0.0,
+            "ttft_mean_s": float(np.mean(ttfts)),
+            "ttft_p95_s": pq(sorted(ttfts), 0.95),
+            "pool": pool,
+            "cache": engine.prefix_cache.stats()
+            if engine.prefix_cache else None,
+            "cached": [r.cached_prefix_len for r in finished],
+            "slo": sched.slo.snapshot() if sched.slo else None,
+        }
+
+    telemetry = _StepTelemetry()
+    t0 = time.perf_counter()
+    base = run_one(False)
+    cached = run_one(True)
+    dt = time.perf_counter() - t0
+    violations = int((cached["slo"] or {}).get("violations", 0))
+
+    # chunked-prefill stall bound: a long prompt admitted mid-decode; the
+    # running stream's per-token p99 must not absorb the whole prefill
+    def stall_mix(chunked):
+        engine = ServingEngine(model, cfg, page_size=page_size,
+                               decode_buckets=(1, 2), temperature=0.0,
+                               prefill_chunk=chunk if chunked else None)
+        sched = ContinuousBatchingScheduler(engine)
+        rng = np.random.default_rng(5)
+        short = rng.integers(0, cfg.vocab_size,
+                             (suffix_len,)).astype(np.int32)
+        # the long prompt spans many chunks, so the unchunked engine's
+        # single-tick prefill is a real stall for the running stream
+        long_p = rng.integers(
+            0, cfg.vocab_size,
+            (min(8 * chunk, engine.max_seq_len - 3 * max_new - 1),)
+        ).astype(np.int32)
+        r = sched.submit(short, max_new_tokens=max_new * 3)
+        sched.step(); sched.step()
+        sched.submit(long_p, max_new_tokens=2)
+        # wall-clock gaps between the short stream's token emissions:
+        # THE stall metric — an unchunked engine parks the whole long
+        # prefill inside one gap, the chunked one spreads it
+        gaps, n_prev, t_prev = [], len(r.tokens), time.perf_counter()
+        while sched.pending:
+            sched.step()
+            if len(r.tokens) > n_prev:
+                now = time.perf_counter()
+                gaps.append(now - t_prev)
+                n_prev, t_prev = len(r.tokens), now
+        return 1e3 * pq(sorted(gaps or [0.0]), 0.99)
+
+    p99_unchunked = stall_mix(False)
+    p99_chunked = stall_mix(True)
+    emit("serving_shared_prefix", cached["tps"],
+         "tokens/s (end-to-end goodput, prefix cache + chunked "
+         "prefill)", {
+             "requests": n_req, "prefix_len": prefix_len,
+             "suffix_len": suffix_len, "max_new": max_new,
+             "page_size": page_size, "prefill_chunk": chunk,
+             "tokens_per_sec_no_cache": round(base["tps"], 2),
+             "goodput_speedup": round(
+                 cached["tps"] / base["tps"], 3) if base["tps"] else 0.0,
+             "ttft_mean_s_cached": round(cached["ttft_mean_s"], 4),
+             "ttft_mean_s_no_cache": round(base["ttft_mean_s"], 4),
+             "ttft_speedup": round(
+                 base["ttft_mean_s"] / cached["ttft_mean_s"], 3)
+             if cached["ttft_mean_s"] else 0.0,
+             "cached_prefix_lens": cached["cached"],
+             "kv_pool_stats": cached["pool"],
+             "prefix_cache_stats": cached["cache"],
+             "slo_violations": violations,
+             "slo_clean": violations == 0,
+             "chunked_prefill": {
+                 "per_token_p99_ms_chunked": round(p99_chunked, 2),
+                 "per_token_p99_ms_unchunked": round(p99_unchunked, 2),
+                 "stall_reduction": round(
+                     p99_unchunked / p99_chunked, 3) if p99_chunked
+                 else 0.0,
+             },
+             **telemetry.extras(wall_s=dt),
+         })
 
 
 def bench_serving_engine(args, model, cfg, on_cpu):
@@ -1264,6 +1408,8 @@ def main():
         emit_predicted_rows()
         emit_serving_predicted_row()
         emit_serving_predicted_row(quantize="int8")
+        emit_serving_predicted_row(mode="shared_prefix")
+        emit_serving_predicted_row(mode="disagg")
         # pure arithmetic, no backend needed: the quantized-collective
         # wire-bytes anchor always lands in the artifact
         emit_collective_compression_predicted()

@@ -33,6 +33,14 @@ paged-decode == XLA reference attention without a TPU; the same
 ``pallas_call`` compiles on TPU (x64 disabled around the trace, head_dim
 padded to the 128-lane width — prefer d_head=128 models so the pool
 needs no per-step pad copy).
+
+**Shared (prefix-cache) pages**: all reads here are page-table gathers,
+so a page mapped into many sequences' tables (refcounted sharing in
+``serving.kv_pool`` / ``serving.prefix_cache``) is attended with zero
+copies; writes never go through this module — the pool's copy-on-write
+barrier keeps every written page exclusive. The chunk/suffix prefill
+read path is :func:`paged_prefill_attention` (traced ``q_offset``
+causal rule, one program for every chunk position).
 """
 from __future__ import annotations
 
@@ -178,6 +186,48 @@ def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens,
                       page_table.astype(jnp.int32),
                       seq_lens.astype(jnp.int32), float(scale))
     return out.reshape(B, nh, -1)[..., :d]
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset,
+                            scale=None):
+    """Chunk/suffix prefill attention over a paged KV cache (XLA path).
+
+    ``q`` ``[B, C, num_heads, d]`` — a prompt *chunk* whose row ``i``
+    sits at absolute position ``q_offset + i``; pages/table as in
+    :func:`paged_attention_decode`. Row ``i`` attends keys at positions
+    ``<= q_offset + i`` — the flash-attention ``q_offset`` masking rule
+    (PR 8), but with a **traced** offset, so ONE compiled program covers
+    every chunk position and every cached-prefix length: chunked prefill
+    and prefix-cache suffix prefill never recompile. The chunk's own
+    K/V must already be scattered into the pages (same contract as
+    decode: a position's K/V is written before it is attended).
+
+    Because shared (prefix-cache) pages are read through the same
+    gather, a page mapped into many sequences' tables is attended
+    without copies; writes stay safe via the pool's copy-on-write
+    barrier, never this read path.
+    """
+    B, C, nh, d = q.shape
+    _, ps, nkv, _ = k_pages.shape
+    g = nh // nkv
+    t = page_table.shape[1] * ps
+    k = k_pages[page_table].reshape(B, t, nkv, d)
+    v = v_pages[page_table].reshape(B, t, nkv, d)
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    # mirror gpt_block's dense-attention numerics exactly (divide by
+    # sqrt(d) in compute dtype, -1e30 mask, f32 softmax) so chunked
+    # prefill is token-for-token equal to the one-shot bucketed prefill
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k) / math.sqrt(d) \
+        if scale is None else jnp.einsum("bsnd,btnd->bnst", q, k) * scale
+    row = jnp.asarray(q_offset, jnp.int32) \
+        + jnp.arange(C, dtype=jnp.int32)[:, None]
+    col = jnp.arange(t, dtype=jnp.int32)[None, :]
+    mask = (col <= row)[None, None, :, :]
+    logits = jnp.where(mask, logits, jnp.asarray(_NEG_INF, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,

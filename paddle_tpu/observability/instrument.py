@@ -59,6 +59,9 @@ paddle_serving_slo_violations_total   counter    slo={ttft_p95,per_token_p99,
                                                  queue_wait_p95}
 paddle_serving_slo_burn_rate          gauge      slo
 paddle_serving_goodput_tokens_total   counter    —
+paddle_serving_prefix_cache_hits_total counter   —
+paddle_serving_prefix_tokens_reused_total counter —
+paddle_serving_prefill_chunks_total   counter    —
 ====================================  =========  =============================
 
 Serving decode steps additionally ride ``record_train_step`` with
@@ -308,6 +311,26 @@ def serving_goodput_tokens_counter():
     return get_registry().counter(
         "paddle_serving_goodput_tokens_total",
         "tokens from requests that met every configured SLO target")
+
+
+def serving_prefix_hits_counter():
+    return get_registry().counter(
+        "paddle_serving_prefix_cache_hits_total",
+        "admissions whose prompt reused >0 cached prefix tokens")
+
+
+def serving_prefix_tokens_reused_counter():
+    return get_registry().counter(
+        "paddle_serving_prefix_tokens_reused_total",
+        "prompt tokens served from the prefix cache instead of "
+        "prefilled (skipped prefill work)")
+
+
+def serving_prefill_chunks_counter():
+    return get_registry().counter(
+        "paddle_serving_prefill_chunks_total",
+        "chunk-program invocations (chunked prefill interleaves these "
+        "with decode ticks)")
 
 
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,

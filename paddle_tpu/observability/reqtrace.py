@@ -193,6 +193,16 @@ def fold_request_records(records) -> dict | None:
         "rejected": sum(reject_reasons.values()),
         "reject_reasons": reject_reasons,
         "new_tokens_total": sum(tokens),
+        # prefix-cache reuse: prompt tokens whose prefill was SKIPPED —
+        # the doctor's prefill bucket reads prefill_seconds_total next
+        # to this, so "prefill looks cheap" is attributable to cache
+        # hits instead of looking like a measurement hole
+        "cached_prefix_tokens_total": sum(
+            int(r.get("cached_prefix_len") or 0) for r in finished),
+        "prefix_hit_requests": sum(
+            1 for r in finished if (r.get("cached_prefix_len") or 0) > 0),
+        "prefill_chunks_total": sum(
+            int(r.get("prefill_chunks") or 0) for r in finished),
         "request_seconds_total": round(sum(vals("total_s")), 6),
         "queue_wait_seconds_total": round(sum(vals("queue_wait_s")), 6),
         "prefill_seconds_total": round(sum(vals("prefill_s")), 6),

@@ -35,13 +35,36 @@ exposes live ``/metrics`` + ``/healthz`` + ``/status``; and
 ``tools/perf_doctor.py <run_dir>`` prints the per-output-token
 measured-vs-predicted attribution for any serving run dir.
 
+Prefix sharing & prefill scheduling (README "Prefix caching &
+disaggregated serving"):
+
+- :mod:`.prefix_cache` — ``PrefixCache``: radix-style token trie over
+  the pool's refcounted pages. ``ServingEngine(prefix_cache=True)``
+  maps the longest cached prefix straight into a new sequence's page
+  table (COW on a mid-page divergence), prefills only the suffix, and
+  publishes pages at prefill-complete + release (multi-turn hits);
+  LRU eviction under page pressure via ``reclaim``. ``pool.stats()``
+  gains ``pages_shared`` / ``tokens_reused`` / ``prefix_hit_rate``.
+- **Chunked prefill** — ``ServingEngine(prefill_chunk=C)`` replaces the
+  per-bucket prefill programs with ONE traced-offset chunk program
+  (:func:`.engine.chunk_prefill_fn`); the scheduler's
+  ``prefill_token_budget`` bounds per-tick prefill work so long
+  prompts interleave with decode ticks instead of stalling them.
+- **Disaggregated prefill/decode** — ``ServingEngine(
+  disaggregated=True)`` runs prefill on its own (virtual) mesh
+  (:func:`.engine.prefill_kv_fn`), ships dense K/V to the decode mesh
+  once per request, and lands it with :func:`.engine.scatter_kv_fn`;
+  each side keeps its own bucket set.
+
 The static gate: ``python tools/check_program.py --model serving`` lints
-the decode step and replays a randomized admission mix through the real
-scheduler (:func:`.scheduler.simulate_decode_signatures`) to prove the
-bucketed shape set is closed — zero retraces for any request mix.
-TPU-less rounds still carry serving numbers via :mod:`.predict`
-(``serving_predicted`` bench row from the PR-5 static cost model over
-the decode jaxpr).
+the decode step AND the chunk program, and replays a randomized
+admission mix through the real scheduler
+(:func:`.scheduler.simulate_decode_signatures`) in all three engine
+modes to prove each mode's shape set is closed — zero retraces for any
+request mix. TPU-less rounds still carry serving numbers via
+:mod:`.predict` (``serving_predicted`` plus the
+``serving_shared_prefix_predicted`` / ``serving_disagg_predicted``
+anchors from the PR-5 static cost model over the real traced programs).
 
 Quickstart::
 
@@ -54,13 +77,16 @@ Quickstart::
 """
 from .kv_pool import PagePool, PagePoolError, PagePoolOOM  # noqa: F401
 from .engine import (EngineShapeError, ServingEngine,  # noqa: F401
-                     decode_step_fn, prefill_fn)
+                     chunk_prefill_fn, decode_step_fn, prefill_fn,
+                     prefill_kv_fn, scatter_kv_fn)
+from .prefix_cache import (PrefixCache,  # noqa: F401
+                           make_shared_prefix_workload)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         Request, simulate_decode_signatures)
 
 __all__ = [
     "PagePool", "PagePoolError", "PagePoolOOM",
-    "ServingEngine", "EngineShapeError",
+    "ServingEngine", "EngineShapeError", "PrefixCache",
     "ContinuousBatchingScheduler", "Request",
-    "simulate_decode_signatures",
+    "simulate_decode_signatures", "make_shared_prefix_workload",
 ]

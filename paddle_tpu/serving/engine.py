@@ -42,11 +42,14 @@ import numpy as np
 from ..models.gpt import (GPTConfig, _ln, flash_attention_gate, gpt_block,
                           sample_logits, stack_gpt_weights)
 from ..kernels.paged_attention import (paged_attention_decode,
-                                       paged_attention_reference)
+                                       paged_attention_reference,
+                                       paged_prefill_attention)
 from .kv_pool import PagePool
+from .prefix_cache import PrefixCache
 
 __all__ = ["ServingEngine", "EngineShapeError", "decode_step_fn",
-           "prefill_fn"]
+           "prefill_fn", "chunk_prefill_fn", "prefill_kv_fn",
+           "scatter_kv_fn"]
 
 
 class EngineShapeError(RuntimeError):
@@ -206,6 +209,113 @@ def prefill_fn(params, k_pages, v_pages, ids, true_len, dest_rows, key, *,
     return k_pages, v_pages, tok
 
 
+def chunk_prefill_fn(params, k_pages, v_pages, ids, q_offset, chunk_len,
+                     page_table, dest_rows, key, *, eps, temperature,
+                     top_k, compute_dtype=None):
+    """Prefill one CHUNK of a prompt (batch 1, ``ids`` padded to the
+    engine's chunk length ``C``): embed the chunk at absolute positions
+    ``q_offset + i``, scatter its K/V into the sequence's pages
+    (``dest_rows``; padding rows → sink), attend over the page table
+    with the traced-offset causal rule (row ``i`` sees positions
+    ``<= q_offset + i`` — cached prefix pages included, so this one
+    program is BOTH the chunked-prefill tick and the prefix-cache
+    suffix prefill), and sample a token at local index ``chunk_len-1``
+    (only meaningful on the final chunk; earlier chunks' samples are
+    discarded by the caller).
+
+    ``q_offset``/``chunk_len`` are traced int32 scalars: every chunk of
+    every prompt at every cached-prefix length is the SAME compiled
+    program — the chunk shape set stays closed (one signature) and
+    serving never recompiles.
+
+    Returns ``(k_pages, v_pages, tok[1])``.
+    """
+    blocks, wte, wpe = params["blocks"], params["wte"], params["wpe"]
+    dt = _compute_dtype(params, compute_dtype)
+    C = ids.shape[1]
+    np_, ps = k_pages.shape[1], k_pages.shape[2]
+    q_offset = jnp.asarray(q_offset, jnp.int32)
+    chunk_len = jnp.asarray(chunk_len, jnp.int32)
+    max_pos = (wpe["q"] if _is_quant(wpe) else wpe).shape[0]
+    positions = jnp.minimum(q_offset + jnp.arange(C, dtype=jnp.int32),
+                            max_pos - 1)
+    x = (_emb(wte, ids, dt) + _emb(wpe, positions, dt)[None]).astype(dt)
+    rows = dest_rows.astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+
+    def layer(carry, p_kp_vp):
+        (x,) = carry
+        p, kp, vp = p_kp_vp
+        nkv, d = kp.shape[2], kp.shape[3]
+        h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
+        qkv = _mm("bsh,hknd->bsknd", h, p["wqkv"], dt) + p["bqkv"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [1,C,nh,d]
+        kp = kp.reshape(np_ * ps, nkv, d).at[rows].set(
+            k[0].astype(kp.dtype)).reshape(np_, ps, nkv, d)
+        vp = vp.reshape(np_ * ps, nkv, d).at[rows].set(
+            v[0].astype(vp.dtype)).reshape(np_, ps, nkv, d)
+        attn = paged_prefill_attention(q, kp, vp, page_table, q_offset)
+        o = _mm("bsnd,ndh->bsh", attn.astype(x.dtype), p["wo"], dt)
+        x = x + o + p["bo"]
+        h2 = _ln(x, p["ln2_w"], p["ln2_b"], eps)
+        u = jax.nn.gelu(_mm("bsh,hf->bsf", h2, p["w1"], dt) + p["b1"],
+                        approximate=True)
+        x = x + _mm("bsf,fh->bsh", u, p["w2"], dt) + p["b2"]
+        return (x,), (kp, vp)
+
+    (x,), (k_pages, v_pages) = jax.lax.scan(
+        layer, (x,), (blocks, k_pages, v_pages))
+    h_last = jax.lax.dynamic_slice_in_dim(
+        x, jnp.maximum(chunk_len - 1, 0), 1, axis=1)
+    h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
+    logits = _mm("bsh,vh->bsv", h_last, wte, dt)[:, 0]
+    tok = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
+    return k_pages, v_pages, tok
+
+
+def prefill_kv_fn(params, ids, true_len, key, *, eps, temperature, top_k,
+                  use_flash, compute_dtype=None):
+    """Disaggregated-mode prefill: the full causal forward of
+    :func:`prefill_fn`, but returning the per-layer K/V **dense**
+    (``[L, S, nkv, d]``) instead of scattering into a local page pool —
+    the dense tensors are the explicit KV handoff payload shipped from
+    the prefill mesh to the decode mesh, where :func:`scatter_kv_fn`
+    lands them in the decode-side pool. Returns ``(ks, vs, tok[1])``."""
+    blocks, wte = params["blocks"], params["wte"]
+    dt = _compute_dtype(params, compute_dtype)
+    s = ids.shape[1]
+    h = (_emb(wte, ids, dt)
+         + _emb(params["wpe"], jnp.arange(s), dt)).astype(dt)
+
+    def pre(x, p):
+        out, k, v = gpt_block(_dequant_block(p, dt), x, eps,
+                              use_flash=use_flash, return_kv=True)
+        return out, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(pre, h, blocks)  # [L, 1, S, nkv, d]
+    h_last = jax.lax.dynamic_slice_in_dim(
+        h, jnp.maximum(true_len - 1, 0), 1, axis=1)
+    h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
+    logits = _mm("bsh,vh->bsv", h_last, wte, dt)[:, 0]
+    tok = sample_logits(logits, key, temperature, top_k).astype(jnp.int32)
+    return ks[:, 0], vs[:, 0], tok
+
+
+def scatter_kv_fn(k_pages, v_pages, ks, vs, dest_rows):
+    """Decode-side landing of a disaggregated KV handoff: scatter the
+    transferred dense K/V (``[L, S, nkv, d]``) into the decode pool's
+    pages at ``dest_rows`` (padding rows → sink). Pages are donated on
+    TPU — the handoff updates the pool in place."""
+    L, _, nkv, d = ks.shape
+    np_, ps = k_pages.shape[1], k_pages.shape[2]
+    rows = dest_rows.astype(jnp.int32)
+    k_pages = k_pages.reshape(L, np_ * ps, nkv, d).at[:, rows].set(
+        ks.astype(k_pages.dtype)).reshape(k_pages.shape)
+    v_pages = v_pages.reshape(L, np_ * ps, nkv, d).at[:, rows].set(
+        vs.astype(v_pages.dtype)).reshape(v_pages.shape)
+    return k_pages, v_pages
+
+
 def default_prefill_buckets(page_size, max_seq_len):
     """Doubling page-multiple prompt buckets covering max_seq_len —
     small, closed, and every bucket is a whole number of pages."""
@@ -227,7 +337,10 @@ class ServingEngine:
     def __init__(self, model, config=None, *, page_size=16, num_pages=None,
                  max_seq_len=None, decode_buckets=(1, 2, 4, 8),
                  prefill_buckets=None, temperature=0.0, top_k=0, seed=0,
-                 use_flash=None, use_kernel=True, aot=True, quantize=None):
+                 use_flash=None, use_kernel=True, aot=True, quantize=None,
+                 prefill_chunk=None, prefix_cache=False,
+                 disaggregated=False, prefill_devices=None,
+                 decode_devices=None):
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.cfg: GPTConfig = config or gpt.config
         cfg = self.cfg
@@ -273,6 +386,41 @@ class ServingEngine:
         self.max_seq_len = max_seq_len
         self._key = jax.random.key(int(seed))
         self._calls = 0
+        # ---- chunked prefill + prefix cache (tentpole features) -----
+        # prefix sharing needs the offset-aware chunk program (a suffix
+        # prefill starts mid-prompt), so prefix_cache implies chunking
+        if prefix_cache and prefill_chunk is None:
+            prefill_chunk = min(8 * page_size, self.prefill_buckets[-1])
+        self.prefill_chunk = None
+        if prefill_chunk is not None:
+            c = int(prefill_chunk)
+            if c < 1 or c % page_size:
+                raise ValueError(
+                    f"prefill_chunk {c} must be a positive multiple of "
+                    f"page_size {page_size} (chunks scatter whole page "
+                    f"rows)")
+            self.prefill_chunk = c
+        self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
+        self._chunk_state: dict = {}   # seq_id -> in-flight prefill
+        self._cached_len: dict = {}    # seq_id -> matched prefix tokens
+        # ---- disaggregated prefill/decode (opt-in mode) -------------
+        self.disaggregated = bool(disaggregated)
+        if self.disaggregated and (self.prefill_chunk is not None
+                                   or self.prefix_cache is not None):
+            raise ValueError(
+                "disaggregated=True runs whole-prompt prefills on a "
+                "separate mesh; combine it with prefix_cache/"
+                "prefill_chunk in a later PR, not here")
+        self.kv_transfer_bytes = 0
+        self.kv_transfers = 0
+        self._prefill_device = self._decode_device = None
+        if self.disaggregated:
+            devs = list(jax.devices())
+            self._prefill_device = (list(prefill_devices)[0]
+                                    if prefill_devices else devs[0])
+            self._decode_device = (list(decode_devices)[0]
+                                   if decode_devices
+                                   else devs[-1 if len(devs) > 1 else 0])
         # donation lets XLA update the pool in place on TPU; the CPU
         # backend can't donate and would warn on every step
         donate = jax.default_backend() != "cpu"
@@ -295,8 +443,47 @@ class ServingEngine:
                     compute_dtype=cdt),
                 donate_argnums=(1, 2) if donate else ())
             for sb in self.prefill_buckets}
+        # ONE chunk program: q_offset/chunk_len ride as traced scalars,
+        # so every chunk of every prompt (and every cached-prefix
+        # suffix) reuses the same executable
+        self._chunk_jit = jax.jit(
+            functools.partial(chunk_prefill_fn, eps=eps,
+                              temperature=self.temperature,
+                              top_k=self.top_k, compute_dtype=cdt),
+            donate_argnums=(1, 2) if donate else ()) \
+            if self.prefill_chunk is not None else None
+        # COW boundary copy: one fixed-shape program per pool (donated
+        # on TPU so the copy is page-local, not a pool-sized shuffle)
+        self._copy_page_jit = jax.jit(
+            lambda kp, vp, src, dst: (
+                kp.at[:, dst].set(kp[:, src]),
+                vp.at[:, dst].set(vp[:, src])),
+            donate_argnums=(0, 1) if donate else ())
+        if self.disaggregated:
+            # weights live on BOTH meshes (replicated at init — the
+            # per-request wire traffic is only the KV handoff); the
+            # pool and decode programs are committed to the decode mesh
+            self._prefill_params = jax.device_put(self.params,
+                                                  self._prefill_device)
+            self.params = jax.device_put(self.params, self._decode_device)
+            self.pool.bind(
+                jax.device_put(self.pool.k_pages, self._decode_device),
+                jax.device_put(self.pool.v_pages, self._decode_device))
+            self._prefill_kv_jit = {
+                sb: jax.jit(functools.partial(
+                    prefill_kv_fn, eps=eps,
+                    temperature=self.temperature, top_k=self.top_k,
+                    use_flash=flash_attention_gate(sb, cfg.head_dim,
+                                                   use_flash),
+                    compute_dtype=cdt))
+                for sb in self.prefill_buckets}
+            self._scatter_jit = jax.jit(
+                scatter_kv_fn, donate_argnums=(0, 1) if donate else ())
         self._decode_exe: dict = {}
         self._prefill_exe: dict = {}
+        self._chunk_exe = None
+        self._copy_exe = None
+        self._scatter_exe: dict = {}
         self.compile_s = 0.0
         if aot:
             self.compile_buckets()
@@ -318,6 +505,26 @@ class ServingEngine:
         target.set_state_dict(state)
         return cls(model, config, **kw)
 
+    def _aval(self, shape, dtype, side="decode"):
+        """ShapeDtypeStruct for AOT lowering — carrying an explicit
+        single-device sharding in disaggregated mode, so each side's
+        executables compile for THEIR mesh (not the default device;
+        committed runtime arrays would otherwise mismatch)."""
+        if not self.disaggregated:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        from jax.sharding import SingleDeviceSharding
+        dev = self._prefill_device if side == "prefill" \
+            else self._decode_device
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=SingleDeviceSharding(dev))
+
+    def _to_decode(self, x):
+        """Commit a host array to the decode mesh in disaggregated
+        mode (no-op otherwise — default placement already matches)."""
+        if not self.disaggregated:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._decode_device)
+
     def compile_buckets(self):
         """AOT-compile every (prefill, decode) bucket program so no
         request mix ever compiles at serving time. Records wall time in
@@ -325,30 +532,71 @@ class ServingEngine:
         from ..observability.instrument import record_compile
         t0 = time.perf_counter()
         p = self.pool
-        kp = jax.ShapeDtypeStruct(p.k_pages.shape, p.k_pages.dtype)
+        kp = self._aval(p.k_pages.shape, p.k_pages.dtype)
         params_avals = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.params)
-        key_aval = jax.ShapeDtypeStruct(self._key.shape, self._key.dtype)
+            lambda a: self._aval(a.shape, a.dtype), self.params)
+        key_aval = self._aval(self._key.shape, self._key.dtype)
         i32 = jnp.int32
         for b in self.decode_buckets:
             if b in self._decode_exe:
                 continue
             self._decode_exe[b] = self._decode_jit.lower(
                 params_avals, kp, kp,
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b,), i32),
-                jax.ShapeDtypeStruct((b, p.max_pages_per_seq), i32),
-                jax.ShapeDtypeStruct((b,), i32),
+                self._aval((b,), i32),
+                self._aval((b,), i32),
+                self._aval((b, p.max_pages_per_seq), i32),
+                self._aval((b,), i32),
                 key_aval).compile()
-        for sb in self.prefill_buckets:
-            if sb in self._prefill_exe:
-                continue
-            self._prefill_exe[sb] = self._prefill_jit[sb].lower(
-                params_avals, kp, kp,
-                jax.ShapeDtypeStruct((1, sb), i32),
-                jax.ShapeDtypeStruct((), i32),
-                jax.ShapeDtypeStruct((sb,), i32),
-                key_aval).compile()
+        if self.prefill_chunk is not None:
+            # the chunk program REPLACES the per-bucket prefill set:
+            # one executable serves every prompt length / chunk offset
+            if self._chunk_exe is None:
+                C = self.prefill_chunk
+                self._chunk_exe = self._chunk_jit.lower(
+                    params_avals, kp, kp,
+                    jax.ShapeDtypeStruct((1, C), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((1, p.max_pages_per_seq), i32),
+                    jax.ShapeDtypeStruct((C,), i32),
+                    key_aval).compile()
+        elif self.disaggregated:
+            # per-side bucket sets: prefill programs compile FOR the
+            # prefill mesh, the scatter (handoff landing) + decode
+            # programs FOR the decode mesh — the avals carry each
+            # side's device so the executables match the committed
+            # runtime arrays on any topology
+            L, nkv, d = (self.cfg.num_layers, p.num_kv_heads, p.head_dim)
+            pa = lambda s, dt: self._aval(s, dt, side="prefill")
+            for sb in self.prefill_buckets:
+                if sb in self._prefill_exe:
+                    continue
+                self._prefill_exe[sb] = self._prefill_kv_jit[sb].lower(
+                    jax.tree_util.tree_map(
+                        lambda a: pa(a.shape, a.dtype),
+                        self._prefill_params),
+                    pa((1, sb), i32), pa((), i32),
+                    pa(self._key.shape, self._key.dtype)).compile()
+                kv = self._aval((L, sb, nkv, d), p.k_pages.dtype)
+                self._scatter_exe[sb] = self._scatter_jit.lower(
+                    kp, kp, kv, kv, self._aval((sb,), i32)).compile()
+        else:
+            for sb in self.prefill_buckets:
+                if sb in self._prefill_exe:
+                    continue
+                self._prefill_exe[sb] = self._prefill_jit[sb].lower(
+                    params_avals, kp, kp,
+                    jax.ShapeDtypeStruct((1, sb), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((sb,), i32),
+                    key_aval).compile()
+        if self.prefix_cache is not None and self._copy_exe is None:
+            # the COW boundary copy is a serving-time program too: AOT
+            # it so the FIRST mid-page cache hit never compiles inside
+            # a tick (same zero-retrace contract as the bucket set)
+            self._copy_exe = self._copy_page_jit.lower(
+                kp, kp, jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32)).compile()
         self.compile_s += time.perf_counter() - t0
         record_compile(time.perf_counter() - t0, what="serving_buckets")
 
@@ -367,20 +615,60 @@ class ServingEngine:
         return {(b, self.pool.max_pages_per_seq)
                 for b in self.decode_buckets}
 
+    def prefill_signatures(self) -> set:
+        """The closed set of prefill-side program shapes for THIS
+        engine mode: ``("chunk", C, pages_per_seq)`` (one program) when
+        chunked, ``("disagg", sb)`` + ``("scatter", sb)`` per bucket
+        when disaggregated, else the classic ``(1, sb)`` bucket set —
+        what the recompile lint checks the scheduler against."""
+        if self.prefill_chunk is not None:
+            return {("chunk", self.prefill_chunk,
+                     self.pool.max_pages_per_seq)}
+        if self.disaggregated:
+            return {("disagg", sb) for sb in self.prefill_buckets} \
+                | {("scatter", sb) for sb in self.prefill_buckets}
+        return {(1, sb) for sb in self.prefill_buckets}
+
+    def reclaim_cache_pages(self, n_pages: int) -> int:
+        """Evict LRU prefix-cache entries until ``n_pages`` returned to
+        the free list (0 without a cache) — the scheduler's admission
+        pressure valve: cache-held pages are free capacity until a
+        paying sequence needs them."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.reclaim(int(n_pages))
+
     def status(self) -> dict:
         """Engine-side JSON snapshot for the live ``/status`` endpoint:
-        weight/pool sizing, bucket sets, compile accounting."""
-        return {
+        weight/pool sizing, bucket sets, compile accounting, prefix
+        cache + disaggregation state."""
+        st = {
             "compute_dtype": str(np.dtype(self.compute_dtype)),
             "quantize": self.quantize,
             "weights_mb": round(self.weight_bytes() / 2 ** 20, 2),
             "decode_buckets": list(self.decode_buckets),
             "prefill_buckets": list(self.prefill_buckets),
+            "prefill_chunk": self.prefill_chunk,
             "max_seq_len": self.max_seq_len,
             "compile_s": round(self.compile_s, 3),
-            "aot_programs": len(self._decode_exe) + len(self._prefill_exe),
+            "aot_programs": (len(self._decode_exe)
+                             + len(self._prefill_exe)
+                             + len(self._scatter_exe)
+                             + (1 if self._chunk_exe is not None else 0)
+                             + (1 if self._copy_exe is not None else 0)),
             "pool": self.pool.stats(),
         }
+        if self.prefix_cache is not None:
+            st["prefix_cache"] = self.prefix_cache.stats()
+        if self.disaggregated:
+            st["disaggregated"] = {
+                "prefill_device": str(self._prefill_device),
+                "decode_device": str(self._decode_device),
+                "kv_transfers": self.kv_transfers,
+                "kv_transfer_mb": round(
+                    self.kv_transfer_bytes / 2 ** 20, 2),
+            }
+        return st
 
     # ------------------------------------------------------------ lookup
     def _next_key(self):
@@ -422,16 +710,30 @@ class ServingEngine:
         return self._prefill_jit[bucket]
 
     # ------------------------------------------------------------- steps
-    def prefill(self, seq_id, prompt_ids) -> int:
-        """Allocate pages for ``prompt_ids``, run the bucketed prefill,
-        return the first generated token (int)."""
+    def _check_prompt_room(self, prompt_ids) -> np.ndarray:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         n = int(prompt.shape[0])
         if n + 1 > self.max_seq_len:
             raise EngineShapeError(
                 f"prompt of {n} tokens leaves no room to decode within "
                 f"max_seq_len {self.max_seq_len}")
+        return prompt
+
+    def prefill(self, seq_id, prompt_ids) -> int:
+        """Allocate pages for ``prompt_ids``, run the prefill (bucketed
+        one-shot, chunked, or disaggregated — whatever this engine
+        mode compiled), return the first generated token (int)."""
+        if self.prefill_chunk is not None:
+            self.prefill_begin(seq_id, prompt_ids)
+            while True:
+                _, done, tok = self.prefill_step(seq_id)
+                if done:
+                    return tok
+        prompt = self._check_prompt_room(prompt_ids)
+        n = int(prompt.shape[0])
         sb = self.prefill_bucket(n)
+        if self.disaggregated:
+            return self._prefill_disaggregated(seq_id, prompt, sb)
         self.pool.alloc(seq_id, n)
         ids = np.zeros((1, sb), np.int32)
         ids[0, :n] = prompt
@@ -444,6 +746,128 @@ class ServingEngine:
         tok = int(np.asarray(tok)[0])
         self._last_token[seq_id] = tok
         return tok
+
+    def _prefill_disaggregated(self, seq_id, prompt, sb) -> int:
+        """Prefill on the prefill mesh, explicit KV handoff, scatter
+        into the decode-side pool — TPLA's split, each side keeping its
+        own parallelism and bucket set."""
+        n = int(prompt.shape[0])
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :n] = prompt
+        fn = self._prefill_exe.get(sb) or self._prefill_kv_jit[sb]
+        put_p = functools.partial(jax.device_put,
+                                  device=self._prefill_device)
+        ks, vs, tok = fn(self._prefill_params,
+                         put_p(jnp.asarray(ids)),
+                         put_p(jnp.asarray(np.int32(n))),
+                         put_p(self._next_key()))
+        # the handoff: dense prompt K/V crosses meshes exactly once;
+        # book the TRUE payload (the prompt's n positions), not the
+        # bucket-padded tensor — predict.py prices prompt_len and the
+        # measured/predicted reconciliation must compare like to like
+        ks, vs = jax.device_put((ks, vs), self._decode_device)
+        per_pos = int(ks.nbytes) // sb
+        self.kv_transfers += 1
+        self.kv_transfer_bytes += 2 * per_pos * n
+        self.pool.alloc(seq_id, n)
+        rows = self.pool.prefill_rows(seq_id, sb)
+        scatter = self._scatter_exe.get(sb) or self._scatter_jit
+        kp, vp = scatter(self.pool.k_pages, self.pool.v_pages, ks, vs,
+                         self._to_decode(rows))
+        self.pool.bind(kp, vp)
+        tok = int(np.asarray(tok)[0])
+        self._last_token[seq_id] = tok
+        return tok
+
+    # ----------------------------------------- chunked / cached prefill
+    def prefill_begin(self, seq_id, prompt_ids) -> int:
+        """Start a chunked prefill: match the prefix cache (longest
+        cached prefix maps straight into the new page table; a
+        mid-page divergence copies the boundary page — COW), allocate
+        the remaining pages, and queue the suffix for
+        :meth:`prefill_step` ticks. Returns the cached prefix length
+        (0 without a cache or on a miss)."""
+        if self.prefill_chunk is None:
+            raise EngineShapeError(
+                "prefill_begin requires a chunked engine "
+                "(prefill_chunk=...)")
+        prompt = self._check_prompt_room(prompt_ids)
+        n = int(prompt.shape[0])
+        cached_len = 0
+        if self.prefix_cache is not None:
+            cache = self.prefix_cache
+            nodes, boundary, cached_len = cache.match(prompt)
+            pages = cache.map_into(seq_id, nodes, boundary)
+            cow = None
+            try:
+                if boundary is not None:
+                    cow = self.pool._take_page()
+                    copy = self._copy_exe if self._copy_exe is not None \
+                        else self._copy_page_jit
+                    kp, vp = copy(
+                        self.pool.k_pages, self.pool.v_pages,
+                        jnp.asarray(np.int32(boundary[0].page)),
+                        jnp.asarray(np.int32(cow)))
+                    self.pool.bind(kp, vp)
+                    pages = pages + [cow]
+                self.pool.alloc_prefixed(seq_id, n, pages, cached_len)
+            except Exception:
+                # shared pages stay cache-owned (map_into only pinned
+                # them); only the transient COW page needs returning
+                cache.release(seq_id)
+                if cow is not None:
+                    self.pool.decref([cow])
+                raise
+            if cow is not None:
+                # alloc_prefixed took the sequence's reference on the
+                # COW page; drop the engine's transient one (net: the
+                # copy is private to the sequence)
+                self.pool.decref([cow])
+        else:
+            self.pool.note_prefix_lookup(0)
+            self.pool.alloc(seq_id, n)
+        self._chunk_state[seq_id] = {"prompt": prompt, "pos": cached_len,
+                                     "n": n}
+        self._cached_len[seq_id] = cached_len
+        return cached_len
+
+    def prefill_step(self, seq_id):
+        """Run ONE chunk of an in-flight prefill. Returns ``(tokens
+        processed, done, first_token_or_None)`` — the scheduler spends
+        its per-tick prefill token budget on these, so a long prompt
+        interleaves with decode ticks instead of stalling them."""
+        st = self._chunk_state[seq_id]
+        C = self.prefill_chunk
+        start, n = st["pos"], st["n"]
+        clen = min(C, n - start)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :clen] = st["prompt"][start:start + clen]
+        rows = self.pool.chunk_rows(seq_id, start, C)
+        table = self.pool.table_array([seq_id])
+        fn = self._chunk_exe if self._chunk_exe is not None \
+            else self._chunk_jit
+        kp, vp, tok = fn(
+            self.params, self.pool.k_pages, self.pool.v_pages,
+            jnp.asarray(ids), jnp.asarray(np.int32(start)),
+            jnp.asarray(np.int32(clen)), jnp.asarray(table),
+            jnp.asarray(rows), self._next_key())
+        self.pool.bind(kp, vp)
+        st["pos"] = start + clen
+        if st["pos"] < n:
+            return clen, False, None
+        tok = int(np.asarray(tok)[0])
+        self._last_token[seq_id] = tok
+        del self._chunk_state[seq_id]
+        if self.prefix_cache is not None:
+            # content now exists: publish the prompt's full pages so
+            # queued same-prefix requests hit them
+            self.prefix_cache.insert(st["prompt"],
+                                     self.pool.table(seq_id))
+        return clen, True, tok
+
+    def cached_prefix_len(self, seq_id) -> int:
+        """Tokens this sequence reused from the prefix cache."""
+        return self._cached_len.get(seq_id, 0)
 
     def decode(self, seq_ids, bucket=None):
         """One decode step for ``seq_ids`` (each already holding its new
@@ -461,8 +885,9 @@ class ServingEngine:
         positions = np.maximum(lens - 1, 0).astype(np.int32)
         kp, vp, nxt = self._decode_fn(bucket)(
             self.params, self.pool.k_pages, self.pool.v_pages,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(table), jnp.asarray(lens), self._next_key())
+            self._to_decode(tokens), self._to_decode(positions),
+            self._to_decode(table), self._to_decode(lens),
+            self._to_decode(self._next_key()))
         self.pool.bind(kp, vp)
         out = [int(t) for t in np.asarray(nxt)[:n]]
         for sid, t in zip(seq_ids, out):
@@ -475,6 +900,20 @@ class ServingEngine:
     def _last_token(self) -> dict:
         return {}
 
-    def release(self, seq_id):
+    def release(self, seq_id, token_ids=None):
+        """Free a finished sequence. With a prefix cache, ``token_ids``
+        (prompt + generated tokens whose K/V actually entered the pool
+        — i.e. everything but the final sampled token) publishes the
+        sequence's full pages into the trie first, so multi-turn
+        follow-ups and repeated completions become cache hits."""
         self._last_token.pop(seq_id, None)
+        self._chunk_state.pop(seq_id, None)
+        self._cached_len.pop(seq_id, None)
+        if self.prefix_cache is not None:
+            if token_ids is not None and len(token_ids):
+                ids = np.asarray(token_ids, np.int32).reshape(-1)
+                valid = min(int(ids.shape[0]), self.pool.seq_len(seq_id))
+                self.prefix_cache.insert(ids[:valid],
+                                         self.pool.table(seq_id))
+            self.prefix_cache.release(seq_id)
         self.pool.free(seq_id)

@@ -18,6 +18,15 @@ The device arrays are updated *functionally*: the engine passes
 arrays back, and rebinds them via :meth:`bind`. The host bookkeeping
 (``alloc``/``extend``/``free``) is plain Python — a few dict/list ops per
 request per step, never on the device critical path.
+
+Pages are **refcounted** so the prefix cache
+(:mod:`paddle_tpu.serving.prefix_cache`) can map one physical page into
+many sequences' page tables (and into the cache's own trie nodes): a
+page returns to the free list only when its last reference drops.
+Writers stay safe via the copy-on-write invariant — :meth:`extend`
+refuses to grow a sequence into a page another holder still references
+(the engine COWs the boundary page at admission, so a correctly driven
+pool never trips this guard).
 """
 from __future__ import annotations
 
@@ -65,6 +74,12 @@ class PagePool:
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._tables: dict = {}   # seq_id -> [page, ...]
         self._lens: dict = {}     # seq_id -> true token count
+        self._refs: dict = {}     # page -> reference count (seqs + cache)
+        # prefix-cache accounting (the cache reports into its pool so
+        # one stats() snapshot carries pool AND reuse numbers)
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        self._tokens_reused = 0
 
     # ------------------------------------------------------------ sizing
     def pages_needed(self, n_tokens: int) -> int:
@@ -86,13 +101,36 @@ class PagePool:
     def live_sequences(self) -> int:
         return len(self._tables)
 
+    @property
+    def pages_shared(self) -> int:
+        """Pages mapped by more than one holder (sequences and/or the
+        prefix-cache trie) — >0 proves physical page reuse."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def note_prefix_lookup(self, tokens_reused: int):
+        """Prefix-cache reuse accounting (called by the cache on every
+        admission match attempt): a lookup reusing >0 tokens is a hit."""
+        self._prefix_lookups += 1
+        if tokens_reused > 0:
+            self._prefix_hits += 1
+            self._tokens_reused += int(tokens_reused)
+
     def stats(self) -> dict:
-        """Fragmentation accounting: ``utilization`` = live tokens over
-        the token capacity of the pages actually held, so
+        """Fragmentation + sharing accounting: ``utilization`` = the
+        PHYSICALLY occupied share of allocated page slots, so
         ``internal_fragmentation`` is the share of allocated HBM wasted
-        on partially-filled trailing pages."""
+        on partially-filled trailing pages. Only a sequence's trailing
+        page can be partial, and partial pages are always exclusive
+        (the COW invariant), so waste sums per-sequence without double
+        counting — and stays in [0, 1] even when shared pages make
+        ``live_tokens`` (a logical, reuse-counting total) exceed the
+        physical slot count. ``pages_shared`` / ``tokens_reused`` /
+        ``prefix_hit_rate`` surface prefix-cache page reuse (all zero
+        without a cache)."""
         cap = self.pages_in_use * self.page_size
-        util = (self.live_tokens / cap) if cap else 1.0
+        waste = sum((self.page_size - n % self.page_size)
+                    % self.page_size for n in self._lens.values())
+        util = ((cap - waste) / cap) if cap else 1.0
         itemsize = jnp.zeros((), self.k_pages.dtype).dtype.itemsize
         return {
             "num_pages": self.num_pages,
@@ -105,63 +143,163 @@ class PagePool:
             "utilization": round(util, 4),
             "internal_fragmentation": round(1.0 - util, 4),
             "pool_bytes": 2 * int(np.prod(self.k_pages.shape)) * itemsize,
+            "pages_shared": self.pages_shared,
+            "tokens_reused": self._tokens_reused,
+            "prefix_hit_rate": round(
+                self._prefix_hits / self._prefix_lookups, 4)
+            if self._prefix_lookups else 0.0,
         }
 
     # ------------------------------------------------------- bookkeeping
+    def _require(self, seq_id):
+        if seq_id not in self._tables:
+            raise PagePoolError(
+                f"unknown or already-freed sequence {seq_id!r} "
+                f"({self.live_sequences} live)")
+
+    def _take_page(self) -> int:
+        """Pop one page off the free list at refcount 1 (caller owns it
+        — used for COW boundary copies before a table exists)."""
+        if not self._free:
+            raise PagePoolOOM("no free pages for a copy-on-write page")
+        p = self._free.pop()
+        self._refs[p] = 1
+        return p
+
+    def incref(self, pages):
+        """Add one reference per page (prefix-cache node adoption or
+        mapping a cached page into a new sequence's table). Validates
+        EVERY page before touching any refcount, so a bad batch leaves
+        the pool untouched — same no-partial-mutation discipline as
+        :meth:`extend`'s write barrier."""
+        pages = list(pages)
+        for p in pages:
+            if p == self.SINK or not (0 < p < self.num_pages):
+                raise PagePoolError(f"cannot reference page {p}")
+            if p not in self._refs:
+                raise PagePoolError(f"page {p} is not allocated")
+        for p in pages:
+            self._refs[p] += 1
+
+    def decref(self, pages):
+        """Drop one reference per page; pages reaching zero return to
+        the free list (lowest ids reused first)."""
+        freed = []
+        for p in pages:
+            c = self._refs.get(p, 0)
+            if c < 1:
+                raise PagePoolError(f"page {p} is not referenced")
+            if c == 1:
+                del self._refs[p]
+                freed.append(p)
+            else:
+                self._refs[p] = c - 1
+        self._free.extend(sorted(freed, reverse=True))
+        return freed
+
+    def page_ref(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
     def alloc(self, seq_id, n_tokens: int):
         """Register a new sequence holding ``n_tokens`` and hand it pages."""
+        return self.alloc_prefixed(seq_id, n_tokens, (), 0)
+
+    def alloc_prefixed(self, seq_id, n_tokens: int, prefix_pages,
+                       prefix_len: int):
+        """Register a new sequence whose first ``prefix_len`` tokens
+        already live in ``prefix_pages`` (cached prefix pages the caller
+        mapped — this takes one reference on each); only the pages
+        covering tokens beyond the prefix draw from the free list.
+        Returns the full page table."""
         if seq_id in self._tables:
             raise PagePoolError(f"sequence {seq_id!r} already allocated")
         n_tokens = int(n_tokens)
+        prefix_len = int(prefix_len)
+        prefix_pages = list(prefix_pages)
         if n_tokens < 1:
             raise PagePoolError(f"n_tokens {n_tokens} must be >= 1")
         if n_tokens > self.max_seq_len:
             raise PagePoolError(
                 f"n_tokens {n_tokens} exceeds max_seq_len "
                 f"{self.max_seq_len}")
-        need = self.pages_needed(n_tokens)
+        if prefix_len > n_tokens:
+            raise PagePoolError(
+                f"prefix_len {prefix_len} exceeds n_tokens {n_tokens}")
+        if prefix_pages and not prefix_len:
+            raise PagePoolError("prefix pages without a prefix length")
+        if prefix_len and len(prefix_pages) != math.ceil(
+                prefix_len / self.page_size):
+            raise PagePoolError(
+                f"prefix of {prefix_len} tokens needs "
+                f"{math.ceil(prefix_len / self.page_size)} pages, "
+                f"got {len(prefix_pages)}")
+        need = self.pages_needed(n_tokens) - len(prefix_pages)
         if need > len(self._free):
             raise PagePoolOOM(
-                f"need {need} pages for {n_tokens} tokens, "
-                f"{len(self._free)} free")
-        pages = [self._free.pop() for _ in range(need)]
-        self._tables[seq_id] = pages
+                f"need {need} pages for {n_tokens} tokens "
+                f"({prefix_len} cached), {len(self._free)} free")
+        self.incref(prefix_pages)
+        fresh = []
+        for _ in range(max(need, 0)):
+            p = self._free.pop()
+            self._refs[p] = 1
+            fresh.append(p)
+        self._tables[seq_id] = prefix_pages + fresh
         self._lens[seq_id] = n_tokens
-        return list(pages)
+        return list(self._tables[seq_id])
 
     def extend(self, seq_id, n_new: int = 1) -> int:
         """Grow a sequence by ``n_new`` tokens, allocating pages as the
-        length crosses page boundaries. Returns the new length."""
-        if seq_id not in self._tables:
-            raise PagePoolError(f"unknown sequence {seq_id!r}")
+        length crosses page boundaries. Returns the new length. The
+        page the new tokens land in must be exclusively held (COW
+        invariant): growing into a shared page would corrupt every
+        other holder's cache."""
+        self._require(seq_id)
         new_len = self._lens[seq_id] + int(n_new)
         if new_len > self.max_seq_len:
             raise PagePoolError(
                 f"sequence {seq_id!r} would exceed max_seq_len "
                 f"{self.max_seq_len}")
-        need = self.pages_needed(new_len) - len(self._tables[seq_id])
+        table = self._tables[seq_id]
+        need = self.pages_needed(new_len) - len(table)
         if need > len(self._free):
             raise PagePoolOOM(
                 f"sequence {seq_id!r} needs {need} more page(s), "
                 f"{len(self._free)} free")
+        # the write barrier runs BEFORE any allocation so a refused
+        # extend leaves the pool untouched: every EXISTING page
+        # receiving one of the new tokens must be private to this
+        # sequence (fresh pages are born private)
+        first = self._lens[seq_id] // self.page_size
+        last = (new_len - 1) // self.page_size
+        for idx in range(first, min(last, len(table) - 1) + 1):
+            p = table[idx]
+            if self._refs.get(p, 0) != 1:
+                raise PagePoolError(
+                    f"sequence {seq_id!r} would write shared page {p} "
+                    f"(refcount {self._refs.get(p, 0)}) — copy-on-write "
+                    f"the boundary page before extending")
         for _ in range(need):
-            self._tables[seq_id].append(self._free.pop())
+            p = self._free.pop()
+            self._refs[p] = 1
+            table.append(p)
         self._lens[seq_id] = new_len
         return new_len
 
     def free(self, seq_id):
-        """Return a sequence's pages to the pool."""
-        if seq_id not in self._tables:
-            raise PagePoolError(f"unknown sequence {seq_id!r}")
+        """Drop the sequence's reference on its pages; pages held by no
+        other sequence (or prefix-cache node) return to the pool."""
+        self._require(seq_id)
         pages = self._tables.pop(seq_id)
         del self._lens[seq_id]
-        # re-add in reverse so the pool reuses low page ids first again
-        self._free.extend(reversed(pages))
+        self.decref(pages)
 
     def seq_len(self, seq_id) -> int:
+        self._require(seq_id)
         return self._lens[seq_id]
 
     def table(self, seq_id) -> list:
+        self._require(seq_id)
         return list(self._tables[seq_id])
 
     # ---------------------------------------------- device-facing arrays
@@ -186,15 +324,25 @@ class PagePool:
         ``[num_pages*page_size]`` page-row view for a prefill scatter:
         token ``t`` of the sequence lands in its page's slot; padded
         positions (``t >= seq_len``) land in the sink page."""
+        return self.chunk_rows(seq_id, 0, bucket_len)
+
+    def chunk_rows(self, seq_id, start: int, bucket_len: int) -> np.ndarray:
+        """Destination rows for a prefill *chunk*: positions ``[start,
+        start + bucket_len)`` of the sequence map to their page slots;
+        positions at or beyond the true length land in the sink page
+        (same contract as :meth:`prefill_rows`, which is the
+        ``start == 0`` case)."""
+        self._require(seq_id)
         ps = self.page_size
         pages = self._tables[seq_id]
         n = self._lens[seq_id]
         rows = np.empty(int(bucket_len), dtype=np.int32)
-        for t in range(int(bucket_len)):
+        for i in range(int(bucket_len)):
+            t = int(start) + i
             if t < n:
-                rows[t] = pages[t // ps] * ps + (t % ps)
+                rows[i] = pages[t // ps] * ps + (t % ps)
             else:
-                rows[t] = self.SINK * ps + (t % ps)
+                rows[i] = self.SINK * ps + (t % ps)
         return rows
 
     def bind(self, k_pages, v_pages):

@@ -28,21 +28,13 @@ import json
 import math
 import sys
 
-__all__ = ["predicted_serving_row"]
+__all__ = ["predicted_serving_row", "predicted_shared_prefix_row",
+           "predicted_disagg_row"]
 
 
-def predicted_serving_row(config: str = "345m", concurrency: int = 8,
-                          page_size: int = 64, chip: str = "v5e",
-                          dtype: str = "bfloat16",
-                          quantize: str | None = None) -> dict:
-    import jax
-    import jax.numpy as jnp
-    from ..analysis.passes.cost import estimate_jaxpr_cost
+def _gpt_config(config: str):
     from ..models.gpt import (gpt_13b_config, gpt_1p3b_config,
                               gpt_345m_config, gpt_tiny_config)
-    from ..observability.instrument import chip_specs
-    from .engine import decode_step_fn
-
     cfgs = {
         "tiny": lambda: gpt_tiny_config(),
         # the bench's TPU-native 345M shape (d_head=128)
@@ -51,28 +43,29 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
         "1.3b": lambda: gpt_1p3b_config(),
         "13b": lambda: gpt_13b_config(),
     }
-    cfg = cfgs[config]()
+    return cfgs[config]()
+
+
+def _params_avals(cfg, dtype, quantize):
+    """Abstract stacked-GPT weight pytree (quantized form — int8 q +
+    f32 per-channel scales, exactly what
+    ``quantize_stacked_gpt_weights`` emits — when ``quantize="int8"``),
+    so the cost model prices the real decode/prefill programs."""
+    import jax
+    import jax.numpy as jnp
     L, H, nh, d = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                    cfg.head_dim)
     V, F = cfg.vocab_size, cfg.intermediate_size
-    B = int(concurrency)
-    ps = int(page_size)
-    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
-    num_pages = B * pages_per_seq + 1
     wdt = jnp.dtype(dtype)
     sds = jax.ShapeDtypeStruct
     i8, f32 = jnp.int8, jnp.float32
 
     def w(shape, s_shape=None):
-        """One weight aval — quantized form (int8 q + f32 per-channel
-        scales, exactly what ``quantize_stacked_gpt_weights`` emits)
-        when ``quantize="int8"``, so the cost model prices the real
-        int8-storage decode program."""
         if quantize == "int8" and s_shape is not None:
             return {"q": sds(shape, i8), "s": sds(s_shape, f32)}
         return sds(shape, wdt)
 
-    params = {
+    return {
         "blocks": {
             "ln1_w": sds((L, H), wdt), "ln1_b": sds((L, H), wdt),
             "wqkv": w((L, H, 3, nh, d), (L, 3, nh, d)),
@@ -87,6 +80,27 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
                  (cfg.max_position_embeddings,)),
         "lnf_w": sds((H,), wdt), "lnf_b": sds((H,), wdt),
     }
+
+
+def predicted_serving_row(config: str = "345m", concurrency: int = 8,
+                          page_size: int = 64, chip: str = "v5e",
+                          dtype: str = "bfloat16",
+                          quantize: str | None = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from ..analysis.passes.cost import estimate_jaxpr_cost
+    from ..observability.instrument import chip_specs
+    from .engine import decode_step_fn
+
+    cfg = _gpt_config(config)
+    L, nh, d = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    B = int(concurrency)
+    ps = int(page_size)
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = B * pages_per_seq + 1
+    wdt = jnp.dtype(dtype)
+    sds = jax.ShapeDtypeStruct
+    params = _params_avals(cfg, dtype, quantize)
     kp = sds((L, num_pages, ps, nh, d), wdt)
     i32 = jnp.int32
     fn = functools.partial(decode_step_fn, eps=cfg.layer_norm_epsilon,
@@ -125,6 +139,163 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
     }
 
 
+def _chunk_step_ms(cfg, dtype, quantize, chunk, pages_per_seq, num_pages,
+                   page_size, spec):
+    """Roofline cost of ONE chunk-program invocation (the real
+    :func:`..serving.engine.chunk_prefill_fn` jaxpr — the program both
+    chunked prefill and prefix-cache suffix prefill run)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from ..analysis.passes.cost import estimate_jaxpr_cost
+    from .engine import chunk_prefill_fn
+
+    sds = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    L, nh, d = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    params = _params_avals(cfg, dtype, quantize)
+    kp = sds((L, num_pages, page_size, nh, d), jnp.dtype(dtype))
+    fn = functools.partial(chunk_prefill_fn, eps=cfg.layer_norm_epsilon,
+                           temperature=0.0, top_k=0, compute_dtype=dtype)
+    closed = jax.make_jaxpr(fn)(
+        params, kp, kp, sds((1, chunk), i32), sds((), i32),
+        sds((), i32), sds((1, pages_per_seq), i32),
+        sds((chunk,), i32), None)
+    return estimate_jaxpr_cost(closed, chip=spec).step_ms
+
+
+def predicted_shared_prefix_row(config: str = "345m",
+                                concurrency: int = 8,
+                                prompt_len: int = 1024,
+                                shared_fraction: float = 0.75,
+                                max_new: int = 64,
+                                prefill_chunk: int = 256,
+                                page_size: int = 64, chip: str = "v5e",
+                                dtype: str = "bfloat16") -> dict:
+    """``serving_shared_prefix_predicted``: the static shared-prefix
+    serving anchor. N concurrent requests share ``shared_fraction`` of
+    a ``prompt_len`` prompt; the cache-hit engine prefills only the
+    suffix (chunk program invocations over ``prompt_len - cached``
+    tokens) while the baseline prefills everything. Workload makespan =
+    serialized prefills (one prefill lane — the scheduler's budget
+    ticks) + the batched decode tail, so the row's VALUE is predicted
+    end-to-end goodput tokens/s WITH the cache; the baseline and the
+    TTFT split ride in the extras. Zero device work, zero noise —
+    ``tools/bench_compare.py`` anchors the measured row on it."""
+    from ..observability.instrument import chip_specs
+    cfg = _gpt_config(config)
+    B = int(concurrency)
+    ps = int(page_size)
+    chunk = max(int(prefill_chunk) // ps, 1) * ps
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = B * pages_per_seq + 1
+    spec = chip_specs(chip)
+    cached = int(min(max(shared_fraction, 0.0), 1.0) * prompt_len)
+    cached = min(cached, prompt_len - 1)
+    suffix = prompt_len - cached
+    chunk_ms = _chunk_step_ms(cfg, dtype, None, chunk, pages_per_seq,
+                              num_pages, ps, spec)
+    decode = predicted_serving_row(config, concurrency, page_size, chip,
+                                   dtype)
+    step_ms = decode["predicted_decode_step_ms"]
+    chunks_hit = math.ceil(suffix / chunk)
+    chunks_miss = math.ceil(prompt_len / chunk)
+    # first request is always a miss (it fills the cache); the rest hit
+    prefill_hit_ms = chunks_hit * chunk_ms
+    prefill_miss_ms = chunks_miss * chunk_ms
+    total_prefill_ms = prefill_miss_ms + (B - 1) * prefill_hit_ms
+    base_prefill_ms = B * prefill_miss_ms
+    decode_ms = max_new * step_ms
+    makespan_ms = total_prefill_ms + decode_ms
+    base_makespan_ms = base_prefill_ms + decode_ms
+    tok = B * max_new
+
+    def tps(ms):
+        return round(tok / (ms / 1e3), 1) if ms else 0.0
+
+    return {
+        "config": config,
+        "concurrency": B,
+        "prompt_len": int(prompt_len),
+        "shared_fraction": round(shared_fraction, 4),
+        "cached_prefix_len": cached,
+        "prefill_chunk": chunk,
+        "page_size": ps,
+        "dtype": dtype,
+        "predicted_tokens_per_sec": tps(makespan_ms),
+        "predicted_tokens_per_sec_no_cache": tps(base_makespan_ms),
+        "predicted_goodput_speedup": round(
+            base_makespan_ms / makespan_ms, 3) if makespan_ms else 0.0,
+        "predicted_ttft_ms_hit": round(prefill_hit_ms, 3),
+        "predicted_ttft_ms_miss": round(prefill_miss_ms, 3),
+        "predicted_ttft_speedup": round(
+            prefill_miss_ms / prefill_hit_ms, 3) if prefill_hit_ms
+        else 0.0,
+        "predicted_chunk_ms": round(chunk_ms, 3),
+        "predicted_decode_step_ms": step_ms,
+        "predicted_tokens_reused": (B - 1) * cached,
+        "chip_assumed": spec.get("name"),
+    }
+
+
+def predicted_disagg_row(config: str = "345m", concurrency: int = 8,
+                         prompt_len: int = 1024, page_size: int = 64,
+                         chip: str = "v5e",
+                         dtype: str = "bfloat16") -> dict:
+    """``serving_disagg_predicted``: price the disaggregated split —
+    prefill program (the real :func:`..serving.engine.prefill_kv_fn`
+    jaxpr) on the prefill mesh, dense-KV handoff over ICI, decode step
+    on the decode mesh. TTFT = prefill + transfer; decode throughput is
+    the decode mesh's alone (prefill no longer steals its ticks)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from ..analysis.passes.cost import estimate_jaxpr_cost
+    from ..observability.instrument import chip_specs
+    from .engine import prefill_kv_fn
+
+    cfg = _gpt_config(config)
+    L, nh, d = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    sds = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+    spec = chip_specs(chip)
+    wdt = jnp.dtype(dtype)
+    # bucketize the prompt the way default_prefill_buckets would
+    sb = int(page_size)
+    while sb < prompt_len:
+        sb *= 2
+    sb = min(sb, cfg.max_position_embeddings)
+    params = _params_avals(cfg, dtype, None)
+    fn = functools.partial(prefill_kv_fn, eps=cfg.layer_norm_epsilon,
+                           temperature=0.0, top_k=0, use_flash=False,
+                           compute_dtype=dtype)
+    closed = jax.make_jaxpr(fn)(params, sds((1, sb), i32),
+                                sds((), i32), None)
+    prefill_ms = estimate_jaxpr_cost(closed, chip=spec).step_ms
+    itemsize = jnp.zeros((), wdt).dtype.itemsize
+    kv_bytes = 2 * L * prompt_len * nh * d * itemsize
+    transfer_ms = 1e3 * kv_bytes / spec["ici_bw"]
+    decode = predicted_serving_row(config, concurrency, page_size, chip,
+                                   dtype)
+    return {
+        "config": config,
+        "concurrency": int(concurrency),
+        "prompt_len": int(prompt_len),
+        "prefill_bucket": sb,
+        "dtype": dtype,
+        "predicted_tokens_per_sec": decode["predicted_tokens_per_sec"],
+        "predicted_prefill_ms": round(prefill_ms, 3),
+        "predicted_kv_transfer_mb": round(kv_bytes / 2 ** 20, 2),
+        "predicted_kv_transfer_ms": round(transfer_ms, 3),
+        "predicted_ttft_ms": round(prefill_ms + transfer_ms, 3),
+        "predicted_decode_step_ms": decode["predicted_decode_step_ms"],
+        "predicted_transfer_share_of_ttft": round(
+            transfer_ms / (prefill_ms + transfer_ms), 4)
+        if prefill_ms + transfer_ms else 0.0,
+        "chip_assumed": spec.get("name"),
+    }
+
+
 def _main(argv=None):
     import os
     import subprocess
@@ -139,6 +310,16 @@ def _main(argv=None):
     ap.add_argument("--quantize", default=None, choices=[None, "int8"],
                     help="price the weight-only-int8 decode program "
                          "(serving engine quantize='int8')")
+    ap.add_argument("--mode", default="decode",
+                    choices=["decode", "shared_prefix", "disagg"],
+                    help="decode = classic serving_predicted row; "
+                         "shared_prefix = prefix-cache goodput/TTFT "
+                         "anchor; disagg = disaggregated prefill/"
+                         "decode split anchor")
+    ap.add_argument("--prompt-len", type=int, default=1024)
+    ap.add_argument("--shared-fraction", type=float, default=0.75)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--prefill-chunk", type=int, default=256)
     args = ap.parse_args(argv)
     if not os.environ.get("_PREDICT_RESPAWNED"):
         # same contract as analysis.predict: force the CPU backend in a
@@ -154,9 +335,19 @@ def _main(argv=None):
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:
-        row = predicted_serving_row(args.config, args.concurrency,
-                                    args.page_size, args.chip,
-                                    quantize=args.quantize)
+        if args.mode == "shared_prefix":
+            row = predicted_shared_prefix_row(
+                args.config, args.concurrency, args.prompt_len,
+                args.shared_fraction, args.max_new, args.prefill_chunk,
+                args.page_size, args.chip)
+        elif args.mode == "disagg":
+            row = predicted_disagg_row(
+                args.config, args.concurrency, args.prompt_len,
+                args.page_size, args.chip)
+        else:
+            row = predicted_serving_row(args.config, args.concurrency,
+                                        args.page_size, args.chip,
+                                        quantize=args.quantize)
     except Exception as e:  # noqa: BLE001 — the row must say why
         row = {"config": args.config, "error": repr(e)[:300]}
     print(json.dumps(row), flush=True)

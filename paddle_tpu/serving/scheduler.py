@@ -62,8 +62,11 @@ class Request:
     first_token_time: float | None = None
     finish_time: float | None = None
     prefill_s: float | None = None     # measured prefill walltime
+    cached_prefix_len: int = 0         # prompt tokens reused from cache
+    prefill_chunks: int = 0            # chunk program invocations
     tokens: list = field(default_factory=list)   # generated ids
-    state: str = "queued"              # queued|running|finished|rejected
+    state: str = "queued"              # queued|prefilling|running|
+    #                                    finished|rejected
     reject_reason: str | None = None   # max_new<1|too_long|queue_full|
     #                                    pool_too_small
     slo_met: bool | None = None        # stamped at finish by the tracker
@@ -103,6 +106,8 @@ class Request:
                "new_tokens": len(self.tokens),
                "queue_wait_s": queue_wait, "ttft_s": ttft,
                "prefill_s": self.prefill_s,
+               "cached_prefix_len": self.cached_prefix_len,
+               "prefill_chunks": self.prefill_chunks,
                "decode_s": decode_s, "total_s": total_s,
                "decode_tokens_per_sec": tps,
                "slo_met": self.slo_met}
@@ -113,7 +118,7 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, engine, max_queue: int = 1024, slo=None,
-                 max_retained: int = 4096):
+                 max_retained: int = 4096, prefill_token_budget=None):
         from ..observability.slo import SLOConfig, SLOTracker
         self.engine = engine
         self.buckets = tuple(engine.decode_buckets)
@@ -121,6 +126,16 @@ class ContinuousBatchingScheduler:
         self.max_queue = int(max_queue)
         self._queue: deque = deque()
         self._running: dict = {}          # rid -> Request, insertion order
+        self._prefilling: dict = {}       # rid -> Request (chunked mode)
+        self._begun: set = set()          # rids whose prefill has pages
+        # chunked engines interleave prefill with decode: each tick
+        # spends at most this many prefill tokens (chunk-granular; the
+        # default of one chunk is the tightest decode-stall bound)
+        self.chunked = getattr(engine, "prefill_chunk", None) is not None
+        self.prefill_token_budget = int(prefill_token_budget) \
+            if prefill_token_budget else (engine.prefill_chunk
+                                          if self.chunked else None)
+        self.prefill_tokens_per_tick: list = []   # observability/tests
         self._reserved_pages = 0          # pages promised, not yet alloc'd
         self._rid = itertools.count()
         # terminal Request objects kept in memory for run()/bench/status
@@ -187,7 +202,8 @@ class ContinuousBatchingScheduler:
 
     @property
     def pending(self) -> int:
-        return len(self._queue) + len(self._running)
+        return len(self._queue) + len(self._prefilling) \
+            + len(self._running)
 
     # ------------------------------------------------------------ phases
     def _completion_pages(self, r: Request) -> int:
@@ -212,7 +228,10 @@ class ContinuousBatchingScheduler:
             r = self._running.pop(rid)
             held = len(self.engine.pool.table(rid))
             self._reserved_pages -= self._completion_pages(r) - held
-            self.engine.release(rid)
+            # everything but the final sampled token has K/V in the
+            # pool — exactly what the prefix cache may re-serve
+            self.engine.release(rid, token_ids=np.concatenate(
+                [r.prompt, np.asarray(r.tokens[:-1], np.int32)]))
             r.state = "finished"
             r.finish_time = time.perf_counter()
             if r.trace is not None and r.first_token_time is not None:
@@ -225,13 +244,106 @@ class ContinuousBatchingScheduler:
             obs.serving_requests_counter().inc(event="finished")
             self._log_request(r)
 
+    def _page_room(self, need: int) -> bool:
+        """Free pages (after reservations) cover ``need``? Under
+        pressure, ask the engine to reclaim prefix-cache pages first —
+        cached pages are free capacity until a paying request needs
+        them (LRU eviction inside)."""
+        pool = self.engine.pool
+        avail = pool.free_pages - self._reserved_pages
+        if avail < need:
+            avail += self.engine.reclaim_cache_pages(need - avail) \
+                if hasattr(self.engine, "reclaim_cache_pages") else 0
+        return avail >= need
+
+    def _admit_chunked(self):
+        """Chunked admission: reserve the full completion and hand the
+        request to the prefill phase — page allocation AND the prefix-
+        cache match happen at its first chunk (so a same-prefix request
+        earlier in the queue has published its pages by then)."""
+        from ..observability import instrument as obs
+        while self._queue and (len(self._running) + len(self._prefilling)
+                               < self.max_concurrency):
+            r = self._queue[0]
+            need = self._completion_pages(r)
+            if not self._page_room(need):
+                break  # head-of-line: keep arrival order deterministic
+            self._queue.popleft()
+            r.admit_time = time.perf_counter()
+            r.state = "prefilling"
+            r.prefill_s = 0.0
+            self._reserved_pages += need
+            self._prefilling[r.rid] = r
+            if r.trace is not None:
+                r.trace.span("queued", r.submit_time, r.admit_time)
+            obs.serving_requests_counter().inc(event="admitted")
+            obs.serving_queue_wait_histogram().observe(
+                r.admit_time - r.submit_time)
+
+    def _prefill_tick(self):
+        """Spend the per-tick prefill token budget on head-of-line
+        prefilling requests, one chunk at a time — the decode step that
+        follows is stalled by at most ``prefill_token_budget`` tokens
+        of prefill work (chunk-granular), never a whole long prompt."""
+        from ..observability import instrument as obs
+        eng = self.engine
+        budget = self.prefill_token_budget
+        spent = 0
+        while self._prefilling and spent < budget:
+            rid, r = next(iter(self._prefilling.items()))
+            pool = eng.pool
+            t0 = time.perf_counter()
+            if rid not in self._begun:
+                cached = eng.prefill_begin(rid, r.prompt)
+                self._begun.add(rid)
+                r.cached_prefix_len = cached
+                self._reserved_pages -= len(pool.table(rid))
+                if cached:
+                    obs.serving_prefix_hits_counter().inc()
+                    obs.serving_prefix_tokens_reused_counter().inc(
+                        float(cached))
+            processed, done, tok = eng.prefill_step(rid)
+            dt = time.perf_counter() - t0
+            spent += processed
+            r.prefill_s += dt
+            r.prefill_chunks += 1
+            obs.serving_prefill_chunks_counter().inc()
+            obs.record_train_step(dt, tokens=processed,
+                                  path="serving_prefill")
+            if not done:
+                continue
+            del self._prefilling[rid]
+            self._begun.discard(rid)
+            t_done = time.perf_counter()
+            r.tokens.append(tok)
+            r.state = "running"
+            r.first_token_time = t_done
+            self._running[rid] = r
+            if r.trace is not None:
+                r.trace.span("prefill", r.admit_time, t_done,
+                             prompt_len=int(r.prompt.shape[0]),
+                             chunks=r.prefill_chunks,
+                             cached_prefix_len=r.cached_prefix_len)
+            obs.serving_prefill_histogram().observe(r.prefill_s)
+            obs.serving_ttft_histogram().observe(
+                r.first_token_time - r.submit_time)
+            obs.serving_tokens_out_counter().inc()
+            if self.slo is not None:
+                self.slo.observe_admission(
+                    rid, ttft_s=r.first_token_time - r.submit_time,
+                    queue_wait_s=r.admit_time - r.submit_time)
+        if spent:
+            self.prefill_tokens_per_tick.append(spent)
+
     def _admit(self):
         from ..observability import instrument as obs
+        if self.chunked:
+            return self._admit_chunked()
         pool = self.engine.pool
         while self._queue and len(self._running) < self.max_concurrency:
             r = self._queue[0]
             need = self._completion_pages(r)
-            if pool.free_pages - self._reserved_pages < need:
+            if not self._page_room(need):
                 break  # head-of-line: keep arrival order deterministic
             self._queue.popleft()
             r.admit_time = time.perf_counter()
@@ -289,13 +401,15 @@ class ContinuousBatchingScheduler:
         from ..observability import instrument as obs
         self._evict_finished()
         self._admit()
+        if self.chunked:
+            self._prefill_tick()
         obs.serving_queue_depth_gauge().set(float(len(self._queue)))
         obs.serving_kv_pages_gauge().set(
             float(self.engine.pool.pages_in_use))
         # admission may have finished short requests (max_new=1)
         active = [r for r in self._running.values() if not r.done]
         if not active:
-            return bool(self._queue or self._running)
+            return bool(self._queue or self._prefilling or self._running)
         t0 = time.perf_counter()
         # ONE bucket-selection implementation: the engine's (raises
         # EngineShapeError on overflow, same as every other shape gate)
@@ -355,6 +469,7 @@ class ContinuousBatchingScheduler:
                 "ts": time.time(),
                 "uptime_s": round(time.time() - self._start_ts, 3),
                 "queue_depth": len(self._queue),
+                "prefilling": len(self._prefilling),
                 "running": len(self._running),
                 "finished": len(self.finished),
                 "rejected": len(self.rejected),
@@ -386,25 +501,59 @@ class _ShapeProbeEngine:
     """Engine stand-in for :func:`simulate_decode_signatures`: real
     :class:`~.kv_pool.PagePool` bookkeeping and bucket tables, but
     prefill/decode only record the shapes they were asked for. Must
-    mirror the real engine's interface the scheduler touches."""
+    mirror the real engine's interface the scheduler touches — in every
+    mode (classic bucketed, chunked/prefix-cache, disaggregated)."""
 
     def __init__(self, decode_buckets, prefill_buckets, page_size,
-                 num_pages, max_seq_len):
+                 num_pages, max_seq_len, prefill_chunk=None,
+                 disaggregated=False):
         from .kv_pool import PagePool
         self.decode_buckets = tuple(sorted(set(decode_buckets)))
         self.prefill_buckets = tuple(sorted(set(prefill_buckets)))
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        self.disaggregated = bool(disaggregated)
         self.pool = PagePool(num_pages, page_size, num_layers=1,
                              num_kv_heads=1, head_dim=1,
                              max_seq_len=max_seq_len)
         self.decode_signatures_used: set = set()
         self.prefill_signatures_used: set = set()
+        self._chunk_pos: dict = {}
 
     def prefill(self, seq_id, prompt_ids):
         n = int(np.asarray(prompt_ids).reshape(-1).shape[0])
         from .engine import ServingEngine
         sb = ServingEngine.prefill_bucket(self, n)
         self.pool.alloc(seq_id, n)
-        self.prefill_signatures_used.add((1, sb))
+        if self.disaggregated:
+            # prefill program on the prefill mesh + the KV-handoff
+            # scatter landing on the decode mesh — both must stay
+            # inside the per-side bucket sets
+            self.prefill_signatures_used.add(("disagg", sb))
+            self.prefill_signatures_used.add(("scatter", sb))
+        else:
+            self.prefill_signatures_used.add((1, sb))
+        return 0
+
+    # ---- chunked-mode surface the scheduler drives -----------------
+    def prefill_begin(self, seq_id, prompt_ids):
+        n = int(np.asarray(prompt_ids).reshape(-1).shape[0])
+        self.pool.alloc(seq_id, n)
+        self._chunk_pos[seq_id] = [0, n]
+        return 0
+
+    def prefill_step(self, seq_id):
+        pos, n = self._chunk_pos[seq_id]
+        c = min(self.prefill_chunk, n - pos)
+        self.prefill_signatures_used.add(
+            ("chunk", self.prefill_chunk, self.pool.max_pages_per_seq))
+        pos += c
+        self._chunk_pos[seq_id][0] = pos
+        if pos < n:
+            return c, False, None
+        del self._chunk_pos[seq_id]
+        return c, True, 0
+
+    def reclaim_cache_pages(self, n):
         return 0
 
     def prefill_bucket(self, n):  # same lookup the real engine uses
@@ -420,22 +569,28 @@ class _ShapeProbeEngine:
             (int(bucket), self.pool.max_pages_per_seq))
         return [0] * len(seq_ids)
 
-    def release(self, seq_id):
+    def release(self, seq_id, token_ids=None):
         self.pool.free(seq_id)
 
 
 def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
                                num_pages, max_seq_len, n_requests=200,
-                               seed=0, arrival_p=0.35):
+                               seed=0, arrival_p=0.35, prefill_chunk=None,
+                               disaggregated=False):
     """Replay the REAL scheduler over a randomized admission mix (ragged
     prompt lengths, random completion budgets, bursty arrivals) with a
     shape-probe engine. Returns ``(decode_sigs_used, prefill_sigs_used,
     allowed_decode_sigs, allowed_prefill_sigs)`` — the recompile lint
     proves ``used ⊆ allowed``: the AOT bucket set is closed and no
-    request mix can retrace at serving time."""
+    request mix can retrace at serving time. ``prefill_chunk`` /
+    ``disaggregated`` replay the chunked (prefix-cache) and
+    disaggregated engine modes, whose prefill-side program sets differ
+    (one chunk signature; per-bucket prefill + scatter)."""
     rng = np.random.default_rng(seed)
     eng = _ShapeProbeEngine(decode_buckets, prefill_buckets, page_size,
-                            num_pages, max_seq_len)
+                            num_pages, max_seq_len,
+                            prefill_chunk=prefill_chunk,
+                            disaggregated=disaggregated)
     sched = ContinuousBatchingScheduler(eng)
     submitted = 0
     while submitted < n_requests or sched.pending:
@@ -448,6 +603,12 @@ def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
             sched.step()
     pages_per_seq = eng.pool.max_pages_per_seq
     allowed_decode = {(b, pages_per_seq) for b in eng.decode_buckets}
-    allowed_prefill = {(1, sb) for sb in eng.prefill_buckets}
+    if prefill_chunk:
+        allowed_prefill = {("chunk", eng.prefill_chunk, pages_per_seq)}
+    elif disaggregated:
+        allowed_prefill = {("disagg", sb) for sb in eng.prefill_buckets} \
+            | {("scatter", sb) for sb in eng.prefill_buckets}
+    else:
+        allowed_prefill = {(1, sb) for sb in eng.prefill_buckets}
     return (eng.decode_signatures_used, eng.prefill_signatures_used,
             allowed_decode, allowed_prefill)

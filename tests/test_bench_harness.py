@@ -92,6 +92,7 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
     assert {r["metric"] for r in predicted} == {
         "gpt_345m_predicted", "gpt_1p3b_predicted", "gpt_13b_predicted",
         "serving_predicted", "serving_int8_predicted",
+        "serving_shared_prefix_predicted", "serving_disagg_predicted",
         "collective_compression_predicted"}
     for r in predicted:
         if r["metric"] == "collective_compression_predicted":

@@ -1,0 +1,446 @@
+"""Prefix-cache sharing, chunked prefill, and disaggregated serving.
+
+The PR-11 tentpole: the KV page pool as a shared radix cache
+(refcounted pages, COW boundary pages, LRU eviction), chunked prefill
+that bounds per-tick decode stall, and the disaggregated prefill/decode
+split. The load-bearing assertions are token-for-token equivalence —
+every engine mode must reproduce the plain PR-8 engine's greedy outputs
+exactly — and refcount conservation (no leaked or double-freed pages).
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (ContinuousBatchingScheduler, PagePool,
+                                PagePoolError, ServingEngine,
+                                simulate_decode_signatures)
+from paddle_tpu.serving.prefix_cache import (PrefixCache,
+                                             make_shared_prefix_workload)
+
+
+def _tiny_model(seed=0):
+    from paddle_tpu.models.gpt import (GPTForPretraining, GPTModel,
+                                       gpt_tiny_config)
+    paddle.seed(seed)
+    cfg = gpt_tiny_config()
+    return GPTForPretraining(GPTModel(cfg)), cfg
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (s,)).astype(np.int32)
+            for s in lens]
+
+
+def _run(engine, prompts, max_new, budget=None):
+    sched = ContinuousBatchingScheduler(engine,
+                                        prefill_token_budget=budget)
+    reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+    sched.run()
+    assert all(r.state == "finished" for r in reqs), \
+        [r.state for r in reqs]
+    return sched, reqs
+
+
+# ------------------------------------------------------------- pool API
+
+def test_pool_errors_name_the_sequence_and_refcounts():
+    pool = PagePool(num_pages=9, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    with pytest.raises(PagePoolError, match="'ghost'"):
+        pool.free("ghost")
+    with pytest.raises(PagePoolError, match="'ghost'"):
+        pool.extend("ghost")
+    with pytest.raises(PagePoolError, match="'ghost'"):
+        pool.seq_len("ghost")
+    with pytest.raises(PagePoolError, match="'ghost'"):
+        pool.table("ghost")
+    pool.alloc("a", 6)
+    pool.free("a")
+    with pytest.raises(PagePoolError, match="already-freed"):
+        pool.free("a")                          # double free, not KeyError
+    # refcount sharing: two sequences mapping one page
+    pages = pool.alloc("x", 8)                  # 2 full pages
+    pool.alloc_prefixed("y", 10, pages, 8)      # shares both + 1 fresh
+    assert pool.page_ref(pages[0]) == 2
+    assert pool.stats()["pages_shared"] == 2
+    pool.free("x")
+    assert pool.page_ref(pages[0]) == 1         # still held by y
+    pool.free("y")
+    assert pool.page_ref(pages[0]) == 0
+    assert pool.free_pages == 8
+
+
+def test_pool_cow_write_barrier():
+    """extend() refuses to grow a sequence into a shared page — the
+    write path is COW-aware at the pool level, whatever drives it."""
+    pool = PagePool(num_pages=9, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    pages = pool.alloc("a", 8)
+    # b maps a's pages with a PARTIAL boundary page (the engine would
+    # COW this; the pool-level barrier is the backstop)
+    pool.alloc_prefixed("b", 7, pages, 7)
+    with pytest.raises(PagePoolError, match="shared page"):
+        pool.extend("b", 1)                     # would write page 1 @ref 2
+    pool.free("a")                              # ref drops to 1 (b only)
+    assert pool.page_ref(pages[1]) == 1
+    assert pool.extend("b", 1) == 8             # now exclusive: writable
+
+
+def test_pool_stats_new_fields_default_zero():
+    pool = PagePool(num_pages=5, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    st = pool.stats()
+    assert st["pages_shared"] == 0
+    assert st["tokens_reused"] == 0
+    assert st["prefix_hit_rate"] == 0.0
+
+
+# ------------------------------------------------------- trie unit tests
+
+def test_prefix_cache_trie_match_insert_evict():
+    pool = PagePool(num_pages=17, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    cache = PrefixCache(pool)
+    toks = np.arange(12, dtype=np.int32)        # 3 full pages
+    pages = pool.alloc("s", 12)
+    assert cache.insert(toks, pages) == 3
+    assert pool.page_ref(pages[0]) == 2         # seq + trie
+    nodes, boundary, cached = cache.match(np.arange(12, dtype=np.int32))
+    assert cached == 11                          # capped at len-1
+    assert len(nodes) == 2 and boundary is not None
+    assert boundary[1] == 3                      # partial page 3 rows
+    # divergent prompt: full match on page 0, partial on page 1
+    div = np.arange(12, dtype=np.int32)
+    div[6] = 99
+    nodes, boundary, cached = cache.match(div)
+    assert len(nodes) == 1 and cached == 6 and boundary[1] == 2
+    # miss
+    nodes, boundary, cached = cache.match(
+        np.full(8, 77, np.int32))
+    assert not nodes and boundary is None and cached == 0
+    # eviction: free the seq, then reclaim — LRU leaves go first and
+    # pages actually return to the free list
+    pool.free("s")
+    free0 = pool.free_pages
+    assert cache.reclaim(2) == 2
+    assert pool.free_pages == free0 + 2
+    assert cache.stats()["nodes"] == 1
+    cache.clear()
+    assert cache.stats()["nodes"] == 0
+    assert pool.free_pages == free0 + 3
+
+
+def test_prefix_cache_pinned_nodes_survive_reclaim():
+    pool = PagePool(num_pages=9, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    cache = PrefixCache(pool)
+    toks = np.arange(8, dtype=np.int32)
+    pages = pool.alloc("s", 8)
+    cache.insert(toks, pages)
+    pool.free("s")
+    nodes, boundary, cached = cache.match(
+        np.concatenate([toks, [1, 2]]).astype(np.int32))
+    cache.map_into("t", nodes, boundary)
+    assert cache.reclaim(10) == 0               # everything pinned
+    cache.release("t")
+    assert cache.reclaim(10) == 2               # now evictable
+
+
+# --------------------------------------------------------- equivalence
+
+def test_shared_prefix_scheduler_equivalence_on_off():
+    """The satellite acceptance: greedy outputs with prefix cache ON ==
+    OFF, token for token, over a shared-prefix workload including a
+    mid-page (COW-boundary) divergence — and the pool proves reuse."""
+    model, cfg = _tiny_model()
+    prompts = make_shared_prefix_workload(
+        cfg.vocab_size, 6, prefix_len=24, suffix_len=6, seed=3,
+        divergence_offsets=(0, 0, 0, 5, 0, 0))  # req 3 diverges mid-page
+    eng_off = ServingEngine(model, page_size=8,
+                            decode_buckets=(1, 2, 4, 8), aot=False)
+    eng_on = ServingEngine(model, page_size=8,
+                           decode_buckets=(1, 2, 4, 8), aot=False,
+                           prefix_cache=True, prefill_chunk=16)
+    _, r_off = _run(eng_off, prompts, max_new=5)
+    s_on, r_on = _run(eng_on, prompts, max_new=5)
+    for a, b in zip(r_off, r_on):
+        np.testing.assert_array_equal(a.output_ids, b.output_ids)
+    cached = [r.cached_prefix_len for r in r_on]
+    assert cached[0] == 0                        # first = cold miss
+    assert cached[1] == 24 and cached[2] == 24   # full-prefix hits
+    assert cached[3] == 19                       # COW: 16 full + 3 partial
+    st = eng_on.pool.stats()
+    assert st["tokens_reused"] == sum(cached)
+    assert st["prefix_hit_rate"] > 0.5
+    # refcount conservation after drain: only the trie holds pages
+    assert eng_on.pool.live_sequences == 0
+    assert all(c == 1 for c in eng_on.pool._refs.values())
+    # summaries carry the reuse fields
+    s = r_on[3].summary()
+    assert s["cached_prefix_len"] == 19 and s["prefill_chunks"] >= 1
+
+
+def test_prefix_sharing_happens_in_flight():
+    """Same-prefix requests admitted in one wave share pages while
+    running (pages_shared > 0 mid-flight), not just sequentially."""
+    model, cfg = _tiny_model(seed=1)
+    prompts = make_shared_prefix_workload(
+        cfg.vocab_size, 5, prefix_len=24, suffix_len=6, seed=4)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2, 4, 8),
+                        aot=False, prefix_cache=True, prefill_chunk=16)
+    sched = ContinuousBatchingScheduler(eng)
+    for p in prompts:
+        sched.submit(p, max_new_tokens=4)
+    max_shared = 0
+    while sched.pending:
+        sched.step()
+        max_shared = max(max_shared, eng.pool.stats()["pages_shared"])
+    assert max_shared > 0
+
+
+def test_prefix_cache_eviction_under_page_pressure():
+    """A pool too small for cache + new work reclaims cached pages
+    (LRU) instead of refusing admission — and outputs stay correct."""
+    model, cfg = _tiny_model(seed=2)
+    kw = dict(page_size=8, num_pages=9, max_seq_len=48,
+              decode_buckets=(1,), aot=False)
+    eng = ServingEngine(model, prefix_cache=True, prefill_chunk=8, **kw)
+    sched = ContinuousBatchingScheduler(eng)
+    pa, pb = _prompts(cfg, (24, 40), seed=7)
+    ra = sched.submit(pa, max_new_tokens=4)
+    sched.run()
+    assert eng.prefix_cache.stats()["nodes"] > 0
+    rb = sched.submit(pb, max_new_tokens=6)     # needs reclaimed pages
+    sched.run()
+    assert rb.state == "finished"
+    assert eng.prefix_cache.evictions > 0
+    plain = ServingEngine(model, **kw)
+    ps = ContinuousBatchingScheduler(plain)
+    xa = ps.submit(pa, max_new_tokens=4); ps.run()
+    xb = ps.submit(pb, max_new_tokens=6); ps.run()
+    np.testing.assert_array_equal(ra.output_ids, xa.output_ids)
+    np.testing.assert_array_equal(rb.output_ids, xb.output_ids)
+
+
+def test_multi_turn_release_insert_enables_followup_hits():
+    """Insert-on-release covers generated tokens: a follow-up turn
+    whose prompt extends (prompt + completion) hits the cache."""
+    model, cfg = _tiny_model(seed=3)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                        aot=False, prefix_cache=True, prefill_chunk=8)
+    sched = ContinuousBatchingScheduler(eng)
+    (p1,) = _prompts(cfg, (16,), seed=8)
+    r1 = sched.submit(p1, max_new_tokens=9)
+    sched.run()
+    # next turn: history = prompt + ALL generated tokens + new user turn
+    follow = np.concatenate(
+        [p1, np.asarray(r1.tokens, np.int32),
+         _prompts(cfg, (4,), seed=9)[0]])
+    r2 = sched.submit(follow, max_new_tokens=3)
+    sched.run()
+    # KV exists for prompt+tokens[:-1] = 24 tokens = 3 full pages
+    assert r2.cached_prefix_len >= 24
+    plain = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                          aot=False)
+    ps = ContinuousBatchingScheduler(plain)
+    y = ps.submit(follow, max_new_tokens=3)
+    ps.run()
+    np.testing.assert_array_equal(r2.output_ids, y.output_ids)
+
+
+# ------------------------------------------------------ chunked prefill
+
+def test_chunked_prefill_equivalence_and_stall_bound():
+    """Chunked engine == unchunked engine token for token; per-tick
+    prefill work never exceeds the budget; and decode PROGRESSES while
+    a long prompt is prefilling (the stall bound, deterministically)."""
+    model, cfg = _tiny_model(seed=4)
+    rng = np.random.default_rng(11)
+    short = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    llong = rng.integers(0, cfg.vocab_size, (60,)).astype(np.int32)
+
+    def drive(engine):
+        sched = ContinuousBatchingScheduler(engine)
+        r_s = sched.submit(short, max_new_tokens=20)
+        sched.step(); sched.step()
+        toks0 = len(r_s.tokens)
+        r_l = sched.submit(llong, max_new_tokens=2)
+        during = []
+        while sched.pending:
+            sched.step()
+            if r_l.state == "prefilling":
+                during.append(len(r_s.tokens))
+        return sched, r_s, r_l, toks0, during
+
+    chunked = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                            aot=False, prefill_chunk=8)
+    s_c, rc_s, rc_l, toks0, during = drive(chunked)
+    assert max(s_c.prefill_tokens_per_tick) <= 8  # budget bound
+    # the long prompt spanned multiple ticks AND decode moved meanwhile
+    assert during and during[-1] > toks0
+    plain = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                          aot=False)
+    _, rp_s, rp_l, _, _ = drive(plain)
+    np.testing.assert_array_equal(rc_s.output_ids, rp_s.output_ids)
+    np.testing.assert_array_equal(rc_l.output_ids, rp_l.output_ids)
+
+
+def test_chunked_engine_validation_and_direct_prefill():
+    model, _ = _tiny_model(seed=5)
+    with pytest.raises(ValueError, match="multiple of"):
+        ServingEngine(model, page_size=8, prefill_chunk=12, aot=False)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1,),
+                        aot=False, prefill_chunk=16)
+    # engine.prefill() drives chunks internally for non-scheduler users
+    tok = eng.prefill("a", np.zeros(20, np.int32))
+    plain = ServingEngine(model, page_size=8, decode_buckets=(1,),
+                          aot=False)
+    assert tok == plain.prefill("a", np.zeros(20, np.int32))
+
+
+def test_chunked_aot_single_program_closure():
+    """AOT chunked engine compiles ONE chunk program and serves any
+    mix without growing the executable set (never recompiles)."""
+    model, cfg = _tiny_model(seed=6)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                        aot=True, prefix_cache=True, prefill_chunk=16)
+    assert eng._chunk_exe is not None
+    assert not eng._prefill_exe                 # replaced by the chunk
+    n_dec = len(eng._decode_exe)
+    compile_s0 = eng.compile_s
+    _run(eng, _prompts(cfg, (3, 21, 9, 40), seed=12), max_new=3)
+    assert len(eng._decode_exe) == n_dec
+    assert eng._chunk_exe is not None and eng.compile_s == compile_s0
+    assert ("chunk", 16, eng.pool.max_pages_per_seq) \
+        in eng.prefill_signatures()
+
+
+# -------------------------------------------------------- disaggregated
+
+def test_disaggregated_engine_equivalence_and_handoff():
+    model, cfg = _tiny_model(seed=7)
+    prompts = _prompts(cfg, (7, 13, 30), seed=13)
+    plain = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                          aot=False)
+    disagg = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                           aot=False, disaggregated=True)
+    _, r_p = _run(plain, prompts, max_new=4)
+    _, r_d = _run(disagg, prompts, max_new=4)
+    for a, b in zip(r_p, r_d):
+        np.testing.assert_array_equal(a.output_ids, b.output_ids)
+    assert disagg.kv_transfers == len(prompts)
+    assert disagg.kv_transfer_bytes > 0
+    st = disagg.status()["disaggregated"]
+    assert st["kv_transfers"] == 3 and st["kv_transfer_mb"] > 0
+    sigs = disagg.prefill_signatures()
+    assert any(s[0] == "disagg" for s in sigs)
+    assert any(s[0] == "scatter" for s in sigs)
+
+
+def test_disaggregated_aot_cross_device():
+    """AOT executables must compile FOR each side's device: under the
+    8-device test mesh, prefill lands on device 0 and decode on device
+    7 — a default-device compile would reject the committed pool
+    arrays at the first decode (the exact multi-topology crash the
+    single-device smoke can't see)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    model, cfg = _tiny_model(seed=10)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                        prefill_buckets=(16, 128), aot=True,
+                        disaggregated=True)
+    st = eng.status()["disaggregated"]
+    assert st["prefill_device"] != st["decode_device"]
+    plain = ServingEngine(model, page_size=8, decode_buckets=(1, 2),
+                          aot=False)
+    prompts = _prompts(cfg, (7, 13), seed=14)
+    _, r_d = _run(eng, prompts, max_new=4)
+    _, r_p = _run(plain, prompts, max_new=4)
+    for a, b in zip(r_d, r_p):
+        np.testing.assert_array_equal(a.output_ids, b.output_ids)
+    # transfer accounting books the TRUE payload (prompt positions),
+    # not the bucket-padded tensor
+    L, nkv, d = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    assert eng.kv_transfer_bytes == 2 * L * (7 + 13) * nkv * d * 4
+
+
+def test_disaggregated_rejects_prefix_cache():
+    model, _ = _tiny_model(seed=8)
+    with pytest.raises(ValueError, match="disaggregated"):
+        ServingEngine(model, page_size=8, disaggregated=True,
+                      prefix_cache=True, aot=False)
+
+
+# ------------------------------------------------- closure + metrics
+
+def test_closure_simulation_all_modes():
+    """used ⊆ allowed for classic, chunked, and disaggregated modes —
+    what the check_program serving gate replays."""
+    for kw in (dict(), dict(prefill_chunk=16), dict(disaggregated=True)):
+        ud, up, okd, okp = simulate_decode_signatures(
+            (1, 2, 4), (8, 16, 32, 64, 128), 8, 129, 128,
+            n_requests=80, seed=1, **kw)
+        assert ud and ud <= okd, (kw, ud, okd)
+        assert up and up <= okp, (kw, up, okp)
+
+
+def test_prefix_metrics_and_request_records():
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.observability.reqtrace import fold_request_records
+    model, cfg = _tiny_model(seed=9)
+    reg = get_registry()
+
+    def val(name):
+        inst = reg.get(name)
+        if inst is None:
+            return 0.0
+        return sum(state.get("value", state.get("count", 0.0))
+                   for _, state in inst.collect())
+
+    hits0 = val("paddle_serving_prefix_cache_hits_total")
+    reused0 = val("paddle_serving_prefix_tokens_reused_total")
+    chunks0 = val("paddle_serving_prefill_chunks_total")
+    prompts = make_shared_prefix_workload(
+        cfg.vocab_size, 4, prefix_len=16, suffix_len=8, seed=5)
+    eng = ServingEngine(model, page_size=8, decode_buckets=(1, 2, 4),
+                        aot=False, prefix_cache=True, prefill_chunk=8)
+    _, reqs = _run(eng, prompts, max_new=3)
+    assert val("paddle_serving_prefix_cache_hits_total") >= hits0 + 3
+    assert val("paddle_serving_prefix_tokens_reused_total") \
+        >= reused0 + 3 * 16
+    assert val("paddle_serving_prefill_chunks_total") > chunks0
+    # requests.jsonl folding: skipped prefill work is accounted
+    folded = fold_request_records([r.summary() | {"event": "request"}
+                                   for r in reqs])
+    assert folded["cached_prefix_tokens_total"] == sum(
+        r.cached_prefix_len for r in reqs)
+    assert folded["prefix_hit_requests"] == 3
+    assert folded["prefill_chunks_total"] >= 4
+    # /status carries the new pool fields + prefix cache section
+    sched = ContinuousBatchingScheduler(eng)
+    st = sched.status()
+    assert "prefix_hit_rate" in st["kv_pool"]
+    assert "tokens_reused" in st["kv_pool"]
+    assert "prefilling" in st
+    assert "prefix_cache" in st["engine"]
+
+
+def test_predicted_shared_prefix_and_disagg_rows():
+    from paddle_tpu.serving.predict import (predicted_disagg_row,
+                                            predicted_shared_prefix_row)
+    row = predicted_shared_prefix_row("tiny", concurrency=4,
+                                      prompt_len=64,
+                                      shared_fraction=0.75, max_new=8,
+                                      prefill_chunk=16, page_size=8)
+    assert row["predicted_tokens_per_sec"] > 0
+    assert row["predicted_tokens_per_sec"] \
+        > row["predicted_tokens_per_sec_no_cache"]
+    assert row["predicted_ttft_speedup"] > 1
+    assert row["predicted_tokens_reused"] == 3 * 48
+    d = predicted_disagg_row("tiny", concurrency=4, prompt_len=48,
+                             page_size=8)
+    assert d["predicted_tokens_per_sec"] > 0
+    assert d["predicted_ttft_ms"] >= d["predicted_prefill_ms"]
+    assert d["predicted_kv_transfer_mb"] > 0

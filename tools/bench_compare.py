@@ -84,6 +84,8 @@ def _lower_is_better(metric, row):
 _ANCHOR_MAP = {
     "serving_engine_tokens_per_sec": "serving_predicted",
     "serving_engine_int8_tokens_per_sec": "serving_int8_predicted",
+    "serving_shared_prefix": "serving_shared_prefix_predicted",
+    "serving_disagg": "serving_disagg_predicted",
     "collective_compression": "collective_compression_predicted",
 }
 

@@ -172,7 +172,7 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
                                        gpt_tiny_config)
     from paddle_tpu.ops._dispatch import unwrap
     from paddle_tpu.serving import ServingEngine, simulate_decode_signatures
-    from paddle_tpu.serving.engine import decode_step_fn
+    from paddle_tpu.serving.engine import chunk_prefill_fn, decode_step_fn
     import functools
 
     paddle.seed(0)
@@ -203,32 +203,85 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
         jax.ShapeDtypeStruct((bucket,), i32),
         name="serving.decode_step")]
 
-    used_d, used_p, ok_d, ok_p = simulate_decode_signatures(
-        eng.decode_buckets, eng.prefill_buckets, pool.page_size,
-        pool.num_pages, eng.max_seq_len, n_requests=200, seed=0)
     diags = []
-    if ok_d != eng.decode_signatures():
-        # the closure proof is only a proof if the probe's allowed set
-        # IS the set the real engine AOT-compiles
-        diags.append(Diagnostic(
-            "PTRC002", "recompile", "error",
-            f"shape-probe allowed set {sorted(ok_d)} drifted from the "
-            f"engine's AOT decode signatures "
-            f"{sorted(eng.decode_signatures())}",
-            op="serving.decode"))
-    for used, ok, what in ((used_d, ok_d, "decode"),
-                           (used_p, ok_p, "prefill")):
-        escaped = sorted(used - ok)
-        if escaped:
+    # the closure proof runs once per ENGINE MODE — the classic
+    # bucketed engine, the chunked/prefix-cache engine (whose prefill
+    # side is ONE traced-offset chunk program), and the disaggregated
+    # engine (per-bucket prefill programs on the prefill mesh + scatter
+    # landings on the decode mesh). Each mode's allowed set must match
+    # what the real engine would AOT-compile, and every signature the
+    # real scheduler requests must fall inside it.
+    chunk = eng.prefill_buckets[0]
+    modes = {
+        "classic": (dict(), eng),
+        "chunked": (dict(prefill_chunk=chunk),
+                    ServingEngine(model, page_size=8,
+                                  decode_buckets=(1, 2, 4),
+                                  prefill_chunk=chunk, aot=False)),
+        "disagg": (dict(disaggregated=True),
+                   ServingEngine(model, page_size=8,
+                                 decode_buckets=(1, 2, 4),
+                                 disaggregated=True, aot=False)),
+    }
+    for mode, (sim_kw, mode_eng) in modes.items():
+        used_d, used_p, ok_d, ok_p = simulate_decode_signatures(
+            mode_eng.decode_buckets, mode_eng.prefill_buckets,
+            mode_eng.pool.page_size, mode_eng.pool.num_pages,
+            mode_eng.max_seq_len, n_requests=200, seed=0, **sim_kw)
+        if ok_d != mode_eng.decode_signatures():
+            # the closure proof is only a proof if the probe's allowed
+            # set IS the set the real engine AOT-compiles
             diags.append(Diagnostic(
                 "PTRC002", "recompile", "error",
-                f"serving {what} requested shape(s) {escaped} outside "
-                f"the AOT bucket set {sorted(ok)} — every such shape "
-                f"retraces at serving time; widen the bucket config",
-                op=f"serving.{what}"))
+                f"[{mode}] shape-probe allowed set {sorted(ok_d)} "
+                f"drifted from the engine's AOT decode signatures "
+                f"{sorted(mode_eng.decode_signatures())}",
+                op="serving.decode"))
+        if ok_p != mode_eng.prefill_signatures():
+            diags.append(Diagnostic(
+                "PTRC002", "recompile", "error",
+                f"[{mode}] shape-probe allowed prefill set "
+                f"{sorted(ok_p, key=str)} drifted from the engine's "
+                f"AOT prefill signatures "
+                f"{sorted(mode_eng.prefill_signatures(), key=str)}",
+                op="serving.prefill"))
+        for used, ok, what in ((used_d, ok_d, "decode"),
+                               (used_p, ok_p, "prefill")):
+            escaped = sorted(used - ok, key=str)
+            if escaped:
+                diags.append(Diagnostic(
+                    "PTRC002", "recompile", "error",
+                    f"[{mode}] serving {what} requested shape(s) "
+                    f"{escaped} outside the AOT bucket set "
+                    f"{sorted(ok, key=str)} — every such shape "
+                    f"retraces at serving time; widen the bucket "
+                    f"config", op=f"serving.{what}"))
     rep = Report("serving.decode_buckets", diags)
     rep.emit()
     reports.append(rep)
+
+    # the chunk program itself through the pass suite (abstract): it is
+    # the only NEW serving-side program shape this engine family runs
+    ceng = modes["chunked"][1]
+    cpool = ceng.pool
+    cfn = functools.partial(chunk_prefill_fn, eps=cfg.layer_norm_epsilon,
+                            temperature=0.0, top_k=0)
+
+    def chunk_step(kp, vp, ids, off, clen, table, rows):
+        a = [unwrap(t) for t in (kp, vp, ids, off, clen, table, rows)]
+        return cfn(ceng.params, *a, None)
+
+    ckp = jax.ShapeDtypeStruct(cpool.k_pages.shape, cpool.k_pages.dtype)
+    C = ceng.prefill_chunk
+    reports.append(ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
+        chunk_step, ckp, ckp,
+        jax.ShapeDtypeStruct((1, C), i32),
+        jax.ShapeDtypeStruct((), i32),
+        jax.ShapeDtypeStruct((), i32),
+        jax.ShapeDtypeStruct((1, cpool.max_pages_per_seq), i32),
+        jax.ShapeDtypeStruct((C,), i32),
+        name="serving.chunk_prefill"))
     return reports
 
 
