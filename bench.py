@@ -156,6 +156,58 @@ def emit_predicted_rows(configs=("345m", "1.3b", "13b"), timeout_s=420):
                           "extras": {"returncode": r.returncode,
                                      "stderr": r.stderr[-300:]}}),
               flush=True)
+    if "13b" in configs:
+        emit_planned_predicted_row()
+
+
+def emit_planned_predicted_row(devices=16, timeout_s=300):
+    """``gpt_13b_planned_predicted``: the parallelism planner's best 13B
+    config priced by the SAME cost model as the hand-written
+    ``gpt_13b_predicted`` anchor beside it — the two rows together show
+    what the cost-model search buys over the hand config (predicted
+    MFU), and ``planner_s`` makes plan-time regressions visible.
+    Shelled out to ``tools/plan.py --json`` (trace-only on a virtual
+    mesh) so a wedged backend can't take the row down."""
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    metric = "gpt_13b_planned_predicted"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "plan.py"),
+             "--model", "gpt_13b", "--devices", str(devices),
+             "--chip", "v5e", "--json"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=repo)
+        doc = json.loads(r.stdout.splitlines()[-1])
+        best = doc.get("best")
+        if not best:  # plan.py exits 0 with best=null when nothing fits
+            raise RuntimeError(
+                f"planner found no feasible plan "
+                f"({doc.get('n_pruned', '?')} pruned)")
+    except Exception as e:
+        print(json.dumps({"metric": f"{metric}_ERROR", "value": 0.0,
+                          "unit": "error", "vs_baseline": 0.0,
+                          "extras": {"error": repr(e)[:300]}}), flush=True)
+        return
+    print(json.dumps({
+        "metric": metric,
+        "value": best["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip (static cost model, planner's best)",
+        "vs_baseline": 0.0,
+        "extras": {
+            "mesh": best["mesh"], "n_micro": best["n_micro"],
+            "remat": best["remat"], "wire_dtype": best["wire_dtype"],
+            "pipeline_schedule": best["pipeline_schedule"],
+            "predicted_step_ms": best["step_ms"],
+            "predicted_mfu": best["predicted_mfu"],
+            "predicted_peak_hbm_gb": best["peak_hbm_gb"],
+            "predicted_bound": best["bound"],
+            "batch": best["global_batch"], "seq": best["seq_len"],
+            "n_devices": best["n_devices"],
+            "chip_assumed": best["chip"],
+            "planner_s": doc["planner_s"],
+            "n_candidates": doc["n_candidates"],
+            "n_traced": doc["n_traced"],
+        }}), flush=True)
 
 
 class _PerModelTimeout(Exception):

@@ -85,6 +85,12 @@ Surfaces::
     python -m paddle_tpu.analysis.predict     # bench-config *_predicted rows
     python tools/mem_probe.py --compare-static --compute-dtype float32
 
+    python tools/plan.py --model gpt_13b --devices 64   # the cost model as a
+    # DECISION-MAKER: distributed/auto_parallel/planner.py sweeps (dp, mp,
+    # pp, sharding, n_micro, remat, donation, wire dtype), prunes with the
+    # memory pass (PTMM001 = infeasible) and ranks by this package's
+    # roofline — see README "Auto-parallel planner"
+
 Findings are emitted as ``analysis_diagnostic`` runlog events and the
 ``paddle_analysis_diagnostics_total`` counter; cost/memory rollups land
 on the ``paddle_analysis_predicted_{step_ms,peak_hbm_mb,mfu}`` gauges

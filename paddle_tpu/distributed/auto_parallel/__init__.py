@@ -11,10 +11,14 @@ IS the GSPMD partitioner inside XLA. A ``shard_tensor`` annotation becomes a
 happen in the compiler. What remains here is the thin user surface.
 """
 from .interface import ProcessMesh, shard_tensor, shard_op  # noqa: F401
-from .engine import Engine  # noqa: F401
+from .engine import Engine, match_partition_rules  # noqa: F401
 from .cost_model import (  # noqa: F401
     Cluster, Cost, CostEstimator, ModelSpec,
 )
 from .tuner import (  # noqa: F401
     OptimizationTuner, ParallelTuner, Trial, TrialStatus, TunableSpace,
+)
+from .planner import (  # noqa: F401
+    Plan, PlanReport, Planner, plan_gpt, plan_serving, price_config,
+    virtual_hcg,
 )

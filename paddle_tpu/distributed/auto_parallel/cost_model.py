@@ -27,28 +27,43 @@ class Cluster:
     """One slice of TPU hardware (reference Cluster JSON topology).
 
     Bandwidths in bytes/s, flops in FLOP/s, memory in bytes — per chip.
+    Chip numbers come from ``observability.instrument.chip_specs()``
+    (:meth:`from_chip`) — ONE chip table shared with the trace-based
+    cost pass and the MFU gauge, so the closed-form pre-ranker and the
+    authoritative jaxpr model can never drift apart and mis-rank plans.
+    ``ici_bandwidth`` is the per-chip aggregate interconnect bandwidth
+    (the same number the ring collective model divides by);
+    ``dcn_bandwidth`` is Cluster-only (chip_specs has no multi-slice
+    entry).
     """
 
     num_devices: int
     peak_flops: float = 197e12          # bf16 v5e default
     hbm_bandwidth: float = 819e9
     hbm_bytes: float = 16e9
-    ici_bandwidth: float = 4.5e10       # per-link, one direction
+    ici_bandwidth: float = 186e9        # per-chip aggregate
     dcn_bandwidth: float = 6.25e9
     devices_per_host: int = 4
     name: str = "tpu"
 
     @classmethod
+    def from_chip(cls, kind, num_devices, devices_per_host=4):
+        """Build from the shared ``chip_specs()`` roofline table."""
+        from ...observability.instrument import chip_specs
+        s = chip_specs(kind)
+        return cls(num_devices, peak_flops=s["peak_flops"],
+                   hbm_bandwidth=s["hbm_bw"],
+                   hbm_bytes=s["hbm_gb"] * 1024 ** 3,
+                   ici_bandwidth=s["ici_bw"],
+                   devices_per_host=devices_per_host, name=s["name"])
+
+    @classmethod
     def v5e(cls, num_devices):
-        return cls(num_devices, peak_flops=197e12, hbm_bandwidth=819e9,
-                   hbm_bytes=16e9, ici_bandwidth=4.5e10,
-                   devices_per_host=4, name="v5e")
+        return cls.from_chip("v5e", num_devices)
 
     @classmethod
     def v5p(cls, num_devices):
-        return cls(num_devices, peak_flops=459e12, hbm_bandwidth=2765e9,
-                   hbm_bytes=95e9, ici_bandwidth=9e10,
-                   devices_per_host=4, name="v5p")
+        return cls.from_chip("v5p", num_devices)
 
     def link_bandwidth(self, world):
         """ICI within a slice; DCN once an axis spans more chips than the
@@ -108,7 +123,15 @@ class CostEstimator:
     dict {dp, mp, pp, sharding, micro_batches, global_batch,
     recompute}."""
 
-    MFU_CAP = 0.6       # attainable fraction of peak on dense matmuls
+    # attainable fraction of peak on dense matmuls: the SAME sustained-
+    # MXU efficiency the jaxpr cost model uses (one constant — see
+    # analysis/passes/cost.py MXU_EFFICIENCY, calibrated against the
+    # measured bench rows), so closed-form pre-ranking and trace-based
+    # scoring sit on one roofline
+    try:
+        from ...analysis.passes.cost import MXU_EFFICIENCY as MFU_CAP
+    except ImportError:  # pragma: no cover - circular-import guard
+        MFU_CAP = 0.55
     COMM_EFF = 0.8      # achievable fraction of link bandwidth
     OVERLAP = 0.5       # fraction of compute the dp grad sync hides under
 
@@ -145,6 +168,26 @@ class CostEstimator:
         batch_tokens = st["global_batch"] * s.seq_len
         comp = s.step_flops(batch_tokens) / world / (
             c.peak_flops * self.MFU_CAP)
+        # HBM roofline (the term the jaxpr model prices exactly): the
+        # step streams its weight/optimizer shard once-ish and the
+        # activations a few times per layer — small or heavily-sharded
+        # models are HBM-bound, not FLOPs-bound, and a pre-rank blind
+        # to that mis-orders the planner's trace budget
+        param_shard = s.n_params / (st["mp"] * st["pp"])
+        w_traffic = param_shard * (
+            2 * s.param_bytes
+            + 2 * s.optimizer_state_per_param / max(st["sharding"], 1))
+        # activations stream at full width within an mp group (the
+        # block input is replicated; only weights and heads shard) and
+        # the SPMD pipeline schedule's full-batch carry buffers cancel
+        # pp's per-stage saving, so act traffic divides over
+        # dp/sharding only — matching what the jaxpr model measures on
+        # the real schedule
+        replica_tokens = batch_tokens / (st["dp"] * max(st["sharding"], 1))
+        act_traffic = (replica_tokens * s.hidden * s.dtype_bytes
+                       * s.layers * 8)
+        t_hbm = (w_traffic + act_traffic) / c.hbm_bandwidth
+        comp = max(comp, t_hbm)
 
         eff_bw = c.link_bandwidth(world) * self.COMM_EFF
         param_shard_bytes = (s.n_params / (st["mp"] * st["pp"])
@@ -172,12 +215,20 @@ class CostEstimator:
         # hiding); only the excess beyond OVERLAP*compute is exposed
         t_dp_exposed = max(0.0, t_dp - comp_total * self.OVERLAP)
         total = comp_total + t_dp_exposed + t_mp
+        # full-overlap roofline: max(compute-or-HBM stretched by bubble
+        # and recompute, total wire time) — the closest closed-form
+        # analog of the jaxpr model's max() verdict; the planner
+        # pre-ranks on THIS, while time_ms keeps the legacy
+        # partial-overlap semantics
+        roofline = max(comp_total, t_dp + t_mp)
         return total * 1e3, {
             "compute_ms": comp * 1e3,
+            "hbm_ms": t_hbm * 1e3,
             "bubble_ms": comp * bubble * 1e3,
             "dp_comm_ms": t_dp * 1e3,
             "dp_comm_exposed_ms": t_dp_exposed * 1e3,
             "mp_comm_ms": t_mp * 1e3,
+            "roofline_ms": roofline * 1e3,
         }
 
     def estimate(self, strategy) -> Cost:

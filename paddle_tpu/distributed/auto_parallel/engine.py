@@ -3,9 +3,14 @@
 Parity: ``/root/reference/python/paddle/distributed/auto_parallel/engine.py``
 (:122 Engine; fit :807 → _build :514 → Planner/Parallelizer/_initialize).
 The reference plans a distributed program by propagating user ``shard_tensor``
-annotations and rewriting per rank; here the same flow is: user annotations →
-parameter ``sharding_spec`` / data shardings → one pjit-compiled train step
-(GSPMD does the planning). The fit/evaluate/predict loop shape mirrors hapi.
+annotations and rewriting per rank; here the same flow is: user annotations
+(+ optional fmengine-style regex partition rules, + an optional planner
+:class:`~.planner.Plan`) → parameter PartitionSpecs → ONE pjit-compiled,
+donated train step (:class:`...fleet.train_step.ParallelTrainStep` — GSPMD
+does the partitioning). ``fit`` runs that compiled step per batch; the
+eager per-batch ``_step`` survives only as the fallback for models/
+optimizers the compiled path cannot consume (no loss, no jit-able
+optimizer, label-less batches).
 """
 from __future__ import annotations
 
@@ -23,11 +28,55 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def match_partition_rules(rules, named_params, mesh):
+    """fmengine-style regex partition rules: the first ``(pattern,
+    spec)`` whose pattern ``re.search``-matches the parameter name wins.
+    Scalars/1-element tensors and unmatched parameters stay replicated
+    (friendlier than fmengine's raise — annotate-what-you-shard).
+    A matched axis is dropped (replicated) when the mesh lacks it or
+    the dim doesn't divide it, so a rule set written for a big mesh
+    degrades cleanly on a small one. Returns ``{name: PartitionSpec}``."""
+    import re
+    from jax.sharding import PartitionSpec as P
+
+    axis_sizes = dict(mesh.shape) if mesh is not None else {}
+
+    def to_spec(spec, shape):
+        parts = list(spec)[: len(shape)]
+        parts += [None] * (len(shape) - len(parts))
+        out = []
+        for part, dim in zip(parts, shape):
+            axes = part if isinstance(part, tuple) else (part,)
+            n = 1
+            for a in axes:
+                if a is None:
+                    continue
+                if a not in axis_sizes:
+                    n = 0
+                    break
+                n *= int(axis_sizes[a])
+            out.append(part if n and dim % n == 0 else None)
+        return P(*out)
+
+    specs = {}
+    for name, p in named_params:
+        if not p.shape or int(np.prod(p.shape)) <= 1:
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                specs[name] = to_spec(spec, p.shape)
+                break
+    return specs
+
+
 class Engine:
     """Engine(model, loss, optimizer, metrics, strategy).
 
-    ``strategy`` accepts the fleet DistributedStrategy (auto-parallel configs
-    are realized by GSPMD; the object is stored for parity/introspection).
+    ``strategy`` accepts the fleet DistributedStrategy (auto-parallel
+    configs are realized by GSPMD; the object is stored for parity/
+    introspection). ``fit`` runs a pjit-compiled planned step (see
+    :meth:`prepare`); pass ``parallel=False`` to ``prepare`` to force
+    the eager per-batch loop.
     """
 
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
@@ -38,22 +87,172 @@ class Engine:
         self._metrics = _to_list(metrics)
         self._strategy = strategy
         self._mesh: ProcessMesh | None = None
+        self._hcg = None
+        self._plan = None
+        self._partition_rules = None
+        self._parallel = None          # None=auto, True/False=forced
+        self._parallel_step = None     # built ParallelTrainStep
+        self._rule_applied = {}        # id(param) -> rule-derived spec
         self.history = None
 
-    # the reference auto-discovers the mesh from annotations; allow explicit
+    # ------------------------------------------------------------- prepare
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
-                mesh: ProcessMesh = None):
+                mesh: ProcessMesh = None, plan=None, partition_rules=None,
+                parallel=None):
+        """Plan the distributed program.
+
+        - ``mesh``: explicit :class:`ProcessMesh` (user ``shard_tensor``
+          annotations refer to its dims); becomes the global mesh.
+        - ``plan``: a :class:`~.planner.Plan` (or mesh-degrees dict)
+          from the cost-model planner — builds the hybrid
+          (dp/mp/pp/sharding) mesh over the real devices and the
+          compiled step runs on it with the plan's donation choice.
+          The plan's ``n_micro``/``remat``/``wire_dtype`` dimensions
+          belong to the GPT hybrid step the planner traced
+          (``GPTHybridTrainStep``); the generic compiled step here
+          executes mesh + donation and warns when a plan carries the
+          other dimensions, since its memory profile then differs
+          from the plan's prediction.
+        - ``partition_rules``: fmengine-style ``[(regex, spec), ...]``
+          applied to parameters that carry no ``shard_tensor``
+          annotation (see :func:`match_partition_rules`).
+        - ``parallel``: force (True) or forbid (False) the compiled
+          path; default auto (compiled whenever model/loss/optimizer
+          fit its contract).
+        """
+        if plan is not None and mesh is not None:
+            raise ValueError(
+                "pass either plan= (builds the hybrid mesh) or mesh= "
+                "(explicit ProcessMesh), not both — the compiled step "
+                "can only execute on one mesh")
+        if plan is not None:
+            degrees = (plan.mesh_degrees() if hasattr(plan, "mesh_degrees")
+                       else dict(plan))
+            from ..mesh import HybridCommunicateGroup
+            self._hcg = HybridCommunicateGroup(
+                dp_degree=degrees.get("dp", 1),
+                mp_degree=degrees.get("mp", 1),
+                pp_degree=degrees.get("pp", 1),
+                sharding_degree=degrees.get("sharding", 1))
+            self._plan = plan
         if mesh is not None:
             self._mesh = mesh
             from ..mesh import set_global_mesh
             set_global_mesh(mesh.jax_mesh)
+        if partition_rules is not None:
+            self._partition_rules = list(partition_rules)
+        if parallel is not None:
+            self._parallel = parallel
+        if self._parallel_step is not None:
+            # don't strand the live accumulators in the step object
+            # about to be dropped
+            self._parallel_step.sync_optimizer_state()
+        self._parallel_step = None  # re-prepare drops the compiled step
         return self
 
-    def _loader(self, data, batch_size):
+    # ------------------------------------------------------------- helpers
+    def _jax_mesh(self):
+        if self._hcg is not None:
+            return self._hcg.mesh
+        if self._mesh is not None:
+            return self._mesh.jax_mesh
+        from ..mesh import get_global_mesh
+        return get_global_mesh()
+
+    def _loader(self, data, batch_size, shuffle=False, drop_last=False):
+        """Contract: a ``DataLoader`` passes through untouched — its own
+        batch_size/shuffle/drop_last win and the ``batch_size=``
+        argument is ignored (it describes how to batch raw data, not
+        how to re-batch an already-batched loader). Datasets/lists are
+        wrapped with THIS ``batch_size``/``shuffle``/``drop_last``."""
         if data is None or isinstance(data, DataLoader):
             return data
-        return DataLoader(data, batch_size=batch_size, shuffle=False)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last)
 
+    def _data_shard_ways(self):
+        """Devices the compiled step shards the batch dim over — the
+        divisibility every batch must satisfy on the compiled path
+        (ParallelTrainStep's own data_axes resolution: its DATA_AXES
+        filtered to the mesh, first mesh axis as fallback)."""
+        mesh = self._jax_mesh()
+        if mesh is None:
+            return 1
+        from ..fleet.train_step import DATA_AXES
+        axes = [a for a in DATA_AXES if a in mesh.shape] \
+            or [tuple(mesh.axis_names)[0]]
+        ways = 1
+        for a in axes:
+            ways *= int(mesh.shape[a])
+        return ways
+
+    def _use_parallel(self):
+        if self._parallel is False:
+            return False
+        if self._loss is None or self._optimizer is None:
+            return False
+        # the compiled step drives the optimizer through its jit
+        # interface and the model through parameters()/buffers()
+        if not hasattr(self._optimizer, "_jit_apply") or \
+                not hasattr(self._model, "parameters"):
+            return False
+        return self._jax_mesh() is not None
+
+    def _apply_partition_rules(self):
+        if not self._partition_rules or \
+                not hasattr(self._model, "named_parameters"):
+            return
+        mesh = self._jax_mesh()
+        # rules only fill in for params the USER left unannotated — and
+        # for params a previous prepare()'s rules annotated (tracked in
+        # _rule_applied so a re-prepare with new rules re-derives them
+        # instead of mistaking the old rule output for a user spec)
+        applied = self._rule_applied
+        named = list(self._model.named_parameters())
+        specs = match_partition_rules(
+            self._partition_rules,
+            [(n, p) for n, p in named
+             if getattr(p, "sharding_spec", None) is None
+             or applied.get(id(p)) == p.sharding_spec], mesh)
+        for name, p in named:
+            if name in specs:
+                p.sharding_spec = specs[name]
+                applied[id(p)] = specs[name]
+
+    def _get_parallel_step(self):
+        if self._parallel_step is not None:
+            return self._parallel_step
+        from ..fleet.train_step import ParallelTrainStep
+        self._apply_partition_rules()
+
+        def loss_fn(model, *batch):
+            *inputs, label = batch
+            outputs = model(*inputs)
+            return self._loss(outputs, label)
+
+        if getattr(self._plan, "n_micro", 1) > 1 or \
+                getattr(self._plan, "remat", False):
+            # the generic compiled step executes the plan's mesh +
+            # donation; micro-batching and remat are dimensions of the
+            # GPT hybrid step the planner traced — say so instead of
+            # silently running a different program than the one priced
+            import logging
+            logging.getLogger("paddle_tpu.auto_parallel").warning(
+                "Engine executes the plan's mesh/donation only; "
+                "n_micro=%s and remat=%s apply to the GPTHybridTrainStep "
+                "path, so this step's memory may exceed the plan's "
+                "predicted peak",
+                getattr(self._plan, "n_micro", 1),
+                getattr(self._plan, "remat", False))
+        donate = bool(getattr(self._plan, "donate", True))
+        self._parallel_step = ParallelTrainStep(
+            self._model, self._optimizer, loss_fn,
+            hcg=self._hcg, mesh=None if self._hcg else self._jax_mesh(),
+            donate=donate)
+        self._parallel_step.telemetry_path = "auto_parallel"
+        return self._parallel_step
+
+    # ---------------------------------------------------------- eager step
     def _step(self, batch, train=True):
         batch = batch if isinstance(batch, (list, tuple)) else [batch]
         *inputs, label = batch if len(batch) > 1 else (batch[0], None)
@@ -67,19 +266,98 @@ class Engine:
             self._optimizer.clear_grad()
         return outputs, loss
 
+    # ---------------------------------------------------------------- fit
     def fit(self, train_data, train_sample_split=None, batch_size=1,
             epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
             save_freq=1, valid_data=None, valid_sample_split=None,
             valid_freq=1, valid_steps=None, collate_fn=None, callbacks=None,
-            verbose=2):
-        loader = self._loader(train_data, batch_size)
+            verbose=2, shuffle=True, drop_last=False):
+        """Train on ``train_data``.
+
+        Batching contract: when ``train_data`` is a Dataset/list it is
+        wrapped in a DataLoader with ``batch_size``/``shuffle``/
+        ``drop_last``; when it is already a ``DataLoader`` it is
+        iterated as-is — its own batch_size/shuffle/drop_last settings
+        win and the ``batch_size`` argument here is ignored.
+
+        Execution: runs the pjit-compiled planned step
+        (ParallelTrainStep — donated params/state, batch sharded over
+        the mesh's data axes, GSPMD-partitioned from ``shard_tensor``/
+        partition-rule specs) whenever prepare()'s contract allows;
+        falls back to the eager per-batch step otherwise. Loss values
+        are identical either way (same math, one compiled program).
+        On the compiled path every batch's leading dim must divide the
+        mesh's data-axis extent; when this fit wraps a Dataset whose
+        batching provably violates that (batch_size or the final
+        partial batch indivisible, ``drop_last=False``), the whole fit
+        stays on the eager path rather than crash mid-epoch — pass
+        ``drop_last=True`` or a mesh-divisible batch size to keep the
+        compiled step.
+        """
+        loader = self._loader(train_data, batch_size, shuffle=shuffle,
+                              drop_last=drop_last)
+        use_parallel = self._use_parallel()
+        if use_parallel and not isinstance(train_data, DataLoader) \
+                and hasattr(train_data, "__len__"):
+            # prove the wrap's batching divides the mesh BEFORE any
+            # compiled state exists (mixing compiled and eager steps
+            # would fork the optimizer state)
+            ways = max(self._data_shard_ways(), 1)
+            tail = 0 if drop_last else len(train_data) % batch_size
+            if steps_per_epoch is not None and steps_per_epoch \
+                    < -(-len(train_data) // batch_size):
+                tail = 0  # the capped epoch never reaches the tail batch
+            if batch_size % ways or (tail and tail % ways):
+                use_parallel = False
+                if verbose:
+                    print(f"[auto_parallel] eager fallback: batch_size "
+                          f"{batch_size} (tail {tail}) does not divide "
+                          f"the mesh's {ways} data shards; pass "
+                          f"drop_last=True or a divisible batch_size "
+                          f"for the compiled step")
+        step_fn = None
         logs = {"loss": []}
         for epoch in range(epochs):
             self._model.train()
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
-                _, loss = self._step(batch, train=True)
+                batch = batch if isinstance(batch, (list, tuple)) \
+                    else [batch]
+                if use_parallel and len(batch) < 2:
+                    if step_fn is not None:
+                        # same hazard as the indivisible-batch case:
+                        # the optimizer state lives in the compiled
+                        # step, so a silent eager detour would fork it
+                        raise ValueError(
+                            "label-less batch after compiled steps "
+                            "already ran; a loss-bearing fit must "
+                            "yield (inputs..., label) batches "
+                            "throughout")
+                    use_parallel = False  # label-less batch: eager only
+                if use_parallel:
+                    b0 = batch[0]
+                    lead = np.shape(getattr(b0, "_value", b0))[0]
+                    ways = max(self._data_shard_ways(), 1)
+                    if lead % ways:
+                        if step_fn is None:
+                            # nothing compiled ran yet: the whole fit
+                            # can still safely take the eager path
+                            use_parallel = False
+                        else:
+                            raise ValueError(
+                                f"batch of {lead} rows does not divide "
+                                f"the mesh's {ways} data shards and "
+                                f"compiled steps already ran (the "
+                                f"optimizer state lives in the compiled "
+                                f"step); re-run fit with drop_last=True "
+                                f"or a batch size divisible by {ways}")
+                if use_parallel:
+                    if step_fn is None:
+                        step_fn = self._get_parallel_step()
+                    loss = step_fn(*batch)
+                else:
+                    _, loss = self._step(batch, train=True)
                 if loss is not None:
                     logs["loss"].append(float(np.asarray(loss._value)))
                 if verbose > 1 and log_freq and (step + 1) % log_freq == 0:
@@ -124,6 +402,12 @@ class Engine:
 
     def save(self, path, training=True):
         from ...framework import io as io_mod
+        if self._parallel_step is not None:
+            # the compiled step owns the live accumulators
+            # (ParallelTrainStep.sync_optimizer_state contract): sync
+            # them back so the persisted optimizer state is post-fit,
+            # not the stale build-time snapshot
+            self._parallel_step.sync_optimizer_state()
         io_mod.save(self._model.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             io_mod.save(self._optimizer.state_dict(), path + ".pdopt")

@@ -1,21 +1,22 @@
-"""Parallel-strategy tuner over the analytic cost model.
+"""Parallel-strategy tuner — the legacy surface over the planner.
 
 Parity: ``/root/reference/python/paddle/distributed/auto_parallel/tuner/``
 — ``tunable_space.py:21 TunableSpace`` / ``trial.py:34 Trial`` search
 primitives, ``parallel_tuner.py`` (mesh-shape search) and
 ``optimization_tuner.py:196 OptimizationTuner`` (pass-config search,
-profile-driven). The TPU build searches the same space — (dp, mp, pp,
-sharding, micro_batches, recompute) — but scores candidates with the
-closed-form roofline ``CostEstimator`` instead of launching profiling
-jobs, so a full sweep over every divisor factorization of the slice is
-instant and deterministic.
+profile-driven). ``ParallelTuner`` searches the same space — (dp, mp,
+pp, sharding, micro_batches, recompute) — but is rebased onto
+:mod:`.planner`: candidates are scored by tracing the real hybrid train
+step on a virtual mesh through ``analysis/passes/cost.py`` (the ONE
+cost model bench predictions use), with the closed-form
+``CostEstimator`` surviving only as the planner's instant pre-ranking
+stage. No profiling jobs, no devices: a 13B/32-chip tune costs seconds.
 """
 from __future__ import annotations
 
-import itertools
 import random
 
-from .cost_model import Cluster, Cost, CostEstimator, ModelSpec
+from .cost_model import Cluster, Cost, CostEstimator, ModelSpec  # noqa: F401  (re-exported legacy surface)
 
 __all__ = ["TunableSpace", "Trial", "TrialStatus", "ParallelTuner",
            "OptimizationTuner"]
@@ -153,29 +154,49 @@ class Trial:
         return f"Trial({self.values}, {self.cost}, {self.status})"
 
 
-def _factorizations(n, ways):
-    """All ordered tuples of `ways` ints >= 1 whose product is n."""
-    if ways == 1:
-        yield (n,)
-        return
-    for d in sorted({d for d in range(1, n + 1) if n % d == 0}):
-        for rest in _factorizations(n // d, ways - 1):
-            yield (d,) + rest
+from .planner import _factorizations  # noqa: E402  (one legality rule)
+
+
+def _config_from_spec(spec: ModelSpec):
+    """Map the legacy ModelSpec onto a GPTConfig the planner can trace.
+    ``heads`` defaults to d_head=128 (the MXU-filling choice the bench
+    configs use) when hidden allows it, else the largest power-of-two
+    head dim that divides hidden — always a legal split, so every
+    ModelSpec the closed-form tuner accepted still tunes."""
+    from ...models.gpt import GPTConfig
+    heads = spec.heads
+    if not heads:
+        d_head = 1
+        while d_head < 128 and spec.hidden % (d_head * 2) == 0:
+            d_head *= 2
+        heads = spec.hidden // d_head
+    return GPTConfig(vocab_size=spec.vocab_size, hidden_size=spec.hidden,
+                     num_layers=spec.layers, num_heads=heads,
+                     intermediate_size=spec.ffn_mult * spec.hidden,
+                     max_position_embeddings=spec.seq_len)
 
 
 class ParallelTuner:
     """Search mesh axis degrees for a model on a cluster
-    (reference parallel_tuner.py, scored analytically).
+    (reference parallel_tuner.py).
 
-    ``tune()`` sweeps every (dp, mp, pp, sharding) factorization of the
-    slice x micro-batch/recompute choices, drops candidates that exceed
-    chip memory, and returns the fastest feasible trial.
+    Rebased onto the cost-model planner (PR 12): ``tune()`` runs
+    :class:`.planner.Planner`'s search — every legal (dp, mp, pp,
+    sharding) factorization of the slice x micro-batch/recompute
+    choices, closed-form HBM pre-prune, and trace-based scoring of the
+    finalists through ``analysis/passes/cost.py`` on a virtual mesh —
+    so the legacy surface and the planner rank with ONE cost model.
+    Results come back in the historical Trial shape: traced feasible
+    candidates are ``COMPLETED``, memory-rejected ones ``INVALID``;
+    candidates the trace budget never reached are not materialized as
+    trials (``len(self.trials)`` counts scored candidates, not the
+    whole space).
     """
 
     def __init__(self, spec: ModelSpec, cluster: Cluster,
                  global_batch=None, max_mp=8, max_pp=None,
                  micro_batch_choices=(1, 2, 4, 8, 16),
-                 mem_headroom=0.9):
+                 mem_headroom=0.9, max_traces=8):
         self.spec = spec
         self.cluster = cluster
         self.global_batch = global_batch or cluster.num_devices
@@ -183,40 +204,60 @@ class ParallelTuner:
         self.max_pp = max_pp or spec.layers
         self.micro_batch_choices = micro_batch_choices
         self.mem_headroom = mem_headroom
+        self.max_traces = max_traces
         self.trials = []
 
-    def _candidates(self):
-        n = self.cluster.num_devices
-        for dp, mp, pp, sh in _factorizations(n, 4):
-            if mp > self.max_mp or pp > self.max_pp:
-                continue
-            if self.spec.layers % pp:
-                continue
-            batch_per_dp = self.global_batch // max(dp * sh, 1)
-            if batch_per_dp < 1 or self.global_batch % max(dp * sh, 1):
-                continue
-            for mb in self.micro_batch_choices:
-                if batch_per_dp % mb or (pp > 1 and mb < pp):
-                    continue
-                for rc in (False, True):
-                    yield {"dp": dp, "mp": mp, "pp": pp,
-                           "sharding": sh, "micro_batches": mb,
-                           "global_batch": self.global_batch,
-                           "recompute": rc}
+    def _planner(self):
+        from .planner import Planner
+        c = self.cluster
+        chip = dict(name=c.name, peak_flops=c.peak_flops,
+                    hbm_bw=c.hbm_bandwidth, ici_bw=c.ici_bandwidth,
+                    hbm_gb=c.hbm_bytes / 1024 ** 3)
+        step_kw = dict(
+            compute_dtype="bfloat16" if self.spec.dtype_bytes == 2
+            else None,
+            param_dtype="bfloat16" if self.spec.param_bytes == 2
+            else None,
+            moment_dtype="bfloat16"
+            if self.spec.optimizer_state_per_param == 4 else None)
+        return Planner(_config_from_spec(self.spec), c.num_devices,
+                       chip=chip, global_batch=self.global_batch,
+                       seq_len=self.spec.seq_len,
+                       headroom=self.mem_headroom, max_mp=self.max_mp,
+                       max_pp=self.max_pp,
+                       n_micro_choices=self.micro_batch_choices,
+                       remat_choices=(False, True),
+                       max_traces=self.max_traces, step_kw=step_kw)
+
+    @staticmethod
+    def _trial(plan, trial_id, status):
+        t = Trial({"dp": plan.dp, "mp": plan.mp, "pp": plan.pp,
+                   "sharding": plan.sharding,
+                   "micro_batches": plan.n_micro,
+                   "global_batch": plan.global_batch,
+                   "recompute": bool(plan.remat)}, trial_id=trial_id)
+        t.cost = Cost(plan.step_ms, plan.peak_hbm_bytes,
+                      breakdown={"compute_ms": plan.compute_ms,
+                                 "hbm_ms": plan.hbm_ms,
+                                 "comm_ms": plan.comm_ms,
+                                 "bound": plan.bound,
+                                 "traced": plan.traced,
+                                 "reject_reason": plan.reject_reason})
+        t.status = status
+        t.metrics["predicted_mfu"] = plan.predicted_mfu
+        return t
 
     def tune(self, top_k=1):
-        est = CostEstimator(self.spec, self.cluster)
-        budget = self.cluster.hbm_bytes * self.mem_headroom
-        best = []
-        for i, cand in enumerate(self._candidates()):
-            t = Trial(cand, trial_id=i)
-            t.cost = est.estimate(cand)
-            t.status = (TrialStatus.COMPLETED
-                        if t.cost.memory_bytes <= budget
-                        else TrialStatus.INVALID)
-            self.trials.append(t)
-            if t.status == TrialStatus.COMPLETED:
-                best.append(t)
+        report = self._planner().search()
+        self.trials = []
+        for plan in report.plans:
+            self.trials.append(self._trial(plan, len(self.trials),
+                                           TrialStatus.COMPLETED))
+        for plan in report.pruned:
+            self.trials.append(self._trial(plan, len(self.trials),
+                                           TrialStatus.INVALID))
+        best = [t for t in self.trials
+                if t.status == TrialStatus.COMPLETED]
         if not best:
             raise RuntimeError(
                 "no feasible strategy fits chip memory; grow the slice "

@@ -37,7 +37,7 @@ DATA_AXES = ("dp", "sharding")  # batch dim sharding (paddle hybrid semantics)
 
 def _param_spec(p, zero_stage, mesh):
     spec = getattr(p, "sharding_spec", None) or P()
-    if zero_stage >= 3 and mesh.shape["sharding"] > 1:
+    if zero_stage >= 3 and mesh.shape.get("sharding", 1) > 1:
         # ZeRO-3: additionally shard the largest free dim over `sharding`
         parts = list(spec) + [None] * (len(p.shape) - len(spec))
         for i, (s, dim) in enumerate(zip(parts, p.shape)):
@@ -55,7 +55,7 @@ def _state_spec(p_spec, shape, mesh, zero_stage):
         return P()
     parts = list(p_spec) + [None] * (len(shape) - len(p_spec))
     parts = parts[: len(shape)]
-    if zero_stage >= 1 and mesh.shape["sharding"] > 1 and \
+    if zero_stage >= 1 and mesh.shape.get("sharding", 1) > 1 and \
             "sharding" not in parts:
         for i, (s, dim) in enumerate(zip(parts, shape)):
             if s is None and dim % mesh.shape["sharding"] == 0 and dim > 1:
@@ -69,15 +69,27 @@ class ParallelTrainStep:
 
     def __init__(self, model, optimizer, loss_fn, hcg=None, zero_stage=1,
                  batch_spec=None, accumulate_steps=1, data_axes=DATA_AXES,
-                 scaler=None, validate=False, donate=True):
+                 scaler=None, validate=False, donate=True, mesh=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn  # loss_fn(model, *batch_tensors) -> scalar Tensor
-        self.hcg = hcg or get_hybrid_communicate_group()
-        self.mesh = self.hcg.mesh
+        if mesh is not None:
+            # explicit mesh (auto_parallel Engine path): axes may be
+            # user-named (ProcessMesh dims), not the hybrid
+            # pp/dp/sharding/sep/mp set — the batch shards over
+            # whichever data_axes the mesh actually has, falling back
+            # to its first axis
+            self.hcg = hcg
+            self.mesh = mesh
+        else:
+            self.hcg = hcg or get_hybrid_communicate_group()
+            self.mesh = self.hcg.mesh
         self.zero_stage = zero_stage
         self.accumulate_steps = accumulate_steps
-        self.data_axes = tuple(a for a in data_axes if self.mesh.shape[a] >= 1)
+        self.data_axes = tuple(a for a in data_axes
+                               if a in self.mesh.shape)
+        if not self.data_axes:
+            self.data_axes = (tuple(self.mesh.axis_names)[0],)
         self.batch_spec = batch_spec
         # dynamic loss scaling INSIDE the compiled step (GradScaler parity):
         # loss scales up before grad, grads unscale + finite-check before the

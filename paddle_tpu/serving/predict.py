@@ -19,6 +19,12 @@ CLI (bench.py shells out here so a wedged backend can't take the row
 down with it)::
 
     python -m paddle_tpu.serving.predict --config 345m --concurrency 8
+
+These rows are also the objective of the serving-side plan search:
+``distributed.auto_parallel.plan_serving`` (``tools/plan.py
+--serving``) sweeps (decode-batch bucket, page size, ``quantize=``)
+over :func:`predicted_serving_row` under the chip HBM budget and
+returns the ranked, feasible configurations.
 """
 from __future__ import annotations
 

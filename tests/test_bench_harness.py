@@ -91,14 +91,23 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
     predicted = [r for r in recs if r["metric"].endswith("_predicted")]
     assert {r["metric"] for r in predicted} == {
         "gpt_345m_predicted", "gpt_1p3b_predicted", "gpt_13b_predicted",
+        "gpt_13b_planned_predicted",
         "serving_predicted", "serving_int8_predicted",
         "serving_shared_prefix_predicted", "serving_disagg_predicted",
         "collective_compression_predicted"}
+    planned = {r["metric"]: r for r in predicted}["gpt_13b_planned_predicted"]
+    hand = {r["metric"]: r for r in predicted}["gpt_13b_predicted"]
+    # the planner's best 13B config beats the hand-written anchor beside
+    # it, and the plan-time regression signal rides along
+    assert planned["extras"]["predicted_mfu"] > hand["extras"]["predicted_mfu"]
+    assert planned["extras"]["planner_s"] > 0
     for r in predicted:
         if r["metric"] == "collective_compression_predicted":
             # the acceptance anchor: int8 all_reduce wire-bytes
             # reduction on the GPT grad-sync config >= 1.8x
             assert r["value"] >= 1.8
+        elif r["metric"] == "gpt_13b_planned_predicted":
+            assert r["extras"]["predicted_peak_hbm_gb"] > 0
         elif r["metric"].startswith("serving"):
             assert r["extras"]["predicted_tokens_per_sec"] > 0
         else:

@@ -87,6 +87,9 @@ _ANCHOR_MAP = {
     "serving_shared_prefix": "serving_shared_prefix_predicted",
     "serving_disagg": "serving_disagg_predicted",
     "collective_compression": "collective_compression_predicted",
+    # a measured planner-config 13B run (TPU rounds) anchors on the
+    # planner's own predicted row, not the hand-written config's
+    "gpt_13b_planned_tokens_per_sec_per_chip": "gpt_13b_planned_predicted",
 }
 
 
