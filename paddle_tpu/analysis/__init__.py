@@ -58,6 +58,11 @@ PTCS003 compression would flip the bound: int8 wire (compressed
         — the what-if PTCS001 carries, promoted to its own finding;
         ``distributed.auto_enable_compression(report)`` acts on it
         (info)
+PTCS004 fusion opportunity: an unfused gate→dispatch chain (top-k
+        routing + materialized cumsum/gather/scatter glue — the MoE
+        dispatch shape) streams >2× the HBM a fused dispatch kernel
+        would; ``kernels.moe_dispatch`` /
+        ``MoELayer(fused_dispatch=True)`` is the fused path (info)
 PTMM001 predicted peak HBM exceeds the budget — OOM before compile
         (error)
 PTBD001 use-after-donate: donated input read after the jitted call

@@ -41,6 +41,16 @@ Diagnostics:
   comm-bound at the current wire dtype but int8-compressed collectives
   (``new_group(compress="int8")`` / ``prims.c_*_q``) would make it
   compute- or HBM-bound — the cheapest predicted win on the table.
+- **PTCS004** (info) — fusion opportunity: an unfused gate→dispatch
+  chain (top-k routing followed by materialized cumsum/gather/scatter
+  glue — the MoE dispatch shape) charges >2× the HBM traffic a fused
+  dispatch kernel would stream (read the tokens once, write the expert
+  buffers once). Neptune's locality lens applied to the fusion-aware
+  HBM model: the glue ops are *anchors* XLA cannot fuse away, so the
+  round-trips are real. ``kernels.moe_dispatch.fused_moe_dispatch`` /
+  ``MoELayer(fused_dispatch=True)`` is the fused path; a ``pallas_call``
+  never fires this (it IS the fused form, and is priced as one anchor:
+  body FLOPs × grid steps, HBM = the call's operands + results).
 """
 from __future__ import annotations
 
@@ -430,6 +440,27 @@ class _JaxprCoster:
                     self.walk(sub, [dof(v) for v in eqn.invars], mult)
                 continue
 
+            if name == "pallas_call":
+                # fused-kernel pricing: the body's FLOPs all execute
+                # (once per grid step), but only the call's operands and
+                # results stream HBM — every intermediate the body
+                # touches lives in VMEM. This is what makes a fused
+                # dispatch kernel cheaper than the identical unfused
+                # math in the model, not just on the chip.
+                probe = CostSummary()
+                inner = _JaxprCoster(probe, self.axis_sizes,
+                                     self.wire_dtype)
+                for sub in _sub_jaxprs(eqn.params):
+                    inner.walk(sub, [1] * len(sub.invars), 1.0)
+                steps = 1
+                gm = eqn.params.get("grid_mapping")
+                for d in (getattr(gm, "grid", None) or ()):
+                    if isinstance(d, int):
+                        steps *= max(d, 1)
+                self.charge(name, mult * probe.flops * steps / d_out,
+                            mult * self._anchor_bytes(eqn) / d_out)
+                continue
+
             if name in _COLLECTIVES:
                 axes = eqn.params.get("axes",
                                       eqn.params.get("axis_name"))
@@ -469,6 +500,14 @@ class _JaxprCoster:
                 continue
 
             if name in _FREE:
+                continue
+            if name == "dynamic_update_slice":
+                # work is the UPDATE operand, not the whole buffer a
+                # one-flop-per-output-element default would charge (a
+                # single-row write into a pool/cache is row-sized work)
+                self.charge(name,
+                            mult * _nelems(eqn.invars[1].aval) / d_out,
+                            mult * self._anchor_bytes(eqn) / d_out)
                 continue
             if name == "dot_general":
                 flops = _dot_general_flops(eqn)
@@ -560,6 +599,75 @@ def eager_collective_cost(ledger, world_size: int,
 
 
 # ---------------------------------------------------------------------------
+# PTCS004: unfused MoE-dispatch chains (fusion opportunity)
+# ---------------------------------------------------------------------------
+
+# materializing glue the unfused dispatch streams through HBM between
+# the gate and the expert matmul: position math, index gathers, token
+# scatters, pad concats. All are cost-model ANCHORS (not in _FUSABLE),
+# so the bytes counted here are exactly what the walk charged them.
+_PTCS004_GLUE = {"cumsum", "gather", "scatter", "scatter-add",
+                 "scatter_add", "sort", "concatenate",
+                 "dynamic_update_slice"}
+_PTCS004_FLOOR = 1 << 20   # toy traces (tests, tiny zoo configs) stay quiet
+_PTCS004_RATIO = 2.0
+
+
+def _moe_fusion_opportunities(jaxpr, _found=None):
+    """Detect unfused gate→dispatch chains: a ``top_k`` (the routing
+    decision) whose downstream dataflow materializes gather/scatter/
+    cumsum glue charging > ``_PTCS004_RATIO``× the HBM traffic a fused
+    dispatch kernel would stream (tokens read once + expert buffers
+    written once — approximated by the chain's largest materialized
+    output plus its largest input). Recurses into sub-jaxprs EXCEPT
+    ``pallas_call`` bodies — a Pallas kernel is already the fused form.
+    Returns ``[{glue_bytes, fused_bytes, n_ops, ratio}, ...]``."""
+    found = [] if _found is None else _found
+
+    tainted = set()
+    glue_bytes = 0.0
+    big_out = 0.0
+    big_in = 0.0
+    n_ops = 0
+    saw_topk = False
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            continue  # fused already; neither taints nor recurses
+        for sub in _sub_jaxprs(eqn.params):
+            _moe_fusion_opportunities(sub, found)
+        ins = [v for v in eqn.invars
+               if not isinstance(v, jax.core.Literal)]
+        hit = any(id(v) in tainted for v in ins)
+        if name == "top_k":
+            saw_topk = True
+            hit = True
+        if hit:
+            for v in eqn.outvars:
+                tainted.add(id(v))
+            if name in _PTCS004_GLUE:
+                n_ops += 1
+                in_b = max([_nbytes(v.aval) for v in ins] or [0])
+                out_b = max([_nbytes(v.aval) for v in eqn.outvars]
+                            or [0])
+                glue_bytes += sum(_nbytes(v.aval) for v in ins)
+                glue_bytes += sum(_nbytes(v.aval) for v in eqn.outvars)
+                if out_b > big_out:
+                    big_out, big_in = out_b, in_b
+    if saw_topk and n_ops:
+        # what the fused kernel streams: the dispatched expert buffer
+        # out + the token matrix in (the chain's dominant materialized
+        # tensors), plus a small index/weight allowance
+        fused = big_out + big_in + (64 << 10)
+        if glue_bytes >= _PTCS004_FLOOR \
+                and glue_bytes > _PTCS004_RATIO * fused:
+            found.append({"glue_bytes": glue_bytes,
+                          "fused_bytes": fused, "n_ops": n_ops,
+                          "ratio": glue_bytes / fused})
+    return found
+
+
+# ---------------------------------------------------------------------------
 # the registered pass
 # ---------------------------------------------------------------------------
 
@@ -631,4 +739,19 @@ def cost_pass(ctx):
             f"memory-bound at {s.predicted_mfu:.1%} predicted MFU; fuse "
             f"elementwise chains, grow the batch, or store in bf16",
             extra={"cost": s.as_dict()}))
+    if ctx.jaxpr is not None:
+        for opp in _moe_fusion_opportunities(ctx.jaxpr.jaxpr):
+            out.append(Diagnostic(
+                "PTCS004", "cost", "info",
+                f"fusion opportunity: an unfused gate→dispatch chain "
+                f"(top-k routing + {opp['n_ops']} materialized "
+                f"gather/scatter/cumsum ops) streams "
+                f"{opp['glue_bytes'] / 2 ** 20:.1f} MiB of HBM glue — "
+                f"{opp['ratio']:.1f}x what a fused dispatch kernel "
+                f"would move (~{opp['fused_bytes'] / 2 ** 20:.1f} MiB: "
+                f"tokens in + expert buffers out). "
+                f"kernels.moe_dispatch.fused_moe_dispatch / "
+                f"MoELayer(fused_dispatch=True) is the fused path",
+                extra={"fusion": {k: round(v, 1) if isinstance(v, float)
+                                  else v for k, v in opp.items()}}))
     return out

@@ -25,12 +25,58 @@ def _counts(x):
 
 
 def _check_counts(x, local_count, global_count):
+    """Validate the (local_count, global_count) pair against ``x``,
+    naming the offending expert on a mismatch — a bare total-sum assert
+    gives no clue WHICH expert's row count went wrong, and MoE count
+    bugs are almost always per-expert (a gate/capacity mismatch on one
+    expert), not uniform."""
     lc, gc = _counts(local_count), _counts(global_count)
     n = unwrap(x).shape[0]
-    if not (int(lc.sum()) == int(gc.sum()) == n):
+    if lc.shape != gc.shape:
         raise ValueError(
-            f"counts must cover all rows: local={int(lc.sum())} "
-            f"global={int(gc.sum())} rows={n}")
+            f"global_scatter/global_gather: local_count has "
+            f"{lc.shape[0]} expert bins but global_count has "
+            f"{gc.shape[0]} — one bin per (rank, expert) pair on both "
+            f"sides")
+    if int(lc.sum()) != n:
+        bad = _first_count_mismatch(lc, gc)
+        raise ValueError(
+            f"global_scatter/global_gather: local_count sums to "
+            f"{int(lc.sum())} rows but x has {n} — every row must be "
+            f"assigned to exactly one expert bin"
+            + (f"; first diverging expert bin {bad[0]}: local sends "
+               f"{bad[1]} row(s), global receives {bad[2]}" if bad
+               else ""))
+    if int(gc.sum()) != n:
+        bad = _first_count_mismatch(lc, gc)
+        raise ValueError(
+            f"global_scatter/global_gather: global_count sums to "
+            f"{int(gc.sum())} rows but x has {n}"
+            + (f"; first diverging expert bin {bad[0]}: local sends "
+               f"{bad[1]} row(s), global receives {bad[2]}" if bad
+               else ""))
+    bad = _first_count_mismatch(lc, gc)
+    if bad is not None:
+        # single-process exchange: every destination is local, so the
+        # received count must equal the sent count PER EXPERT BIN
+        raise ValueError(
+            f"global_scatter/global_gather: expert bin {bad[0]} "
+            f"mismatch — local_count sends {bad[1]} row(s) but "
+            f"global_count receives {bad[2]} (single-process exchange "
+            f"must be an identity regroup; totals "
+            f"local={int(lc.sum())} global={int(gc.sum())} rows={n})")
+
+
+def _first_count_mismatch(lc, gc):
+    """First (expert_bin, local, global) triple where the two count
+    vectors disagree, or None."""
+    if lc.shape != gc.shape:
+        return None
+    diff = np.nonzero(lc != gc)[0]
+    if diff.size == 0:
+        return None
+    e = int(diff[0])
+    return e, int(lc[e]), int(gc[e])
 
 
 def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):

@@ -59,28 +59,15 @@ def _dispatch_indices(idx, num_expert, capacity):
     the TPU-native form (global_scatter/gather in the reference are
     exactly index-routed sends): O(S*k*M) data movement.
 
-    idx [S, k] int32 expert choices (k = priority order). Returns
-      slot_token [E*C] int32: token feeding each expert slot (S = empty),
-      comb_idx  [S, k] int32: flat slot for each choice (E*C = dropped).
+    The slot math itself (priority-major GShard counters, drop
+    sentinel) lives in :func:`paddle_tpu.kernels.moe_dispatch.
+    dispatch_indices` — ONE implementation shared with the fused
+    kernels' reference/VJP, so the gather path and the fused path can
+    never drift apart on drop semantics.
     """
-    S, k = idx.shape
-    E, C = num_expert, capacity
-    # priority-major running per-expert counter: all 1st choices claim
-    # capacity before any 2nd choice (GShard rule)
-    oh = jax.nn.one_hot(idx.T, E, dtype=jnp.float32)          # [k, S, E]
-    pos = jnp.cumsum(oh.reshape(k * S, E), axis=0) - 1.0
-    e_f = idx.T.reshape(-1).astype(jnp.int32)                 # [k*S]
-    slot_f = jnp.take_along_axis(
-        pos, e_f[:, None], axis=1)[:, 0].astype(jnp.int32)
-    within = slot_f < C
-    token_f = jnp.tile(jnp.arange(S, dtype=jnp.int32), k)
-    flat_ec = jnp.where(within, e_f * C + slot_f, E * C)
-    # unique per (expert, slot) by construction of the running counter;
-    # out-of-capacity entries scatter out of bounds and are dropped
-    slot_token = jnp.full((E * C,), S, jnp.int32).at[flat_ec].set(
-        token_f, mode="drop")
-    comb_idx = flat_ec.reshape(k, S).T                         # [S, k]
-    return slot_token, comb_idx
+    from .....kernels.moe_dispatch import dispatch_indices
+    return dispatch_indices(idx, num_expert=num_expert,
+                            capacity=capacity)
 
 
 def _gather_dispatch(x, slot_token):
@@ -92,17 +79,15 @@ def _gather_dispatch(x, slot_token):
 def _gather_combine(expert_out_flat, val, comb_idx):
     """expert_out_flat [E*C, M], val [S, k], comb_idx [S, k] ->
     y [S, M] = sum_k val * expert_out[slot]; dropped tokens (idx == E*C)
-    read the zero pad row and contribute nothing."""
-    ep = jnp.concatenate(
-        [expert_out_flat,
-         jnp.zeros((1, expert_out_flat.shape[-1]), expert_out_flat.dtype)],
-        axis=0)
-    g = ep[comb_idx]                                           # [S, k, M]
-    return jnp.einsum("skm,sk->sm", g, val.astype(g.dtype))
+    read the zero pad row and contribute nothing. Delegates to the
+    shared reference in kernels.moe_dispatch (one combine semantics)."""
+    from .....kernels.moe_dispatch import reference_moe_combine
+    return reference_moe_combine(expert_out_flat, val, comb_idx)
 
 
 def ep_moe_ffn(x, gate_w, gate_b, w1, b1, w2, b2, *, ep_axis, num_expert,
-               capacity, top_k=2, act=None):
+               capacity, top_k=2, act=None, fused_dispatch=False,
+               wire_dtype=None):
     """GShard MoE FFN with EXPLICIT expert-parallel all_to_all dispatch —
     the compiled-path counterpart of MoELayer for use INSIDE a shard_map
     region (global_scatter_op.cc / global_gather_op.cc parity, driven by
@@ -119,22 +104,48 @@ def ep_moe_ffn(x, gate_w, gate_b, w1, b1, w2, b2, *, ep_axis, num_expert,
     runs locally, and the reverse all_to_all + weighted combine return
     [S_local, M]. ``ep_axis=None`` runs the identical program minus the
     collectives (single-chip oracle / ep=1).
+
+    ``fused_dispatch=True`` replaces the gate→indices→gather chain and
+    the gather-combine with the fused Pallas kernels
+    (:mod:`paddle_tpu.kernels.moe_dispatch`, ``gate_kind="renorm"`` —
+    identical math, one HBM round-trip). ``wire_dtype="int8"|"bf16"``
+    runs the two expert all_to_alls compressed on the wire (PR 9's
+    ``prims.all_to_all_q`` path) — the exchange the cost pass's int8
+    what-if prices, auto-enabled by
+    ``distributed.auto_enable_compression`` when comm-bound.
     """
     if act is None:
         act = jax.nn.gelu
     S, M = x.shape
     E, C = num_expert, capacity
-    logits = x @ gate_w.astype(x.dtype) + gate_b.astype(x.dtype)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    val, idx = jax.lax.top_k(probs, top_k)                     # [S, k]
-    val = val / jnp.maximum(jnp.sum(val, -1, keepdims=True), 1e-9)
-    slot_token, comb_idx = _dispatch_indices(idx.astype(jnp.int32),
-                                             num_expert=E, capacity=C)
-    send = _gather_dispatch(x, slot_token).reshape(E, C, M)
+    if fused_dispatch:
+        from .....kernels.moe_dispatch import (fused_moe_combine,
+                                               fused_moe_dispatch)
+        send, comb_idx, val, _, _ = fused_moe_dispatch(
+            x, gate_w, gate_b, num_expert=E, capacity=C, top_k=top_k,
+            gate_kind="renorm")
+        send = send.astype(x.dtype)
+    else:
+        logits = x @ gate_w.astype(x.dtype) + gate_b.astype(x.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        val, idx = jax.lax.top_k(probs, top_k)                 # [S, k]
+        val = val / jnp.maximum(jnp.sum(val, -1, keepdims=True), 1e-9)
+        slot_token, comb_idx = _dispatch_indices(idx.astype(jnp.int32),
+                                                 num_expert=E, capacity=C)
+        send = _gather_dispatch(x, slot_token).reshape(E, C, M)
+
+    def exchange(v, split_axis, concat_axis):
+        if wire_dtype is not None:
+            from .....distributed import compress as compress_mod
+            return compress_mod.all_to_all_compressed(
+                v, ep_axis, split_axis=split_axis,
+                concat_axis=concat_axis, wire_dtype=wire_dtype)
+        return jax.lax.all_to_all(v, ep_axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
     if ep_axis is not None:
         # [E, C, M] -> [E_local, ep*C, M]: expert e's rows from every rank
-        recv = jax.lax.all_to_all(send, ep_axis, split_axis=0,
-                                  concat_axis=1, tiled=True)
+        recv = exchange(send, 0, 1)
     else:
         recv = send
     h = act(jnp.einsum("ecm,emh->ech", recv, w1.astype(x.dtype))
@@ -144,10 +155,11 @@ def ep_moe_ffn(x, gate_w, gate_b, w1, b1, w2, b2, *, ep_axis, num_expert,
     if ep_axis is not None:
         # reverse exchange: every token's expert output returns to the
         # rank that owns the token
-        back = jax.lax.all_to_all(out, ep_axis, split_axis=1,
-                                  concat_axis=0, tiled=True)
+        back = exchange(out, 1, 0)
     else:
         back = out
+    if fused_dispatch:
+        return fused_moe_combine(back.reshape(E * C, M), val, comb_idx)
     return _gather_combine(back.reshape(E * C, M), val, comb_idx)
 
 
@@ -165,13 +177,22 @@ class MoELayer(nn.Layer):
         recompute_interval: >0 remats the expert computation (jax.checkpoint).
         capacity_factor: per-expert buffer slots = cf * top_k * S / E
             (defaults from the gate's ``capacity`` tuple: train/eval).
+        fused_dispatch: route gate + capacity-clamped scatter and the
+            weighted combine through the fused Pallas kernels
+            (:mod:`paddle_tpu.kernels.moe_dispatch`) instead of the
+            einsum/gather chain — identical numerics (asserted in
+            tier-1), one HBM round-trip instead of five. Falls back to
+            the reference path for gate configs the kernel cannot
+            replicate (GShard random routing / Switch jitter in
+            training mode — both involve framework RNG draws).
     """
 
     def __init__(self, d_model, experts, gate=None, moe_group=None,
                  mp_group=None, recompute_interval=0, recompute_ctx=None,
-                 capacity_factor=None):
+                 capacity_factor=None, fused_dispatch=False):
         super().__init__()
         self.d_model = d_model
+        self.fused_dispatch = bool(fused_dispatch)
         if isinstance(experts, (list, tuple)):
             experts = nn.LayerList(experts)
         self.experts = experts
@@ -229,11 +250,33 @@ class MoELayer(nn.Layer):
                    tuple(e.htoh4.weight.shape) == tuple(e0.htoh4.weight.shape)
                    for e in self.experts)
 
+    def _fused_gate_kind(self):
+        """The fused kernel's ``gate_kind`` for this layer's gate, or
+        ``None`` when the gate's current behavior cannot be replicated
+        in-kernel (training-time RNG: gshard random routing, switch
+        jitter)."""
+        if isinstance(self.gate, GShardGate):
+            if self.training and self.gate.random_routing:
+                return None
+            return "gshard"
+        if isinstance(self.gate, SwitchGate):
+            if self.training and self.gate.switch_eps:
+                return None
+            return "switch"
+        if isinstance(self.gate, NaiveGate):
+            return "naive"
+        return None
+
     def forward(self, inp):
         orig_shape = inp.shape
         x = ops.reshape(inp, [-1, self.d_model])
         S = x.shape[0]
         E, C = self.num_expert, self._capacity(S)
+
+        kind = self._fused_gate_kind() if self.fused_dispatch else None
+        if kind is not None:
+            return ops.reshape(self._forward_fused(x, E, C, kind),
+                               orig_shape)
 
         val, idx = self.gate(x)
         val = ops.reshape(val, [S, self.top_k])
@@ -249,10 +292,47 @@ class MoELayer(nn.Layer):
             apply(_gather_dispatch, x, slot_token, op_name="moe_dispatch"),
             [E, C, self.d_model])
 
+        expert_out = self._run_experts(expert_in, E)
+
+        y = apply(_gather_combine,
+                  ops.reshape(expert_out, [E * C, self.d_model]), val,
+                  comb_idx, op_name="moe_combine")
+        return ops.reshape(y, orig_shape)
+
+    def _forward_fused(self, x, E, C, kind):
+        """Fused-kernel path: ONE Pallas program for gate + scatter, one
+        for the weighted combine (kernels.moe_dispatch; parity with the
+        reference path asserted in tier-1). The aux load-balance loss is
+        rebuilt from the kernel's ``me``/``ce`` outputs — same formula
+        as ``gate._load_balance_loss``, no second gate matmul."""
+        from .....kernels.moe_dispatch import (fused_moe_combine,
+                                               fused_moe_dispatch)
+        expert_in, comb_idx, val, me, ce = apply(
+            fused_moe_dispatch, x, self.gate.gate.weight,
+            self.gate.gate.bias, num_expert=E, capacity=C,
+            top_k=self.top_k, gate_kind=kind,
+            op_name="moe_fused_dispatch")
+        if not isinstance(self.gate, NaiveGate):
+            if self.training:
+                self.gate.set_loss(ops.sum(me * ce) * float(E))
+            else:
+                self.gate.set_loss(None)
+
+        expert_out = self._run_experts(expert_in, E)
+
+        return apply(fused_moe_combine,
+                     ops.reshape(expert_out, [E * C, self.d_model]), val,
+                     comb_idx, op_name="moe_fused_combine")
+
+    def _run_experts(self, expert_in, E):
+        """The expert-FFN walk shared by the gather and fused paths:
+        ep-shard the dispatched buffer, run the stacked fast path (or
+        the per-expert loop with optional remat), ep-shard the output —
+        ONE implementation, so sharding/remat changes can't drift
+        between the two dispatch paths."""
         ep = self._ep_axis()
         if ep is not None:
             expert_in = with_sharding_constraint(expert_in, P(ep, None, None))
-
         if self._homogeneous_ffn():
             expert_out = self._experts_stacked(expert_in)
         else:
@@ -260,14 +340,9 @@ class MoELayer(nn.Layer):
             outs = [_recompute(self.experts[e], expert_in[e]) if remat
                     else self.experts[e](expert_in[e]) for e in range(E)]
             expert_out = ops.stack(outs, axis=0)
-
         if ep is not None:
             expert_out = with_sharding_constraint(expert_out, P(ep, None, None))
-
-        y = apply(_gather_combine,
-                  ops.reshape(expert_out, [E * C, self.d_model]), val,
-                  comb_idx, op_name="moe_combine")
-        return ops.reshape(y, orig_shape)
+        return expert_out
 
     def _experts_stacked(self, expert_in):
         """Fast path: batched expert FFN as two [E,·,·] einsums (MXU-batched;

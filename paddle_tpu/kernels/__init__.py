@@ -13,4 +13,9 @@ Pallas TPU kernels instead of hand-written CUDA.
   over a block KV-cache pool (page-table gather via scalar prefetch;
   the serving engine's attention core).
 - :mod:`.ring_attention` — sequence-parallel ring attention.
+- :mod:`.moe_dispatch` — fused MoE dispatch/combine: ONE kernel for
+  top-k gate + capacity-clamped scatter into per-expert buffers, one
+  for the weighted combine (scalar-prefetch row gather); gather-based
+  reference + recompute VJPs, so fused training is trajectory-
+  equivalent to the unfused path.
 """

@@ -15,5 +15,6 @@ from .bert import (  # noqa: F401
 )
 from .ernie import (  # noqa: F401
     ErnieMoeConfig, ErnieMoeModel, ErnieMoeForPretraining,
+    ErnieMoeGenerator, stack_ernie_moe_weights,
     ernie_moe_tiny_config, ernie_moe_base_config,
 )

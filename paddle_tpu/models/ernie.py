@@ -27,6 +27,8 @@ class ErnieMoeConfig:
     num_experts: int = 8
     top_k: int = 2
     moe_every: int = 2          # every 2nd layer's FFN is MoE (ERNIE/GShard)
+    capacity_factor: float = None   # None = gate default (1.2/2.4)
+    fused_dispatch: bool = False    # Pallas fused MoE dispatch/combine
     hidden_act: str = "gelu"
     hidden_dropout_prob: float = 0.0
     attention_probs_dropout_prob: float = 0.0
@@ -66,7 +68,9 @@ class _MoeFfnBlock(nn.Layer):
             [ExpertLayer(cfg.hidden_size, cfg.intermediate_size,
                          act=cfg.hidden_act)
              for _ in range(cfg.num_experts)],
-            gate={"type": "gshard", "top_k": cfg.top_k})
+            gate={"type": "gshard", "top_k": cfg.top_k},
+            capacity_factor=cfg.capacity_factor,
+            fused_dispatch=cfg.fused_dispatch)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def forward(self, x, src_mask=None):
@@ -109,7 +113,10 @@ class ErnieMoeModel(nn.Layer):
         _init_weights(self, cfg.initializer_range)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        if attention_mask is not None:
+        if attention_mask is not None and len(attention_mask.shape) <= 2:
+            # 2D [B, S] padding mask → additive; an already-broadcast
+            # 3D/4D mask (e.g. a causal bool mask for generation)
+            # passes through to the attention untouched
             m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
             attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
         h = self.embeddings(input_ids, token_type_ids)
@@ -172,3 +179,130 @@ class ErnieMoeForPretraining(nn.Layer):
             if aux is not None:
                 loss = loss + aux_loss_weight * aux
         return loss
+
+
+# ---------------------------------------------------------------------------
+# serving-side weight stacking + eager generation oracle
+# ---------------------------------------------------------------------------
+
+def stack_ernie_moe_weights(model):
+    """Stack an :class:`ErnieMoeForPretraining`'s Parameters into the
+    decode-side pytree the MoE serving engine consumes — the
+    ``stack_gpt_weights`` pattern applied to the heterogeneous
+    dense/MoE encoder stack. Because dense and MoE layers have
+    different leaf sets, layers stack as a TUPLE of per-layer dicts
+    (the layer loop in the decode program is a static Python loop, not
+    a scan), with the static layer-kind sequence returned alongside.
+
+    Returns ``(params, kinds)``: ``params = {"wte", "wpe", "eln_w",
+    "eln_b", "layers": (dict, ...), "head": {...}}``; ``kinds`` a tuple
+    of ``"dense" | "moe"``. Per-layer dicts carry q/k/v/out projections
+    + the two LayerNorms, then either the dense FFN (``w1/b1/w2/b2``)
+    or the MoE gate + stacked expert weights (``gate_w/gate_b/ew1/eb1/
+    ew2/eb2`` with the expert dim leading)."""
+    import jax.numpy as jnp
+
+    if not isinstance(model, ErnieMoeForPretraining):
+        raise TypeError("stack_ernie_moe_weights needs an "
+                        "ErnieMoeForPretraining (the LM head is part "
+                        "of the decode program)")
+    ernie = model.ernie
+    emb = ernie.embeddings
+    v = lambda p: p._value
+
+    def attn_block(attn, ln1, ln2):
+        return {
+            "wq": v(attn.q_proj.weight), "bq": v(attn.q_proj.bias),
+            "wk": v(attn.k_proj.weight), "bk": v(attn.k_proj.bias),
+            "wv": v(attn.v_proj.weight), "bv": v(attn.v_proj.bias),
+            "wo": v(attn.out_proj.weight), "bo": v(attn.out_proj.bias),
+            "ln1_w": v(ln1.weight), "ln1_b": v(ln1.bias),
+            "ln2_w": v(ln2.weight), "ln2_b": v(ln2.bias),
+        }
+
+    layers, kinds = [], []
+    for blk in ernie.layers:
+        if hasattr(blk, "moe"):
+            p = attn_block(blk.attn, blk.ln1, blk.ln2)
+            moe = blk.moe
+            p.update({
+                "gate_w": v(moe.gate.gate.weight),
+                "gate_b": v(moe.gate.gate.bias),
+                "ew1": jnp.stack([v(e.htoh4.weight) for e in moe.experts]),
+                "eb1": jnp.stack([v(e.htoh4.bias) for e in moe.experts]),
+                "ew2": jnp.stack([v(e.h4toh.weight) for e in moe.experts]),
+                "eb2": jnp.stack([v(e.h4toh.bias) for e in moe.experts]),
+            })
+            kinds.append("moe")
+        else:
+            inner = blk.inner
+            p = attn_block(inner.self_attn, inner.norm1, inner.norm2)
+            p.update({
+                "w1": v(inner.linear1.weight), "b1": v(inner.linear1.bias),
+                "w2": v(inner.linear2.weight), "b2": v(inner.linear2.bias),
+            })
+            kinds.append("dense")
+        layers.append(p)
+
+    params = {
+        "wte": v(emb.word_embeddings.weight),
+        "wpe": v(emb.position_embeddings.weight),
+        "eln_w": v(emb.layer_norm.weight),
+        "eln_b": v(emb.layer_norm.bias),
+        "layers": tuple(layers),
+        "head": {
+            "tw": v(model.transform.weight), "tb": v(model.transform.bias),
+            "ln_w": v(model.layer_norm.weight),
+            "ln_b": v(model.layer_norm.bias),
+            "dw": v(model.decoder_weight), "db": v(model.decoder_bias),
+        },
+    }
+    return params, tuple(kinds)
+
+
+class ErnieMoeGenerator:
+    """Eager greedy generation oracle over :class:`ErnieMoeForPretraining`
+    run as a CAUSAL decoder: each step re-runs the full forward under a
+    lower-triangular bool mask and takes the argmax of the last
+    position's LM-head logits. No KV cache, no compiled program —
+    deliberately the simplest possible semantics, the token-for-token
+    oracle the paged MoE serving engine
+    (:class:`paddle_tpu.serving.moe_engine.MoEServingEngine`) is
+    asserted against.
+
+    Parity caveat (MoE capacity): incremental decode routes each token
+    through the experts once, while full recompute routes the whole
+    prefix every step — the two agree only when no token is capacity-
+    dropped. Build the model with a no-drop ``capacity_factor`` (the
+    serving engine's own programs always size capacity at
+    ``tokens * top_k``)."""
+
+    def __init__(self, model: ErnieMoeForPretraining):
+        self.model = model
+        self.cfg = model.ernie.config
+
+    def __call__(self, input_ids, max_new_tokens=16):
+        import numpy as np
+        from .. import to_tensor
+
+        # generate in eval mode but RESTORE the caller's mode after — a
+        # mid-training validation sample must not silently flip the
+        # gates into their eval (aux-loss-less) branch for good
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            ids = np.asarray(input_ids, dtype=np.int64)
+            if ids.ndim == 1:
+                ids = ids[None, :]
+            for _ in range(int(max_new_tokens)):
+                S = ids.shape[1]
+                causal = np.tril(np.ones((S, S), bool))[None, None]
+                logits = self.model(to_tensor(ids),
+                                    attention_mask=to_tensor(causal))
+                last = np.asarray(logits.numpy())[:, -1]
+                nxt = np.argmax(last, axis=-1).astype(np.int64)
+                ids = np.concatenate([ids, nxt[:, None]], axis=1)
+            return ids[:, -int(max_new_tokens):]
+        finally:
+            if was_training:
+                self.model.train()

@@ -56,6 +56,14 @@ disaggregated serving"):
   once per request, and lands it with :func:`.engine.scatter_kv_fn`;
   each side keeps its own bucket set.
 
+MoE serving (README "Fused MoE dispatch & MoE serving"):
+:mod:`.moe_engine` — ``MoEServingEngine`` makes ERNIE-MoE a first-class
+serving workload: stacked dense/MoE layer weights
+(``models.ernie.stack_ernie_moe_weights``), the same paged pool +
+bucket-closed AOT programs, and the **fused Pallas MoE dispatch**
+(``kernels.moe_dispatch``) inside every decode/prefill program; greedy
+parity with eager ``ErnieMoeGenerator`` asserted in tier-1.
+
 The static gate: ``python tools/check_program.py --model serving`` lints
 the decode step AND the chunk program, and replays a randomized
 admission mix through the real scheduler
@@ -79,6 +87,8 @@ from .kv_pool import PagePool, PagePoolError, PagePoolOOM  # noqa: F401
 from .engine import (EngineShapeError, ServingEngine,  # noqa: F401
                      chunk_prefill_fn, decode_step_fn, prefill_fn,
                      prefill_kv_fn, scatter_kv_fn)
+from .moe_engine import (MoEServingEngine,  # noqa: F401
+                         moe_decode_step_fn, moe_prefill_fn)
 from .prefix_cache import (PrefixCache,  # noqa: F401
                            make_shared_prefix_workload)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
@@ -86,7 +96,7 @@ from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
 
 __all__ = [
     "PagePool", "PagePoolError", "PagePoolOOM",
-    "ServingEngine", "EngineShapeError", "PrefixCache",
-    "ContinuousBatchingScheduler", "Request",
+    "ServingEngine", "EngineShapeError", "MoEServingEngine",
+    "PrefixCache", "ContinuousBatchingScheduler", "Request",
     "simulate_decode_signatures", "make_shared_prefix_workload",
 ]

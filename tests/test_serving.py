@@ -389,12 +389,12 @@ def test_check_program_serving_gate_clean():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     reports = mod.lint_model("serving", hbm_budget_gb=16)
-    assert len(reports) == 3
+    assert len(reports) == 4
     for rep in reports:
         assert rep.clean, str(rep)
     assert {r.target_name for r in reports} == {
         "serving.decode_step", "serving.decode_buckets",
-        "serving.chunk_prefill"}
+        "serving.chunk_prefill", "serving.moe_decode_step"}
 
 
 # ------------------------------------------------------------- predict

@@ -86,6 +86,10 @@ _ANCHOR_MAP = {
     "serving_engine_int8_tokens_per_sec": "serving_int8_predicted",
     "serving_shared_prefix": "serving_shared_prefix_predicted",
     "serving_disagg": "serving_disagg_predicted",
+    # the MoE serving engine row (ERNIE-MoE, fused Pallas dispatch)
+    # anchors on the static cost model's MoE decode-program row
+    "serving_moe_tokens_per_sec": "serving_moe_predicted",
+    "serving_moe": "serving_moe_predicted",
     "collective_compression": "collective_compression_predicted",
     # a measured planner-config 13B run (TPU rounds) anchors on the
     # planner's own predicted row, not the hand-written config's

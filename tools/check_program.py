@@ -206,11 +206,24 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
     diags = []
     # the closure proof runs once per ENGINE MODE — the classic
     # bucketed engine, the chunked/prefix-cache engine (whose prefill
-    # side is ONE traced-offset chunk program), and the disaggregated
+    # side is ONE traced-offset chunk program), the disaggregated
     # engine (per-bucket prefill programs on the prefill mesh + scatter
-    # landings on the decode mesh). Each mode's allowed set must match
-    # what the real engine would AOT-compile, and every signature the
-    # real scheduler requests must fall inside it.
+    # landings on the decode mesh), and the MoE engine (ERNIE-MoE
+    # dense/MoE stack, fused Pallas dispatch — classic prefill
+    # semantics, its own bucket/pool sizing). Each mode's allowed set
+    # must match what the real engine would AOT-compile, and every
+    # signature the real scheduler requests must fall inside it.
+    from paddle_tpu.models import (ErnieMoeForPretraining, ErnieMoeModel,
+                                   ernie_moe_tiny_config)
+    from paddle_tpu.serving import MoEServingEngine
+    mcfg = ernie_moe_tiny_config(num_hidden_layers=2, hidden_size=32,
+                                 num_attention_heads=2,
+                                 intermediate_size=64, num_experts=4,
+                                 max_position_embeddings=64)
+    mmodel = ErnieMoeForPretraining(ErnieMoeModel(mcfg))
+    mmodel.eval()
+    moe_eng = MoEServingEngine(mmodel, page_size=8,
+                               decode_buckets=(1, 2, 4), aot=False)
     chunk = eng.prefill_buckets[0]
     modes = {
         "classic": (dict(), eng),
@@ -222,6 +235,10 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
                    ServingEngine(model, page_size=8,
                                  decode_buckets=(1, 2, 4),
                                  disaggregated=True, aot=False)),
+        # MoE: classic prefill/decode semantics over the MoE engine's
+        # own pool/bucket config — proves the scheduler can never ask
+        # the MoE decode program for an uncompiled shape either
+        "moe": (dict(), moe_eng),
     }
     for mode, (sim_kw, mode_eng) in modes.items():
         used_d, used_p, ok_d, ok_p = simulate_decode_signatures(
@@ -282,6 +299,32 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
         jax.ShapeDtypeStruct((1, cpool.max_pages_per_seq), i32),
         jax.ShapeDtypeStruct((C,), i32),
         name="serving.chunk_prefill"))
+
+    # the MoE decode program (fused Pallas dispatch inside) through the
+    # full pass suite: the fused path must lint clean — in particular
+    # the cost pass's PTCS004 fusion-opportunity diagnostic must NOT
+    # fire on it (a pallas_call IS the fused form)
+    from paddle_tpu.serving.moe_engine import moe_decode_step_fn
+    mpool = moe_eng.pool
+    mbucket = moe_eng.decode_buckets[-1]
+    mfn = functools.partial(
+        moe_decode_step_fn, kinds=moe_eng.kinds,
+        eps=mcfg.layer_norm_eps, top_k=mcfg.top_k, temperature=0.0,
+        topk_sample=0, use_kernel=False, use_fused_moe=True)
+
+    def moe_decode(kp, vp, tokens, positions, table, lens):
+        a = [unwrap(t) for t in (kp, vp, tokens, positions, table, lens)]
+        return mfn(moe_eng.params, *a, None)
+
+    mkp = jax.ShapeDtypeStruct(mpool.k_pages.shape, mpool.k_pages.dtype)
+    reports.append(ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
+        moe_decode, mkp, mkp,
+        jax.ShapeDtypeStruct((mbucket,), i32),
+        jax.ShapeDtypeStruct((mbucket,), i32),
+        jax.ShapeDtypeStruct((mbucket, mpool.max_pages_per_seq), i32),
+        jax.ShapeDtypeStruct((mbucket,), i32),
+        name="serving.moe_decode_step"))
     return reports
 
 
