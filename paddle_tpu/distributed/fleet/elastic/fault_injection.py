@@ -106,3 +106,18 @@ class FaultInjector:
 
     def last_injection_time(self):
         return self.events[-1][0] if self.events else None
+
+
+def kill_replica(router, replica_id, sig=signal.SIGKILL):
+    """SIGKILL one serving-fleet replica in place (game-day drill /
+    the replica-kill-under-load acceptance test).
+
+    ``router`` is a :class:`paddle_tpu.serving.fleet.FleetRouter` — it
+    exposes the same ``pid_of`` surface ``PodLauncher`` does, so
+    :class:`FaultInjector` also works against a fleet directly; this
+    helper is the discoverable one-liner, delegating to the router's
+    own :meth:`kill_replica`. The router's next supervision tick
+    re-enqueues the dead replica's in-flight requests (idempotent by
+    request id) and relaunches a replacement: goodput recovers with
+    zero failed requests. Returns the killed pid."""
+    return router.kill_replica(replica_id, sig)

@@ -262,14 +262,23 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
     one token per decode step, so the predicted decode step time IS the
     predicted per-token latency). Buckets:
 
-    ==========  ============================================================
-    ``queue``   submit→admit wait, amortized per token
-    ``prefill`` measured prefill walltime per token
-    ``compile`` AOT bucket-compile seconds amortized per token
-    ``decode``  everything else — decode slower than the roofline plus
-                scheduler overhead (the residual is a bucket, not an
-                apology; same contract as the training attribution)
-    ==========  ============================================================
+    ===============  =======================================================
+    ``router_queue`` FLEET runs only: wait at the fleet router before a
+                     replica saw the request (absent when zero — a
+                     single-replica run keeps the classic bucket set)
+    ``queue``        submit→admit wait at the replica, amortized per token
+    ``prefill``      measured prefill walltime per token
+    ``compile``      AOT bucket-compile seconds amortized per token
+    ``decode``       everything else — decode slower than the roofline
+                     plus scheduler overhead (the residual is a bucket,
+                     not an apology; same contract as the training
+                     attribution)
+    ===============  =======================================================
+
+    Fleet runs (federated records spanning >1 replica) additionally get
+    a ``fleet`` section: per-replica per-token means and — mirroring
+    the training straggler pass — the slowest replica named when its
+    mean exceeds ``straggler_threshold``× the fleet median.
     """
     sv = summary.get("serving") or {}
     tokens = int(sv.get("new_tokens_total") or 0)
@@ -281,17 +290,27 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
     if predicted_ms <= 0:
         return None
     total_s = float(sv.get("request_seconds_total") or 0.0)
+    # replica-side request walltime starts when the REPLICA saw the
+    # request; a fleet run's router wait happened before that, so the
+    # end-to-end measured time adds it explicitly (and the router_queue
+    # bucket carries exactly that addition)
+    router_s = float(sv.get("router_wait_seconds_total") or 0.0)
     compile_s = float((summary.get("compile") or {}).get("seconds") or 0.0)
-    measured_ms = (total_s + compile_s) / tokens * 1e3
+    measured_ms = (total_s + router_s + compile_s) / tokens * 1e3
     delta_ms = measured_ms - predicted_ms
+    router_b = router_s / tokens * 1e3
     queue_b = float(sv.get("queue_wait_seconds_total") or 0.0) \
         / tokens * 1e3
     prefill_b = float(sv.get("prefill_seconds_total") or 0.0) \
         / tokens * 1e3
     compile_b = compile_s / tokens * 1e3
-    decode_b = delta_ms - queue_b - prefill_b - compile_b
+    decode_b = delta_ms - router_b - queue_b - prefill_b - compile_b
     buckets = {"queue": queue_b, "prefill": prefill_b,
                "compile": compile_b, "decode": decode_b}
+    if router_b > 0:
+        # fleet bucket only when the run actually crossed a router —
+        # single-replica attributions keep the classic four-bucket shape
+        buckets["router_queue"] = router_b
     out = {
         "measured_ms": round(measured_ms, 3),
         "predicted_ms": round(predicted_ms, 3),
@@ -325,6 +344,42 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
             "measured_request_rate divides tokens by summed per-request "
             "wall seconds (streams overlap, so engine throughput is "
             "higher at concurrency > 1)")
+    fleet = _fleet_section(sv)
+    if fleet is not None:
+        out["fleet"] = fleet
+    return out
+
+
+def _fleet_section(sv: dict, straggler_threshold: float = 1.3
+                   ) -> dict | None:
+    """Per-replica view of a federated serving summary: decode-speed
+    means by replica and the straggler verdict (slowest replica's
+    per-token mean vs the fleet median — the serving twin of the
+    training straggler pass). None for single-replica runs."""
+    per = sv.get("per_replica") or {}
+    means = {r: d.get("per_token_s_mean") for r, d in per.items()
+             if isinstance(d.get("per_token_s_mean"), (int, float))}
+    if len(per) < 2:
+        return None
+    out = {
+        "replicas": len(per),
+        "per_replica": per,
+        "router_wait_seconds_total": sv.get("router_wait_seconds_total"),
+        "straggler": None,
+    }
+    if len(means) >= 2:
+        ordered = sorted(means.values())
+        mid = len(ordered) // 2
+        median = ordered[mid] if len(ordered) % 2 \
+            else 0.5 * (ordered[mid - 1] + ordered[mid])
+        slow = max(means, key=means.get)
+        if median > 0 and means[slow] / median >= straggler_threshold:
+            out["straggler"] = {
+                "replica": slow,
+                "skew": round(means[slow] / median, 3),
+                "replica_mean_ms": round(means[slow] * 1e3, 3),
+                "fleet_median_ms": round(median * 1e3, 3),
+            }
     return out
 
 
@@ -427,6 +482,15 @@ def collect_findings(summary: dict, attribution: dict | None = None,
             sorted((sv.get("reject_reasons") or {}).items()))
         add("warn" if n_req and n_rej / n_req > 0.05 else "info",
             "rejected_requests", detail)
+    if serving_attribution and serving_attribution.get("fleet"):
+        strag = serving_attribution["fleet"].get("straggler")
+        if strag:
+            add("crit", "straggler_replica",
+                f"replica {strag['replica']} decodes at "
+                f"{strag['replica_mean_ms']}ms/token vs the fleet median "
+                f"{strag['fleet_median_ms']}ms ({strag['skew']}x) — "
+                f"affinity keeps routing its prefixes there; drain it or "
+                f"check the host")
     if serving_attribution:
         b = serving_attribution["buckets"]
         top = max(b, key=lambda k: b[k])
@@ -547,9 +611,23 @@ def format_report(report: dict) -> str:
         total = sum(abs(v) for v in b.values()) or 1.0
         for k, v in sorted(b.items(), key=lambda kv: -abs(kv[1])):
             share = 100 * abs(v) / total
-            lines.append(f"  {k:<8} {v:+9.3f} ms  ({share:4.1f}%)")
+            lines.append(f"  {k:<12} {v:+9.3f} ms  ({share:4.1f}%)")
         for note in sattr.get("notes", []):
             lines.append(f"note: {note}")
+        fl = sattr.get("fleet")
+        if fl:
+            per = {r: d.get("per_token_s_mean")
+                   for r, d in fl["per_replica"].items()}
+            lines.append(
+                f"fleet: {fl['replicas']} replicas; per-token mean s by "
+                f"replica: " + ", ".join(
+                    f"{r}={v}" for r, v in sorted(per.items())))
+            strag = fl.get("straggler")
+            if strag:
+                lines.append(
+                    f"fleet straggler: replica {strag['replica']} at "
+                    f"{strag['replica_mean_ms']}ms/token vs median "
+                    f"{strag['fleet_median_ms']}ms ({strag['skew']}x)")
     if sv:
         def pcts(key, scale=1e3, unit="ms"):
             p = sv.get(key) or {}

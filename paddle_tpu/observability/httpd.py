@@ -5,10 +5,15 @@ process with a metrics registry) — no new dependencies, nothing on the
 hot path. Routes:
 
 - ``/metrics``  — the registry's Prometheus text exposition 0.0.4
-  (what a Prometheus scraper or ``curl`` reads mid-run),
+  (what a Prometheus scraper or ``curl`` reads mid-run); a fleet
+  front-end passes ``metrics_fn`` to serve a FEDERATED exposition
+  (per-replica series relabeled and concatenated) instead,
 - ``/healthz``  — 200 ``ok`` while the status provider reports healthy,
-  503 naming ``last_error`` once the serving loop has died on an engine
-  failure (the liveness probe contract),
+  200 ``draining`` while the provider is healthy but draining (a
+  retiring replica: finish in-flight work, accept nothing new — a
+  fleet router must distinguish this from dead), 503 naming
+  ``last_error`` once the serving loop has died on an engine failure
+  (the liveness probe contract),
 - ``/status``   — a JSON snapshot from the status provider: queue depth,
   active/finished/rejected counts, KV-pool utilization + fragmentation,
   SLO burn rates, last anomaly (see
@@ -31,6 +36,11 @@ The server is a daemon ``ThreadingHTTPServer`` — concurrent scrapes each
 get their own handler thread, and the registry's locking makes every
 ``/metrics`` body a consistent cut. ``close()`` is idempotent and leaves
 no thread or socket behind (tier-1 asserts this).
+
+Fleet hardening: the default ``port=0`` binds an EPHEMERAL port and the
+bound port is returned on ``.port`` / ``.url`` — N replicas starting on
+one host must never race for a fixed port. Pass an explicit ``port``
+only for a singleton deployment.
 """
 from __future__ import annotations
 
@@ -59,13 +69,21 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                self._send(200, owner.registry.to_prometheus(),
+                self._send(200, owner.metrics_text(),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
-                healthy, detail = owner.health()
-                self._send(200 if healthy else 503,
-                           "ok\n" if healthy else f"unhealthy: {detail}\n",
-                           "text/plain; charset=utf-8")
+                healthy, detail, draining = owner.probe()
+                if healthy and draining:
+                    # alive and finishing in-flight work, routable: NO —
+                    # 200 keeps liveness probes green while the body
+                    # tells the router to stop sending traffic
+                    self._send(200, "draining\n",
+                               "text/plain; charset=utf-8")
+                else:
+                    self._send(200 if healthy else 503,
+                               "ok\n" if healthy
+                               else f"unhealthy: {detail}\n",
+                               "text/plain; charset=utf-8")
             elif path == "/status":
                 self._send(200, json.dumps(owner.status(), sort_keys=True,
                                            default=str) + "\n",
@@ -88,15 +106,20 @@ class ServingStatusServer:
 
     ``status_fn`` returns the ``/status`` JSON dict; when it carries
     ``{"healthy": False, "last_error": ...}`` the ``/healthz`` probe
-    flips to 503. Without a provider the server is registry-only
-    (``/status`` serves a minimal snapshot, ``/healthz`` is always ok).
+    flips to 503, and ``{"draining": True}`` makes it answer 200
+    ``draining`` (retiring, not dead). Without a provider the server is
+    registry-only (``/status`` serves a minimal snapshot, ``/healthz``
+    is always ok). ``metrics_fn`` overrides the ``/metrics`` body — the
+    fleet front-end uses it to serve the federated exposition.
     """
 
     def __init__(self, status_fn=None, registry=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_fn=None):
         from .metrics import get_registry
         self.registry = registry or get_registry()
         self._status_fn = status_fn
+        self._metrics_fn = metrics_fn
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self  # type: ignore[attr-defined]
@@ -120,16 +143,37 @@ class ServingStatusServer:
             return {"healthy": True, "serving": None}
         return self._status_fn()
 
-    def health(self) -> tuple:
-        """(healthy, detail) from the status provider."""
+    def probe(self) -> tuple:
+        """(healthy, detail, draining) from ONE status() snapshot —
+        the /healthz handler's view. A single call both bounds the
+        probe's cost (a provider may hold the scheduler lock or
+        aggregate a fleet) and keeps healthy/draining consistent."""
         try:
             st = self.status()
         except Exception as e:
-            return False, repr(e)[:200]
+            return False, repr(e)[:200], False
         if not isinstance(st, dict):
-            return True, ""
-        healthy = st.get("healthy", True)
-        return bool(healthy), str(st.get("last_error") or "")[:200]
+            return True, "", False
+        return (bool(st.get("healthy", True)),
+                str(st.get("last_error") or "")[:200],
+                bool(st.get("draining")))
+
+    def health(self) -> tuple:
+        """(healthy, detail) from the status provider."""
+        healthy, detail, _ = self.probe()
+        return healthy, detail
+
+    def draining(self) -> bool:
+        """Provider-reported drain state (False on any failure — a
+        broken provider reads as unhealthy, not draining)."""
+        return self.probe()[2]
+
+    def metrics_text(self) -> str:
+        """The ``/metrics`` body: the override when given (fleet
+        federation), else this process's registry exposition."""
+        if self._metrics_fn is not None:
+            return self._metrics_fn()
+        return self.registry.to_prometheus()
 
     # ---------------------------------------------------------- shutdown
     def close(self):

@@ -62,6 +62,12 @@ paddle_serving_goodput_tokens_total   counter    —
 paddle_serving_prefix_cache_hits_total counter   —
 paddle_serving_prefix_tokens_reused_total counter —
 paddle_serving_prefill_chunks_total   counter    —
+paddle_fleet_replicas                 gauge      state={active,draining}
+paddle_fleet_router_queue_depth       gauge      —
+paddle_fleet_routed_total             counter    outcome={affinity,fallback,
+                                                 round_robin,least_loaded}
+paddle_fleet_requeued_total           counter    —
+paddle_fleet_scale_events_total       counter    action={scale_out,scale_in}
 ====================================  =========  =============================
 
 Serving decode steps additionally ride ``record_train_step`` with
@@ -331,6 +337,39 @@ def serving_prefill_chunks_counter():
         "paddle_serving_prefill_chunks_total",
         "chunk-program invocations (chunked prefill interleaves these "
         "with decode ticks)")
+
+
+def fleet_replicas_gauge():
+    return get_registry().gauge(
+        "paddle_fleet_replicas",
+        "serving-engine replicas by state (active / draining)")
+
+
+def fleet_router_queue_gauge():
+    return get_registry().gauge(
+        "paddle_fleet_router_queue_depth",
+        "requests waiting at the fleet router for a routable replica")
+
+
+def fleet_routed_counter():
+    return get_registry().counter(
+        "paddle_fleet_routed_total",
+        "routing decisions by outcome (affinity = preferred replica "
+        "taken, fallback = preferred saturated -> least-loaded)")
+
+
+def fleet_requeued_counter():
+    return get_registry().counter(
+        "paddle_fleet_requeued_total",
+        "in-flight requests re-enqueued at the router after their "
+        "replica died (idempotent by request id; zero failed requests)")
+
+
+def fleet_scale_events_counter():
+    return get_registry().counter(
+        "paddle_fleet_scale_events_total",
+        "autoscaler actions executed (SLO-burn scale-out / idle "
+        "drain-then-retire scale-in)")
 
 
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,

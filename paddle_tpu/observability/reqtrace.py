@@ -205,6 +205,10 @@ def fold_request_records(records) -> dict | None:
             int(r.get("prefill_chunks") or 0) for r in finished),
         "request_seconds_total": round(sum(vals("total_s")), 6),
         "queue_wait_seconds_total": round(sum(vals("queue_wait_s")), 6),
+        # fleet: time spent queued at the ROUTER before a replica saw
+        # the request (0 for single-replica runs) — the doctor's
+        # router_queue bucket divides this
+        "router_wait_seconds_total": round(sum(vals("router_wait_s")), 6),
         "prefill_seconds_total": round(sum(vals("prefill_s")), 6),
         "decode_seconds_total": round(sum(vals("decode_s")), 6),
         "queue_wait_s": _pcts(vals("queue_wait_s")),
@@ -212,6 +216,38 @@ def fold_request_records(records) -> dict | None:
         "per_token_s": _pcts(per_token),
         "tokens": _pcts(tokens),
     }
+    # fleet runs: records span >1 replica (rank = replica id) — keep a
+    # per-replica breakdown so the doctor can name a straggler REPLICA
+    # the way the training straggler pass names a rank
+    ranks = sorted({int(r["rank"]) for r in finished
+                    if isinstance(r.get("rank"), int) and r["rank"] >= 0})
+    if len(ranks) > 1:
+        per = {}
+        for rank in ranks:
+            rf = [r for r in finished if r.get("rank") == rank]
+            pt = []
+            for r in rf:
+                s = r.get("per_token_s") or {}
+                if isinstance(s.get("mean"), (int, float)):
+                    pt.append(s["mean"])
+                elif isinstance(r.get("decode_s"), (int, float)) \
+                        and (r.get("new_tokens") or 0) > 1:
+                    pt.append(r["decode_s"] / (r["new_tokens"] - 1))
+            per[str(rank)] = {
+                "requests": len(rf),
+                "new_tokens": sum(int(r.get("new_tokens") or 0)
+                                  for r in rf),
+                "per_token_s_mean": round(sum(pt) / len(pt), 6)
+                if pt else None,
+                "ttft_s_mean": round(sum(
+                    r["ttft_s"] for r in rf
+                    if isinstance(r.get("ttft_s"), (int, float)))
+                    / max(sum(1 for r in rf if isinstance(
+                        r.get("ttft_s"), (int, float))), 1), 6),
+                "cached_prefix_tokens": sum(
+                    int(r.get("cached_prefix_len") or 0) for r in rf),
+            }
+        out["per_replica"] = per
     if slo_met:
         met_tokens = sum(int(r.get("new_tokens") or 0) for r in finished
                          if r.get("slo_met"))

@@ -69,8 +69,15 @@ class RunLogger:
         self._fh = open(self._events_path, "a")
         # serving request stream (reqtrace.request_record lines); one
         # shared file — serving is one scheduler process per engine, and
-        # every record is rank/generation-stamped anyway
-        self._requests_path = os.path.join(run_dir, "requests.jsonl")
+        # every record is rank/generation-stamped anyway. A FLEET run
+        # (N replica processes sharing one run dir) sets
+        # PADDLE_REQUESTS_PER_RANK=1 so each replica appends its own
+        # requests.rank<k>.jsonl (no cross-process interleaving);
+        # load_request_records globs requests*.jsonl either way.
+        base = f"requests.rank{self.rank}.jsonl" \
+            if os.environ.get("PADDLE_REQUESTS_PER_RANK") \
+            else "requests.jsonl"
+        self._requests_path = os.path.join(run_dir, base)
         self._requests_fh = None   # opened lazily on first request
 
     def log(self, event: str, **fields):

@@ -56,6 +56,33 @@ disaggregated serving"):
   once per request, and lands it with :func:`.engine.scatter_kv_fn`;
   each side keeps its own bucket set.
 
+Fleet serving (README "Fleet serving"):
+
+- :mod:`.fleet` — ``FleetRouter``: N replica PROCESSES (each a full
+  engine + scheduler + SLO tracker + ``/metrics``/``/healthz``/
+  ``/status``), spawned via ``distributed.spawn``'s store-backed
+  rendezvous and warm-started ``from_checkpoint``; a JSON-over-TCP RPC
+  plane (stdlib sockets, no new deps); crash recovery that re-enqueues
+  the dead replica's in-flight requests at the router (idempotent by
+  global request id — a replica SIGKILL under load costs seconds of
+  throughput and ZERO failed requests) and relaunches a replacement
+  with the elastic controller's restart accounting.
+- :mod:`.router` — the pure policies: ``PrefixAffinityRouter``
+  (rendezvous hash over the first page-granularity token block → the
+  replica already holding that prefix's KV pages; least-loaded
+  fallback by queue depth + free pages) and ``SLOAutoscaler`` (scale
+  out on SUSTAINED SLO burn, drain-then-retire on sustained idle —
+  scale-in never drops an in-flight request).
+- Federation: every replica logs into one shared run dir (rank =
+  replica id), so ``merge_run_dir`` folds the fleet into ONE
+  ``run_summary.json`` (per-replica breakdown + router-queue bucket in
+  the doctor's serving attribution, straggler REPLICA named);
+  ``FleetRouter.serve_http()`` exposes fleet ``/status`` and a
+  federated ``/metrics`` (per-replica series relabeled
+  ``replica="<k>"``). ``serving.predict --mode fleet`` prices the
+  whole thing (per-replica roofline × N minus router overhead,
+  hit-rate-split TTFT) as the ``serving_fleet_predicted`` anchor.
+
 MoE serving (README "Fused MoE dispatch & MoE serving"):
 :mod:`.moe_engine` — ``MoEServingEngine`` makes ERNIE-MoE a first-class
 serving workload: stacked dense/MoE layer weights
@@ -93,10 +120,14 @@ from .prefix_cache import (PrefixCache,  # noqa: F401
                            make_shared_prefix_workload)
 from .scheduler import (ContinuousBatchingScheduler,  # noqa: F401
                         Request, simulate_decode_signatures)
+from .router import PrefixAffinityRouter, SLOAutoscaler  # noqa: F401
+from .fleet import FleetError, FleetRouter, ReplicaHandle  # noqa: F401
 
 __all__ = [
     "PagePool", "PagePoolError", "PagePoolOOM",
     "ServingEngine", "EngineShapeError", "MoEServingEngine",
     "PrefixCache", "ContinuousBatchingScheduler", "Request",
     "simulate_decode_signatures", "make_shared_prefix_workload",
+    "FleetRouter", "FleetError", "ReplicaHandle",
+    "PrefixAffinityRouter", "SLOAutoscaler",
 ]

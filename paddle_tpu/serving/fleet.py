@@ -1,0 +1,1060 @@
+"""Fleet serving: N engine replicas behind one prefix-affinity router.
+
+One ``ContinuousBatchingScheduler`` on one host is a single-replica
+story; this module composes the existing pieces into the
+millions-of-users shape (ROADMAP item 3):
+
+- **Replicas** — each replica is ONE OS process running a full serving
+  stack (``ServingEngine``/``MoEServingEngine`` + scheduler + SLO
+  tracker + per-replica ``/metrics``/``/healthz``/``/status``),
+  spawned via :func:`paddle_tpu.distributed.spawn`'s store-backed
+  rendezvous and warm-started with ``from_checkpoint`` when a
+  checkpoint is given. The replica publishes its RPC + HTTP ports back
+  through the rendezvous store (child-chosen ephemeral ports — N
+  replicas on one host can never collide), then serves until told to
+  shut down.
+- **Router** — :class:`FleetRouter` front-ends the fleet: requests are
+  routed with **prefix affinity** (:class:`~.router.
+  PrefixAffinityRouter` — consistent hash over the first
+  page-granularity token block, so same-prefix traffic lands on the
+  replica already holding those KV pages and PR 11's cache turns the
+  prefill into a page-table copy), falling back to least-loaded by
+  queue depth + free KV pages when the preferred replica is saturated.
+- **Elasticity** — the supervision tick replaces crashed replicas
+  (same restart accounting the elastic relaunch controller uses:
+  ``relaunch`` runlog events + ``paddle_elastic_restarts_total``) and
+  re-enqueues the dead replica's in-flight requests at the router —
+  idempotent by GLOBAL request id, so a replica SIGKILL under load
+  costs throughput for a few seconds and **zero failed requests**.
+  :class:`~.router.SLOAutoscaler` drives elastic sizing off PR 10's
+  SLO burn rates: sustained TTFT/queue-wait burn scales out, a
+  sustained idle fleet drains one replica (stop routing to it, let
+  in-flight work finish) and retires it — scale-in never drops a
+  request either.
+- **Federation** — every replica logs into ONE shared run dir
+  (rank = replica id, per-rank ``requests.rank<k>.jsonl`` streams), so
+  ``merge_run_dir`` already folds the whole fleet into one
+  ``run_summary.json``; :meth:`FleetRouter.federate` adds the
+  fleet-level section (routing stats, requeued rids, scale events,
+  restarts). :meth:`FleetRouter.serve_http` exposes the fleet
+  ``/status`` (per-replica health + pool + burn rates + aggregates)
+  and a federated ``/metrics`` (per-replica series relabeled with
+  ``replica="<k>"``).
+
+The RPC plane is newline-delimited JSON over stdlib TCP sockets (one
+short-lived connection per call, no framing state, no new
+dependencies); the rendezvous store is the only other wire.
+
+Quickstart::
+
+    from paddle_tpu.serving.fleet import FleetRouter
+    fleet = FleetRouter(cfg, checkpoint="gpt.pdparams", n_replicas=2,
+                        engine_kwargs=dict(page_size=16,
+                                           decode_buckets=(1, 2, 4)))
+    fleet.start()
+    rids = [fleet.submit(ids, max_new_tokens=32) for ids in prompts]
+    fleet.run()                     # tick until drained
+    out = fleet.results[rids[0]]["tokens"]
+    fleet.shutdown()                # reap + retire + federate
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["FleetRouter", "ReplicaHandle", "FleetError"]
+
+_RPC_TIMEOUT_S = 60.0
+
+
+def _debug(msg: str):
+    """Replica-startup breadcrumbs to stderr (PADDLE_FLEET_DEBUG=1) —
+    a replica that wedges before its rendezvous publish is otherwise
+    invisible (its RPC plane does not exist yet)."""
+    if os.environ.get("PADDLE_FLEET_DEBUG"):
+        import sys
+        print(f"[fleet pid={os.getpid()}] {msg}", file=sys.stderr,
+              flush=True)
+
+
+class FleetError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# RPC plane: newline-delimited JSON over stdlib TCP
+# ---------------------------------------------------------------------------
+
+def _rpc_request(addr: tuple, payload: dict,
+                 timeout: float = _RPC_TIMEOUT_S) -> dict:
+    """One call: connect, send one JSON line, read one JSON line."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(json.dumps(payload).encode() + b"\n")
+        with s.makefile("rb") as f:
+            line = f.readline()
+    if not line:
+        raise ConnectionError(f"empty RPC reply from {addr}")
+    return json.loads(line.decode())
+
+
+class _RPCServer:
+    """Replica-side accept loop (daemon threads, one per connection)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1"):
+        self._handler = handler
+        self._sock = socket.create_server((host, 0))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="fleet-rpc")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn):
+        try:
+            conn.settimeout(_RPC_TIMEOUT_S)
+            with conn, conn.makefile("rb") as f:
+                line = f.readline()
+                if not line:
+                    return
+                try:
+                    reply = self._handler(json.loads(line.decode()))
+                except Exception as e:  # a bad request must not kill serving
+                    reply = {"ok": False, "error": repr(e)[:300]}
+                conn.sendall(json.dumps(reply).encode() + b"\n")
+        except Exception:
+            pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# replica process
+# ---------------------------------------------------------------------------
+
+def _build_engine(spec: dict):
+    """Engine from a replica spec — warm start via ``from_checkpoint``
+    when a checkpoint path is given, else a freshly built (seeded)
+    model. Runs inside the replica process."""
+    kind = spec.get("model_kind", "gpt")
+    cfg = spec["config"]
+    kw = dict(spec.get("engine_kwargs") or {})
+    ckpt = spec.get("checkpoint")
+    if kind == "gpt":
+        from .engine import ServingEngine
+        if ckpt:
+            return ServingEngine.from_checkpoint(ckpt, cfg, **kw)
+        import paddle_tpu as paddle
+        from ..models.gpt import GPTForPretraining, GPTModel
+        paddle.seed(int(spec.get("seed", 0)))
+        return ServingEngine(GPTForPretraining(GPTModel(cfg)), cfg, **kw)
+    if kind == "moe":
+        from .moe_engine import MoEServingEngine
+        import paddle_tpu as paddle
+        from ..models import ErnieMoeForPretraining, ErnieMoeModel
+        if ckpt:
+            raise FleetError("checkpoint warm-start is GPT-only for now")
+        paddle.seed(int(spec.get("seed", 0)))
+        model = ErnieMoeForPretraining(ErnieMoeModel(cfg))
+        model.eval()
+        return MoEServingEngine(model, **kw)
+    raise FleetError(f"unknown model_kind {kind!r}")
+
+
+def _fleet_replica_main(spec: dict):
+    """Child entry (spawned by :meth:`FleetRouter._spawn_replica`):
+    build the serving stack, publish RPC/HTTP endpoints through the
+    rendezvous store, then run the scheduler loop until a ``shutdown``
+    RPC arrives. An engine failure logs, re-raises, and kills the
+    process — the router's supervision tick treats the dead process as
+    a crash (re-enqueue + relaunch)."""
+    # replica processes run on CPU by default: N engine processes on one
+    # host cannot share the (exclusive-per-process) TPU; a multi-chip
+    # deployment sets platform per replica instead
+    platform = spec.get("platform", "cpu")
+    os.environ["JAX_PLATFORMS"] = platform
+    _debug(f"replica {spec.get('replica_id')} booting (platform "
+           f"{platform})")
+    import jax
+    jax.config.update("jax_platforms", platform)
+    # telemetry identity: rank = REPLICA id (spawn set rank-0 vars for
+    # its 1-process pod), one shared fleet run dir, per-rank request
+    # streams so N appenders never interleave
+    rid = int(spec["replica_id"])
+    os.environ["PADDLE_TRAINER_ID"] = str(rid)
+    os.environ["PADDLE_REQUESTS_PER_RANK"] = "1"
+    if spec.get("run_dir"):
+        os.environ["PADDLE_TELEMETRY_DIR"] = spec["run_dir"]
+
+    from ..observability.runlog import get_run_logger
+    from ..observability.slo import SLOConfig
+    from .scheduler import ContinuousBatchingScheduler
+
+    _debug("building engine")
+    engine = _build_engine(spec)
+    _debug("engine built")
+    slo = spec.get("slo")
+    sched = ContinuousBatchingScheduler(
+        engine, slo=SLOConfig(**slo) if isinstance(slo, dict) else slo,
+        max_queue=int(spec.get("max_queue", 1024)),
+        **dict(spec.get("scheduler_kwargs") or {}))
+    http = sched.serve_http(port=0)  # ephemeral: replicas never collide
+    stop = threading.Event()
+    reported: set = set()
+
+    def handler(msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "replica": rid}
+        if op == "submit":
+            r = sched.submit(np.asarray(msg["prompt"], np.int32),
+                             int(msg["max_new"]), eos_id=msg.get("eos_id"),
+                             rid=int(msg["rid"]),
+                             router_wait_s=float(msg.get("router_wait_s")
+                                                 or 0.0))
+            if r.state == "rejected":
+                # surfaced synchronously; keep reap from re-reporting it
+                reported.add(r.rid)
+                return {"ok": True, "accepted": False,
+                        "reason": r.reject_reason}
+            return {"ok": True, "accepted": True}
+        if op == "poll":
+            done = []
+            with sched._lock:
+                for r in sched.finished + sched.rejected:
+                    if r.rid in reported:
+                        continue
+                    reported.add(r.rid)
+                    done.append({"rid": r.rid, "state": r.state,
+                                 "reject_reason": r.reject_reason,
+                                 "tokens": [int(t) for t in r.tokens],
+                                 "summary": r.summary()})
+            st = sched.status()
+            st["replica"] = rid
+            st["pid"] = os.getpid()
+            st["http_url"] = http.url
+            return {"ok": True, "done": done, "status": st}
+        if op == "drain":
+            sched.drain()
+            return {"ok": True, "draining": True}
+        if op == "shutdown":
+            stop.set()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    rpc = _RPCServer(handler)
+    # publish endpoints through the spawn rendezvous store: the parent
+    # blocks on these keys, so a replica that fails to build an engine
+    # fails the startup handshake loudly instead of hanging the fleet
+    from ..distributed.store import TCPStore
+    host, port = os.environ["PADDLE_STORE_ENDPOINT"].rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=False, world_size=1)
+    try:
+        store.set("fleet/rpc", f"{rpc.host}:{rpc.port}".encode())
+        store.set("fleet/http", http.url.encode())
+    finally:
+        store.close()
+    _debug(f"rendezvous published rpc={rpc.host}:{rpc.port}")
+
+    logger = get_run_logger()
+    if logger is not None:
+        logger.log("replica_start", replica=rid, rpc_port=rpc.port,
+                   http_url=http.url,
+                   engine=type(engine).__name__,
+                   warm_start=bool(spec.get("checkpoint")))
+    last_flush = time.monotonic()
+    try:
+        while not stop.is_set():
+            try:
+                busy = sched.step() if sched.pending else False
+            except Exception as e:
+                if logger is not None:
+                    logger.log("replica_engine_error", replica=rid,
+                               error=repr(e)[:300])
+                raise  # die nonzero -> supervisor relaunches
+            if not busy:
+                time.sleep(0.002)
+            now = time.monotonic()
+            if logger is not None and now - last_flush > 2.0:
+                # periodic snapshot: a SIGKILLed replica still leaves
+                # recent counters for the federated summary
+                logger.flush_metrics()
+                last_flush = now
+    finally:
+        if logger is not None:
+            logger.log("replica_stop", replica=rid,
+                       finished=len(sched.finished),
+                       draining=sched.draining)
+            logger.close()  # flushes metrics
+        http.close()
+        rpc.close()
+
+
+# ---------------------------------------------------------------------------
+# parent-side replica handle
+# ---------------------------------------------------------------------------
+
+class ReplicaHandle:
+    """One spawned replica, parent side: process + RPC address + state."""
+
+    def __init__(self, replica_id: int, spec: dict):
+        from ..distributed.spawn import spawn
+        self.replica_id = int(replica_id)
+        self.spec = spec
+        self.draining = False
+        self.retired = False
+        self.launched_ts = time.monotonic()
+        self.last_status: dict = {}
+        self._ctx = spawn(_fleet_replica_main, args=(spec,), nprocs=1,
+                          join=False,
+                          job_id=f"fleet{os.getpid()}r{replica_id}")
+        self.proc = self._ctx.processes[0]
+        try:
+            ep = self._ctx._store.get("fleet/rpc").decode()
+            self.http_url = self._ctx._store.get("fleet/http").decode()
+        except Exception as e:
+            self.stop(grace=False)
+            raise FleetError(
+                f"replica {replica_id} failed startup rendezvous: "
+                f"{e!r}") from e
+        host, port = ep.rsplit(":", 1)
+        self.rpc_addr = (host, int(port))
+
+    @property
+    def pid(self):
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def rpc(self, payload: dict, timeout: float = _RPC_TIMEOUT_S) -> dict:
+        reply = _rpc_request(self.rpc_addr, payload, timeout=timeout)
+        if not reply.get("ok"):
+            raise FleetError(
+                f"replica {self.replica_id} RPC {payload.get('op')!r} "
+                f"failed: {reply.get('error')}")
+        return reply
+
+    def stop(self, grace: bool = True, timeout: float = 15.0):
+        """Graceful shutdown (RPC + join), escalating to terminate."""
+        if grace and self.alive():
+            try:
+                self.rpc({"op": "shutdown"}, timeout=10.0)
+            except Exception:
+                pass
+        self.proc.join(timeout)
+        if self.proc.is_alive():
+            self.proc.terminate()
+            self.proc.join(5.0)
+        self._ctx._close()
+        self.retired = True
+
+
+# ---------------------------------------------------------------------------
+# the fleet router
+# ---------------------------------------------------------------------------
+
+class FleetRouter:
+    """Front-end over N serving-engine replicas (see module docstring).
+
+    ``config`` is the model config (``GPTConfig`` / ``ErnieMoeConfig``);
+    ``checkpoint`` warm-starts every replica via ``from_checkpoint``;
+    ``engine_kwargs`` pass through to the engine (``prefix_cache=True``
+    by default — affinity routing exists to feed it). ``policy`` is the
+    routing policy (``affinity`` / ``round_robin`` / ``least_loaded``)
+    and ``autoscaler`` an optional :class:`~.router.SLOAutoscaler`.
+    """
+
+    def __init__(self, config, *, checkpoint=None, n_replicas: int = 2,
+                 model_kind: str = "gpt", engine_kwargs: dict | None = None,
+                 scheduler_kwargs: dict | None = None,
+                 policy: str = "affinity", affinity_block: int | None = None,
+                 slo: dict | None = None, autoscaler=None,
+                 run_dir: str | None = None, replica_platform: str = "cpu",
+                 max_restarts: int = 3, max_queue: int = 4096, seed: int = 0):
+        from .router import PrefixAffinityRouter
+        self.config = config
+        self.checkpoint = checkpoint
+        self.model_kind = model_kind
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        if model_kind == "gpt":
+            self.engine_kwargs.setdefault("prefix_cache", True)
+        self.n_replicas = int(n_replicas)
+        self.replica_platform = replica_platform
+        self.max_restarts = int(max_restarts)
+        self.max_queue = int(max_queue)
+        self.seed = int(seed)
+        self.slo = slo
+        self.autoscaler = autoscaler
+        self.page_size = int(self.engine_kwargs.get("page_size", 16))
+        self.policy = PrefixAffinityRouter(
+            block_tokens=int(affinity_block or self.page_size),
+            policy=policy)
+        if run_dir is None:
+            import tempfile
+            run_dir = tempfile.mkdtemp(prefix="fleet_run_")
+        self.run_dir = run_dir
+        self.replicas: dict[int, ReplicaHandle] = {}
+        self.retired: list = []
+        self.restarts = 0
+        self._next_replica = 0
+        self._next_rid = 0
+        self._queue: list = []          # router-held request dicts
+        self._inflight: dict = {}       # rid -> request dict (dispatched)
+        self.results: dict = {}         # rid -> terminal record
+        self.requeued_rids: list = []
+        self.scale_events: list = []
+        self._lock = threading.RLock()
+        self._boot_threads: list = []   # in-flight async relaunches
+        self._started = False
+        self._logger = None
+        self._http = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Spawn the initial replica set — in parallel threads, since
+        each rendezvous blocks on the replica's engine build — and the
+        router's own telemetry stream (rank -1, controller convention)."""
+        from ..observability.runlog import RunLogger
+        if self._started:
+            return self
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._logger = RunLogger(self.run_dir, rank=-1, generation=0)
+        ids, errs, threads = [], [], []
+        for _ in range(self.n_replicas):
+            ids.append(self._next_replica)
+            self._next_replica += 1
+
+        def boot(rid):
+            try:
+                h = ReplicaHandle(rid, self._spec(rid))
+                with self._lock:
+                    self.replicas[rid] = h
+            except Exception as e:
+                errs.append(e)
+        for rid in ids:
+            t = threading.Thread(target=boot, args=(rid,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            self.shutdown(federate=False)
+            raise errs[0]
+        self._update_replica_gauges()
+        self._started = True
+        self._logger.log("fleet_start",
+                         replicas=sorted(self.replicas),
+                         policy=self.policy.policy,
+                         checkpoint=bool(self.checkpoint))
+        return self
+
+    def _spec(self, replica_id: int) -> dict:
+        return {
+            "replica_id": replica_id,
+            "model_kind": self.model_kind,
+            "config": self.config,
+            "checkpoint": self.checkpoint,
+            "engine_kwargs": dict(self.engine_kwargs),
+            "scheduler_kwargs": dict(self.scheduler_kwargs),
+            "run_dir": self.run_dir,
+            "slo": self.slo,
+            "platform": self.replica_platform,
+            "seed": self.seed,
+        }
+
+    def _spawn_replica(self) -> int:
+        rid = self._next_replica
+        self._next_replica += 1
+        handle = ReplicaHandle(rid, self._spec(rid))
+        self.replicas[rid] = handle
+        self._update_replica_gauges()
+        return rid
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -------------------------------------------------------------- intake
+    def submit(self, prompt_ids, max_new_tokens: int, eos_id=None) -> int:
+        """Queue one request with a fleet-global rid; dispatched to a
+        replica on this call when one is routable, else held at the
+        router (and counted in the router queue depth the autoscaler
+        watches)."""
+        if not self._started:
+            raise FleetError("FleetRouter.start() first")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            rec = {"rid": rid, "prompt": prompt,
+                   "max_new": int(max_new_tokens), "eos_id": eos_id,
+                   "enqueued_ts": time.monotonic(), "requeues": 0}
+            if len(self._queue) >= self.max_queue:
+                self._terminal(rec, state="rejected",
+                               reject_reason="router_queue_full")
+                return rid
+            self._queue.append(rec)
+        self._dispatch_queued()
+        return rid
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._inflight)
+
+    def warmup(self, max_new_tokens: int = 1, timeout: float = 120.0):
+        """One tiny request DIRECTLY to every live replica, bypassing
+        the routing policy (an affinity hash would send every warmup
+        to the same replica and leave the rest cold). First-execution
+        costs — the first invocation of the AOT programs, device
+        paging — land here instead of inside the first user request's
+        TTFT. Blocks until the warmups finish; returns their rids."""
+        with self._lock:
+            targets = [rid for rid, h in self.replicas.items()
+                       if h.alive() and not h.retired and not h.draining]
+        rids = []
+        for t in targets:
+            with self._lock:
+                rid = self._next_rid
+                self._next_rid += 1
+                rec = {"rid": rid,
+                       "prompt": np.arange(4, dtype=np.int32),
+                       "max_new": int(max_new_tokens), "eos_id": None,
+                       "enqueued_ts": time.monotonic(), "requeues": 0}
+                if self._dispatch(rec, t) == "accepted":
+                    rids.append(rid)
+        deadline = time.monotonic() + timeout
+        while any(r not in self.results for r in rids):
+            if time.monotonic() > deadline:
+                raise FleetError("fleet warmup timed out")
+            # full supervision, not just polling: a replica that dies
+            # ON its warmup request still gets requeued + relaunched
+            self.tick()
+            time.sleep(0.005)
+        return rids
+
+    # ------------------------------------------------------------- routing
+    def _snapshots(self) -> dict:
+        """Routing view of the live, started replicas."""
+        out = {}
+        for rid, h in self.replicas.items():
+            if h.retired or not h.alive():
+                continue
+            st = h.last_status or {}
+            pool = st.get("kv_pool") or {}
+            out[rid] = {
+                "healthy": st.get("healthy", True),
+                "draining": h.draining or st.get("draining", False),
+                "queue_depth": int(st.get("queue_depth") or 0),
+                "pending": int(st.get("queue_depth") or 0)
+                + int(st.get("prefilling") or 0)
+                + int(st.get("running") or 0),
+                "free_pages": int(pool.get("free_pages") or 0),
+                "num_pages": int(pool.get("num_pages") or 0),
+            }
+        return out
+
+    def _dispatch_queued(self):
+        from ..observability import instrument as obs
+        with self._lock:
+            snaps = self._snapshots()
+            still_queued = []
+            for rec in self._queue:
+                pages = -(-(len(rec["prompt"]) + rec["max_new"])
+                          // self.page_size)
+                target = self.policy.route(rec["prompt"], snaps,
+                                           pages_needed=pages)
+                if target is None:
+                    still_queued.append(rec)
+                    continue
+                outcome = self._dispatch(rec, target)
+                if outcome == "accepted":
+                    obs.fleet_routed_counter().inc(
+                        outcome=self.policy.last_outcome or "?")
+                    # optimistic load update so one tick's burst doesn't
+                    # all pile onto the same snapshot
+                    if target in snaps:
+                        snaps[target]["pending"] += 1
+                        snaps[target]["queue_depth"] += 1
+                        snaps[target]["free_pages"] = max(
+                            snaps[target]["free_pages"] - pages, 0)
+                elif outcome == "queued":
+                    still_queued.append(rec)
+                    snaps = self._snapshots()
+                # "rejected": terminal result recorded; neither routed
+                # nor load-updated — the replica refused it
+            self._queue = still_queued
+            obs.fleet_router_queue_gauge().set(float(len(self._queue)))
+
+    def _dispatch(self, rec: dict, target: int) -> str:
+        """Send one request to one replica. Returns ``"accepted"``
+        (in-flight there), ``"queued"`` (transient refusal / dead
+        replica — keep it at the router), or ``"rejected"`` (permanent:
+        a terminal rejected result was recorded — no replica in this
+        fleet can ever serve it)."""
+        handle = self.replicas.get(target)
+        if handle is None:
+            return "queued"
+        wait_s = time.monotonic() - rec["enqueued_ts"]
+        try:
+            reply = handle.rpc({
+                "op": "submit", "rid": rec["rid"],
+                "prompt": [int(t) for t in rec["prompt"]],
+                "max_new": rec["max_new"], "eos_id": rec["eos_id"],
+                "router_wait_s": round(wait_s, 6)})
+        except Exception:
+            return "queued"  # dead or wedged: _supervise decides
+        if reply.get("accepted"):
+            with self._lock:
+                rec["replica"] = target
+                self._inflight[rec["rid"]] = rec
+            return "accepted"
+        reason = str(reply.get("reason") or "?")
+        if reason in ("draining", "queue_full"):
+            return "queued"  # transient: another replica / next tick
+        self._terminal(rec, state="rejected", reject_reason=reason)
+        return "rejected"
+
+    def _terminal(self, rec: dict, state: str, reject_reason=None,
+                  tokens=(), summary=None):
+        with self._lock:
+            self.results[rec["rid"]] = {
+                "rid": rec["rid"], "state": state,
+                "reject_reason": reject_reason,
+                "tokens": list(tokens),
+                "replica": rec.get("replica"),
+                "requeues": rec.get("requeues", 0),
+                "summary": summary,
+            }
+            self._inflight.pop(rec["rid"], None)
+
+    # ---------------------------------------------------------- supervision
+    def tick(self):
+        """One supervision round: poll replicas (reap finished, refresh
+        status), replace dead replicas (re-enqueue their in-flight
+        requests), dispatch the router queue, complete drains, autoscale."""
+        self._poll_replicas()
+        self._supervise()
+        self._dispatch_queued()
+        self._finish_drains()
+        self._autoscale()
+
+    def _poll_replicas(self):
+        for rid, h in list(self.replicas.items()):
+            if h.retired or not h.alive():
+                continue
+            try:
+                reply = h.rpc({"op": "poll"})
+            except Exception:
+                continue  # _supervise decides dead-vs-slow by the process
+            h.last_status = reply.get("status") or {}
+            with self._lock:
+                for done in reply.get("done") or ():
+                    gid = int(done["rid"])
+                    if gid in self.results:
+                        continue  # idempotent by request id
+                    rec = self._inflight.pop(gid, None) or {"rid": gid}
+                    rec.setdefault("replica", rid)
+                    self._terminal(
+                        rec, state=done["state"],
+                        reject_reason=done.get("reject_reason"),
+                        tokens=done.get("tokens") or (),
+                        summary=done.get("summary"))
+
+    def _supervise(self):
+        from ..observability import instrument as obs
+        for rid, h in list(self.replicas.items()):
+            if h.retired or h.alive():
+                continue
+            # crashed (or SIGKILLed) replica: everything it held in
+            # flight re-enqueues at the router — the rid is the
+            # idempotency key, so a request it already finished (and we
+            # already reaped) is never re-run
+            del self.replicas[rid]
+            self.retired.append(h)
+            with self._lock:
+                lost = [rec for rec in self._inflight.values()
+                        if rec.get("replica") == rid]
+                for rec in lost:
+                    self._inflight.pop(rec["rid"], None)
+                    rec["requeues"] += 1
+                    rec["enqueued_ts"] = time.monotonic()
+                    rec.pop("replica", None)
+                    self._queue.insert(0, rec)
+                    self.requeued_rids.append(rec["rid"])
+                    obs.fleet_requeued_counter().inc()
+                    if self._logger is not None:
+                        # visible in the fleet requests stream: the
+                        # black-box record that rid N survived a dead
+                        # replica (event != "request", so request
+                        # folding never counts it twice)
+                        self._logger.log_request({
+                            "event": "request_requeue", "rid": rec["rid"],
+                            "from_replica": rid,
+                            "requeues": rec["requeues"]})
+            if h.draining:
+                # a retiring replica died after drain: nothing to
+                # relaunch — scale-in wanted it gone anyway
+                self._update_replica_gauges()
+                continue
+            exitcode = h.proc.exitcode
+            if self._logger is not None:
+                self._logger.log("replica_dead", replica=rid,
+                                 exitcode=exitcode,
+                                 requeued=[rec["rid"] for rec in lost])
+            if self.restarts >= self.max_restarts:
+                self._update_replica_gauges()
+                continue
+            self.restarts += 1
+            obs.restarts_counter().inc()
+            # relaunch ASYNCHRONOUSLY: the replacement's engine build
+            # takes seconds, and the surviving replicas must keep being
+            # polled/dispatched meanwhile (the requeued requests go to
+            # them right away — that IS the goodput recovery)
+            with self._lock:
+                new_rid = self._next_replica
+                self._next_replica += 1
+
+            def boot(new_rid=new_rid, dead=rid):
+                try:
+                    h = ReplicaHandle(new_rid, self._spec(new_rid))
+                    with self._lock:
+                        self.replicas[new_rid] = h
+                    self._update_replica_gauges()
+                except Exception as e:
+                    if self._logger is not None:
+                        self._logger.log("replica_relaunch_failed",
+                                         replica=new_rid,
+                                         error=repr(e)[:300])
+            t = threading.Thread(target=boot, daemon=True,
+                                 name=f"fleet-relaunch-{new_rid}")
+            t.start()
+            self._boot_threads.append(t)
+            if self._logger is not None:
+                # same event shape the elastic relaunch controller logs,
+                # so merge_run_dir's restart tally needs zero new code
+                self._logger.log("relaunch", restarts=self.restarts,
+                                 dead_replica=rid, new_replica=new_rid)
+
+    def _finish_drains(self):
+        """Retire draining replicas whose in-flight work is done."""
+        for rid, h in list(self.replicas.items()):
+            if not h.draining or h.retired or not h.alive():
+                continue
+            st = h.last_status or {}
+            pending = (int(st.get("queue_depth") or 0)
+                       + int(st.get("prefilling") or 0)
+                       + int(st.get("running") or 0))
+            with self._lock:
+                inflight_here = any(rec.get("replica") == rid
+                                    for rec in self._inflight.values())
+            if pending == 0 and not inflight_here:
+                try:
+                    self._poll_replicas()  # final reap before shutdown
+                except Exception:
+                    pass
+                h.stop()
+                del self.replicas[rid]
+                self.retired.append(h)
+                if self._logger is not None:
+                    self._logger.log("replica_retired", replica=rid)
+                self._update_replica_gauges()
+
+    # ----------------------------------------------------------- autoscale
+    def _burn_rate(self) -> float:
+        burn = 0.0
+        with self._lock:
+            handles = list(self.replicas.values())
+        for h in handles:
+            rates = ((h.last_status or {}).get("slo") or {})\
+                .get("burn_rates") or {}
+            for v in rates.values():
+                burn = max(burn, float(v))
+        return burn
+
+    def _autoscale(self):
+        if self.autoscaler is None:
+            return
+        active = [rid for rid, h in self.replicas.items()
+                  if not h.draining and not h.retired]
+        busy = bool(self._queue or self._inflight) or any(
+            (h.last_status or {}).get("queue_depth")
+            or (h.last_status or {}).get("running")
+            for h in self.replicas.values())
+        decision = self.autoscaler.observe(
+            replicas=len(active), burn_rate=self._burn_rate(), busy=busy,
+            router_queue_depth=len(self._queue))
+        if decision["action"] == "scale_out":
+            self.scale_out(reason=decision["reason"])
+        elif decision["action"] == "scale_in":
+            self.scale_in(reason=decision["reason"])
+
+    def scale_out(self, reason: str = "manual"):
+        from ..observability import instrument as obs
+        rid = self._spawn_replica()
+        obs.fleet_scale_events_counter().inc(action="scale_out")
+        ev = {"action": "scale_out", "replica": rid, "reason": reason,
+              "ts": time.time()}
+        self.scale_events.append(ev)
+        if self._logger is not None:
+            self._logger.log("fleet_scale", **ev)
+        return rid
+
+    def scale_in(self, replica_id: int | None = None,
+                 reason: str = "manual"):
+        """Drain-then-retire one replica (the least loaded, unless
+        named): stop routing to it now; :meth:`tick` retires it once
+        its in-flight work finishes — nothing is dropped."""
+        from ..observability import instrument as obs
+        candidates = {rid: h for rid, h in self.replicas.items()
+                      if not h.draining and not h.retired and h.alive()}
+        if replica_id is not None:
+            candidates = {replica_id: self.replicas[replica_id]} \
+                if replica_id in candidates else {}
+        if len(self.replicas) <= 1 or not candidates:
+            return None
+        rid = min(candidates, key=lambda r: (
+            int((candidates[r].last_status or {}).get("running") or 0)
+            + int((candidates[r].last_status or {}).get("queue_depth")
+                  or 0)))
+        h = self.replicas[rid]
+        h.draining = True
+        try:
+            h.rpc({"op": "drain"})
+        except Exception:
+            pass  # if it died, _supervise handles it
+        obs.fleet_scale_events_counter().inc(action="scale_in")
+        ev = {"action": "scale_in", "replica": rid, "reason": reason,
+              "ts": time.time()}
+        self.scale_events.append(ev)
+        if self._logger is not None:
+            self._logger.log("fleet_scale", **ev)
+        self._update_replica_gauges()
+        return rid
+
+    def _update_replica_gauges(self):
+        from ..observability import instrument as obs
+        g = obs.fleet_replicas_gauge()
+        with self._lock:
+            live = [h for h in self.replicas.values()
+                    if not h.retired and h.alive()]
+        g.set(float(sum(1 for h in live if not h.draining)),
+              state="active")
+        g.set(float(sum(1 for h in live if h.draining)), state="draining")
+
+    # ------------------------------------------------------------- driving
+    def run(self, timeout: float | None = None,
+            tick_interval: float = 0.01) -> bool:
+        """Tick until every submitted request has a terminal result.
+        Returns True when drained, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.outstanding:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self.tick()
+            if self.outstanding:
+                time.sleep(tick_interval)
+        return True
+
+    # ---------------------------------------------------- fault injection
+    def pid_of(self, replica_id: int):
+        """FaultInjector interface: the live pid behind a replica id."""
+        h = self.replicas.get(replica_id)
+        if h is None or h.retired or not h.alive():
+            return None
+        return h.pid
+
+    def kill_replica(self, replica_id: int, sig=signal.SIGKILL):
+        """Game-day helper: SIGKILL one replica in place (see
+        ``fleet.elastic.fault_injection.kill_replica``)."""
+        pid = self.pid_of(replica_id)
+        if pid is None:
+            raise FleetError(f"no live replica {replica_id}")
+        os.kill(pid, sig)
+        return pid
+
+    # ----------------------------------------------------------- federation
+    def fleet_status(self) -> dict:
+        """The fleet ``/status`` body: per-replica health + pool + burn
+        rates, plus fleet aggregates (total pages, federated prefix hit
+        rate, router queue, routing + scale accounting)."""
+        per_replica = {}
+        agg = {"pages_in_use": 0, "free_pages": 0, "num_pages": 0,
+               "tokens_reused": 0, "pages_shared": 0,
+               "prefix_lookups": 0, "prefix_hits": 0}
+        # snapshot under the lock: the HTTP status thread runs this
+        # while a supervision tick may be del-ing replica entries
+        with self._lock:
+            replicas = list(self.replicas.items())
+        for rid, h in replicas:
+            st = dict(h.last_status or {})
+            st["alive"] = h.alive()
+            st["draining"] = h.draining or st.get("draining", False)
+            per_replica[str(rid)] = st
+            pool = st.get("kv_pool") or {}
+            for k in ("pages_in_use", "free_pages", "num_pages",
+                      "tokens_reused", "pages_shared",
+                      "prefix_lookups", "prefix_hits"):
+                agg[k] += int(pool.get(k) or 0)
+        agg["prefix_hit_rate"] = round(
+            agg["prefix_hits"] / agg["prefix_lookups"], 4) \
+            if agg["prefix_lookups"] else 0.0
+        healthy = bool(replicas) and all(
+            h.alive() and (h.last_status or {}).get("healthy", True)
+            for _, h in replicas if not h.draining)
+        return {
+            "healthy": healthy,
+            "ts": time.time(),
+            "replicas": per_replica,
+            "n_replicas": len(replicas),
+            "router_queue_depth": len(self._queue),
+            "inflight": len(self._inflight),
+            "results": len(self.results),
+            "requeued": len(self.requeued_rids),
+            "restarts": self.restarts,
+            "routing": self.policy.stats(),
+            "autoscaler": self.autoscaler.snapshot()
+            if self.autoscaler is not None else None,
+            "scale_events": self.scale_events[-8:],
+            "pool_aggregate": agg,
+            "burn_rate": round(self._burn_rate(), 4),
+        }
+
+    def _federated_metrics(self) -> str:
+        """One exposition for the whole fleet: the router process's own
+        registry verbatim, then every replica's series relabeled with
+        ``replica="<k>"`` (comments dropped — HELP/TYPE live in the
+        router's section)."""
+        from ..observability.metrics import get_registry
+        import urllib.request
+        parts = [get_registry().to_prometheus()]
+        with self._lock:
+            replicas = sorted(self.replicas.items())
+        for rid, h in replicas:
+            if h.retired or not h.alive():
+                continue
+            try:
+                with urllib.request.urlopen(h.http_url + "/metrics",
+                                            timeout=5) as resp:
+                    text = resp.read().decode()
+            except Exception:
+                continue
+            out = []
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name, _, rest = line.partition(" ")
+                if "{" in name:
+                    base, _, labels = name.partition("{")
+                    name = f'{base}{{replica="{rid}",{labels}'
+                else:
+                    name = f'{name}{{replica="{rid}"}}'
+                out.append(f"{name} {rest}")
+            parts.append("\n".join(out))
+        return "\n".join(p for p in parts if p) + "\n"
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Fleet-level /status + federated /metrics + /healthz."""
+        from ..observability.httpd import ServingStatusServer
+        self._http = ServingStatusServer(
+            status_fn=self.fleet_status, host=host, port=port,
+            metrics_fn=self._federated_metrics)
+        return self._http
+
+    def federate(self, write: bool = True) -> dict:
+        """Fold the shared fleet run dir into one ``run_summary.json``
+        (every replica's metrics/events/requests — ``merge_run_dir``
+        does the heavy lifting) and add the fleet section: routing
+        stats, requeued rids, restarts, scale events, terminal-result
+        tallies."""
+        from ..observability.runlog import merge_run_dir
+        if self._logger is not None:
+            try:
+                self._logger.flush_metrics()
+            except Exception:
+                pass
+        summary = merge_run_dir(self.run_dir, write=False)
+        states: dict = {}
+        for rec in self.results.values():
+            states[rec["state"]] = states.get(rec["state"], 0) + 1
+        summary["fleet"] = {
+            "replicas_launched": self._next_replica,
+            "replicas_live": len(self.replicas),
+            "replicas_retired": len(self.retired),
+            "restarts": self.restarts,
+            "requeued_rids": sorted(set(self.requeued_rids)),
+            "router": self.policy.stats(),
+            "router_results": states,
+            "scale_events": list(self.scale_events),
+            "autoscaler": self.autoscaler.snapshot()
+            if self.autoscaler is not None else None,
+        }
+        if write:
+            out = os.path.join(self.run_dir, "run_summary.json")
+            tmp = f"{out}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True,
+                          default=str)
+            os.replace(tmp, out)
+        return summary
+
+    def shutdown(self, federate: bool = True):
+        """Final reap, stop every replica, close the fleet endpoint,
+        and (by default) write the federated run summary."""
+        for t in self._boot_threads:
+            # an async relaunch still building must land (or fail)
+            # before we stop "every" replica — otherwise its process
+            # would outlive the fleet
+            t.join(timeout=_RPC_TIMEOUT_S)
+        self._boot_threads = []
+        try:
+            self._poll_replicas()
+        except Exception:
+            pass
+        for rid, h in list(self.replicas.items()):
+            try:
+                h.stop()
+            except Exception:
+                pass
+            self.retired.append(h)
+            del self.replicas[rid]
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+        summary = None
+        if federate and self._started:
+            try:
+                summary = self.federate()
+            except Exception:
+                pass
+        if self._logger is not None:
+            self._logger.log("fleet_stop", results=len(self.results),
+                             restarts=self.restarts)
+            self._logger.close()
+            self._logger = None
+        self._started = False
+        return summary

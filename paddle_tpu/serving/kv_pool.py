@@ -145,6 +145,10 @@ class PagePool:
             "pool_bytes": 2 * int(np.prod(self.k_pages.shape)) * itemsize,
             "pages_shared": self.pages_shared,
             "tokens_reused": self._tokens_reused,
+            # raw counts next to the rate so a FLEET can aggregate hit
+            # rates exactly (sum hits / sum lookups), not average ratios
+            "prefix_lookups": self._prefix_lookups,
+            "prefix_hits": self._prefix_hits,
             "prefix_hit_rate": round(
                 self._prefix_hits / self._prefix_lookups, 4)
             if self._prefix_lookups else 0.0,

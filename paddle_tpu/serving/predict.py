@@ -36,7 +36,7 @@ import sys
 
 __all__ = ["predicted_serving_row", "predicted_shared_prefix_row",
            "predicted_disagg_row", "predicted_moe_serving_row",
-           "predicted_fused_dispatch_row"]
+           "predicted_fused_dispatch_row", "predicted_fleet_row"]
 
 
 def _gpt_config(config: str):
@@ -303,6 +303,117 @@ def predicted_disagg_row(config: str = "345m", concurrency: int = 8,
     }
 
 
+def predicted_fleet_row(config: str = "345m", replicas: int = 2,
+                        n_requests: int = 16, concurrency: int = 8,
+                        prompt_len: int = 1024,
+                        shared_fraction: float = 0.75, max_new: int = 64,
+                        prefill_chunk: int = 256, page_size: int = 64,
+                        chip: str = "v5e", dtype: str = "bfloat16",
+                        router_overhead_ms: float = 0.2) -> dict:
+    """``serving_fleet_predicted``: the fleet-level static anchor —
+    per-replica roofline × N minus router overhead, with a hit-rate-
+    split TTFT model.
+
+    Workload model: ``n_requests`` requests in N same-prefix groups
+    (one group per replica — the shape prefix-affinity routing
+    produces), each prompt ``prompt_len`` tokens sharing
+    ``shared_fraction`` with its group. Per replica the makespan is
+    serialized prefills (cache-miss chunks for the group's FIRST
+    request, cache-hit suffix chunks for the rest, plus
+    ``router_overhead_ms`` of routing/RPC per request) followed by the
+    batched decode tail; replicas run in parallel, so fleet goodput =
+    total new tokens / the per-replica makespan. The same model under
+    ROUND-ROBIN routing (every group smeared across all replicas →
+    ``min(N, per-replica requests)`` compulsory misses each) is the
+    in-row baseline: the value the affinity policy must beat, computed
+    from the same roofline so the comparison is noise-free."""
+    from ..observability.instrument import chip_specs
+
+    cfg = _gpt_config(config)
+    N = max(int(replicas), 1)
+    M = max(int(n_requests), N)
+    B = int(concurrency)
+    ps = int(page_size)
+    chunk = max(int(prefill_chunk) // ps, 1) * ps
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = B * pages_per_seq + 1
+    spec = chip_specs(chip)
+    cached = int(min(max(shared_fraction, 0.0), 1.0) * prompt_len)
+    cached = min(cached, prompt_len - 1)
+    suffix = prompt_len - cached
+    chunk_ms = _chunk_step_ms(cfg, dtype, None, chunk, pages_per_seq,
+                              num_pages, ps, spec)
+    decode = predicted_serving_row(config, concurrency, page_size, chip,
+                                   dtype)
+    step_ms = decode["predicted_decode_step_ms"]
+    hit_ms = math.ceil(suffix / chunk) * chunk_ms
+    miss_ms = math.ceil(prompt_len / chunk) * chunk_ms
+    per_replica = math.ceil(M / N)
+    tok = M * max_new
+
+    def makespan(n_miss, n_req):
+        n_miss = min(n_miss, n_req)
+        prefill = (n_miss * miss_ms + (n_req - n_miss) * hit_ms
+                   + n_req * float(router_overhead_ms))
+        # decode runs at most B streams at once: requests beyond the
+        # widest decode bucket take extra batched rounds
+        decode = math.ceil(n_req / B) * max_new * step_ms
+        return prefill + decode
+
+    ms_aff = makespan(1, per_replica)     # affinity: one group, one miss
+    ms_rr = makespan(min(N, per_replica),  # round-robin: N groups each
+                     per_replica)
+    # the scaling baseline: the SAME router with one replica behind it
+    # (like-for-like — router overhead on both sides of the ratio)
+    ms_single = makespan(1, M)
+
+    def tps(ms):
+        return round(tok / (ms / 1e3), 1) if ms else 0.0
+
+    fleet_tps = tps(ms_aff)
+    single_tps = tps(ms_single)
+    hit_rate_aff = (per_replica - 1) / per_replica if per_replica else 0.0
+    n_miss_rr = min(N, per_replica)
+    hit_rate_rr = (per_replica - n_miss_rr) / per_replica \
+        if per_replica else 0.0
+    return {
+        "config": config,
+        "replicas": N,
+        "n_requests": M,
+        "concurrency": B,
+        "prompt_len": int(prompt_len),
+        "shared_fraction": round(shared_fraction, 4),
+        "prefill_chunk": chunk,
+        "page_size": ps,
+        "dtype": dtype,
+        "router_overhead_ms": float(router_overhead_ms),
+        "predicted_tokens_per_sec": fleet_tps,
+        "predicted_tokens_per_sec_round_robin": tps(ms_rr),
+        "predicted_affinity_speedup_vs_round_robin": round(
+            ms_rr / ms_aff, 3) if ms_aff else 0.0,
+        "predicted_tokens_per_sec_single_replica": single_tps,
+        "predicted_scaling_efficiency": round(
+            fleet_tps / (N * single_tps), 4) if single_tps else 0.0,
+        "predicted_prefix_hit_rate": round(hit_rate_aff, 4),
+        "predicted_prefix_hit_rate_round_robin": round(hit_rate_rr, 4),
+        # hit-rate-split TTFT: what an affinity-routed request sees vs
+        # a compulsory miss (router overhead included in both)
+        "predicted_ttft_ms_hit": round(
+            hit_ms + float(router_overhead_ms), 3),
+        "predicted_ttft_ms_miss": round(
+            miss_ms + float(router_overhead_ms), 3),
+        "predicted_ttft_ms_mean": round(
+            hit_rate_aff * hit_ms + (1 - hit_rate_aff) * miss_ms
+            + float(router_overhead_ms), 3),
+        "predicted_ttft_ms_mean_round_robin": round(
+            hit_rate_rr * hit_ms + (1 - hit_rate_rr) * miss_ms
+            + float(router_overhead_ms), 3),
+        "predicted_decode_step_ms": step_ms,
+        "predicted_chunk_ms": round(chunk_ms, 3),
+        "chip_assumed": spec.get("name"),
+    }
+
+
 def _moe_config(config: str):
     from ..models.ernie import ErnieMoeConfig, ernie_moe_tiny_config
     if config == "tiny":
@@ -512,18 +623,26 @@ def _main(argv=None):
                          "(serving engine quantize='int8')")
     ap.add_argument("--mode", default="decode",
                     choices=["decode", "shared_prefix", "disagg", "moe",
-                             "fused_dispatch"],
+                             "fused_dispatch", "fleet"],
                     help="decode = classic serving_predicted row; "
                          "shared_prefix = prefix-cache goodput/TTFT "
                          "anchor; disagg = disaggregated prefill/"
                          "decode split anchor; moe = ERNIE-MoE engine "
                          "(fused Pallas dispatch) anchor; "
                          "fused_dispatch = fused-vs-unfused MoE "
-                         "dispatch stage speedup anchor")
+                         "dispatch stage speedup anchor; fleet = "
+                         "N-replica router anchor (per-replica "
+                         "roofline x N minus router overhead, "
+                         "hit-rate-split TTFT)")
     ap.add_argument("--prompt-len", type=int, default=1024)
     ap.add_argument("--shared-fraction", type=float, default=0.75)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--prefill-chunk", type=int, default=256)
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet mode: engine replicas behind the router")
+    ap.add_argument("--n-requests", type=int, default=16,
+                    help="fleet mode: total requests in the workload "
+                         "model")
     args = ap.parse_args(argv)
     if not os.environ.get("_PREDICT_RESPAWNED"):
         # same contract as analysis.predict: force the CPU backend in a
@@ -545,6 +664,12 @@ def _main(argv=None):
                 args.concurrency, args.page_size, args.chip)
         elif args.mode == "fused_dispatch":
             row = predicted_fused_dispatch_row(chip=args.chip)
+        elif args.mode == "fleet":
+            row = predicted_fleet_row(
+                args.config, args.replicas, args.n_requests,
+                args.concurrency, args.prompt_len, args.shared_fraction,
+                args.max_new, args.prefill_chunk, args.page_size,
+                args.chip)
         elif args.mode == "shared_prefix":
             row = predicted_shared_prefix_row(
                 args.config, args.concurrency, args.prompt_len,

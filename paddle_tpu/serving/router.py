@@ -1,0 +1,249 @@
+"""Fleet routing + autoscaling policy — pure decisions, no processes.
+
+The :class:`~.fleet.FleetRouter` owns processes, sockets, and telemetry;
+THIS module owns the two decisions it makes per request / per tick, as
+plain deterministic functions over status snapshots, so policy behavior
+is unit-testable (and replayable from recorded series) without spawning
+a single replica:
+
+- **Where does a request go?** :class:`PrefixAffinityRouter` — a
+  rendezvous (highest-random-weight) hash over the request's first
+  page-granularity token block picks a *preferred* replica, so every
+  request sharing a prompt prefix lands on the replica already holding
+  that prefix's KV pages (PR 11's radix cache then skips the prefill).
+  Rendezvous hashing keeps the mapping stable under elasticity: adding
+  or retiring a replica only remaps the keys that hashed to it, never
+  reshuffles the whole fleet. When the preferred replica is saturated
+  (queue depth past ``max_queue_depth``, or too few free KV pages for
+  the request's full completion), the router falls back to the
+  least-loaded healthy replica by (pending requests, free-page
+  fraction) — a cache hit is worth queueing for, but not unboundedly.
+  ``policy="round_robin"`` / ``"least_loaded"`` are the A/B baselines
+  the fleet bench row compares against.
+
+- **How many replicas?** :class:`SLOAutoscaler` — consumes the fleet's
+  SLO **burn rates** (PR 10's error-budget accounting: 1.0 = burning
+  exactly at budget) plus busyness, and fires ``scale_out`` when the
+  worst burn stays >= ``scale_out_burn`` for ``sustain_s`` (a p95
+  blip is not an incident; a sustained burn is), ``scale_in`` when the
+  fleet stays idle (no pending work, burn ~0) for ``idle_s``, with a
+  ``cooldown_s`` floor between actions so the fleet never flaps. The
+  clock is injectable — tests replay recorded burn series against a
+  fake clock and assert the exact decision sequence.
+
+Both consume the same per-replica snapshot shape the fleet's status
+RPC returns: ``{"healthy", "draining", "queue_depth", "pending",
+"free_pages", "num_pages", "burn_rates"}``.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+
+__all__ = ["affinity_key", "rendezvous_order", "PrefixAffinityRouter",
+           "SLOAutoscaler"]
+
+
+def affinity_key(prompt_ids, block_tokens: int) -> bytes:
+    """The routing key: the request's first ``block_tokens`` prompt
+    tokens (one KV-page-granularity block). Requests sharing a prefix
+    of at least one page share the key — exactly the granularity at
+    which PR 11's prefix cache can reuse pages, so affinity routing is
+    keyed on what the cache can actually serve."""
+    ids = [int(t) for t in list(prompt_ids)[:max(int(block_tokens), 1)]]
+    return (",".join(str(t) for t in ids)).encode()
+
+
+def rendezvous_order(key: bytes, replica_ids) -> list:
+    """Replica ids sorted by rendezvous (HRW) score for ``key``, best
+    first. Stable under membership change: removing a replica promotes
+    the runner-up for ITS keys only; every other key keeps its
+    winner — no rehash storm, no lost affinity fleet-wide."""
+    def score(rid):
+        return hashlib.md5(key + b"|%d" % int(rid)).digest()
+    return sorted(replica_ids, key=score, reverse=True)
+
+
+class PrefixAffinityRouter:
+    """Pick a replica for each request from status snapshots.
+
+    ``route(prompt_ids, snapshots, pages_needed=None)`` returns the
+    chosen replica id, or ``None`` when no healthy non-draining replica
+    exists (the caller queues the request at the router). Counters in
+    ``stats()`` record how often affinity won vs fell back — the fleet
+    bench surfaces them next to the aggregate prefix hit rate.
+    """
+
+    def __init__(self, block_tokens: int = 64, policy: str = "affinity",
+                 max_queue_depth: int = 32):
+        if policy not in ("affinity", "round_robin", "least_loaded"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.block_tokens = int(block_tokens)
+        self.policy = policy
+        self.max_queue_depth = int(max_queue_depth)
+        self._rr = 0
+        self.routed = 0
+        self.affinity_hits = 0      # preferred replica taken
+        self.fallbacks = 0          # preferred saturated -> least-loaded
+        self.last_outcome = None    # affinity|fallback|round_robin|
+        #                             least_loaded (how the last route
+        #                             was decided — the fleet's routing
+        #                             counter labels)
+
+    # ------------------------------------------------------------ scoring
+    @staticmethod
+    def _load(snap: dict) -> tuple:
+        """Least-loaded ordering: fewest pending requests first, then
+        the emptiest KV pool (free-page fraction)."""
+        pending = int(snap.get("pending") or snap.get("queue_depth") or 0)
+        num = int(snap.get("num_pages") or 0) or 1
+        free_frac = float(snap.get("free_pages") or 0) / num
+        return (pending, -free_frac)
+
+    def _saturated(self, snap: dict, pages_needed) -> bool:
+        if int(snap.get("queue_depth") or 0) >= self.max_queue_depth:
+            return True
+        if pages_needed is not None \
+                and int(snap.get("free_pages") or 0) < int(pages_needed) \
+                and int(snap.get("queue_depth") or 0) > 0:
+            # no room now AND a queue in front of us: the pages freed by
+            # evictions go to earlier arrivals first
+            return True
+        return False
+
+    # ------------------------------------------------------------ routing
+    def route(self, prompt_ids, snapshots: dict,
+              pages_needed=None) -> int | None:
+        eligible = {rid: s for rid, s in snapshots.items()
+                    if s.get("healthy", True) and not s.get("draining")}
+        if not eligible:
+            return None
+        self.routed += 1
+        if self.policy == "round_robin":
+            order = sorted(eligible)
+            pick = order[self._rr % len(order)]
+            self._rr += 1
+            self.last_outcome = "round_robin"
+            return pick
+        if self.policy == "least_loaded":
+            self.last_outcome = "least_loaded"
+            return min(sorted(eligible),
+                       key=lambda r: self._load(eligible[r]))
+        key = affinity_key(prompt_ids, self.block_tokens)
+        preferred = rendezvous_order(key, sorted(eligible))[0]
+        if not self._saturated(eligible[preferred], pages_needed):
+            self.affinity_hits += 1
+            self.last_outcome = "affinity"
+            return preferred
+        self.fallbacks += 1
+        self.last_outcome = "fallback"
+        # least-loaded among the NON-saturated replicas (falling back
+        # to the full pool only when every replica is saturated — then
+        # the shortest queue is still the best of a bad set)
+        pool = {r: s for r, s in eligible.items()
+                if not self._saturated(s, pages_needed)} or eligible
+        return min(sorted(pool), key=lambda r: self._load(pool[r]))
+
+    def stats(self) -> dict:
+        return {"policy": self.policy, "block_tokens": self.block_tokens,
+                "routed": self.routed, "affinity_hits": self.affinity_hits,
+                "fallbacks": self.fallbacks,
+                "affinity_hit_rate": round(self.affinity_hits / self.routed,
+                                           4) if self.routed else 0.0}
+
+
+class SLOAutoscaler:
+    """SLO-burn-driven elastic sizing decisions (pure; clock injectable).
+
+    Feed one :meth:`observe` per supervision tick with the fleet's
+    worst SLO burn rate and busyness; it returns
+    ``{"action": None | "scale_out" | "scale_in", "reason": ...}``.
+    The caller executes the action (spawn / drain-then-retire) and is
+    trusted to report the resulting replica count on the next tick.
+
+    Rules (all windows in seconds on the injected clock):
+
+    - ``scale_out``: every sample in the last ``sustain_s`` had
+      ``burn >= scale_out_burn`` (and the window is actually covered —
+      one hot sample is not "sustained"), ``replicas < max_replicas``,
+      cooldown elapsed. A saturated router queue
+      (``router_queue_depth > 0`` across the window) counts as burning
+      even before SLO windows fill: queued work IS future burn.
+    - ``scale_in``: every sample in the last ``idle_s`` was idle
+      (``busy`` False and ``burn <= idle_burn``), ``replicas >
+      min_replicas``, cooldown elapsed. The caller must retire via
+      drain (stop routing, let in-flight finish) — never a kill.
+    """
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 scale_out_burn: float = 1.0, sustain_s: float = 2.0,
+                 idle_s: float = 10.0, idle_burn: float = 0.25,
+                 cooldown_s: float = 5.0, clock=None):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_out_burn = float(scale_out_burn)
+        self.sustain_s = float(sustain_s)
+        self.idle_s = float(idle_s)
+        self.idle_burn = float(idle_burn)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock or time.monotonic
+        self._samples: list = []     # (ts, burn, busy, hot)
+        self._last_action_ts = None
+        self.decisions: list = []    # every non-None action (bounded)
+
+    # ------------------------------------------------------------- intake
+    def observe(self, replicas: int, burn_rate: float, busy: bool,
+                router_queue_depth: int = 0, now: float | None = None
+                ) -> dict:
+        now = self._clock() if now is None else float(now)
+        burn = float(burn_rate or 0.0)
+        hot = burn >= self.scale_out_burn or router_queue_depth > 0
+        self._samples.append((now, burn, bool(busy), hot))
+        horizon = now - max(self.sustain_s, self.idle_s) - 1.0
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.pop(0)
+        decision = {"action": None, "reason": "", "ts": now,
+                    "burn_rate": round(burn, 4), "replicas": int(replicas)}
+        if self._last_action_ts is not None \
+                and now - self._last_action_ts < self.cooldown_s:
+            decision["reason"] = "cooldown"
+            return decision
+        if replicas < self.max_replicas \
+                and self._window_all(now, self.sustain_s, lambda s: s[3]):
+            decision["action"] = "scale_out"
+            decision["reason"] = (
+                f"SLO burn >= {self.scale_out_burn} sustained "
+                f"{self.sustain_s}s (burn {burn:.2f}, router queue "
+                f"{router_queue_depth})")
+        elif replicas > self.min_replicas \
+                and self._window_all(now, self.idle_s,
+                                     lambda s: not s[2]
+                                     and s[1] <= self.idle_burn):
+            decision["action"] = "scale_in"
+            decision["reason"] = f"idle for {self.idle_s}s"
+        if decision["action"]:
+            self._last_action_ts = now
+            self.decisions.append(dict(decision))
+            del self.decisions[:-64]
+        return decision
+
+    def _window_all(self, now: float, span: float, pred) -> bool:
+        """True iff samples COVER the last ``span`` seconds (oldest
+        retained sample at or before ``now - span``) and every sample
+        inside the window satisfies ``pred``."""
+        window = [s for s in self._samples if s[0] >= now - span]
+        if not window or self._samples[0][0] > now - span:
+            return False
+        return all(pred(s) for s in window)
+
+    def snapshot(self) -> dict:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "scale_out_burn": self.scale_out_burn,
+            "sustain_s": self.sustain_s, "idle_s": self.idle_s,
+            "cooldown_s": self.cooldown_s,
+            "decisions": list(self.decisions[-8:]),
+        }

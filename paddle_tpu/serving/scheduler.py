@@ -64,11 +64,13 @@ class Request:
     prefill_s: float | None = None     # measured prefill walltime
     cached_prefix_len: int = 0         # prompt tokens reused from cache
     prefill_chunks: int = 0            # chunk program invocations
+    router_wait_s: float = 0.0         # fleet: wait at the router before
+    #                                    this replica saw the request
     tokens: list = field(default_factory=list)   # generated ids
     state: str = "queued"              # queued|prefilling|running|
     #                                    finished|rejected
     reject_reason: str | None = None   # max_new<1|too_long|queue_full|
-    #                                    pool_too_small
+    #                                    pool_too_small|draining
     slo_met: bool | None = None        # stamped at finish by the tracker
     trace: object = None               # observability.reqtrace.RequestTrace
 
@@ -104,6 +106,7 @@ class Request:
                "reject_reason": self.reject_reason,
                "prompt_len": int(self.prompt.shape[0]),
                "new_tokens": len(self.tokens),
+               "router_wait_s": self.router_wait_s,
                "queue_wait_s": queue_wait, "ttft_s": ttft,
                "prefill_s": self.prefill_s,
                "cached_prefix_len": self.cached_prefix_len,
@@ -154,6 +157,11 @@ class ContinuousBatchingScheduler:
                                 else SLOConfig())
         self.healthy = True
         self.last_error: str | None = None
+        # drain-then-retire (fleet scale-in): a draining scheduler
+        # finishes queued + running work but accepts no new submits —
+        # /healthz reports "draining" so a router can tell retiring
+        # from dead
+        self.draining = False
         # one coarse lock makes /status (and concurrent submit) a
         # consistent cut of queue/pool state; step() holds it for the
         # tick, so a scrape waits at most one decode step
@@ -161,14 +169,20 @@ class ContinuousBatchingScheduler:
         self._start_ts = time.time()
 
     # ----------------------------------------------------------- intake
-    def submit(self, prompt_ids, max_new_tokens: int,
-               eos_id=None) -> Request:
+    def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
+               rid=None, router_wait_s: float = 0.0) -> Request:
+        """Queue one request. ``rid`` lets a fleet router thread its
+        GLOBAL request id through (re-enqueues stay idempotent by id
+        and the federated ``requests.jsonl`` speaks one id space);
+        ``router_wait_s`` stamps the time the request already waited at
+        that router, so fleet-level latency attribution sees it."""
         from ..observability import instrument as obs
         from ..observability.reqtrace import RequestTrace
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         with self._lock:
-            r = Request(next(self._rid), prompt, int(max_new_tokens),
-                        eos_id=eos_id)
+            r = Request(next(self._rid) if rid is None else int(rid),
+                        prompt, int(max_new_tokens), eos_id=eos_id,
+                        router_wait_s=float(router_wait_s))
             r.trace = RequestTrace(r.rid, r.submit_time)
             pool = self.engine.pool
             total = prompt.shape[0] + r.max_new_tokens
@@ -176,7 +190,9 @@ class ContinuousBatchingScheduler:
             # n+1 and the engine's prompt-room check can never fire at
             # admission
             reason = None
-            if r.max_new_tokens < 1:
+            if self.draining:
+                reason = "draining"
+            elif r.max_new_tokens < 1:
                 reason = "max_new<1"
             elif total > pool.max_seq_len:
                 reason = "too_long"
@@ -204,6 +220,14 @@ class ContinuousBatchingScheduler:
     def pending(self) -> int:
         return len(self._queue) + len(self._prefilling) \
             + len(self._running)
+
+    def drain(self):
+        """Enter drain-then-retire: refuse new submits (reject reason
+        ``draining``), keep stepping until the in-flight work finishes.
+        A fleet router drains a replica before retiring it so scale-in
+        never drops a request."""
+        with self._lock:
+            self.draining = True
 
     # ------------------------------------------------------------ phases
     def _completion_pages(self, r: Request) -> int:
@@ -465,6 +489,7 @@ class ContinuousBatchingScheduler:
         with self._lock:
             st = {
                 "healthy": self.healthy,
+                "draining": self.draining,
                 "last_error": self.last_error,
                 "ts": time.time(),
                 "uptime_s": round(time.time() - self._start_ts, 3),

@@ -90,6 +90,10 @@ _ANCHOR_MAP = {
     # anchors on the static cost model's MoE decode-program row
     "serving_moe_tokens_per_sec": "serving_moe_predicted",
     "serving_moe": "serving_moe_predicted",
+    # the N-replica fleet row anchors on the fleet roofline model
+    # (per-replica roofline x N minus router overhead)
+    "serving_fleet_tokens_per_sec": "serving_fleet_predicted",
+    "serving_fleet": "serving_fleet_predicted",
     "collective_compression": "collective_compression_predicted",
     # a measured planner-config 13B run (TPU rounds) anchors on the
     # planner's own predicted row, not the hand-written config's
