@@ -85,6 +85,16 @@ class ProgramAnalyzer:
                     rec_r = TraceRecorder(ctx, rank=r, record_ops=False)
                     trace_abstract(fn, ctx.example_inputs, rec_r,
                                    want_jaxpr=False)
+            # transitively-converted callees (dy2static capture) join the
+            # AST pre-pass under their ORIGINAL source, so PTHS002-class
+            # findings attribute to the callee's real file/line
+            seen_codes = {getattr(f, "__code__", None)
+                          for f in ctx.source_fns}
+            for orig in ctx.converted_fns:
+                code = getattr(orig, "__code__", None)
+                if code is not None and code not in seen_codes:
+                    seen_codes.add(code)
+                    ctx.source_fns.append(orig)
 
         diags = []
         for p in get_passes(self._passes):
@@ -136,7 +146,9 @@ class ProgramAnalyzer:
             ctx.target_kind = "to_static"
             ctx.static_function = target
             origin = getattr(target, "_origin", None)
-            ctx.source_fns = [origin[0] if origin else target._fn]
+            fn0 = origin[0] if origin else target._fn
+            # when the AST fallback already ran, scan the ORIGINAL source
+            ctx.source_fns = [getattr(fn0, "__dy2static_origin__", fn0)]
             return target._fn
 
         if isinstance(target, Layer):

@@ -11,7 +11,10 @@ Two detectors, deduped by call site:
   suppressed for StaticFunction targets.
 - **AST pre-pass** (PTHS002, info) — a dy2static-aware source scan of
   the target (and its original, pre-transform function when the AST
-  fallback already ran) for ``.numpy()`` / ``.item()`` / ``.tolist()``
+  fallback already ran, plus every transitively-converted callee the
+  capture layer reported during the trace — ``ctx.converted_fns``, so
+  findings inside nested helpers attribute to the helper's ORIGINAL
+  file/line) for ``.numpy()`` / ``.item()`` / ``.tolist()``
   call sites the trace didn't reach (dead branches, unexecuted paths).
   Info, not warning: the scan cannot see receiver types (a numpy
   scalar's ``.item()`` is harmless), so unverified sites must not fail

@@ -45,13 +45,31 @@ _SKIP_SUBDIRS = tuple(
               "observability", "hapi", "io", "utils"))
 
 
+def _map_dy2static(fn):
+    """Translate a converted-code frame filename ("<dy2static:...>") to
+    the callee's ORIGINAL source file, or None. Line numbers need no
+    translation — ast_transform offsets the tree to match the file."""
+    if not fn.startswith("<dy2static"):
+        return None
+    from ..jit.dy2static.transformer import SOURCE_FILE_MAP
+    return SOURCE_FILE_MAP.get(fn)
+
+
 def callsite():
     """(file, line) of the innermost frame that is user code — outside
     paddle_tpu internals, jax, and the stdlib. Frame-walk, not
-    traceback.extract_stack: this runs once per traced op."""
+    traceback.extract_stack: this runs once per traced op. Frames of
+    transitively-converted callees (dy2static capture) attribute to the
+    callee's original file/line through the conversion source map."""
     f = sys._getframe(1)
     while f is not None:
         fn = f.f_code.co_filename
+        mapped = _map_dy2static(fn)
+        if mapped is not None:
+            if not mapped.startswith(_SKIP_SUBDIRS):
+                return mapped, f.f_lineno
+            f = f.f_back
+            continue
         # normalize: modules imported via a relative sys.path entry carry
         # "/repo/./pkg/..." co_filenames that break the prefix match
         fn = os.path.normpath(fn) if not fn.startswith("<") else fn
@@ -135,6 +153,11 @@ class AnalysisContext:
     program: object = None          # static.Program target
     fetches: list = field(default_factory=list)
     source_fns: list = field(default_factory=list)  # fns for the AST pre-pass
+    # ORIGINAL callables the dy2static capture layer converted (cache hit
+    # or miss) during this trace — fed to the AST pre-pass so hostsync
+    # findings in transitively-converted callees attribute to their real
+    # file/line
+    converted_fns: list = field(default_factory=list)
     static_function: object = None  # jit.api.StaticFunction target
     world_size: int = 1
     trace_error: str | None = None
@@ -352,12 +375,16 @@ def analysis_hooks(recorder: TraceRecorder):
     from ..distributed import collective as coll_mod
     from ..distributed import env as env_mod
 
+    from ..jit.dy2static import capture as capture_mod
+
     prev_tape = tape_mod.set_analysis_hook(recorder.on_op)
     prev_sync = tensor_mod._host_sync_hook
     tensor_mod._host_sync_hook = recorder.on_host_sync
     prev_coll = coll_mod._set_analysis_recorder(recorder)
     prev_rank = env_mod._analysis_rank_hook
     env_mod._analysis_rank_hook = recorder.on_get_rank
+    prev_capture = capture_mod.set_capture_listener(
+        lambda orig: recorder.ctx.converted_fns.append(orig))
 
     prims = coll_mod.prims
     saved_prims = {}
@@ -381,6 +408,7 @@ def analysis_hooks(recorder: TraceRecorder):
         tensor_mod._host_sync_hook = prev_sync
         coll_mod._set_analysis_recorder(prev_coll)
         env_mod._analysis_rank_hook = prev_rank
+        capture_mod.set_capture_listener(prev_capture)
         for name, fn in saved_prims.items():
             setattr(prims, name, fn)
 
@@ -477,6 +505,11 @@ def eqn_site(eqn):
         for fr in reversed(tb.frames):
             fn = getattr(fr, "file_name", None) or getattr(fr, "filename", "")
             line = getattr(fr, "line_num", None) or getattr(fr, "lineno", 0)
+            mapped = _map_dy2static(fn)
+            if mapped is not None:
+                if not mapped.startswith(_SKIP_SUBDIRS):
+                    return mapped, line
+                continue
             fn = os.path.normpath(fn) if not fn.startswith("<") else fn
             if not (fn.startswith("<") or "/jax/" in fn
                     or "site-packages" in fn or fn.startswith(_STDLIB)

@@ -265,9 +265,15 @@ def _find_self_layer(fn):
 
 
 def not_to_static(fn):
+    """Opt-out marker, honored TRANSITIVELY by the dy2static capture
+    layer: a marked function reached from a converted entry passes
+    through ``convert_call`` untouched (dy2static/convert_call.py)."""
     fn._not_to_static = True
     return fn
 
 
 def ignore_module(modules):
-    pass
+    """Register module(s) whose callables ``convert_call`` never
+    converts (reference paddle.jit.ignore_module parity)."""
+    from .dy2static import register_ignore_module
+    register_ignore_module(modules)

@@ -1,8 +1,10 @@
-"""dy2static — AST compilation of dynamic Python control flow.
+"""dy2static — AST compilation of dynamic Python control flow, with
+whole-program capture.
 
 Reference: ``python/paddle/jit/dy2static/`` (program_translator.py:272
 StaticFunction, ast_transformer.py + ~20 transformers rewriting
-if/while/for/boolops into conditional_block/while ops).
+if/while/for/boolops into conditional_block/while ops, and
+convert_call_func.py for transitive callee capture).
 
 TPU-native design: the same source-to-source rewrite, but the runtime
 convert operators lower onto ``lax.cond`` / ``lax.while_loop`` through
@@ -10,9 +12,62 @@ convert operators lower onto ``lax.cond`` / ``lax.while_loop`` through
 of interpreter sub-blocks. The trace-based ``to_static`` stays the fast
 path; when a trace hits data-dependent Python control flow
 (TracerBoolConversionError), the function is AST-transformed and retraced
-automatically.
+automatically — and from there on, **every call site** in converted code
+routes through ``convert_call``, so nested helpers, bound methods,
+``Layer.forward``, lambdas, and closures are transformed transitively:
+the captured program is the *whole* program, not just the entry function.
+
+Conversion rules (``convert_call`` decides per callable at run time):
+
+================================  =======================================
+callable                          decision
+================================  =======================================
+user function / lambda / method   AST-transform (once per code object)
+``Layer`` instance                convert its ``forward``, keep hooks
+``functools.partial``             convert its ``func``
+closure                           convert; ORIGINAL cells stay live, so
+                                  ``nonlocal`` rebinding remains visible
+builtin / C / generator / async   pass through untouched
+numpy / jax / stdlib / site-pkgs  pass through untouched
+``paddle_tpu.*`` (except models/  pass through untouched (the zoo is
+and vision/)                      deliberately user-code-eligible)
+``@not_to_static`` functions      pass through (opt-out, transitive)
+``ignore_module``-registered      pass through
+unreadable / untransformable      ``Dy2StaticError`` naming the callable
+user code                         and its conversion call chain
+================================  =======================================
+
+Cache semantics: the AST transform runs once per *code object*
+(``convert_call.converted_code_objects()``); repeated calls — and
+repeated train-loop steps — hit the cache, so capture never re-triggers
+a transform or a retrace (assert via the recompile pass: a
+nested-helper train loop stays at one ``to_static`` program).
+Functions sharing a code object but differing in closure rebind the
+cached transformed code to their own cells without re-transforming.
+
+Long-tail statement/expression lowering, beyond if/while/for/boolops:
+``assert`` → ``convert_assert`` (message kept, tracer-safe no-op),
+``print`` → ``convert_print`` (``jax.debug.print`` on traced args —
+never a host sync), ``int()/float()/bool()`` → ``convert_var_dtype``
+(dtype cast on tracers instead of a concretizing host sync),
+``tensor.shape`` → ``convert_shape`` (static python value when known,
+traced fallback otherwise), and ternary ``a if p else b`` →
+``convert_ifelse``.
+
+Diagnostics fired inside converted code attribute to the ORIGINAL
+file/line: synthesized modules are registered in
+``transformer.SOURCE_FILE_MAP`` with line numbers offset to match the
+real source, and the analysis layer translates frames through it.
 """
 from . import convert_operators  # noqa: F401
-from .transformer import ast_transform, Dy2StaticError  # noqa: F401
+from . import convert_call as capture  # the module (cache/guard introspection)
+from .convert_call import (convert_call, conversion_stats,  # noqa: F401
+                           converted_code_objects, clear_conversion_cache,
+                           register_ignore_module, set_capture_listener)
+from .transformer import (ast_transform, Dy2StaticError,  # noqa: F401
+                          SOURCE_FILE_MAP)
 
-__all__ = ["ast_transform", "convert_operators", "Dy2StaticError"]
+__all__ = ["ast_transform", "convert_operators", "capture", "convert_call",
+           "conversion_stats", "converted_code_objects",
+           "clear_conversion_cache", "register_ignore_module",
+           "set_capture_listener", "Dy2StaticError", "SOURCE_FILE_MAP"]

@@ -1,0 +1,332 @@
+"""Whole-program capture: transitive conversion of everything reachable
+from a ``to_static`` entry function.
+
+Reference: ``python/paddle/jit/dy2static/convert_call_func.py`` —
+``convert_call(fn)``. At transform time every call site in a converted
+function is rewritten to ``_jst.convert_call(fn)(...)``; at run time this
+module decides, per callable, one of three fates:
+
+- **convert** — user functions, bound methods, ``Layer.forward``,
+  lambdas, closures (the original cells stay live — ``nonlocal``
+  rebinding on either side of the conversion remains visible),
+  ``functools.partial`` (its ``func`` is converted), and callable
+  objects with a user-defined ``__call__``. The AST transform runs once
+  per *code object* (module-level cache), so a nested-helper train loop
+  never re-transforms or retraces per step.
+- **pass through untouched** — builtins and C functions, generators /
+  coroutines, numpy / jax / the stdlib / site-packages, anything inside
+  ``paddle_tpu`` itself (the model zoo under ``paddle_tpu/models`` is
+  deliberately user-code-eligible, mirroring the analysis layer's frame
+  skip list), functions marked ``@paddle.jit.not_to_static``, modules
+  registered via ``paddle.jit.ignore_module``, and already-converted
+  functions.
+- **error** — a user-code callable whose source cannot be read or
+  transformed raises :class:`Dy2StaticError` naming the callable and
+  the conversion call chain that reached it.
+
+A thread-local call chain both powers those error messages and guards
+runaway recursion: more than ``MAX_CALL_DEPTH`` converted frames on the
+chain raises instead of spinning the trace.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+import threading
+import types
+import weakref
+
+from .transformer import Dy2StaticError, ast_transform
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_STDLIB = os.path.dirname(os.__file__)
+# in-package code that stays user-convertible (the zoo proves capture)
+_USER_SUBDIRS = tuple(os.path.join(_PKG_ROOT, d) + os.sep
+                      for d in ("models", "vision"))
+
+# conversion guard: converted frames live on this chain; the depth cap
+# turns infinite convert-recursion into a diagnosable error
+MAX_CALL_DEPTH = 100
+_tls = threading.local()
+
+# module prefixes registered via paddle.jit.ignore_module
+_IGNORE_MODULES: set[str] = set()
+
+# code object -> transformed function (no free variables) or the
+# transformed function's (inner_code-equivalent) template for closures;
+# the cache is what keeps repeated calls from re-running the AST pass
+_CODE_CACHE: dict = {}
+# function object -> its bound converted wrapper (closures differ per
+# function instance even when the code object is shared)
+_FN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+_STATS = {"transforms": 0, "code_hits": 0, "passthrough": 0}
+
+# analysis hook: called with the ORIGINAL callable each time a convert
+# decision lands on "convert" (miss or hit) — the analyzer collects the
+# originals so the AST pre-pass attributes findings to their real file
+_capture_listener = None
+
+
+def set_capture_listener(listener):
+    """Install (or clear, with None) the per-conversion listener; returns
+    the previous listener."""
+    global _capture_listener
+    prev = _capture_listener
+    _capture_listener = listener
+    return prev
+
+
+def conversion_stats():
+    """Copy of the running counters: ``transforms`` (AST passes run),
+    ``code_hits`` (cache hits), ``passthrough`` (untouched callables)."""
+    return dict(_STATS)
+
+
+def converted_code_objects():
+    """The set of ORIGINAL code objects the cache has transformed."""
+    return set(_CODE_CACHE)
+
+
+def clear_conversion_cache():
+    _CODE_CACHE.clear()
+    _FN_CACHE.clear()
+
+
+def register_ignore_module(modules):
+    """paddle.jit.ignore_module parity: callables from these modules are
+    never converted."""
+    for m in modules if isinstance(modules, (list, tuple, set)) else [modules]:
+        name = m if isinstance(m, str) else getattr(m, "__name__", None)
+        if name:
+            _IGNORE_MODULES.add(name)
+
+
+def _chain():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def conversion_call_chain():
+    """The currently-executing converted call chain (qualnames)."""
+    return tuple(_chain())
+
+
+def _chain_str(extra=None):
+    parts = list(_chain()) + ([extra] if extra else [])
+    return " -> ".join(parts) if parts else "<entry>"
+
+
+def push_call_frame(label):
+    """Converted-function prologue (injected by ast_transform): depth
+    guard + call-chain bookkeeping. Every converted frame — including
+    direct recursion through a rebound module name — passes here."""
+    chain = _chain()
+    if len(chain) >= MAX_CALL_DEPTH:
+        raise Dy2StaticError(
+            f"dy2static: conversion call chain exceeded {MAX_CALL_DEPTH} "
+            f"converted frames — runaway recursion through converted "
+            f"code? chain: {_chain_str(label)}")
+    chain.append(label)
+
+
+def pop_call_frame():
+    chain = _chain()
+    if chain:
+        chain.pop()
+
+
+def _is_user_code(code) -> bool:
+    fname = code.co_filename
+    if fname.startswith("<"):
+        # includes "<dy2static...>" (already converted) and interactive
+        return False
+    fname = os.path.normpath(fname)
+    if fname.startswith(_STDLIB) or "site-packages" in fname \
+            or "dist-packages" in fname:
+        return False
+    if fname.startswith(_PKG_ROOT + os.sep):
+        return fname.startswith(_USER_SUBDIRS)
+    return True
+
+
+def _passthrough(fn) -> bool:
+    if getattr(fn, "_not_to_static", False) \
+            or getattr(fn, "__dy2static_converted__", False):
+        return True
+    mod = getattr(fn, "__module__", None) or ""
+    if any(mod == m or mod.startswith(m + ".") for m in _IGNORE_MODULES):
+        return True
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return True  # builtin / C extension / type
+    if inspect.isgeneratorfunction(fn) or inspect.iscoroutinefunction(fn) \
+            or inspect.isasyncgenfunction(fn):
+        return True
+    return not _is_user_code(code)
+
+
+class _ClosureTemplate:
+    """Cell-STRIPPED per-code-object template for converted closures:
+    holds only the transformed code + globals namespace + metadata, so
+    the permanent code cache never pins any instance's closure cells
+    (or the objects they capture)."""
+
+    __slots__ = ("code", "globals", "name", "source")
+
+    def __init__(self, transformed):
+        self.code = transformed.__code__
+        self.globals = transformed.__globals__
+        self.name = transformed.__name__
+        self.source = getattr(transformed, "__dy2static_source__", None)
+
+    def bind(self, fn):
+        cellmap = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+        t = types.FunctionType(
+            self.code, self.globals, self.name, fn.__defaults__,
+            tuple(cellmap[n] for n in self.code.co_freevars))
+        t.__kwdefaults__ = dict(fn.__kwdefaults__) \
+            if fn.__kwdefaults__ else None
+        t.__dy2static_converted__ = True
+        t.__dy2static_source__ = self.source
+        return t
+
+
+def _transform_function(fn):
+    """AST-transform a plain function through the code-object cache.
+    The transformed body carries its own chain/depth guard (injected by
+    ast_transform), so the returned function is used directly."""
+    cached = _FN_CACHE.get(fn)
+    if cached is not None:
+        _STATS["code_hits"] += 1
+        return cached
+    code = fn.__code__
+    label = getattr(fn, "__qualname__", fn.__name__)
+    entry = _CODE_CACHE.get(code)
+    if entry is None:
+        try:
+            transformed = ast_transform(fn)
+        except Dy2StaticError as e:
+            raise Dy2StaticError(
+                f"dy2static: cannot convert {label!r} (reached via "
+                f"{_chain_str(label)}): {e}") from e
+        except Exception as e:
+            raise Dy2StaticError(
+                f"dy2static: AST transform of {label!r} failed (reached "
+                f"via {_chain_str(label)}): {type(e).__name__}: {e}") from e
+        _STATS["transforms"] += 1
+        # drop the origin back-reference on capture-path conversions: a
+        # _FN_CACHE value referencing its own key would defeat weak-key
+        # eviction and pin converted fns forever (attribution rides the
+        # capture listener, which receives the original fn directly)
+        transformed.__dy2static_origin__ = None
+        if code.co_freevars and fn.__closure__:
+            # cache a CELL-STRIPPED template; this instance keeps its
+            # own bound function (returned below, weakly cached)
+            _CODE_CACHE[code] = _ClosureTemplate(transformed)
+        else:
+            _CODE_CACHE[code] = transformed
+    elif isinstance(entry, _ClosureTemplate):
+        # shared code object, different closure: rebind the cached
+        # transformed code to THIS function's cells — no re-transform
+        _STATS["code_hits"] += 1
+        transformed = entry.bind(fn)
+    else:
+        # freevar-less functions share the transformed fn outright
+        _STATS["code_hits"] += 1
+        transformed = entry
+    _FN_CACHE[fn] = transformed
+    return transformed
+
+
+def _notify(orig):
+    if _capture_listener is not None:
+        try:
+            _capture_listener(orig)
+        except Exception:
+            pass
+
+
+def _convert_layer(layer):
+    """A Layer instance: convert its class forward and call it through
+    ``Layer._call_with_hooks`` — the SAME protocol ``Layer.__call__``
+    uses, just with the converted forward substituted."""
+    inst_fwd = layer.__dict__.get("forward")
+    if inst_fwd is not None:
+        # instance-patched forward (e.g. a to_static StaticFunction):
+        # it manages its own conversion — call the layer normally
+        return layer
+    fwd = type(layer).forward
+    if _passthrough(fwd):
+        return layer
+    _notify(fwd)
+    conv = _transform_function(fwd)
+
+    def call(*inputs, **kwargs):
+        return layer._call_with_hooks(
+            types.MethodType(conv, layer), *inputs, **kwargs)
+
+    return call
+
+
+def convert_call(fn):
+    """The run-time capture decision — see module docstring."""
+    if not callable(fn):
+        return fn  # let the call site raise the normal TypeError
+
+    # bound method: convert the underlying function, rebind self
+    if isinstance(fn, types.MethodType):
+        if _passthrough(fn.__func__):
+            _STATS["passthrough"] += 1
+            return fn
+        _notify(fn.__func__)
+        return types.MethodType(_transform_function(fn.__func__),
+                                fn.__self__)
+
+    if isinstance(fn, functools.partial):
+        inner = convert_call(fn.func)
+        if inner is fn.func:
+            return fn
+        return functools.partial(inner, *fn.args, **fn.keywords)
+
+    if isinstance(fn, types.FunctionType):
+        if _passthrough(fn):
+            _STATS["passthrough"] += 1
+            return fn
+        if fn.__name__ == "<lambda>":
+            # a lambda inline in a larger expression (call argument,
+            # comprehension...) often cannot be isolated from its
+            # source line — degrade to passthrough instead of erroring
+            # (its body is one expression; tensor control flow inside
+            # would surface the standard trace error)
+            try:
+                converted = _transform_function(fn)
+            except Dy2StaticError:
+                _STATS["passthrough"] += 1
+                _FN_CACHE[fn] = fn  # don't re-attempt per call
+                return fn
+            _notify(fn)
+            return converted
+        _notify(fn)
+        return _transform_function(fn)
+
+    # Layer instances and other callable objects
+    from ...nn.layer.layers import Layer
+    if isinstance(fn, Layer):
+        out = _convert_layer(fn)
+        if out is fn:
+            _STATS["passthrough"] += 1
+        return out
+
+    call = getattr(type(fn), "__call__", None)
+    if isinstance(call, types.FunctionType) and not _passthrough(call) \
+            and not isinstance(fn, type):
+        _notify(call)
+        return types.MethodType(_transform_function(call), fn)
+
+    _STATS["passthrough"] += 1
+    return fn

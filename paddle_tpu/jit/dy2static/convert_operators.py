@@ -229,3 +229,87 @@ def convert_logical_not(x):
         return ops.logic.logical_not(x) if _is_traced(x) \
             else (not bool(unwrap(x)))
     return not x
+
+
+# ---------------------------------------------------------------------------
+# whole-program capture + transformer long tail (reference convert_call /
+# convert_assert / convert_print / convert_shape / convert_var_dtype)
+# ---------------------------------------------------------------------------
+
+def convert_call(fn):
+    """Per-callable capture decision (transitively rewrite / pass through
+    / error). Implemented in :mod:`.convert_call`; this late-binding shim
+    keeps the module import order cycle-free from any entry point."""
+    from .convert_call import convert_call as _impl
+    return _impl(fn)
+
+
+def push_call_frame(label):
+    """Enter one converted frame (depth guard + error call chains)."""
+    from .convert_call import push_call_frame as _impl
+    _impl(label)
+
+
+def pop_call_frame():
+    from .convert_call import pop_call_frame as _impl
+    _impl()
+
+
+def convert_assert(test_thunk, msg_thunk):
+    """``assert`` statement. Python value: exact assert semantics (the
+    message thunk is only evaluated on failure, and nothing runs under
+    ``python -O``). Traced test: a tracer has no truth value — the
+    assertion is skipped, never a host sync (matching the reference's
+    Assert op, which is a no-op in inference graphs)."""
+    if not __debug__:
+        return
+    test = test_thunk()
+    if _is_traced(test):
+        return
+    tv = bool(unwrap(test)) if isinstance(test, Tensor) else bool(test)
+    if not tv:
+        msg = msg_thunk()
+        raise AssertionError(msg) if msg is not None else AssertionError()
+
+
+def convert_print(*args, **kwargs):
+    """``print``. Any traced argument routes through ``jax.debug.print``
+    (an async device callback — never a host sync, never a trace
+    crash); plain python values keep builtin print semantics."""
+    if any(_is_traced(a) for a in args):
+        sep = kwargs.get("sep")
+        sep = " " if sep is None else sep  # print(sep=None) is the default
+        fmt = sep.join("{}" for _ in args)
+        jax.debug.print(
+            fmt, *[unwrap(a) if isinstance(a, Tensor) else a
+                   for a in args])
+        return
+    print(*args, **kwargs)
+
+
+def convert_shape(x):
+    """``tensor.shape``: the static python value when every dim is
+    known (always true under jax's static shapes — python shape
+    branches then stay host control flow), the traced ``ops.shape``
+    fallback otherwise; non-Tensors keep their own ``.shape``."""
+    if isinstance(x, Tensor):
+        shp = x._value.shape
+        if all(isinstance(d, int) for d in shp):
+            return list(shp)
+        from ... import ops
+        return ops.shape(x)
+    return x.shape
+
+
+_CAST_DTYPE = {"int": "int64", "float": "float32", "bool": "bool"}
+
+
+def convert_var_dtype(x, kind):
+    """``int(x)`` / ``float(x)`` / ``bool(x)``. A traced Tensor becomes
+    a dtype cast (the reference cast_transformer: a host-sync-free
+    lowering of the builtin); everything else — including concrete
+    Tensors — keeps exact python semantics."""
+    if _is_traced(x):
+        from ... import ops
+        return ops.cast(x, _CAST_DTYPE[kind])
+    return {"int": int, "float": float, "bool": bool}[kind](x)

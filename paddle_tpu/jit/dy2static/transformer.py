@@ -42,12 +42,22 @@ from __future__ import annotations
 
 import ast
 import inspect
+import itertools
+import os
 import textwrap
 import types
 
 from . import convert_operators as _ops_mod
 
 _JST = "_jst"
+
+# synthesized-module filename -> original source file (normpath). The
+# analysis layer (tracing.callsite / eqn_site) translates frames whose
+# co_filename starts with "<dy2static" back to the callee's REAL file;
+# line numbers already match because ast_transform offsets the parsed
+# tree by the function's original first line.
+SOURCE_FILE_MAP: dict[str, str] = {}
+_FILE_SEQ = itertools.count()
 
 
 class Dy2StaticError(RuntimeError):
@@ -404,13 +414,92 @@ def _lambda0(body_expr):
 
 
 class ControlFlowTransformer(ast.NodeTransformer):
-    def __init__(self, fn_assigned):
+    def __init__(self, fn_assigned, arg_names=(), freevars=()):
         self._n = 0
         self._fn_assigned = fn_assigned  # names assigned anywhere in the fn
+        self._arg_names = tuple(arg_names)
+        self._freevars = frozenset(freevars)
 
     def _uid(self):
         self._n += 1
         return self._n
+
+    # ---------------- call capture (convert_call) ---------------------
+    # builtins with a dedicated convert operator; everything else routes
+    # through _jst.convert_call at run time (reference convert_call.py)
+    _CAST_BUILTINS = {"int", "float", "bool"}
+
+    def _is_jst_attr(self, node, attr=None):
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == _JST
+                and (attr is None or node.attr == attr))
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        # our own synthesized operator calls stay as-is
+        if self._is_jst_attr(f):
+            return node
+        # idempotence: a re-visited, already-wrapped call
+        if isinstance(f, ast.Call) and self._is_jst_attr(
+                f.func, "convert_call"):
+            return node
+        if isinstance(f, ast.Name):
+            # builtin rewrites apply only when the name really IS the
+            # builtin here — a local/param/closure rebinding must keep
+            # the user's callable (it falls through to the generic
+            # convert_call wrap). Shadowing inside nested defs is not
+            # tracked (single assigned-name set for the whole tree).
+            shadowed = (f.id in self._fn_assigned
+                        or f.id in self._freevars)
+            if f.id == "super" and not shadowed:
+                # zero-arg super() needs the __class__ cell, which the
+                # recompiled function only sees when spelled explicitly
+                if not node.args and not node.keywords \
+                        and "__class__" in self._freevars \
+                        and self._arg_names:
+                    node.args = [_name("__class__"),
+                                 _name(self._arg_names[0])]
+                return node
+            if f.id == "print" and not shadowed:
+                return ast.Call(
+                    func=ast.Attribute(value=_name(_JST),
+                                       attr="convert_print",
+                                       ctx=ast.Load()),
+                    args=node.args, keywords=node.keywords)
+            if f.id in self._CAST_BUILTINS and not shadowed \
+                    and len(node.args) == 1 and not node.keywords:
+                return _jst_call("convert_var_dtype",
+                                 [node.args[0], ast.Constant(f.id)])
+        return ast.Call(
+            func=ast.Call(
+                func=ast.Attribute(value=_name(_JST), attr="convert_call",
+                                   ctx=ast.Load()),
+                args=[f], keywords=[]),
+            args=node.args, keywords=node.keywords)
+
+    # ---------------- assert / tensor.shape ---------------------------
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        return ast.Expr(value=_jst_call(
+            "convert_assert",
+            [_lambda0(node.test),
+             _lambda0(node.msg if node.msg is not None
+                      else ast.Constant(None))]))
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+        if node.attr == "shape" and isinstance(node.ctx, ast.Load):
+            return _jst_call("convert_shape", [node.value])
+        return node
+
+    # ---------------- ternary expressions -----------------------------
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return _jst_call("convert_ifelse",
+                         [node.test, _lambda0(node.body),
+                          _lambda0(node.orelse)])
 
     def _rewrite_loop_flags(self, body):
         """break/continue -> flag rewrite shared by while and for-range.
@@ -617,6 +706,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
         start = r[0] if len(r) >= 2 else ast.Constant(0)
         stop = r[1] if len(r) >= 2 else r[0]
         step = r[2] if len(r) >= 3 else ast.Constant(1)
+        # the bound expressions land in init Assigns that are never
+        # re-visited — transform them here so call sites inside
+        # range(...) still route through convert_call
+        start, stop, step = (self.visit(e) for e in (start, stop, step))
         it, st, sp = f"_jst_it_{i}", f"_jst_stop_{i}", f"_jst_step_{i}"
         # the synthetic iterator/target become loop carries of the
         # generated while — register them so the While transform keeps them
@@ -653,21 +746,60 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return flat
 
 
-def ast_transform(fn):
-    """Rewrite ``fn``'s control flow; returns a new function object.
+def _lambda_fdef(tree, fn):
+    """Extract ``fn``'s Lambda node from the parsed source statement and
+    wrap it as a FunctionDef (lambdas have no def to find)."""
+    code = fn.__code__
+    want = tuple(code.co_varnames[:code.co_argcount])
+    cands = [n for n in ast.walk(tree) if isinstance(n, ast.Lambda)
+             and tuple(a.arg for a in n.args.args) == want
+             and n.lineno == code.co_firstlineno]
+    if len(cands) != 1:
+        raise Dy2StaticError(
+            f"dy2static: cannot isolate lambda {fn!r} in its source line "
+            f"({len(cands)} candidates) — use a named function")
+    lam = cands[0]
+    return ast.FunctionDef(
+        name="_jst_lambda", args=lam.args,
+        body=[ast.Return(value=lam.body)],
+        decorator_list=[], returns=None, type_params=[])
 
-    Free (closure) variables are rebound by value at transform time; the
-    rewritten source is attached as ``__dy2static_source__``.
+
+def ast_transform(fn):
+    """Rewrite ``fn``'s control flow (and wrap every call site in
+    ``_jst.convert_call`` — the whole-program capture hook); returns a
+    new function object.
+
+    Free (closure) variables stay bound to the ORIGINAL cells, so
+    ``nonlocal`` rebinding on either side of the conversion remains
+    visible to both. The rewritten source is attached as
+    ``__dy2static_source__``; the synthesized module name is registered
+    in ``SOURCE_FILE_MAP`` with line numbers matching the original file,
+    so analysis diagnostics attribute to the real source.
     """
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
     try:
-        src = textwrap.dedent(inspect.getsource(fn))
+        src = textwrap.dedent(inspect.getsource(raw))
+        src_file = inspect.getsourcefile(raw)
     except (OSError, TypeError) as e:
         raise Dy2StaticError(
             f"dy2static: cannot read source of {fn!r} (interactive or "
             f"builtin function?)") from e
-    tree = ast.parse(src)
-    fdef = next(n for n in tree.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        raise Dy2StaticError(
+            f"dy2static: source of {fn!r} does not parse standalone "
+            f"({e})") from e
+    # keep original line numbers: diagnostics fired inside converted
+    # code map straight back to the real file through SOURCE_FILE_MAP
+    ast.increment_lineno(tree, raw.__code__.co_firstlineno - 1)
+    if raw.__name__ == "<lambda>":
+        fdef = _lambda_fdef(tree, raw)
+    else:
+        fdef = next(n for n in tree.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)))
     if isinstance(fdef, ast.AsyncFunctionDef):
         raise Dy2StaticError("dy2static: async functions are unsupported")
     fdef.decorator_list = []  # don't re-run @to_static et al.
@@ -698,24 +830,75 @@ def ast_transform(fn):
                                 ast.Constant(may_falloff)]))])
             fn_assigned |= {flag, val}
 
-    new_tree = ast.Module(
-        body=[ControlFlowTransformer(fn_assigned).visit(fdef)],
-        type_ignores=[])
-    ast.fix_missing_locations(new_tree)
+    arg_names = tuple(a.arg for a in (fdef.args.posonlyargs
+                                      + fdef.args.args)) \
+        or ((fdef.args.vararg.arg,) if fdef.args.vararg else ())
+    freevars = raw.__code__.co_freevars
+    fdef = ControlFlowTransformer(fn_assigned, arg_names,
+                                  freevars).visit(fdef)
 
-    ns = dict(fn.__globals__)
+    # conversion call-chain guard, built into the body so EVERY path in
+    # (direct recursion through the rebound module name included) is
+    # depth-checked and contributes to error call chains
+    label = getattr(raw, "__qualname__", raw.__name__)
+    fdef.body = [
+        ast.Expr(value=_jst_call("push_call_frame",
+                                 [ast.Constant(label)])),
+        ast.Try(body=fdef.body, handlers=[], orelse=[],
+                finalbody=[ast.Expr(value=_jst_call("pop_call_frame",
+                                                    []))]),
+    ]
+
+    ns = dict(raw.__globals__)
     ns[_JST] = _ops_mod
-    # closures: rebind free variables by value
-    for name, cell in zip(fn.__code__.co_freevars, fn.__closure__ or ()):
-        try:
-            ns[name] = cell.cell_contents
-        except ValueError:
-            pass  # unfilled cell (recursive def): resolved via globals
-    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
-                   mode="exec")
-    exec(code, ns)
-    new_fn = ns[fdef.name]
+    filename = (f"<dy2static:{next(_FILE_SEQ)}:"
+                f"{os.path.basename(src_file or '?')}:{raw.__name__}>")
+    if src_file:
+        SOURCE_FILE_MAP[filename] = os.path.normpath(src_file)
+
+    # default-argument EXPRESSIONS must not re-evaluate at exec time (a
+    # default like ``n=k`` capturing an enclosing-function local isn't a
+    # freevar of the function and would NameError in the module-globals
+    # namespace; re-evaluation would also rebind mutable defaults) —
+    # strip them from the AST and carry the ORIGINAL default objects
+    # over on the function object below
+    fdef.args.defaults = []
+    fdef.args.kw_defaults = [None] * len(fdef.args.kwonlyargs)
+
+    if freevars and raw.__closure__:
+        # compile inside a factory whose params shadow the free names,
+        # then rebind the inner code to the ORIGINAL cells — nonlocal
+        # rebinding (either direction) stays visible after conversion
+        factory = ast.FunctionDef(
+            name="_jst_factory",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None, type_params=[])
+        new_tree = ast.Module(body=[factory], type_ignores=[])
+        ast.fix_missing_locations(new_tree)
+        exec(compile(new_tree, filename=filename, mode="exec"), ns)
+        inner_code = next(
+            c for c in ns["_jst_factory"].__code__.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == fdef.name)
+        cellmap = dict(zip(freevars, raw.__closure__))
+        new_fn = types.FunctionType(
+            inner_code, ns, fdef.name, raw.__defaults__,
+            tuple(cellmap[n] for n in inner_code.co_freevars))
+    else:
+        new_tree = ast.Module(body=[fdef], type_ignores=[])
+        ast.fix_missing_locations(new_tree)
+        exec(compile(new_tree, filename=filename, mode="exec"), ns)
+        new_fn = ns[fdef.name]
+        new_fn.__defaults__ = raw.__defaults__
+    new_fn.__kwdefaults__ = dict(raw.__kwdefaults__) \
+        if raw.__kwdefaults__ else None
     new_fn.__dy2static_source__ = ast.unparse(new_tree)
+    new_fn.__dy2static_converted__ = True
+    new_fn.__dy2static_origin__ = raw
+    new_fn.__qualname__ = getattr(raw, "__qualname__", raw.__name__)
     if isinstance(fn, types.MethodType):
         new_fn = types.MethodType(new_fn, fn.__self__)
     return new_fn

@@ -63,6 +63,33 @@ def _init_weights(root: nn.Layer, std: float):
                 m.bias._value = jnp.zeros_like(m.bias._value)
 
 
+def additive_attention_mask(attention_mask):
+    """[B, S] 1/0 padding mask → additive [B, 1, 1, S]; an
+    already-broadcast 3D/4D mask (e.g. a causal bool mask for
+    generation) passes through untouched; None stays None. Shared by
+    the BERT and ERNIE encoders — and a genuinely NESTED helper on
+    their forward paths, so whole-program capture (`to_static` +
+    dy2static convert_call) is exercised by the real model zoo."""
+    if attention_mask is None:
+        return None
+    if len(attention_mask.shape) > 2:
+        return attention_mask
+    m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+    return (1.0 - ops.cast(m, "float32")) * -1e4
+
+
+def _mlm_head_loss(cls_head, seq, masked_lm_labels):
+    """Fused MLM head + chunked CE over the tied decoder weights (the
+    nested tail of ``forward_with_mlm_loss`` — transitively captured
+    under ``to_static``)."""
+    from .gpt import fused_mlm_cross_entropy
+
+    h = cls_head.layer_norm(cls_head.activation(cls_head.transform(seq)))
+    return fused_mlm_cross_entropy(h, cls_head.decoder_weight,
+                                   cls_head.decoder_bias,
+                                   masked_lm_labels)
+
+
 class BertEmbeddings(nn.Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
@@ -112,10 +139,7 @@ class BertModel(nn.Layer):
         """Sequence output only — no pooler. The MLM-loss path uses this
         so the pooler isn't computed and dropped (dead work the analysis
         deadcode pass flags)."""
-        if attention_mask is not None:
-            # [B, S] 1/0 → additive [B, 1, 1, S]
-            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
-            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
+        attention_mask = additive_attention_mask(attention_mask)
         h = self.embeddings(input_ids, token_type_ids)
         return self.encoder(h, src_mask=attention_mask)
 
@@ -155,21 +179,23 @@ class BertForPretraining(nn.Layer):
         return self.cls(seq, pooled)
 
     def forward_with_mlm_loss(self, input_ids, masked_lm_labels,
-                              token_type_ids=None, attention_mask=None):
+                              token_type_ids=None, attention_mask=None,
+                              loss_spike_damping=False):
         """Fused MLM head + chunked cross entropy: the [B,S,V] logits are
         never materialized (3.8GB fp32 at B32/S512/V30k) — tokens stream
         through the same remat'ed chunked CE the GPT head uses
         (gpt.vocab_parallel_cross_entropy), with the decoder bias folded
-        in. ignore_index=-100 semantics via the loss mask. Uses
-        BertModel.encode, so the (unused) pooler is never computed."""
-        from .gpt import fused_mlm_cross_entropy
-
+        in (see ``_mlm_head_loss``). ignore_index=-100 semantics via the
+        loss mask. Uses BertModel.encode, so the (unused) pooler is
+        never computed. ``loss_spike_damping`` routes the loss through
+        :func:`~.gpt.damp_loss_spike` — a tensor-dependent nested helper
+        that whole-program ``to_static`` capture converts transitively."""
         seq = self.bert.encode(input_ids, token_type_ids, attention_mask)
-        cls = self.cls
-        h = cls.layer_norm(cls.activation(cls.transform(seq)))
-        return fused_mlm_cross_entropy(h, cls.decoder_weight,
-                                       cls.decoder_bias,
-                                       masked_lm_labels)
+        loss = _mlm_head_loss(self.cls, seq, masked_lm_labels)
+        if loss_spike_damping:
+            from .gpt import damp_loss_spike
+            loss = damp_loss_spike(loss)
+        return loss
 
 
 class BertPretrainingCriterion(nn.Layer):

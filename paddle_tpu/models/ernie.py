@@ -14,7 +14,7 @@ from dataclasses import dataclass
 
 from .. import nn, ops
 from ..incubate.distributed.models.moe import ExpertLayer, MoELayer
-from .bert import BertEmbeddings, _init_weights
+from .bert import BertEmbeddings, _init_weights, additive_attention_mask
 
 
 @dataclass
@@ -113,16 +113,34 @@ class ErnieMoeModel(nn.Layer):
         _init_weights(self, cfg.initializer_range)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        if attention_mask is not None and len(attention_mask.shape) <= 2:
-            # 2D [B, S] padding mask → additive; an already-broadcast
-            # 3D/4D mask (e.g. a causal bool mask for generation)
-            # passes through to the attention untouched
-            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
-            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e4
+        # 2D padding mask → additive; broadcast 3D/4D (e.g. causal bool
+        # for generation) passes through — shared helper with BERT
+        attention_mask = additive_attention_mask(attention_mask)
         h = self.embeddings(input_ids, token_type_ids)
         for blk in self.layers:
             h = blk(h, src_mask=attention_mask)
         return h
+
+
+def _ernie_mlm_head_loss(model, h, masked_lm_labels):
+    """Gelu transform + LayerNorm + fused chunked CE over the tied
+    decoder weights (the nested tail of ``forward_with_mlm_loss`` —
+    transitively captured under ``to_static``)."""
+    from .gpt import fused_mlm_cross_entropy
+
+    h = model.layer_norm(nn.functional.gelu(model.transform(h)))
+    return fused_mlm_cross_entropy(h, model.decoder_weight,
+                                   model.decoder_bias, masked_lm_labels)
+
+
+def _guard_nonfinite(loss):
+    """Skip-step guard: a non-finite loss (overflow, bad batch) is
+    replaced by zero so the gradient step is a no-op instead of
+    poisoning the weights. Tensor-dependent Python branch — under
+    ``to_static`` the capture layer lowers it to ``lax.cond``."""
+    if ops.isfinite(loss):
+        return loss
+    return ops.zeros_like(loss)
 
 
 class ErnieMoeForPretraining(nn.Layer):
@@ -159,25 +177,26 @@ class ErnieMoeForPretraining(nn.Layer):
 
     def forward_with_mlm_loss(self, input_ids, masked_lm_labels,
                               token_type_ids=None, attention_mask=None,
-                              aux_loss_weight=0.01):
+                              aux_loss_weight=0.01, nonfinite_guard=False):
         """Fused MLM head + chunked CE (same design as
         bert.py forward_with_mlm_loss): the [B*S, V] fp32 logits buffer
-        never materializes; ignore_index=-100 via the loss mask. In
-        training mode the gates' load-balance aux loss is added with
-        ``aux_loss_weight`` (GShard §2.2 — without it the router
-        collapses onto few experts; the analysis deadcode pass flagged
-        the previously computed-and-dropped aux loss)."""
-        from .gpt import fused_mlm_cross_entropy
-
+        never materializes; ignore_index=-100 via the loss mask (see
+        ``_ernie_mlm_head_loss``). In training mode the gates'
+        load-balance aux loss is added with ``aux_loss_weight`` (GShard
+        §2.2 — without it the router collapses onto few experts; the
+        analysis deadcode pass flagged the previously
+        computed-and-dropped aux loss). ``nonfinite_guard`` routes the
+        loss through :func:`_guard_nonfinite` — a tensor-dependent
+        nested helper whole-program ``to_static`` capture converts
+        transitively (skip-step semantics on overflow)."""
         h = self.ernie(input_ids, token_type_ids, attention_mask)
-        h = self.layer_norm(nn.functional.gelu(self.transform(h)))
-        loss = fused_mlm_cross_entropy(h, self.decoder_weight,
-                                       self.decoder_bias,
-                                       masked_lm_labels)
+        loss = _ernie_mlm_head_loss(self, h, masked_lm_labels)
         if self.training and aux_loss_weight:
             aux = self.gate_aux_loss()
             if aux is not None:
                 loss = loss + aux_loss_weight * aux
+        if nonfinite_guard:
+            loss = _guard_nonfinite(loss)
         return loss
 
 

@@ -253,6 +253,20 @@ def vocab_parallel_cross_entropy(h, wte_local, labels, mp_axis=None,
     return jnp.mean(loss)
 
 
+def damp_loss_spike(loss, threshold=15.0):
+    """Loss-spike damping: a step loss above ``threshold`` (bad batch,
+    data poisoning, instability) is compressed logarithmically instead
+    of feeding a full-size gradient. The branch is tensor-dependent
+    Python control flow — eager runs it on the host value; under
+    ``to_static`` the dy2static capture layer converts this helper
+    transitively and lowers it to ``lax.cond`` (the model-zoo
+    whole-program capture proof rides exactly this path)."""
+    from .. import ops
+    if loss > threshold:
+        return threshold + ops.log1p(loss - threshold)
+    return loss
+
+
 def fused_mlm_cross_entropy(h, weight, bias, labels):
     """Shared fused MLM head + chunked CE for encoder pretraining heads
     (BERT/ERNIE): ignore_index=-100 via loss mask, labels remapped to -1

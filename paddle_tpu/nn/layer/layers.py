@@ -121,17 +121,23 @@ class Layer:
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError
 
-    def __call__(self, *inputs, **kwargs):
+    def _call_with_hooks(self, forward, *inputs, **kwargs):
+        """The forward-call protocol (pre hooks -> forward -> post
+        hooks), shared by ``__call__`` and the dy2static capture layer
+        (which substitutes a converted forward)."""
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        outputs = forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             out = hook(self, inputs, outputs)
             if out is not None:
                 outputs = out
         return outputs
+
+    def __call__(self, *inputs, **kwargs):
+        return self._call_with_hooks(self.forward, *inputs, **kwargs)
 
     def register_forward_pre_hook(self, hook):
         handle = HookRemoveHelper(self._forward_pre_hooks)

@@ -172,7 +172,7 @@ def _build_engine(spec: dict):
         import paddle_tpu as paddle
         from ..models import ErnieMoeForPretraining, ErnieMoeModel
         if ckpt:
-            raise FleetError("checkpoint warm-start is GPT-only for now")
+            return MoEServingEngine.from_checkpoint(ckpt, cfg, **kw)
         paddle.seed(int(spec.get("seed", 0)))
         model = ErnieMoeForPretraining(ErnieMoeModel(cfg))
         model.eval()

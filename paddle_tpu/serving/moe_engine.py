@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.ernie import (ErnieMoeConfig, ErnieMoeForPretraining,
-                            stack_ernie_moe_weights)
+                            ErnieMoeModel, stack_ernie_moe_weights)
 from ..models.gpt import sample_logits
 from ..kernels.paged_attention import (paged_attention_decode,
                                        paged_attention_reference)
@@ -281,6 +281,23 @@ class MoEServingEngine:
             self.compile_buckets()
 
     # ------------------------------------------------------------- build
+    @classmethod
+    def from_checkpoint(cls, path, config: ErnieMoeConfig, **kw):
+        """checkpoint-load → engine: ``path`` is a ``paddle.save``d
+        ERNIE-MoE state dict (``ErnieMoeForPretraining`` or bare
+        ``ErnieMoeModel`` keys). The warm-start twin of
+        ``ServingEngine.from_checkpoint`` — what ``FleetRouter``
+        replicas use for ``model_kind="moe"``."""
+        from ..framework.io import load as paddle_load
+        state = paddle_load(path)
+        model = ErnieMoeForPretraining(ErnieMoeModel(config))
+        target = model
+        if not any(k.startswith("ernie.") for k in state):
+            target = model.ernie
+        target.set_state_dict(state)
+        model.eval()
+        return cls(model, config, **kw)
+
     def compile_buckets(self):
         """AOT-compile every (prefill, decode) bucket program — same
         zero-recompile-at-serving-time contract as ``ServingEngine``."""
