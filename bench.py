@@ -783,7 +783,8 @@ def emit_serving_predicted_row(timeout_s=180, quantize=None, mode=None):
               "disagg": "serving_disagg_predicted",
               "moe": "serving_moe_predicted",
               "fused_dispatch": "moe_fused_dispatch_predicted",
-              "fleet": "serving_fleet_predicted"}.get(
+              "fleet": "serving_fleet_predicted",
+              "migration": "serving_fleet_migration_predicted"}.get(
         mode, "serving_int8_predicted" if quantize
         else "serving_predicted")
     try:
@@ -826,6 +827,11 @@ def emit_serving_predicted_row(timeout_s=180, quantize=None, mode=None):
         value = row.get("predicted_speedup", 0.0)
         unit = ("x step-time speedup (static cost model, fused Pallas "
                 "MoE dispatch+combine vs gather chain)")
+    elif mode == "migration":
+        value = row.get("predicted_speedup", 0.0)
+        unit = ("x resume speedup (static cost model, live KV-page "
+                "migration over ICI + resume vs full-prompt replay on "
+                "a cold cache)")
     else:
         value = row.get("predicted_tokens_per_sec", 0.0)
         unit = ("tokens/s (static cost model, continuous batching"
@@ -1337,6 +1343,7 @@ def bench_serving_fleet(args):
 
     on_cpu = jax.devices()[0].platform == "cpu"
     emit_serving_predicted_row(mode="fleet")
+    emit_serving_predicted_row(mode="migration")
     if not on_cpu:
         emit_skip("serving_fleet",
                   "fleet replicas are separate processes and cannot "
@@ -1769,6 +1776,7 @@ def main():
         emit_serving_predicted_row(mode="moe")
         emit_serving_predicted_row(mode="fused_dispatch")
         emit_serving_predicted_row(mode="fleet")
+        emit_serving_predicted_row(mode="migration")
         # pure arithmetic, no backend needed: the quantized-collective
         # wire-bytes anchor always lands in the artifact
         emit_collective_compression_predicted()

@@ -3,4 +3,6 @@ from .manager import (  # noqa: F401
     ElasticManager, ElasticStatus, LauncherInterface, ELASTIC_TTL,
     ELASTIC_TIMEOUT, start_worker_heartbeat, maybe_start_worker_heartbeat,
 )
-from .fault_injection import FaultInjector  # noqa: F401
+from .fault_injection import (  # noqa: F401
+    FaultInjector, kill_replica, pause_replica, resume_replica,
+)

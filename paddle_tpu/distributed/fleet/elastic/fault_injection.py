@@ -121,3 +121,21 @@ def kill_replica(router, replica_id, sig=signal.SIGKILL):
     request id) and relaunches a replacement: goodput recovers with
     zero failed requests. Returns the killed pid."""
     return router.kill_replica(replica_id, sig)
+
+
+def pause_replica(router, replica_id):
+    """SIGSTOP one serving-fleet replica: the process stays alive but
+    stops answering polls — the deterministic straggler. After
+    ``PADDLE_FLEET_STRAGGLER_POLLS`` consecutive poll failures the
+    router's supervision tick sheds the replica's in-flight load
+    (live-migrate, falling back to requeue-by-rid), no timing hacks
+    required. Pair with :func:`resume_replica`. Returns the pid."""
+    return router.kill_replica(replica_id, sig=signal.SIGSTOP)
+
+
+def resume_replica(router, replica_id):
+    """SIGCONT a replica paused by :func:`pause_replica`. The replica
+    resumes decoding where it froze; any request the router already
+    shed elsewhere finishes twice, and rid idempotency keeps the first
+    terminal result. Returns the pid."""
+    return router.kill_replica(replica_id, sig=signal.SIGCONT)

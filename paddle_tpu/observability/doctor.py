@@ -266,6 +266,11 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
     ``router_queue`` FLEET runs only: wait at the fleet router before a
                      replica saw the request (absent when zero — a
                      single-replica run keeps the classic bucket set)
+    ``migration``    FLEET runs with live migration only: wall time
+                     requests spent mid-transfer between replicas
+                     (checkpoint → chunked KV stream → adopt), carved
+                     out of the decode residual so moving a request is
+                     attributed as migration cost, not "slow decode"
     ``queue``        submit→admit wait at the replica, amortized per token
     ``prefill``      measured prefill walltime per token
     ``compile``      AOT bucket-compile seconds amortized per token
@@ -304,13 +309,22 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
     prefill_b = float(sv.get("prefill_seconds_total") or 0.0) \
         / tokens * 1e3
     compile_b = compile_s / tokens * 1e3
-    decode_b = delta_ms - router_b - queue_b - prefill_b - compile_b
+    # migration windows happen INSIDE request_seconds_total (the
+    # destination back-dates submit_time so total_s spans the whole
+    # life), so the bucket carves time out of the decode residual —
+    # measured_ms itself is unchanged and the buckets still sum exactly
+    migrate_b = float(sv.get("migrate_seconds_total") or 0.0) \
+        / tokens * 1e3
+    decode_b = (delta_ms - router_b - queue_b - prefill_b - compile_b
+                - migrate_b)
     buckets = {"queue": queue_b, "prefill": prefill_b,
                "compile": compile_b, "decode": decode_b}
     if router_b > 0:
         # fleet bucket only when the run actually crossed a router —
         # single-replica attributions keep the classic four-bucket shape
         buckets["router_queue"] = router_b
+    if migrate_b > 0:
+        buckets["migration"] = migrate_b
     out = {
         "measured_ms": round(measured_ms, 3),
         "predicted_ms": round(predicted_ms, 3),

@@ -44,7 +44,8 @@ paddle_analysis_predicted_step_ms     gauge      target
 paddle_analysis_predicted_peak_hbm_mb gauge      target
 paddle_analysis_predicted_mfu         gauge      target
 paddle_serving_requests_total         counter    event={submitted,admitted,
-                                                 finished,rejected};
+                                                 finished,rejected,
+                                                 migrated_in,migrated_out};
                                                  rejected also carries
                                                  reason={max_new<1,too_long,
                                                  queue_full,pool_too_small}
@@ -68,6 +69,10 @@ paddle_fleet_routed_total             counter    outcome={affinity,fallback,
                                                  round_robin,least_loaded}
 paddle_fleet_requeued_total           counter    —
 paddle_fleet_scale_events_total       counter    action={scale_out,scale_in}
+paddle_fleet_rpc_retries_total        counter    op
+paddle_fleet_migrations_total         counter    outcome={completed,failed,
+                                                 requeue_fallback}
+paddle_fleet_migrated_bytes_total     counter    —
 ====================================  =========  =============================
 
 Serving decode steps additionally ride ``record_train_step`` with
@@ -370,6 +375,27 @@ def fleet_scale_events_counter():
         "paddle_fleet_scale_events_total",
         "autoscaler actions executed (SLO-burn scale-out / idle "
         "drain-then-retire scale-in)")
+
+
+def fleet_rpc_retries_counter():
+    return get_registry().counter(
+        "paddle_fleet_rpc_retries_total",
+        "fleet control-plane RPC retries by op (transient socket "
+        "errors, exponential backoff with jitter)")
+
+
+def fleet_migrations_counter():
+    return get_registry().counter(
+        "paddle_fleet_migrations_total",
+        "live KV-page migrations by outcome (completed / failed / "
+        "requeue_fallback when a wedged replica forces requeue-by-rid)")
+
+
+def fleet_migrated_bytes_counter():
+    return get_registry().counter(
+        "paddle_fleet_migrated_bytes_total",
+        "KV-page payload bytes streamed between replicas by live "
+        "migration (uncached suffix only)")
 
 
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,

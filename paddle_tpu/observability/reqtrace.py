@@ -209,6 +209,14 @@ def fold_request_records(records) -> dict | None:
         # the request (0 for single-replica runs) — the doctor's
         # router_queue bucket divides this
         "router_wait_seconds_total": round(sum(vals("router_wait_s")), 6),
+        # live migration: wall time a request spent mid-transfer between
+        # replicas (inside total_s — the doctor's migration bucket
+        # divides this) plus payload accounting
+        "migrate_seconds_total": round(sum(vals("migrate_s")), 6),
+        "migrate_bytes_total": sum(
+            int(r.get("migrate_bytes") or 0) for r in finished),
+        "migrated_requests": sum(
+            1 for r in finished if (r.get("migrations") or 0) > 0),
         "prefill_seconds_total": round(sum(vals("prefill_s")), 6),
         "decode_seconds_total": round(sum(vals("decode_s")), 6),
         "queue_wait_s": _pcts(vals("queue_wait_s")),

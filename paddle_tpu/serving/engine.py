@@ -413,6 +413,12 @@ class ServingEngine:
                 "prefill_chunk in a later PR, not here")
         self.kv_transfer_bytes = 0
         self.kv_transfers = 0
+        # fleet live migration (export_kv / commit_kv_import): sequences
+        # moved in/out of this engine and the true K/V payload bytes
+        self.kv_migrations_in = 0
+        self.kv_migrations_out = 0
+        self.kv_migration_bytes = 0
+        self._kv_import: dict = {}     # seq_id -> staged import state
         self._prefill_device = self._decode_device = None
         if self.disaggregated:
             devs = list(jax.devices())
@@ -668,6 +674,12 @@ class ServingEngine:
                 "kv_transfer_mb": round(
                     self.kv_transfer_bytes / 2 ** 20, 2),
             }
+        if self.kv_migrations_in or self.kv_migrations_out:
+            st["migration"] = {
+                "migrations_in": self.kv_migrations_in,
+                "migrations_out": self.kv_migrations_out,
+                "kv_bytes": self.kv_migration_bytes,
+            }
         return st
 
     # ------------------------------------------------------------ lookup
@@ -917,3 +929,103 @@ class ServingEngine:
                                          self.pool.table(seq_id))
             self.prefix_cache.release(seq_id)
         self.pool.free(seq_id)
+
+    # -------------------------------------------------- live migration
+    # Host-staged KV hand-off between engines (fleet live migration):
+    # the source gathers a sequence's valid K/V rows into dense arrays,
+    # the wire carries them, and the destination scatters them into its
+    # own pool behind a fresh page table. The destination reuses any
+    # radix-cache prefix it already holds (full pages only — the
+    # mid-page COW boundary is not worth a device copy on this path),
+    # so only the uncached suffix ever crosses the wire.
+
+    def export_kv(self, seq_id, start: int = 0):
+        """Gather K/V for token positions ``[start, seq_len)`` of a live
+        sequence into dense host arrays ``[L, n, num_kv_heads,
+        head_dim]`` (one pair). ``seq_len`` covers exactly the positions
+        whose K/V entered the pool — the final sampled token's K/V has
+        not, and must travel as ``_last_token`` metadata instead."""
+        pool = self.pool
+        n = pool.seq_len(seq_id)
+        rows = pool.token_rows(seq_id, start, n)
+        shape = pool.k_pages.shape    # [L, P, ps, nkv, d]
+        flat = (shape[0], shape[1] * shape[2], shape[3], shape[4])
+        k = np.asarray(pool.k_pages).reshape(flat)[:, rows].copy()
+        v = np.asarray(pool.v_pages).reshape(flat)[:, rows].copy()
+        return k, v
+
+    def begin_kv_import(self, seq_id, token_ids) -> int:
+        """Destination side, step 1: match ``token_ids`` (the tokens
+        whose K/V the source would send) against this engine's prefix
+        cache and pin the matched FULL pages under ``seq_id``. Returns
+        the cached prefix length (page-aligned; 0 without a cache or on
+        a miss) — the source then exports only ``[cached_len, n)``.
+        Must be balanced by :meth:`commit_kv_import` or
+        :meth:`abort_kv_import`."""
+        if seq_id in self._kv_import:
+            raise EngineShapeError(
+                f"sequence {seq_id!r} already has a staged KV import")
+        prompt = np.asarray(token_ids, np.int32).reshape(-1)
+        pages: list = []
+        cached_len = 0
+        if self.prefix_cache is not None:
+            nodes, _boundary, _ = self.prefix_cache.match(prompt)
+            # full pages only: a mid-page boundary would need a COW copy
+            # before any suffix row lands next to shared content
+            cached_len = len(nodes) * self.pool.page_size
+            pages = self.prefix_cache.map_into(seq_id, nodes, None)
+        else:
+            self.pool.note_prefix_lookup(0)
+        self._kv_import[seq_id] = {"pages": pages,
+                                   "cached_len": cached_len}
+        return cached_len
+
+    def commit_kv_import(self, seq_id, total_len: int, k, v,
+                         last_token: int):
+        """Destination side, step 2: allocate the page table (cached
+        prefix pages + fresh suffix pages), scatter the transferred
+        suffix K/V into the pool rows, and arm ``_last_token`` so the
+        next decode step resumes token-exact. ``k``/``v`` are the
+        source's :meth:`export_kv` output for ``[cached_len,
+        total_len)``. On any failure the staged cache pins are released
+        and the pool is left untouched."""
+        st = self._kv_import.pop(seq_id)
+        cached_len = st["cached_len"]
+        total_len = int(total_len)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        if k.shape != v.shape or k.shape[1] != total_len - cached_len:
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(seq_id)
+            raise EngineShapeError(
+                f"migration payload shape {k.shape} does not cover "
+                f"tokens [{cached_len}, {total_len})")
+        try:
+            self.pool.alloc_prefixed(seq_id, total_len, st["pages"],
+                                     cached_len)
+        except Exception:
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(seq_id)
+            raise
+        rows = self.pool.token_rows(seq_id, cached_len, total_len)
+        shape = self.pool.k_pages.shape
+        flat = (shape[0], shape[1] * shape[2], shape[3], shape[4])
+        kp = np.array(self.pool.k_pages).reshape(flat)
+        vp = np.array(self.pool.v_pages).reshape(flat)
+        kp[:, rows] = k.astype(kp.dtype, copy=False)
+        vp[:, rows] = v.astype(vp.dtype, copy=False)
+        self.pool.bind(jnp.asarray(kp.reshape(shape)),
+                       jnp.asarray(vp.reshape(shape)))
+        self._last_token[seq_id] = int(last_token)
+        self._cached_len[seq_id] = cached_len
+        self.kv_migrations_in += 1
+        self.kv_migration_bytes += int(k.nbytes) + int(v.nbytes)
+        return cached_len
+
+    def abort_kv_import(self, seq_id):
+        """Destination side, bail-out: drop a staged import (release
+        the cache pins taken by :meth:`begin_kv_import`). Idempotent —
+        the source stays authoritative for the sequence."""
+        if self._kv_import.pop(seq_id, None) is not None \
+                and self.prefix_cache is not None:
+            self.prefix_cache.release(seq_id)

@@ -31,6 +31,16 @@ millions-of-users shape (ROADMAP item 3):
   sustained idle fleet drains one replica (stop routing to it, let
   in-flight work finish) and retires it — scale-in never drops a
   request either.
+- **Live migration** — a running request's KV pages move between
+  replicas mid-decode: the source checkpoints (token ids, sampling
+  cursor, uncached KV suffix gathered from the page table), the
+  control plane streams chunked + sha256-checksummed payloads with
+  bounded timeouts and backoff, the destination reuses any radix-cache
+  prefix it already holds and resumes decode token-exact. Three paths
+  ride on it: drain-by-migrate scale-in (with a drain deadline so
+  retirement never hangs), mid-stream shedding off wedged/SLO-burning
+  stragglers, and SIGKILL failover that re-prefills only the suffix
+  the surviving fleet's prefix caches don't cover.
 - **Federation** — every replica logs into ONE shared run dir
   (rank = replica id, per-rank ``requests.rank<k>.jsonl`` streams), so
   ``merge_run_dir`` already folds the whole fleet into one
@@ -59,8 +69,11 @@ Quickstart::
 """
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
 import os
+import random
 import signal
 import socket
 import threading
@@ -71,6 +84,14 @@ import numpy as np
 __all__ = ["FleetRouter", "ReplicaHandle", "FleetError"]
 
 _RPC_TIMEOUT_S = 60.0
+_MIGRATE_CHUNK_BYTES = 256 * 1024
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _debug(msg: str):
@@ -92,15 +113,50 @@ class FleetError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 def _rpc_request(addr: tuple, payload: dict,
-                 timeout: float = _RPC_TIMEOUT_S) -> dict:
-    """One call: connect, send one JSON line, read one JSON line."""
-    with socket.create_connection(addr, timeout=timeout) as s:
-        s.sendall(json.dumps(payload).encode() + b"\n")
-        with s.makefile("rb") as f:
-            line = f.readline()
-    if not line:
-        raise ConnectionError(f"empty RPC reply from {addr}")
-    return json.loads(line.decode())
+                 timeout: float | None = None,
+                 retries: int | None = None) -> dict:
+    """One call: connect, send one JSON line, read one JSON line.
+
+    Hardened: every call carries a deadline (``PADDLE_FLEET_RPC_TIMEOUT_S``,
+    default 60s) and transient socket errors retry with exponential
+    backoff + full jitter (``PADDLE_FLEET_RPC_RETRIES`` extra attempts,
+    base ``PADDLE_FLEET_RPC_RETRY_BASE_S``), mirroring the TCPStore
+    retry contract. Callers whose ops are NOT safe to replay (e.g. the
+    router's poll, which drains done-records) pass ``retries=0``;
+    replica-side handlers make submit/migrate idempotent by rid so the
+    default retry budget cannot double-apply them.
+    """
+    from ..observability import instrument as obs
+    if timeout is None:
+        timeout = _env_float("PADDLE_FLEET_RPC_TIMEOUT_S", _RPC_TIMEOUT_S)
+    if retries is None:
+        retries = max(int(_env_float("PADDLE_FLEET_RPC_RETRIES", 2)), 0)
+    base = _env_float("PADDLE_FLEET_RPC_RETRY_BASE_S", 0.05)
+    attempt = 0
+    while True:
+        try:
+            with socket.create_connection(addr, timeout=timeout) as s:
+                s.sendall(json.dumps(payload).encode() + b"\n")
+                with s.makefile("rb") as f:
+                    line = f.readline()
+            if not line:
+                raise ConnectionError(f"empty RPC reply from {addr}")
+            return json.loads(line.decode())
+        except OSError:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            obs.fleet_rpc_retries_counter().inc(
+                op=str(payload.get("op") or "?"))
+            time.sleep(base * (2 ** (attempt - 1)) * (1.0 + random.random()))
+
+
+def _chunk_blob(blob: bytes) -> list:
+    """Split a KV payload into wire chunks (PADDLE_FLEET_MIGRATE_CHUNK_BYTES,
+    default 256 KiB)."""
+    size = max(int(_env_float("PADDLE_FLEET_MIGRATE_CHUNK_BYTES",
+                              _MIGRATE_CHUNK_BYTES)), 1)
+    return [blob[i:i + size] for i in range(0, len(blob), size)]
 
 
 class _RPCServer:
@@ -220,15 +276,89 @@ def _fleet_replica_main(spec: dict):
     http = sched.serve_http(port=0)  # ephemeral: replicas never collide
     stop = threading.Event()
     reported: set = set()
+    submitted: set = set()      # rids ever admitted here (submit idempotency)
+    mig_in: dict = {}           # rid -> staged inbound migration chunks
+    mig_adopted: set = set()    # rids whose migrate_commit already applied
+
+    def _migrate_out(msg: dict) -> dict:
+        """Source side of a live migration: checkpoint the request,
+        stream the uncached KV suffix to ``dest`` in checksummed
+        chunks, and only release local state once the destination ACKs
+        the commit. Any failure aborts: the checkpoint is restored to
+        the run queue and the source stays authoritative."""
+        gid = int(msg["rid"])
+        dest = (msg["dest"][0], int(msg["dest"][1]))
+        if not hasattr(engine, "export_kv"):
+            return {"ok": True, "migrated": False,
+                    "reason": "engine_unsupported"}
+        ck = sched.checkpoint_request(gid)
+        if ck is None:
+            return {"ok": True, "migrated": False, "reason": "not_running"}
+        t0 = time.monotonic()
+        try:
+            token_ids = list(ck["prompt"]) + list(ck["tokens"][:-1])
+            begin = _rpc_request(dest, {
+                "op": "migrate_begin", "rid": gid, "token_ids": token_ids,
+                "prompt_len": len(ck["prompt"]),
+                "max_new": int(ck["max_new"])})
+            if not begin.get("accepted"):
+                raise FleetError("destination refused migration: "
+                                 f"{begin.get('reason') or begin.get('error')}")
+            cached_len = int(begin.get("cached_len") or 0)
+            k, v = engine.export_kv(gid, start=cached_len)
+            blob = k.tobytes() + v.tobytes()
+            chunks = _chunk_blob(blob)
+            for i, ch in enumerate(chunks):
+                rep = _rpc_request(dest, {
+                    "op": "migrate_chunk", "rid": gid, "seq": i,
+                    "data": base64.b64encode(ch).decode(),
+                    "sha256": hashlib.sha256(ch).hexdigest()})
+                if not rep.get("accepted"):
+                    raise FleetError(
+                        f"chunk {i} refused: {rep.get('reason')}")
+            meta = {key: val for key, val in ck.items()}
+            meta["migrate_bytes"] = (int(meta.get("migrate_bytes") or 0)
+                                     + len(blob))
+            commit = _rpc_request(dest, {
+                "op": "migrate_commit", "rid": gid,
+                "n_chunks": len(chunks),
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "kv_shape": [int(x) for x in k.shape],
+                "kv_dtype": str(k.dtype), "meta": meta})
+            if not commit.get("accepted"):
+                raise FleetError("destination refused commit: "
+                                 f"{commit.get('reason')}")
+            sched.complete_migration(gid)
+            engine.kv_migrations_out += 1
+            engine.kv_migration_bytes += len(blob)
+            return {"ok": True, "migrated": True, "bytes": len(blob),
+                    "chunks": len(chunks), "cached_len": cached_len,
+                    "payload_tokens": len(token_ids) - cached_len,
+                    "migrate_s": round(time.monotonic() - t0, 6)}
+        except Exception as e:
+            # source stays authoritative: restore the checkpoint and
+            # tell the destination to discard its half-applied staging
+            sched.abort_migration(gid)
+            try:
+                _rpc_request(dest, {"op": "migrate_abort", "rid": gid},
+                             timeout=2.0, retries=0)
+            except Exception:
+                pass
+            return {"ok": True, "migrated": False, "reason": repr(e)[:200]}
 
     def handler(msg: dict) -> dict:
         op = msg.get("op")
         if op == "ping":
             return {"ok": True, "replica": rid}
         if op == "submit":
+            gid = int(msg["rid"])
+            if gid in submitted:
+                # an RPC-retried submit whose first attempt landed:
+                # accept idempotently, never double-admit a rid
+                return {"ok": True, "accepted": True, "duplicate": True}
             r = sched.submit(np.asarray(msg["prompt"], np.int32),
                              int(msg["max_new"]), eos_id=msg.get("eos_id"),
-                             rid=int(msg["rid"]),
+                             rid=gid,
                              router_wait_s=float(msg.get("router_wait_s")
                                                  or 0.0))
             if r.state == "rejected":
@@ -236,7 +366,84 @@ def _fleet_replica_main(spec: dict):
                 reported.add(r.rid)
                 return {"ok": True, "accepted": False,
                         "reason": r.reject_reason}
+            submitted.add(gid)
             return {"ok": True, "accepted": True}
+        if op == "withdraw":
+            # drain path: pull a queued/prefilling request back so the
+            # router can re-dispatch it to a peer (running ones migrate)
+            return {"ok": True,
+                    "withdrawn": bool(sched.withdraw(int(msg["rid"])))}
+        if op == "migrate_out":
+            return _migrate_out(msg)
+        if op == "migrate_begin":
+            gid = int(msg["rid"])
+            if gid in mig_in:  # idempotent by rid: restart staging
+                mig_in.pop(gid, None)
+                sched.abort_migration_in(gid)
+            mig_adopted.discard(gid)
+            ok2, res = sched.prepare_migration_in(
+                gid, msg["token_ids"], int(msg["prompt_len"]),
+                int(msg["max_new"]))
+            if not ok2:
+                return {"ok": True, "accepted": False, "reason": res}
+            mig_in[gid] = {"chunks": {}, "t0": time.monotonic()}
+            return {"ok": True, "accepted": True, "cached_len": int(res)}
+        if op == "migrate_chunk":
+            gid = int(msg["rid"])
+            st = mig_in.get(gid)
+            if st is None:
+                return {"ok": True, "accepted": False, "reason": "no_begin"}
+            data = base64.b64decode(msg["data"])
+            if hashlib.sha256(data).hexdigest() != msg.get("sha256"):
+                return {"ok": True, "accepted": False,
+                        "reason": "chunk_checksum_mismatch"}
+            st["chunks"][int(msg["seq"])] = data  # idempotent re-store
+            return {"ok": True, "accepted": True}
+        if op == "migrate_commit":
+            gid = int(msg["rid"])
+            st = mig_in.pop(gid, None)
+            if st is None:
+                if gid in mig_adopted:
+                    # retried commit whose first attempt applied and
+                    # whose ACK was lost: re-ACK, don't re-apply
+                    return {"ok": True, "accepted": True,
+                            "duplicate": True}
+                return {"ok": True, "accepted": False, "reason": "no_begin"}
+            n = int(msg["n_chunks"])
+            if sorted(st["chunks"]) != list(range(n)):
+                sched.abort_migration_in(gid)
+                return {"ok": True, "accepted": False,
+                        "reason": "missing_chunks"}
+            blob = b"".join(st["chunks"][i] for i in range(n))
+            if hashlib.sha256(blob).hexdigest() != msg.get("sha256"):
+                sched.abort_migration_in(gid)
+                return {"ok": True, "accepted": False,
+                        "reason": "payload_checksum_mismatch"}
+            shape = tuple(int(x) for x in msg["kv_shape"])
+            dt = np.dtype(msg["kv_dtype"])
+            half = int(np.prod(shape)) * dt.itemsize
+            if len(blob) != 2 * half:
+                sched.abort_migration_in(gid)
+                return {"ok": True, "accepted": False,
+                        "reason": "payload_size_mismatch"}
+            k = np.frombuffer(blob[:half], dtype=dt).reshape(shape)
+            v = np.frombuffer(blob[half:], dtype=dt).reshape(shape)
+            meta = dict(msg.get("meta") or {})
+            window = time.monotonic() - st["t0"]
+            meta["migrate_s"] = float(meta.get("migrate_s") or 0.0) + window
+            meta["migrate_window_s"] = window
+            meta["rid"] = gid
+            ok2, res = sched.adopt_migrated(meta, k, v)
+            if not ok2:
+                return {"ok": True, "accepted": False, "reason": res}
+            mig_adopted.add(gid)
+            submitted.add(gid)
+            return {"ok": True, "accepted": True, "cached_len": int(res)}
+        if op == "migrate_abort":
+            gid = int(msg["rid"])
+            if mig_in.pop(gid, None) is not None:
+                sched.abort_migration_in(gid)
+            return {"ok": True}
         if op == "poll":
             done = []
             with sched._lock:
@@ -324,6 +531,9 @@ class ReplicaHandle:
         self.retired = False
         self.launched_ts = time.monotonic()
         self.last_status: dict = {}
+        self.poll_failures = 0              # consecutive failed polls
+        self.last_shed_ts = 0.0
+        self.drain_deadline = float("inf")
         self._ctx = spawn(_fleet_replica_main, args=(spec,), nprocs=1,
                           join=False,
                           job_id=f"fleet{os.getpid()}r{replica_id}")
@@ -346,8 +556,10 @@ class ReplicaHandle:
     def alive(self) -> bool:
         return self.proc.is_alive()
 
-    def rpc(self, payload: dict, timeout: float = _RPC_TIMEOUT_S) -> dict:
-        reply = _rpc_request(self.rpc_addr, payload, timeout=timeout)
+    def rpc(self, payload: dict, timeout: float | None = None,
+            retries: int | None = None) -> dict:
+        reply = _rpc_request(self.rpc_addr, payload, timeout=timeout,
+                             retries=retries)
         if not reply.get("ok"):
             raise FleetError(
                 f"replica {self.replica_id} RPC {payload.get('op')!r} "
@@ -424,6 +636,12 @@ class FleetRouter:
         self.results: dict = {}         # rid -> terminal record
         self.requeued_rids: list = []
         self.scale_events: list = []
+        self.migrations: list = []      # recent migration event dicts
+        self.migrated_rids: list = []
+        self.migrations_completed = 0
+        self.migrations_failed = 0
+        self.migration_bytes = 0
+        self.shed_events: list = []
         self._lock = threading.RLock()
         self._boot_threads: list = []   # in-flight async relaunches
         self._started = False
@@ -558,21 +776,31 @@ class FleetRouter:
         return rids
 
     # ------------------------------------------------------------- routing
+    @staticmethod
+    def _straggler_polls() -> int:
+        return max(int(_env_float("PADDLE_FLEET_STRAGGLER_POLLS", 3)), 1)
+
     def _snapshots(self) -> dict:
-        """Routing view of the live, started replicas."""
+        """Routing view of the live, started replicas. A replica that
+        missed ``PADDLE_FLEET_STRAGGLER_POLLS`` consecutive polls is
+        reported unhealthy: routing skips it and the supervision tick
+        sheds its load."""
         out = {}
         for rid, h in self.replicas.items():
             if h.retired or not h.alive():
                 continue
             st = h.last_status or {}
             pool = st.get("kv_pool") or {}
+            wedged = h.poll_failures >= self._straggler_polls()
             out[rid] = {
-                "healthy": st.get("healthy", True),
+                "healthy": st.get("healthy", True) and not wedged,
                 "draining": h.draining or st.get("draining", False),
                 "queue_depth": int(st.get("queue_depth") or 0),
                 "pending": int(st.get("queue_depth") or 0)
                 + int(st.get("prefilling") or 0)
-                + int(st.get("running") or 0),
+                + int(st.get("running") or 0)
+                + int(st.get("migrating_out") or 0)
+                + int(st.get("migrating_in") or 0),
                 "free_pages": int(pool.get("free_pages") or 0),
                 "num_pages": int(pool.get("num_pages") or 0),
             }
@@ -664,13 +892,22 @@ class FleetRouter:
         self._autoscale()
 
     def _poll_replicas(self):
+        # short deadline and NO retries: a wedged replica must not hang
+        # the supervision tick, and a replayed poll could lose done-
+        # records the replica already marked reported. Consecutive
+        # failures accumulate; _snapshots/_supervise treat the replica
+        # as a straggler past PADDLE_FLEET_STRAGGLER_POLLS of them.
+        poll_timeout = _env_float("PADDLE_FLEET_POLL_TIMEOUT_S", 5.0)
         for rid, h in list(self.replicas.items()):
             if h.retired or not h.alive():
                 continue
             try:
-                reply = h.rpc({"op": "poll"})
+                reply = h.rpc({"op": "poll"}, timeout=poll_timeout,
+                              retries=0)
             except Exception:
+                h.poll_failures += 1
                 continue  # _supervise decides dead-vs-slow by the process
+            h.poll_failures = 0
             h.last_status = reply.get("status") or {}
             with self._lock:
                 for done in reply.get("done") or ():
@@ -685,37 +922,54 @@ class FleetRouter:
                         tokens=done.get("tokens") or (),
                         summary=done.get("summary"))
 
+    def _requeue_one(self, rec: dict, from_replica, reason: str):
+        """Pull one in-flight request back to the head of the router
+        queue — the rid is the idempotency key, so a request the source
+        already finished (and we already reaped) is never re-run."""
+        from ..observability import instrument as obs
+        with self._lock:
+            self._inflight.pop(rec["rid"], None)
+            rec["requeues"] += 1
+            rec["enqueued_ts"] = time.monotonic()
+            rec.pop("replica", None)
+            self._queue.insert(0, rec)
+            self.requeued_rids.append(rec["rid"])
+            obs.fleet_requeued_counter().inc()
+            if self._logger is not None:
+                # visible in the fleet requests stream: the black-box
+                # record that rid N survived a dead/wedged replica
+                # (event != "request", so request folding never counts
+                # it twice)
+                self._logger.log_request({
+                    "event": "request_requeue", "rid": rec["rid"],
+                    "from_replica": from_replica, "reason": reason,
+                    "requeues": rec["requeues"]})
+
     def _supervise(self):
         from ..observability import instrument as obs
+        # mid-stream shedding: a live-but-wedged straggler (consecutive
+        # poll misses) or an SLO-burning replica (opt-in via
+        # PADDLE_FLEET_SHED_BURN) gets its in-flight load moved off NOW
+        # rather than when it dies
+        for rid, h in list(self.replicas.items()):
+            if h.retired or not h.alive() or h.draining:
+                continue
+            if h.poll_failures >= self._straggler_polls():
+                self.shed_replica(rid, reason="wedged")
+            elif self._should_shed_burn(rid, h):
+                self.shed_replica(rid, reason="slo_burn")
         for rid, h in list(self.replicas.items()):
             if h.retired or h.alive():
                 continue
             # crashed (or SIGKILLed) replica: everything it held in
-            # flight re-enqueues at the router — the rid is the
-            # idempotency key, so a request it already finished (and we
-            # already reaped) is never re-run
+            # flight re-enqueues at the router
             del self.replicas[rid]
             self.retired.append(h)
             with self._lock:
                 lost = [rec for rec in self._inflight.values()
                         if rec.get("replica") == rid]
-                for rec in lost:
-                    self._inflight.pop(rec["rid"], None)
-                    rec["requeues"] += 1
-                    rec["enqueued_ts"] = time.monotonic()
-                    rec.pop("replica", None)
-                    self._queue.insert(0, rec)
-                    self.requeued_rids.append(rec["rid"])
-                    obs.fleet_requeued_counter().inc()
-                    if self._logger is not None:
-                        # visible in the fleet requests stream: the
-                        # black-box record that rid N survived a dead
-                        # replica (event != "request", so request
-                        # folding never counts it twice)
-                        self._logger.log_request({
-                            "event": "request_requeue", "rid": rec["rid"],
-                            "from_replica": rid,
-                            "requeues": rec["requeues"]})
+            for rec in lost:
+                self._requeue_one(rec, rid, reason="replica_dead")
             if h.draining:
                 # a retiring replica died after drain: nothing to
                 # relaunch — scale-in wanted it gone anyway
@@ -760,18 +1014,180 @@ class FleetRouter:
                 self._logger.log("relaunch", restarts=self.restarts,
                                  dead_replica=rid, new_replica=new_rid)
 
+    # ------------------------------------------------------ live migration
+    def migrate(self, rid: int, target: int | None = None,
+                timeout: float | None = None) -> dict:
+        """Live-migrate one in-flight request to another replica: the
+        source checkpoints it mid-decode, streams the KV-page payload
+        (uncached suffix only) to ``target``, and releases its copy
+        only after the destination ACKs — see ``_migrate_out`` for the
+        replica-side protocol. Returns the source's reply dict with
+        ``migrated`` True/False."""
+        from ..observability import instrument as obs
+        with self._lock:
+            rec = self._inflight.get(int(rid))
+            src = rec.get("replica") if rec else None
+        if rec is None or src is None:
+            return {"migrated": False, "reason": "not_inflight"}
+        if target is None:
+            pages = -(-(len(rec["prompt"]) + rec["max_new"])
+                      // self.page_size)
+            target = self.policy.migration_target(
+                self._snapshots(), exclude=(src,), pages_needed=pages)
+        if target is None or target == src:
+            return {"migrated": False, "reason": "no_target"}
+        src_h = self.replicas.get(src)
+        dest_h = self.replicas.get(target)
+        if src_h is None or dest_h is None or dest_h.rpc_addr is None:
+            return {"migrated": False, "reason": "no_target"}
+        if timeout is None:
+            timeout = _env_float("PADDLE_FLEET_MIGRATE_TIMEOUT_S", 30.0)
+        try:
+            reply = src_h.rpc({"op": "migrate_out", "rid": int(rid),
+                               "dest": list(dest_h.rpc_addr)},
+                              timeout=timeout, retries=0)
+        except Exception as e:
+            reply = {"migrated": False, "reason": repr(e)[:200]}
+        if not reply.get("migrated") and \
+                reply.get("reason") == "not_running":
+            # benign race: it finished (or is still queued) at the
+            # source — neither a completed nor a failed migration
+            return dict(reply, to=target)
+        ev = {"rid": int(rid), "from": src, "to": target,
+              "ok": bool(reply.get("migrated")),
+              "reason": reply.get("reason"),
+              "bytes": int(reply.get("bytes") or 0),
+              "chunks": int(reply.get("chunks") or 0),
+              "cached_len": int(reply.get("cached_len") or 0),
+              "payload_tokens": int(reply.get("payload_tokens") or 0),
+              "migrate_s": float(reply.get("migrate_s") or 0.0)}
+        with self._lock:
+            if ev["ok"]:
+                rec["replica"] = target
+                self.migrations_completed += 1
+                self.migration_bytes += ev["bytes"]
+                self.migrated_rids.append(int(rid))
+                obs.fleet_migrations_counter().inc(outcome="completed")
+                obs.fleet_migrated_bytes_counter().inc(float(ev["bytes"]))
+            else:
+                self.migrations_failed += 1
+                obs.fleet_migrations_counter().inc(outcome="failed")
+            self.migrations.append(dict(ev, ts=time.time()))
+            del self.migrations[:-256]
+        if self._logger is not None:
+            # black-box record (event != "request": request folding
+            # never double-counts it) that rid N moved replicas live
+            self._logger.log_request(dict(ev, event="request_migrate"))
+        return dict(reply, to=target)
+
+    def _shed_burn_threshold(self) -> float:
+        # opt-in: 0 disables SLO-burn shedding (wedged shedding is
+        # always on); set PADDLE_FLEET_SHED_BURN=4.0 or similar
+        return _env_float("PADDLE_FLEET_SHED_BURN", 0.0)
+
+    def _should_shed_burn(self, rid: int, h) -> bool:
+        thr = self._shed_burn_threshold()
+        if thr <= 0:
+            return False
+        rates = ((h.last_status or {}).get("slo") or {})\
+            .get("burn_rates") or {}
+        burn = max((float(v) for v in rates.values()), default=0.0)
+        if burn < thr:
+            return False
+        if time.monotonic() - h.last_shed_ts < \
+                _env_float("PADDLE_FLEET_SHED_COOLDOWN_S", 5.0):
+            return False
+        snaps = self._snapshots()
+        return any(r != rid and s.get("healthy", True)
+                   and not s.get("draining") for r, s in snaps.items())
+
+    def shed_replica(self, replica_id: int, reason: str = "manual") -> dict:
+        """Move every in-flight request off a straggler / SLO-burning
+        replica mid-stream: live-migrate each to a healthy peer,
+        falling back to requeue-by-rid when the replica can't even
+        answer RPC (wedged/SIGSTOPped — rid idempotency makes any
+        eventual duplicate completion harmless)."""
+        from ..observability import instrument as obs
+        h = self.replicas.get(replica_id)
+        out = {"replica": replica_id, "reason": reason,
+               "migrated": 0, "requeued": 0}
+        if h is None or h.retired:
+            return out
+        h.last_shed_ts = time.monotonic()
+        with self._lock:
+            recs = [rec for rec in self._inflight.values()
+                    if rec.get("replica") == replica_id]
+        wedged = h.poll_failures >= self._straggler_polls()
+        for rec in recs:
+            migrated = False
+            if not wedged:  # don't burn a timeout per request on a
+                migrated = bool(       # replica that won't answer
+                    self.migrate(rec["rid"]).get("migrated"))
+            if migrated:
+                out["migrated"] += 1
+            else:
+                self._requeue_one(rec, replica_id, reason=f"shed_{reason}")
+                obs.fleet_migrations_counter().inc(
+                    outcome="requeue_fallback")
+                out["requeued"] += 1
+        if recs:
+            self.shed_events.append(dict(out, ts=time.time()))
+            del self.shed_events[:-64]
+            if self._logger is not None:
+                self._logger.log("fleet_shed", **out)
+        return out
+
+    def _migrate_off(self, replica_id: int) -> int:
+        """Drain-by-migrate: move a draining replica's in-flight work
+        to its peers — running requests live-migrate (KV pages and
+        all); queued/prefilling ones are withdrawn and re-dispatched."""
+        h = self.replicas.get(replica_id)
+        if h is None or h.retired or not h.alive():
+            return 0
+        with self._lock:
+            recs = [rec for rec in self._inflight.values()
+                    if rec.get("replica") == replica_id]
+        moved = 0
+        for rec in recs:
+            res = self.migrate(rec["rid"])
+            if res.get("migrated"):
+                moved += 1
+                continue
+            if res.get("reason") == "not_running":
+                # maybe queued/prefilling at the source: withdraw it
+                # and let the router re-dispatch to a peer; if it
+                # actually finished, withdraw is a no-op and the next
+                # poll reaps the result
+                try:
+                    rep = h.rpc({"op": "withdraw", "rid": rec["rid"]},
+                                retries=0)
+                except Exception:
+                    continue
+                if rep.get("withdrawn"):
+                    self._requeue_one(rec, replica_id,
+                                      reason="drain_withdraw")
+                    moved += 1
+        return moved
+
     def _finish_drains(self):
-        """Retire draining replicas whose in-flight work is done."""
+        """Retire draining replicas: every tick drain-by-migrate moves
+        their in-flight work to peers (running requests live-migrate,
+        queued ones withdraw + re-dispatch), and the drain deadline
+        guarantees retirement can never hang — past it the remainder
+        requeues by rid and the replica is stopped anyway."""
         for rid, h in list(self.replicas.items()):
             if not h.draining or h.retired or not h.alive():
                 continue
+            self._migrate_off(rid)
             st = h.last_status or {}
             pending = (int(st.get("queue_depth") or 0)
                        + int(st.get("prefilling") or 0)
-                       + int(st.get("running") or 0))
+                       + int(st.get("running") or 0)
+                       + int(st.get("migrating_out") or 0)
+                       + int(st.get("migrating_in") or 0))
             with self._lock:
-                inflight_here = any(rec.get("replica") == rid
-                                    for rec in self._inflight.values())
+                inflight_here = [rec for rec in self._inflight.values()
+                                 if rec.get("replica") == rid]
             if pending == 0 and not inflight_here:
                 try:
                     self._poll_replicas()  # final reap before shutdown
@@ -782,6 +1198,17 @@ class FleetRouter:
                 self.retired.append(h)
                 if self._logger is not None:
                     self._logger.log("replica_retired", replica=rid)
+                self._update_replica_gauges()
+            elif time.monotonic() > h.drain_deadline:
+                for rec in inflight_here:
+                    self._requeue_one(rec, rid, reason="drain_deadline")
+                if self._logger is not None:
+                    self._logger.log(
+                        "replica_drain_deadline", replica=rid,
+                        requeued=[rec["rid"] for rec in inflight_here])
+                h.stop(grace=False)
+                del self.replicas[rid]
+                self.retired.append(h)
                 self._update_replica_gauges()
 
     # ----------------------------------------------------------- autoscale
@@ -827,8 +1254,9 @@ class FleetRouter:
     def scale_in(self, replica_id: int | None = None,
                  reason: str = "manual"):
         """Drain-then-retire one replica (the least loaded, unless
-        named): stop routing to it now; :meth:`tick` retires it once
-        its in-flight work finishes — nothing is dropped."""
+        named): stop routing to it now; :meth:`tick` live-migrates its
+        in-flight work to peers (drain-by-migrate) and retires it once
+        empty — nothing is dropped, and nothing waits to finish."""
         from ..observability import instrument as obs
         candidates = {rid: h for rid, h in self.replicas.items()
                       if not h.draining and not h.retired and h.alive()}
@@ -843,6 +1271,10 @@ class FleetRouter:
                   or 0)))
         h = self.replicas[rid]
         h.draining = True
+        # drain-by-migrate (see _finish_drains) with a hard deadline:
+        # retirement can never hang on a wedged drain
+        h.drain_deadline = time.monotonic() + _env_float(
+            "PADDLE_FLEET_DRAIN_DEADLINE_S", 120.0)
         try:
             h.rpc({"op": "drain"})
         except Exception:
@@ -940,6 +1372,13 @@ class FleetRouter:
             "autoscaler": self.autoscaler.snapshot()
             if self.autoscaler is not None else None,
             "scale_events": self.scale_events[-8:],
+            "migrations": {
+                "completed": self.migrations_completed,
+                "failed": self.migrations_failed,
+                "bytes": self.migration_bytes,
+                "recent": self.migrations[-8:],
+                "shed_events": self.shed_events[-8:],
+            },
             "pool_aggregate": agg,
             "burn_rate": round(self._burn_rate(), 4),
         }
@@ -1010,6 +1449,13 @@ class FleetRouter:
             "router": self.policy.stats(),
             "router_results": states,
             "scale_events": list(self.scale_events),
+            "migrations": {
+                "completed": self.migrations_completed,
+                "failed": self.migrations_failed,
+                "bytes": self.migration_bytes,
+                "migrated_rids": sorted(set(self.migrated_rids)),
+            },
+            "shed_events": list(self.shed_events),
             "autoscaler": self.autoscaler.snapshot()
             if self.autoscaler is not None else None,
         }

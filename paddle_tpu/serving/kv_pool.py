@@ -349,6 +349,25 @@ class PagePool:
                 rows[i] = self.SINK * ps + (t % ps)
         return rows
 
+    def token_rows(self, seq_id, start: int, stop: int) -> np.ndarray:
+        """Flattened page rows (into the ``[num_pages*page_size]`` view)
+        for token positions ``[start, stop)`` of a live sequence — the
+        gather/scatter index set live migration uses to lift a
+        sequence's K/V out of one pool and land it in another. Unlike
+        :meth:`chunk_rows` there is no bucket padding: every returned
+        row is a real token's slot, so ``len(rows)`` IS the payload
+        token count."""
+        self._require(seq_id)
+        start, stop = int(start), int(stop)
+        if not 0 <= start <= stop <= self._lens[seq_id]:
+            raise PagePoolError(
+                f"token range [{start}, {stop}) outside sequence "
+                f"{seq_id!r} length {self._lens[seq_id]}")
+        ps = self.page_size
+        pages = self._tables[seq_id]
+        return np.asarray([pages[t // ps] * ps + (t % ps)
+                           for t in range(start, stop)], dtype=np.int32)
+
     def bind(self, k_pages, v_pages):
         """Rebind the device arrays after a functional update (the jitted
         step returns the new pool contents)."""

@@ -414,6 +414,93 @@ def predicted_fleet_row(config: str = "345m", replicas: int = 2,
     }
 
 
+def predicted_migration_row(config: str = "345m", prompt_len: int = 1024,
+                            decoded: int = 32,
+                            cached_fraction: float = 0.5,
+                            prefill_chunk: int = 256,
+                            page_size: int = 64, chip: str = "v5e",
+                            dtype: str = "bfloat16") -> dict:
+    """``serving_fleet_migration_predicted``: the live-migration static
+    anchor — KV-page payload bytes over the interconnect roofline plus
+    resume cost, against the full-prompt replay a plain requeue pays.
+
+    Workload model: one request mid-decode (``prompt_len`` prompt +
+    ``decoded`` generated tokens of valid KV) moves replicas. The
+    destination's radix cache already holds a page-aligned
+    ``cached_fraction`` of the prompt, so only the uncached suffix
+    rows travel: gather from the source pool (HBM), stream over the
+    interconnect (ICI; a cross-host DCN figure rides along at the
+    documented ici_bw/8 assumption — ``chip_specs`` carries no DCN
+    number), scatter into the destination pool (HBM), one decode step
+    to resume. The baseline is SIGKILL-style failover with a COLD
+    destination cache: re-prefill the full sequence through the chunk
+    program. ``predicted_speedup`` is replay/migration — the factor
+    the robustness machinery is predicted to save per moved request."""
+    import jax.numpy as jnp
+    from ..observability.instrument import chip_specs
+
+    cfg = _gpt_config(config)
+    L, nh, d = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    ps = int(page_size)
+    chunk = max(int(prefill_chunk) // ps, 1) * ps
+    seq_len = int(prompt_len) + max(int(decoded), 1)
+    # destination reuse is page-granular (full pages only, capped so at
+    # least one KV row always transfers — PrefixCache.match caps at
+    # prompt_len - 1)
+    cached = int(min(max(cached_fraction, 0.0), 1.0) * prompt_len)
+    cached = min(cached, prompt_len - 1) // ps * ps
+    payload_tokens = seq_len - cached
+    spec = chip_specs(chip)
+    itemsize = jnp.zeros((), jnp.dtype(dtype)).dtype.itemsize
+    kv_bytes = 2 * L * payload_tokens * nh * d * itemsize
+    full_bytes = 2 * L * seq_len * nh * d * itemsize
+    gather_ms = 1e3 * kv_bytes / spec["hbm_bw"]     # source pool read
+    scatter_ms = 1e3 * kv_bytes / spec["hbm_bw"]    # dest pool write
+    transfer_ici_ms = 1e3 * kv_bytes / spec["ici_bw"]
+    dcn_bw = spec["ici_bw"] / 8.0
+    transfer_dcn_ms = 1e3 * kv_bytes / dcn_bw
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = 8 * pages_per_seq + 1
+    chunk_ms = _chunk_step_ms(cfg, dtype, None, chunk, pages_per_seq,
+                              num_pages, ps, spec)
+    decode = predicted_serving_row(config, 8, page_size, chip, dtype)
+    step_ms = decode["predicted_decode_step_ms"]
+    migrate_ms = gather_ms + transfer_ici_ms + scatter_ms + step_ms
+    migrate_dcn_ms = gather_ms + transfer_dcn_ms + scatter_ms + step_ms
+    # plain-requeue baseline: chunked prefill of the FULL sequence on a
+    # cold cache, then the same resume step
+    replay_ms = math.ceil(seq_len / chunk) * chunk_ms + step_ms
+    return {
+        "config": config,
+        "prompt_len": int(prompt_len),
+        "decoded": int(decoded),
+        "seq_len": seq_len,
+        "cached_fraction": round(cached_fraction, 4),
+        "cached_prefix_len": cached,
+        "payload_tokens": payload_tokens,
+        "page_size": ps,
+        "prefill_chunk": chunk,
+        "dtype": dtype,
+        "predicted_payload_mb": round(kv_bytes / 2 ** 20, 2),
+        "predicted_full_kv_mb": round(full_bytes / 2 ** 20, 2),
+        "predicted_gather_ms": round(gather_ms, 3),
+        "predicted_scatter_ms": round(scatter_ms, 3),
+        "predicted_transfer_ms_ici": round(transfer_ici_ms, 3),
+        "predicted_transfer_ms_dcn": round(transfer_dcn_ms, 3),
+        "dcn_bw_assumption": "ici_bw/8",
+        "predicted_migration_ms": round(migrate_ms, 3),
+        "predicted_migration_ms_dcn": round(migrate_dcn_ms, 3),
+        "predicted_replay_ms": round(replay_ms, 3),
+        "predicted_speedup": round(replay_ms / migrate_ms, 3)
+        if migrate_ms else 0.0,
+        "predicted_speedup_dcn": round(replay_ms / migrate_dcn_ms, 3)
+        if migrate_dcn_ms else 0.0,
+        "predicted_decode_step_ms": step_ms,
+        "predicted_chunk_ms": round(chunk_ms, 3),
+        "chip_assumed": spec.get("name"),
+    }
+
+
 def _moe_config(config: str):
     from ..models.ernie import ErnieMoeConfig, ernie_moe_tiny_config
     if config == "tiny":
@@ -623,7 +710,7 @@ def _main(argv=None):
                          "(serving engine quantize='int8')")
     ap.add_argument("--mode", default="decode",
                     choices=["decode", "shared_prefix", "disagg", "moe",
-                             "fused_dispatch", "fleet"],
+                             "fused_dispatch", "fleet", "migration"],
                     help="decode = classic serving_predicted row; "
                          "shared_prefix = prefix-cache goodput/TTFT "
                          "anchor; disagg = disaggregated prefill/"
@@ -633,7 +720,10 @@ def _main(argv=None):
                          "dispatch stage speedup anchor; fleet = "
                          "N-replica router anchor (per-replica "
                          "roofline x N minus router overhead, "
-                         "hit-rate-split TTFT)")
+                         "hit-rate-split TTFT); migration = live "
+                         "KV-page migration anchor (payload over the "
+                         "interconnect roofline + resume cost vs "
+                         "full-prompt replay)")
     ap.add_argument("--prompt-len", type=int, default=1024)
     ap.add_argument("--shared-fraction", type=float, default=0.75)
     ap.add_argument("--max-new", type=int, default=64)
@@ -670,6 +760,11 @@ def _main(argv=None):
                 args.concurrency, args.prompt_len, args.shared_fraction,
                 args.max_new, args.prefill_chunk, args.page_size,
                 args.chip)
+        elif args.mode == "migration":
+            row = predicted_migration_row(
+                args.config, args.prompt_len, args.max_new,
+                args.shared_fraction, args.prefill_chunk,
+                args.page_size, args.chip)
         elif args.mode == "shared_prefix":
             row = predicted_shared_prefix_row(
                 args.config, args.concurrency, args.prompt_len,

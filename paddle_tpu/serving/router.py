@@ -144,6 +144,23 @@ class PrefixAffinityRouter:
                 if not self._saturated(s, pages_needed)} or eligible
         return min(sorted(pool), key=lambda r: self._load(pool[r]))
 
+    def migration_target(self, snapshots: dict, exclude=(),
+                         pages_needed=None) -> int | None:
+        """Pick the replica to RECEIVE a live-migrated request: healthy,
+        non-draining, not in ``exclude`` (the source, at minimum),
+        preferring non-saturated replicas by least load. ``None`` when
+        no peer can take it — the caller leaves the request where it
+        is (or requeues it, if the source is being retired)."""
+        exclude = set(exclude)
+        pool = {r: s for r, s in snapshots.items()
+                if r not in exclude and s.get("healthy", True)
+                and not s.get("draining")}
+        if not pool:
+            return None
+        ok = {r: s for r, s in pool.items()
+              if not self._saturated(s, pages_needed)} or pool
+        return min(sorted(ok), key=lambda r: self._load(ok[r]))
+
     def stats(self) -> dict:
         return {"policy": self.policy, "block_tokens": self.block_tokens,
                 "routed": self.routed, "affinity_hits": self.affinity_hits,

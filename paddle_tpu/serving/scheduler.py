@@ -66,6 +66,9 @@ class Request:
     prefill_chunks: int = 0            # chunk program invocations
     router_wait_s: float = 0.0         # fleet: wait at the router before
     #                                    this replica saw the request
+    migrations: int = 0                # fleet: live-migration hops
+    migrate_s: float = 0.0             # fleet: transfer+restore walltime
+    migrate_bytes: int = 0             # fleet: K/V payload moved
     tokens: list = field(default_factory=list)   # generated ids
     state: str = "queued"              # queued|prefilling|running|
     #                                    finished|rejected
@@ -114,6 +117,10 @@ class Request:
                "decode_s": decode_s, "total_s": total_s,
                "decode_tokens_per_sec": tps,
                "slo_met": self.slo_met}
+        if self.migrations:
+            out["migrations"] = self.migrations
+            out["migrate_s"] = round(self.migrate_s, 6)
+            out["migrate_bytes"] = self.migrate_bytes
         if self.trace is not None and self.trace.token_samples:
             out["per_token_s"] = self.trace.per_token_stats()
         return out
@@ -131,6 +138,13 @@ class ContinuousBatchingScheduler:
         self._running: dict = {}          # rid -> Request, insertion order
         self._prefilling: dict = {}       # rid -> Request (chunked mode)
         self._begun: set = set()          # rids whose prefill has pages
+        # fleet live migration: requests checkpointed OUT of _running
+        # (source stays authoritative until the destination ACKs) and
+        # staged page reservations for requests migrating IN
+        self._migrating: dict = {}        # rid -> Request (outbound hold)
+        self._migrating_in: dict = {}     # rid -> {"need": pages reserved}
+        self.migrations_out = 0
+        self.migrations_in = 0
         # chunked engines interleave prefill with decode: each tick
         # spends at most this many prefill tokens (chunk-granular; the
         # default of one chunk is the tightest decode-stall bound)
@@ -287,6 +301,7 @@ class ContinuousBatchingScheduler:
         earlier in the queue has published its pages by then)."""
         from ..observability import instrument as obs
         while self._queue and (len(self._running) + len(self._prefilling)
+                               + len(self._migrating_in)
                                < self.max_concurrency):
             r = self._queue[0]
             need = self._completion_pages(r)
@@ -364,7 +379,9 @@ class ContinuousBatchingScheduler:
         if self.chunked:
             return self._admit_chunked()
         pool = self.engine.pool
-        while self._queue and len(self._running) < self.max_concurrency:
+        while self._queue and (len(self._running)
+                               + len(self._migrating_in)
+                               < self.max_concurrency):
             r = self._queue[0]
             need = self._completion_pages(r)
             if not self._page_room(need):
@@ -475,6 +492,223 @@ class ContinuousBatchingScheduler:
             self._evict_finished()
         return self.finished
 
+    # ------------------------------------------------------ live migration
+    # Fleet-level KV-page live migration (source and destination sides).
+    # Protocol invariants: a checkpointed request leaves _running but
+    # keeps its pages — the SOURCE stays authoritative until the
+    # destination ACKs (complete_migration frees + publishes the pages
+    # to the source's prefix cache; abort_migration puts the request
+    # back token-for-token). The destination reserves pages at prepare
+    # time, so a half-applied migration can always be discarded without
+    # leaking pool capacity.
+
+    def migratable_rids(self) -> list:
+        """Rids currently RUNNING (token-exact checkpointable): decode
+        state is fully described by (tokens, pool pages, last token).
+        Queued/prefilling requests are cheaper to withdraw + replay."""
+        with self._lock:
+            return [rid for rid, r in self._running.items() if not r.done]
+
+    def checkpoint_request(self, rid) -> dict | None:
+        """Source side: freeze one running request for migration — pull
+        it out of the decode set (pages stay put) and return the wire
+        metadata. ``elapsed_s`` carries the request's source-side age so
+        the destination can restart its clocks with ``total_s`` still
+        spanning the whole life; the K/V payload itself travels via
+        ``engine.export_kv``. Returns None when the rid is not running
+        (finished, queued, or unknown) — the caller falls back to
+        withdraw/requeue."""
+        with self._lock:
+            r = self._running.get(rid)
+            if r is None or r.done:
+                return None
+            del self._running[rid]
+            r.state = "migrating"
+            self._migrating[rid] = r
+            now = time.perf_counter()
+            return {
+                "rid": r.rid,
+                "prompt": [int(t) for t in r.prompt],
+                "tokens": [int(t) for t in r.tokens],
+                "max_new": r.max_new_tokens,
+                "eos_id": r.eos_id,
+                "elapsed_s": now - r.submit_time,
+                "queue_wait_s": (r.admit_time - r.submit_time)
+                if r.admit_time is not None else 0.0,
+                "ttft_s": (r.first_token_time - r.submit_time)
+                if r.first_token_time is not None else 0.0,
+                "prefill_s": r.prefill_s or 0.0,
+                "prefill_chunks": r.prefill_chunks,
+                "cached_prefix_len": r.cached_prefix_len,
+                "router_wait_s": r.router_wait_s,
+                "migrations": r.migrations + 1,
+                "migrate_s": r.migrate_s,
+                "migrate_bytes": r.migrate_bytes,
+            }
+
+    def abort_migration(self, rid) -> bool:
+        """Source side: restore a checkpointed request to the decode set
+        after a failed/refused transfer — nothing moved, so the request
+        resumes exactly where it paused."""
+        with self._lock:
+            r = self._migrating.pop(rid, None)
+            if r is None:
+                return False
+            r.state = "running"
+            self._running[rid] = r
+            return True
+
+    def complete_migration(self, rid):
+        """Source side, after the destination ACKed: release the pages
+        (publishing them to the source's prefix cache first, so the
+        prefix stays warm here for future same-prefix traffic) and drop
+        the request WITHOUT a terminal record — the destination now
+        owns its lifecycle and will report it."""
+        from ..observability import instrument as obs
+        with self._lock:
+            r = self._migrating.pop(rid)
+            held = len(self.engine.pool.table(rid))
+            self._reserved_pages -= self._completion_pages(r) - held
+            self.engine.release(rid, token_ids=np.concatenate(
+                [r.prompt, np.asarray(r.tokens[:-1], np.int32)]))
+            self.migrations_out += 1
+            obs.serving_requests_counter().inc(event="migrated_out")
+            return r
+
+    def withdraw(self, rid) -> bool:
+        """Drain accelerator: pull a not-yet-running request back out of
+        the scheduler (queued, or mid-prefill — its pages are released)
+        so the router can re-dispatch it elsewhere. Running requests
+        migrate instead; returns False for them."""
+        with self._lock:
+            for i, r in enumerate(self._queue):
+                if r.rid == rid:
+                    del self._queue[i]
+                    return True
+            r = self._prefilling.pop(rid, None)
+            if r is None:
+                return False
+            if rid in self._begun:
+                self._begun.discard(rid)
+                held = len(self.engine.pool.table(rid))
+                self._reserved_pages -= self._completion_pages(r) - held
+                self.engine.release(rid)
+            else:
+                self._reserved_pages -= self._completion_pages(r)
+            return True
+
+    def prepare_migration_in(self, rid, token_ids, prompt_len: int,
+                             max_new: int):
+        """Destination side, step 1: admission-check an inbound
+        migration and pin any cached prefix. Returns ``(True,
+        cached_len)`` — the source then ships only ``[cached_len, n)``
+        — or ``(False, reason)``. Pages for the FULL completion (minus
+        the cached prefix) are reserved here, so the commit can never
+        OOM a pool that said yes."""
+        eng = self.engine
+        if not hasattr(eng, "begin_kv_import"):
+            return False, "engine_unsupported"
+        with self._lock:
+            if self.draining:
+                return False, "draining"
+            if rid in self._running or rid in self._prefilling \
+                    or rid in self._migrating or rid in self._migrating_in:
+                return False, "duplicate_rid"
+            if (len(self._running) + len(self._prefilling)
+                    + len(self._migrating_in)) >= self.max_concurrency:
+                return False, "no_slot"
+            pool = eng.pool
+            total = int(prompt_len) + int(max_new)
+            if total > pool.max_seq_len:
+                return False, "too_long"
+            cached_len = eng.begin_kv_import(rid, token_ids)
+            need = pool.pages_needed(total) - cached_len // pool.page_size
+            if not self._page_room(need):
+                eng.abort_kv_import(rid)
+                return False, "no_pages"
+            self._reserved_pages += need
+            self._migrating_in[rid] = {"need": need}
+            return True, cached_len
+
+    def adopt_migrated(self, meta: dict, k, v):
+        """Destination side, step 2: scatter the transferred K/V into
+        the pool (``engine.commit_kv_import``), rebuild the request
+        from the wire metadata, and enter it into the decode set —
+        the next decode step resumes token-exact. Returns ``(True,
+        cached_len)`` or ``(False, reason)`` (on failure the staged
+        reservation and cache pins are dropped; the source aborts and
+        stays authoritative)."""
+        from ..observability import instrument as obs
+        from ..observability.reqtrace import RequestTrace
+        eng = self.engine
+        rid = int(meta["rid"])
+        with self._lock:
+            st = self._migrating_in.pop(rid, None)
+            if st is None:
+                return False, "no_staged_migration"
+            self._reserved_pages -= st["need"]
+            if len(self._running) + len(self._prefilling) \
+                    >= self.max_concurrency:
+                eng.abort_kv_import(rid)
+                return False, "no_slot"
+            prompt = np.asarray(meta["prompt"], np.int32)
+            tokens = [int(t) for t in meta["tokens"]]
+            # K/V exists for prompt + tokens[:-1]; the final sampled
+            # token rides as _last_token and decodes next
+            total_len = int(prompt.shape[0]) + len(tokens) - 1
+            try:
+                cached_len = eng.commit_kv_import(
+                    rid, total_len, k, v, last_token=tokens[-1])
+            except Exception as e:
+                eng.abort_kv_import(rid)
+                return False, repr(e)[:200]
+            now = time.perf_counter()
+            r = Request(rid, prompt, int(meta["max_new"]),
+                        eos_id=meta.get("eos_id"))
+            # restart the walltime clocks shifted by the source-side
+            # age, so total_s still spans the request's WHOLE life; the
+            # migration window itself is carried in migrate_s (the
+            # doctor's migration bucket divides it out of the residual)
+            r.submit_time = now - float(meta.get("elapsed_s") or 0.0)
+            r.admit_time = r.submit_time \
+                + float(meta.get("queue_wait_s") or 0.0)
+            r.first_token_time = r.submit_time \
+                + float(meta.get("ttft_s") or 0.0)
+            r.prefill_s = float(meta.get("prefill_s") or 0.0)
+            r.prefill_chunks = int(meta.get("prefill_chunks") or 0)
+            r.cached_prefix_len = int(meta.get("cached_prefix_len") or 0)
+            r.router_wait_s = float(meta.get("router_wait_s") or 0.0)
+            r.migrations = int(meta.get("migrations") or 1)
+            r.migrate_s = float(meta.get("migrate_s") or 0.0)
+            r.migrate_bytes = int(meta.get("migrate_bytes") or 0)
+            r.tokens = tokens
+            r.state = "running"
+            r.trace = RequestTrace(rid, r.submit_time)
+            window = float(meta.get("migrate_window_s") or 0.0)
+            if window > 0:
+                r.trace.span("migrate_in", now - window, now,
+                             bytes=r.migrate_bytes,
+                             cached_prefix_rows=cached_len,
+                             hop=r.migrations)
+            held = len(eng.pool.table(rid))
+            self._reserved_pages += self._completion_pages(r) - held
+            self._running[rid] = r
+            self.migrations_in += 1
+            obs.serving_requests_counter().inc(event="migrated_in")
+            return True, cached_len
+
+    def abort_migration_in(self, rid) -> bool:
+        """Destination side, bail-out: drop a staged inbound migration
+        (reservation + cache pins) — idempotent by rid, so a retried
+        ``migrate_begin`` after a half-applied attempt starts clean."""
+        with self._lock:
+            st = self._migrating_in.pop(rid, None)
+            if st is None:
+                return False
+            self._reserved_pages -= st["need"]
+            self.engine.abort_kv_import(rid)
+            return True
+
     # ------------------------------------------------------- observability
     def request_records(self) -> list:
         """Terminal per-request summaries (finished + rejected) — the
@@ -496,6 +730,10 @@ class ContinuousBatchingScheduler:
                 "queue_depth": len(self._queue),
                 "prefilling": len(self._prefilling),
                 "running": len(self._running),
+                "migrating_out": len(self._migrating),
+                "migrating_in": len(self._migrating_in),
+                "migrations_out": self.migrations_out,
+                "migrations_in": self.migrations_in,
                 "finished": len(self.finished),
                 "rejected": len(self.rejected),
                 "steps": self.steps,

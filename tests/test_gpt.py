@@ -166,7 +166,9 @@ def test_chunked_vocab_ce_matches_full():
     from paddle_tpu.models.gpt import vocab_parallel_cross_entropy
 
     rng = np.random.default_rng(0)
-    B, S, H, V = 2, 2048, 32, 32768  # N=4096, V>=16384 -> chunked
+    # N=2304 > _CE_CHUNK and V >= 16384 -> chunked (2 chunks), with a
+    # non-zero pad tail (2304 % 2048) so the mask-0 padding path is covered
+    B, S, H, V = 2, 1152, 32, 16384
     h = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((V, H)) * 0.02, jnp.float32)
     lab = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)

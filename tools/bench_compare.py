@@ -94,6 +94,10 @@ _ANCHOR_MAP = {
     # (per-replica roofline x N minus router overhead)
     "serving_fleet_tokens_per_sec": "serving_fleet_predicted",
     "serving_fleet": "serving_fleet_predicted",
+    # a future measured live-migration row (ms per moved request /
+    # resume speedup) anchors on the payload-over-interconnect model
+    "serving_fleet_migration": "serving_fleet_migration_predicted",
+    "serving_fleet_migration_ms": "serving_fleet_migration_predicted",
     "collective_compression": "collective_compression_predicted",
     # a measured planner-config 13B run (TPU rounds) anchors on the
     # planner's own predicted row, not the hand-written config's
