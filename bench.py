@@ -86,6 +86,22 @@ def _load_prev(repo_dir=os.path.dirname(os.path.abspath(__file__))):
 
 _PREV = _load_prev()
 _CPU_SMOKE = False  # set when the sweep fell back to the CPU backend
+_CAL_ID = None
+
+
+def _calibration_id() -> str:
+    """Active cost-model calibration id ("default" when none) — stamped
+    on every row so bench_compare can refuse to anchor a measured row
+    against a predicted row priced under different constants."""
+    global _CAL_ID
+    if _CAL_ID is None:
+        try:
+            from paddle_tpu.observability.calibration import \
+                active_calibration_id
+            _CAL_ID = active_calibration_id()
+        except Exception:
+            _CAL_ID = "default"
+    return _CAL_ID
 
 
 def emit(metric, value, unit, extras):
@@ -93,6 +109,8 @@ def emit(metric, value, unit, extras):
         metric += "_cpu_smoke"  # never comparable to (or adopted as) TPU
     prev = _PREV.get(metric)
     vs = round(value / prev, 4) if prev else 1.0
+    extras = dict(extras or {})
+    extras.setdefault("calibration_id", _calibration_id())
     print(json.dumps({"metric": metric, "value": round(value, 1),
                       "unit": unit, "vs_baseline": vs, "extras": extras}),
           flush=True)

@@ -63,6 +63,11 @@ PTCS004 fusion opportunity: an unfused gate→dispatch chain (top-k
         dispatch shape) streams >2× the HBM a fused dispatch kernel
         would; ``kernels.moe_dispatch`` /
         ``MoELayer(fused_dispatch=True)`` is the fused path (info)
+PTCM001 cost-model drift: an op family's measured/predicted time
+        ratio (from an op-attribution run —
+        ``observability.opprof``) left the [0.5, 2.0] band; refit
+        with ``observability.calibration.fit_calibration`` and point
+        ``PADDLE_COST_CALIBRATION`` at the saved file (warning)
 PTMM001 predicted peak HBM exceeds the budget — OOM before compile
         (error)
 PTBD001 use-after-donate: donated input read after the jitted call

@@ -55,12 +55,14 @@ Diagnostics:
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
 import jax
 
 from ..core import Diagnostic, register_pass
+from ..tracing import eqn_site
 
 # interchange-format / view ops: zero FLOPs, zero bytes (XLA folds them
 # into layouts or fuses them away entirely)
@@ -153,8 +155,58 @@ def _floating_dtype(dtype) -> bool:
 # sustained-MXU efficiency knob: a raw peak-FLOPs roofline predicts 100%
 # MFU, which no real schedule reaches; 0.55 is calibrated against the
 # measured 345M/1.3B rows in BENCH_r0x (50-57% MFU) so predicted and
-# measured step times land in the same regime
+# measured step times land in the same regime. A chip dict carrying its
+# own ``mxu_efficiency`` (a fitted ``observability.calibration`` file
+# behind PADDLE_COST_CALIBRATION) overrides this default in
+# :meth:`CostSummary.finalize`.
 MXU_EFFICIENCY = 0.55
+
+
+# ---------------------------------------------------------------------------
+# site keys + op families (the attribution join keys opprof uses)
+# ---------------------------------------------------------------------------
+
+# op families the calibration fits per-family correction factors over;
+# the scatter_gather set deliberately matches the PTCS004 glue ops plus
+# the routing/index prims feeding them, so a family-level drift verdict
+# speaks to the same ops the fusion diagnostic ranks
+_FAMILY_DOT = {"dot_general", "conv_general_dilated"}
+_FAMILY_SCATTER = {"cumsum", "gather", "scatter", "scatter-add",
+                   "scatter_add", "sort", "concatenate",
+                   "dynamic_update_slice", "top_k", "argsort"}
+
+
+def op_family(name: str) -> str:
+    """Coarse family of one primitive: ``dot`` | ``scatter_gather`` |
+    ``collective`` | ``pallas`` | ``elementwise`` | ``other`` — the
+    granularity the cost-model calibration fits correction factors at
+    (finer would overfit a single trace, coarser can't name what's
+    mispriced)."""
+    if name in _FAMILY_DOT:
+        return "dot"
+    if name == "pallas_call":
+        return "pallas"
+    if name in _COLLECTIVES or name in _EAGER_COLLECTIVES:
+        return "collective"
+    if name in _FAMILY_SCATTER:
+        return "scatter_gather"
+    if name in _FUSABLE:
+        return "elementwise"
+    return "other"
+
+
+def eqn_site_id(eqn) -> str:
+    """Stable per-call-site key for one eqn: ``file.py:L123:prim`` from
+    the user-frame source info (:func:`..tracing.eqn_site`), or
+    ``<trace>:prim`` when no user frame survives. This string is the
+    join key between the cost walk's predicted rows, the replay
+    harness's measured rows, and (sanitized) the ``jax.named_scope``
+    ids a real-chip profiler trace carries."""
+    fname, line = eqn_site(eqn)
+    prim = eqn.primitive.name
+    if fname:
+        return f"{os.path.basename(str(fname))}:L{line}:{prim}"
+    return f"<trace>:{prim}"
 
 
 def _nbytes(aval) -> int:
@@ -193,6 +245,9 @@ class CostSummary:
     comm_bytes_int8: float = 0.0  # what-if: same schedule, int8 wire
     wire_dtype: str | None = None  # forced wire dtype, if any
     by_prim: dict = field(default_factory=dict)  # name -> [flops, bytes, n]
+    # site -> [flops, hbm_bytes, comm_bytes, count, family] — the per-eqn
+    # export the op-attribution layer joins measured traces against
+    by_site: dict = field(default_factory=dict)
     chip: dict = field(default_factory=dict)
     compute_ms: float = 0.0
     hbm_ms: float = 0.0
@@ -207,7 +262,8 @@ class CostSummary:
 
     def finalize(self, chip: dict):
         self.chip = dict(chip)
-        eff_peak = chip["peak_flops"] * MXU_EFFICIENCY
+        eff_peak = chip["peak_flops"] * chip.get("mxu_efficiency",
+                                                 MXU_EFFICIENCY)
         self.compute_ms = 1e3 * self.flops / eff_peak
         self.hbm_ms = 1e3 * self.hbm_bytes / chip["hbm_bw"]
         self.comm_ms = 1e3 * self.comm_bytes / chip["ici_bw"]
@@ -338,7 +394,8 @@ class _JaxprCoster:
         view/convert of a narrower stored buffer."""
         return self._storage.get(id(v), _nbytes(v.aval))
 
-    def charge(self, name, flops, nbytes, comm=0.0, comm_int8=None):
+    def charge(self, name, flops, nbytes, comm=0.0, comm_int8=None,
+               eqn=None):
         self.s.flops += flops
         self.s.hbm_bytes += nbytes
         self.s.comm_bytes += comm
@@ -347,6 +404,13 @@ class _JaxprCoster:
         rec[0] += flops
         rec[1] += nbytes
         rec[2] += 1
+        if eqn is not None:
+            site = self.s.by_site.setdefault(
+                eqn_site_id(eqn), [0.0, 0.0, 0.0, 0, op_family(name)])
+            site[0] += flops
+            site[1] += nbytes
+            site[2] += comm
+            site[3] += 1
 
     # ------------------------------------------------------------------
     def walk(self, jaxpr, in_divs, mult=1.0):
@@ -422,6 +486,15 @@ class _JaxprCoster:
                         acc[0] += rec[0]
                         acc[1] += rec[1]
                         acc[2] += rec[2]
+                    # only the winning branch's sites merge — the rows
+                    # must add up to the charged totals, not both arms
+                    for k, rec in best.by_site.items():
+                        acc = self.s.by_site.setdefault(
+                            k, [0.0, 0.0, 0.0, 0, rec[4]])
+                        acc[0] += rec[0]
+                        acc[1] += rec[1]
+                        acc[2] += rec[2]
+                        acc[3] += rec[3]
                 continue
             if name == "shard_map":
                 body = eqn.params["jaxpr"]
@@ -458,7 +531,8 @@ class _JaxprCoster:
                     if isinstance(d, int):
                         steps *= max(d, 1)
                 self.charge(name, mult * probe.flops * steps / d_out,
-                            mult * self._anchor_bytes(eqn) / d_out)
+                            mult * self._anchor_bytes(eqn) / d_out,
+                            eqn=eqn)
                 continue
 
             if name in _COLLECTIVES:
@@ -496,7 +570,7 @@ class _JaxprCoster:
                                   if hasattr(v.aval, "shape")))
                 self.charge(name, mult * flops / d_out, 0.0,
                             comm=mult * wire / d_out,
-                            comm_int8=mult * wire_i8 / d_out)
+                            comm_int8=mult * wire_i8 / d_out, eqn=eqn)
                 continue
 
             if name in _FREE:
@@ -507,7 +581,8 @@ class _JaxprCoster:
                 # single-row write into a pool/cache is row-sized work)
                 self.charge(name,
                             mult * _nelems(eqn.invars[1].aval) / d_out,
-                            mult * self._anchor_bytes(eqn) / d_out)
+                            mult * self._anchor_bytes(eqn) / d_out,
+                            eqn=eqn)
                 continue
             if name == "dot_general":
                 flops = _dot_general_flops(eqn)
@@ -530,7 +605,8 @@ class _JaxprCoster:
                     continue
                 flops = _default_flops(eqn)
                 nbytes = self._anchor_bytes(eqn)
-            self.charge(name, mult * flops / d_out, mult * nbytes / d_out)
+            self.charge(name, mult * flops / d_out, mult * nbytes / d_out,
+                        eqn=eqn)
 
     def _anchor_bytes(self, eqn):
         """HBM traffic of an op that materializes: stream inputs (at
@@ -562,6 +638,33 @@ def estimate_jaxpr_cost(closed_jaxpr, in_divisors=None, axis_sizes=None,
     divs += [1] * (len(jaxpr.invars) - len(divs))
     _JaxprCoster(s, axis_sizes or {}, wire_dtype).walk(jaxpr, divs)
     return s.finalize(chip or chip_specs())
+
+
+def site_rows(summary: CostSummary) -> list[dict]:
+    """Per-site predicted roofline rows from a finalized cost walk: each
+    call site priced by its OWN roofline (max of its compute/HBM/comm
+    time on the summary's chip) with the dominating bound named. These
+    are the prediction half of the op-attribution join
+    (:mod:`paddle_tpu.observability.opprof`); per-site times do NOT sum
+    to ``step_ms`` — the step roofline takes the max over totals, the
+    rows answer *where* each resource's time goes."""
+    chip = summary.chip or {}
+    eff_peak = (float(chip.get("peak_flops") or 1.0)
+                * float(chip.get("mxu_efficiency", MXU_EFFICIENCY)))
+    hbm_bw = float(chip.get("hbm_bw") or 1.0)
+    ici_bw = float(chip.get("ici_bw") or 1.0)
+    rows = []
+    for sid, (fl, hb, cm, n, fam) in sorted(summary.by_site.items()):
+        compute_ms = 1e3 * fl / eff_peak
+        hbm_ms = 1e3 * hb / hbm_bw
+        comm_ms = 1e3 * cm / ici_bw
+        ms = max(compute_ms, hbm_ms, comm_ms)
+        bound = {compute_ms: "compute", hbm_ms: "memory",
+                 comm_ms: "comm"}[ms]
+        rows.append({"site": sid, "family": fam, "count": int(n),
+                     "flops": fl, "hbm_bytes": hb, "comm_bytes": cm,
+                     "predicted_ms": ms, "bound": bound})
+    return rows
 
 
 def spec_divisor(spec, mesh_shape: dict) -> int:
@@ -621,7 +724,10 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
     written once — approximated by the chain's largest materialized
     output plus its largest input). Recurses into sub-jaxprs EXCEPT
     ``pallas_call`` bodies — a Pallas kernel is already the fused form.
-    Returns ``[{glue_bytes, fused_bytes, n_ops, ratio}, ...]``."""
+    Returns ``[{glue_bytes, fused_bytes, n_ops, ratio, sites}, ...]``
+    where ``sites`` are the glue eqns' :func:`eqn_site_id` keys — the
+    join handles an op-attribution trace uses to attach MEASURED glue
+    cost to each candidate (the ranked input auto-fusion needs)."""
     found = [] if _found is None else _found
 
     tainted = set()
@@ -629,6 +735,7 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
     big_out = 0.0
     big_in = 0.0
     n_ops = 0
+    sites = []
     saw_topk = False
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
@@ -647,6 +754,9 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
                 tainted.add(id(v))
             if name in _PTCS004_GLUE:
                 n_ops += 1
+                sid = eqn_site_id(eqn)
+                if sid not in sites:
+                    sites.append(sid)
                 in_b = max([_nbytes(v.aval) for v in ins] or [0])
                 out_b = max([_nbytes(v.aval) for v in eqn.outvars]
                             or [0])
@@ -663,7 +773,7 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
                 and glue_bytes > _PTCS004_RATIO * fused:
             found.append({"glue_bytes": glue_bytes,
                           "fused_bytes": fused, "n_ops": n_ops,
-                          "ratio": glue_bytes / fused})
+                          "ratio": glue_bytes / fused, "sites": sites})
     return found
 
 

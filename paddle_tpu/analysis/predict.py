@@ -54,6 +54,9 @@ def predicted_row(step, batch, seq, chip="v5e", flops_per_token=None):
         "predicted_peak_hbm_mb": round(mem.peak_bytes / 2 ** 20, 1),
         "predicted_bound": cost.bound,
         "chip_assumed": cost.chip.get("name"),
+        # which fitted constants priced this row — bench_compare refuses
+        # to anchor measured rows against a different calibration
+        "calibration_id": cost.chip.get("calibration_id", "default"),
         "batch": batch, "seq": seq, "n_devices": n_dev,
         "comm_mb_per_chip": round(cost.comm_bytes / 2 ** 20, 2),
     }

@@ -1,0 +1,272 @@
+"""Self-calibrating cost-model constants, fitted from measured traces.
+
+The static cost model prices every program with hand-picked constants:
+``MXU_EFFICIENCY = 0.55`` (analysis/passes/cost.py) and the
+``chip_specs()`` peak table (instrument.py). EQuARX's lesson is that
+such constants are only trustworthy when *fit to the hardware*: this
+module closes the loop by fitting them from op-attribution rows
+(:mod:`.opprof` — per-site measured vs predicted ms) and/or whole-step
+(measured, roofline-components) pairs, persisting the result as
+``calibration.json``, and feeding it back into ``chip_specs()`` /
+``estimate_jaxpr_cost()`` behind the ``PADDLE_COST_CALIBRATION`` env
+var (path to the file; unset = the hand constants, id ``"default"``).
+
+What gets fitted:
+
+- ``mxu_efficiency`` — achieved fraction of peak FLOP/s on
+  compute-bound work (replaces the 0.55 default for this chip)
+- ``hbm_bw_fraction`` — achieved fraction of the spec-sheet HBM
+  bandwidth on memory-bound work (scales ``chip["hbm_bw"]``)
+- ``family_correction`` — multiplicative per-op-family factors
+  (dot / elementwise / scatter_gather / collective / pallas / other)
+  applied to per-site predictions by the attribution join and watched
+  by the PTCM001 drift diagnostic
+
+The fit is **robust and monotone**: candidate constants are derived
+from per-row implied values (medians, totals) and the identity is
+always a candidate, so the argmin over mean ``|rel_err|`` on the fit
+set can never be WORSE than the uncalibrated model on that set —
+asserted in tier-1 (tests/test_opprof.py).
+
+Every calibration carries a ``calibration_id`` (sha256 of its canonical
+JSON, 12 hex chars). Bench rows stamp the active id so
+``tools/bench_compare.py`` can refuse to compare a measured row against
+a predicted anchor produced under a different calibration — anchors
+stay noise-free.
+
+Pure python + stdlib: no jax import, so the doctor and the compare
+tooling can consume calibrations anywhere the files can be copied.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+ENV_VAR = "PADDLE_COST_CALIBRATION"
+DEFAULT_ID = "default"
+
+# a family's fitted correction is clamped into this band — a trace
+# pathological enough to imply more than 10x either way is telling us
+# the model is structurally wrong (file a PTCM001, don't bake it in)
+_CORRECTION_CLAMP = (0.1, 10.0)
+_EFFICIENCY_CLAMP = (0.02, 1.0)
+_BW_FRACTION_CLAMP = (0.02, 1.5)
+
+# the families fit_calibration knows; imported by opprof for grouping
+FAMILIES = ("dot", "elementwise", "scatter_gather", "collective",
+            "pallas", "other")
+
+
+def calibration_id(cal: dict) -> str:
+    """Content hash of a calibration (its own ``calibration_id`` field
+    excluded so the id is stable under re-stamping)."""
+    doc = {k: v for k, v in (cal or {}).items() if k != "calibration_id"}
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _median(vals):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    return vals[mid] if len(vals) % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def _clamp(v, lo_hi):
+    lo, hi = lo_hi
+    return min(max(float(v), lo), hi)
+
+
+def _mean_abs_rel_err(pairs):
+    """pairs: iterable of (measured, predicted); rel err against the
+    MEASURED value (the ground truth a prediction is judged by)."""
+    errs = [abs(p - m) / m for m, p in pairs if m > 0]
+    return sum(errs) / len(errs) if errs else 0.0
+
+
+def _fit_family_corrections(rows) -> tuple[dict, dict]:
+    """Per-family multiplicative corrections from attribution rows
+    (dicts with ``family``, ``measured_ms``, ``predicted_ms``).
+    Candidate-argmin per family with the identity always in the pool,
+    so each family's post-fit mean |rel_err| <= pre-fit on these rows."""
+    by_fam: dict[str, list] = {}
+    for r in rows or ():
+        fam = r.get("family")
+        m = float(r.get("measured_ms") or 0.0)
+        p = float(r.get("predicted_ms") or 0.0)
+        if fam and fam != "unattributed" and m > 0 and p > 0:
+            by_fam.setdefault(fam, []).append((m, p))
+    corrections, errs = {}, {}
+    for fam, pairs in by_fam.items():
+        ratios = [m / p for m, p in pairs]
+        cands = {1.0, _median(ratios),
+                 sum(m for m, _ in pairs) / sum(p for _, p in pairs)}
+        best = min(
+            ((_mean_abs_rel_err((m, p * c) for m, p in pairs), c)
+             for c in cands if c),
+            key=lambda t: t[0])
+        c = _clamp(best[1], _CORRECTION_CLAMP)
+        if c != 1.0:
+            corrections[fam] = round(c, 4)
+        errs[fam] = {
+            "pre": round(_mean_abs_rel_err(pairs), 4),
+            "post": round(best[0], 4), "rows": len(pairs),
+        }
+    return corrections, errs
+
+
+def _predict_step(pair, eff, bw_frac, base_eff) -> float:
+    """Re-price one step's roofline under candidate constants. The
+    pair's ``compute_ms`` was computed at ``base_eff``; comm is priced
+    by the ICI model, which the calibration does not touch."""
+    c = float(pair.get("compute_ms") or 0.0) * base_eff / eff
+    h = float(pair.get("hbm_ms") or 0.0) / bw_frac
+    w = float(pair.get("comm_ms") or 0.0)
+    return max(c, h, w, 1e-9)
+
+
+def fit_calibration(rows=None, step_pairs=None, chip="cpu",
+                    base_efficiency=None) -> dict:
+    """Fit a calibration from measured evidence.
+
+    ``rows``: op-attribution rows (per-site ``family`` /
+    ``measured_ms`` / ``predicted_ms``) → ``family_correction``.
+    ``step_pairs``: whole-step records ``{measured_ms, compute_ms,
+    hbm_ms, comm_ms}`` (a :class:`..analysis.passes.cost.CostSummary`'s
+    components next to a measured wall time) → ``mxu_efficiency`` +
+    ``hbm_bw_fraction`` by candidate-argmin of mean |rel_err| of the
+    re-priced roofline step, identity included (post <= pre on the fit
+    set, guaranteed). Either input may be omitted."""
+    if base_efficiency is None:
+        from ..analysis.passes.cost import MXU_EFFICIENCY
+        base_efficiency = MXU_EFFICIENCY
+    chip_name = chip.get("name") if isinstance(chip, dict) else str(chip)
+
+    corrections, fam_errs = _fit_family_corrections(rows)
+
+    eff, bw_frac = base_efficiency, 1.0
+    step_fit = None
+    pairs = [p for p in (step_pairs or ())
+             if float(p.get("measured_ms") or 0.0) > 0]
+    if pairs:
+        eff_cands, bw_cands = {base_efficiency}, {1.0}
+        for p in pairs:
+            m = float(p["measured_ms"])
+            c = float(p.get("compute_ms") or 0.0)
+            h = float(p.get("hbm_ms") or 0.0)
+            if c > 0:  # efficiency that would make compute time == m
+                eff_cands.add(_clamp(base_efficiency * c / m,
+                                     _EFFICIENCY_CLAMP))
+            if h > 0:  # bw fraction that would make hbm time == m
+                bw_cands.add(_clamp(h / m, _BW_FRACTION_CLAMP))
+        med_e = _median([e for e in eff_cands if e != base_efficiency])
+        med_b = _median([b for b in bw_cands if b != 1.0])
+        if med_e:
+            eff_cands.add(med_e)
+        if med_b:
+            bw_cands.add(med_b)
+        pre = _mean_abs_rel_err(
+            (p["measured_ms"],
+             _predict_step(p, base_efficiency, 1.0, base_efficiency))
+            for p in pairs)
+        best = min(
+            ((_mean_abs_rel_err(
+                (p["measured_ms"], _predict_step(p, e, b, base_efficiency))
+                for p in pairs), e, b)
+             for e in eff_cands for b in bw_cands),
+            key=lambda t: t[0])
+        post, eff, bw_frac = best
+        step_fit = {"pre": round(pre, 4), "post": round(post, 4),
+                    "steps": len(pairs)}
+
+    cal = {
+        "chip": chip_name,
+        "mxu_efficiency": round(float(eff), 4),
+        "hbm_bw_fraction": round(float(bw_frac), 4),
+        "family_correction": corrections,
+        "fit": {"families": fam_errs, "step": step_fit,
+                "base_efficiency": base_efficiency},
+    }
+    cal["calibration_id"] = calibration_id(cal)
+    return cal
+
+
+# ---------------------------------------------------------------------------
+# persistence + the PADDLE_COST_CALIBRATION consumption path
+# ---------------------------------------------------------------------------
+
+def save_calibration(cal: dict, path: str) -> str:
+    cal = dict(cal)
+    cal["calibration_id"] = calibration_id(cal)
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(cal, f, indent=1, sort_keys=True)
+    return path
+
+
+def load_calibration(path: str) -> dict | None:
+    """The calibration dict at ``path`` (id re-stamped from content so a
+    hand-edited file can't keep a stale id), or None when unreadable."""
+    try:
+        with open(path) as f:
+            cal = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cal, dict):
+        return None
+    cal["calibration_id"] = calibration_id(cal)
+    return cal
+
+
+# (path, mtime) -> cal; tests rewrite the env file, so mtime is part of
+# the key rather than trusting a pure path cache
+_active_cache: dict = {}
+
+
+def active_calibration() -> dict | None:
+    """The calibration behind ``PADDLE_COST_CALIBRATION``, or None."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return None
+    key = (path, mtime)
+    if key not in _active_cache:
+        _active_cache.clear()
+        _active_cache[key] = load_calibration(path)
+    return _active_cache[key]
+
+
+def active_calibration_id() -> str:
+    """Id of the active calibration (``"default"`` when none) — the
+    stamp every bench row carries so compare tooling can refuse
+    cross-calibration anchor comparisons."""
+    cal = active_calibration()
+    return cal.get("calibration_id", DEFAULT_ID) if cal else DEFAULT_ID
+
+
+def apply_to_chip(spec: dict, cal: dict | None) -> dict:
+    """Merge a calibration into a ``chip_specs()`` row: fitted
+    ``mxu_efficiency`` rides along for ``CostSummary.finalize``, the
+    HBM bandwidth scales by the achieved fraction, and the row is
+    stamped with the calibration id. A calibration fitted for a
+    DIFFERENT chip is ignored — constants measured on one part must
+    never silently price another."""
+    if not cal or not isinstance(spec, dict):
+        return spec
+    cal_chip = cal.get("chip")
+    if cal_chip and spec.get("name") and cal_chip != spec["name"]:
+        return spec
+    out = dict(spec)
+    if isinstance(cal.get("mxu_efficiency"), (int, float)):
+        out["mxu_efficiency"] = float(cal["mxu_efficiency"])
+    if isinstance(cal.get("hbm_bw_fraction"), (int, float)):
+        out["hbm_bw"] = float(spec["hbm_bw"]) * float(cal["hbm_bw_fraction"])
+    out["calibration_id"] = cal.get("calibration_id", calibration_id(cal))
+    return out

@@ -125,6 +125,30 @@ def load_predicted(source) -> dict | None:
                            _PREDICTED_BASENAMES)
 
 
+_ATTRIBUTION_BASENAMES = ("attribution.json",)
+
+
+def _normalize_attribution(row) -> dict | None:
+    """An op-attribution doc (:mod:`.opprof` output): recognized by its
+    schema stamp or by the row/total pair."""
+    if not isinstance(row, dict):
+        return None
+    if row.get("schema") == "op_attribution":
+        return row
+    if "rows" in row and "measured_total_ms" in row:
+        return row
+    return None
+
+
+def load_attribution(source) -> dict | None:
+    """An op-attribution table from: a dict, an ``OpAttribution``, a
+    JSON file, or a run dir containing ``attribution.json``."""
+    if hasattr(source, "as_dict"):
+        source = source.as_dict()
+    return _load_first_row(source, _normalize_attribution,
+                           _ATTRIBUTION_BASENAMES)
+
+
 def _normalize_serving_predicted(row) -> dict | None:
     """A ``serving_predicted`` row (``paddle_tpu.serving.predict``
     output, bare or wrapped in a bench-artifact line)."""
@@ -406,7 +430,8 @@ _SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
 
 def collect_findings(summary: dict, attribution: dict | None = None,
                      flight_dumps=(),
-                     serving_attribution: dict | None = None) -> list[dict]:
+                     serving_attribution: dict | None = None,
+                     op_attribution: dict | None = None) -> list[dict]:
     """Ranked ``{severity, kind, detail}`` findings from the summary."""
     out = []
 
@@ -474,6 +499,33 @@ def collect_findings(summary: dict, attribution: dict | None = None,
             f"roofline says this config is {attribution['predicted_bound']}"
             f"-bound on {attribution['chip']}")
 
+    # ---------------------------------------------------- op attribution
+    if op_attribution:
+        # opprof's module top is stdlib-only, so the doctor stays
+        # device-free; publish=False keeps this a pure-JSON path
+        from . import opprof
+        attr_obj = opprof.OpAttribution.from_dict(op_attribution)
+        row_sum, total = attr_obj.sum_check()
+        tol = max(1e-6, 1e-9 * abs(total))
+        if abs(row_sum - total) > tol:
+            add("warn", "attribution_sum_mismatch",
+                f"op-attribution rows sum to {row_sum:.6f}ms but the "
+                f"measured step total is {total:.6f}ms — the table "
+                f"violates the sum contract (regenerate it; the residual "
+                f"belongs in the 'unattributed' row)")
+        for f in opprof.drift_findings(op_attribution, publish=False):
+            add("warn", "cost_model_drift", f"{f['code']}: {f['message']}")
+        glued = [c for c in attr_obj.fusion_candidates
+                 if c.get("measured_glue_ms") is not None]
+        if glued:
+            top_c = glued[0]
+            add("info", "fusion_glue_measured",
+                f"PTCS004 fusion candidate glue cost measured: "
+                f"{top_c.get('measured_glue_ms')}ms across "
+                f"{len(top_c.get('sites') or ())} glue site(s), "
+                f"{float(top_c.get('glue_bytes') or 0) / 2 ** 20:.1f} MiB "
+                f"streamed — ranked input for auto-fusion")
+
     # ----------------------------------------------------------- serving
     sv = summary.get("serving") or {}
     viol = {k: n for k, n in (sv.get("slo_violations") or {}).items() if n}
@@ -526,6 +578,87 @@ def collect_findings(summary: dict, attribution: dict | None = None,
 
 
 # ---------------------------------------------------------------------------
+# op-level views
+# ---------------------------------------------------------------------------
+
+def decode_subfamilies(serving_attribution: dict | None,
+                       op_attribution: dict | None = None,
+                       serving_predicted: dict | None = None
+                       ) -> dict | None:
+    """Split the serving ``decode`` bucket (the residual where all
+    roofline deviation lands) across op families, so 'decode is slow'
+    names WHICH kind of op: measured family shares from a decode-tick
+    op attribution when one exists, else the decode jaxpr's predicted
+    family split (``predicted_decode_family_ms`` on the
+    ``serving_predicted`` row). Shares are scaled to sum exactly to
+    the decode bucket — the bucket contract survives the zoom-in."""
+    if not serving_attribution:
+        return None
+    decode_ms = (serving_attribution.get("buckets") or {}).get("decode")
+    if decode_ms is None:
+        return None
+    shares: dict[str, float] = {}
+    if op_attribution:
+        for r in op_attribution.get("rows") or ():
+            fam = r.get("family")
+            if fam and fam != "unattributed":
+                shares[fam] = shares.get(fam, 0.0) \
+                    + float(r.get("measured_ms") or 0.0)
+    elif serving_predicted and isinstance(
+            serving_predicted.get("predicted_decode_family_ms"), dict):
+        shares = {k: float(v) for k, v in
+                  serving_predicted["predicted_decode_family_ms"].items()
+                  if isinstance(v, (int, float))}
+    total = sum(shares.values())
+    if total <= 0:
+        return None
+    return {fam: round(decode_ms * v / total, 4)
+            for fam, v in sorted(shares.items()) if v > 0}
+
+
+def format_ops_table(op_attribution: dict, top: int = 10) -> str:
+    """The ``--ops`` view: top-N sites by |measured − predicted|, the
+    family rollup, and the sum line re-asserting the total contract."""
+    from . import opprof
+    attr = opprof.OpAttribution.from_dict(op_attribution) \
+        if isinstance(op_attribution, dict) else op_attribution
+    lines = [f"op attribution ({attr.source}; chip {attr.chip}; "
+             f"calibration {attr.calibration_id}):",
+             f"  {'site':<44} {'family':<14} {'meas ms':>9} "
+             f"{'pred ms':>9} {'rel err':>8}  bound"]
+    for r in attr.top_deviations(top):
+        rel = r.get("rel_err")
+        lines.append(
+            f"  {str(r['site'])[:44]:<44} {str(r['family'])[:14]:<14} "
+            f"{float(r.get('measured_ms') or 0):>9.4f} "
+            f"{float(r.get('predicted_ms') or 0):>9.4f} "
+            f"{(f'{rel:+.2f}' if isinstance(rel, (int, float)) else 'n/a'):>8}"
+            f"  {r.get('bound') or '-'}")
+    fams = attr.by_family()
+    resid = fams.pop("unattributed", None)
+    lines.append("  by family: " + ", ".join(
+        f"{fam} {agg['measured_ms']:.4f}ms"
+        + (f" ({agg['ratio']}x pred)" if agg.get("ratio") else "")
+        for fam, agg in sorted(fams.items(),
+                               key=lambda kv: -kv[1]["measured_ms"])))
+    if resid:
+        lines.append(f"  unattributed residual: "
+                     f"{resid['measured_ms']:.4f}ms")
+    row_sum, total = attr.sum_check()
+    lines.append(f"  rows sum {row_sum:.4f}ms = measured total "
+                 f"{total:.4f}ms")
+    glued = [c for c in attr.fusion_candidates
+             if c.get("measured_glue_ms") is not None]
+    for c in glued[:3]:
+        lines.append(
+            f"  fusion candidate: {c['measured_glue_ms']}ms measured "
+            f"glue over {len(c.get('sites') or ())} site(s) "
+            f"(predicted {float(c.get('glue_bytes') or 0) / 2 ** 20:.1f} "
+            f"MiB streamed, ratio {float(c.get('ratio') or 0):.1f}x)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # diagnosis + report
 # ---------------------------------------------------------------------------
 
@@ -545,9 +678,17 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
     serving_predicted = load_serving_predicted(pred_source) \
         or load_serving_predicted(run_dir)
     serving_attribution = attribute_serving_gap(summary, serving_predicted)
+    op_attribution = load_attribution(pred_source) \
+        or load_attribution(run_dir)
+    if serving_attribution:
+        sub = decode_subfamilies(serving_attribution, op_attribution,
+                                 serving_predicted)
+        if sub:
+            serving_attribution["decode_subfamilies"] = sub
     dumps = sorted(glob.glob(os.path.join(run_dir, "flight.rank*.json")))
     findings = collect_findings(summary, attribution, flight_dumps=dumps,
-                                serving_attribution=serving_attribution)
+                                serving_attribution=serving_attribution,
+                                op_attribution=op_attribution)
     crit = [f for f in findings if f["severity"] == "crit"]
     if crit:
         verdict = crit[0]["detail"].split(" — ")[0]
@@ -581,14 +722,16 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
         "verdict": verdict,
         "attribution": attribution,
         "serving_attribution": serving_attribution,
+        "op_attribution": op_attribution,
         "findings": findings,
         "flight_dumps": dumps,
         "summary": summary,
     }
 
 
-def format_report(report: dict) -> str:
-    """Human-ranked 'why is this run slow' text."""
+def format_report(report: dict, ops_top: int | None = None) -> str:
+    """Human-ranked 'why is this run slow' text; ``ops_top`` appends
+    the op-attribution deviation table (``perf_doctor --ops``)."""
     lines = [f"perf doctor: {report['run_dir']}",
              f"verdict: {report['verdict']}"]
     attr = report.get("attribution")
@@ -626,6 +769,11 @@ def format_report(report: dict) -> str:
         for k, v in sorted(b.items(), key=lambda kv: -abs(kv[1])):
             share = 100 * abs(v) / total
             lines.append(f"  {k:<12} {v:+9.3f} ms  ({share:4.1f}%)")
+        sub = sattr.get("decode_subfamilies")
+        if sub:
+            lines.append("decode bucket by op family (sums to decode): "
+                         + ", ".join(f"{fam}={v}ms"
+                                     for fam, v in sub.items()))
         for note in sattr.get("notes", []):
             lines.append(f"note: {note}")
         fl = sattr.get("fleet")
@@ -663,6 +811,9 @@ def format_report(report: dict) -> str:
                 f"{slo.get('missed', 0)} missed, goodput "
                 f"{slo.get('goodput_tokens', 0)} tokens"
                 + (f" ({100 * gf:.1f}%)" if gf is not None else ""))
+    if ops_top and report.get("op_attribution"):
+        lines.append(format_ops_table(report["op_attribution"],
+                                      top=ops_top))
     findings = report.get("findings") or []
     if findings:
         lines.append("findings:")

@@ -43,6 +43,9 @@ paddle_anomalies_total                counter    kind={step_time_spike,
 paddle_analysis_predicted_step_ms     gauge      target
 paddle_analysis_predicted_peak_hbm_mb gauge      target
 paddle_analysis_predicted_mfu         gauge      target
+paddle_cost_model_drift_ratio         gauge      family={dot,elementwise,
+                                                 scatter_gather,collective,
+                                                 pallas,other}
 paddle_serving_requests_total         counter    event={submitted,admitted,
                                                  finished,rejected,
                                                  migrated_in,migrated_out};
@@ -87,6 +90,7 @@ or sweeps live arrays (CPU fallback) once per step.
 """
 from __future__ import annotations
 
+import os
 import time
 
 from .metrics import get_registry
@@ -251,6 +255,14 @@ def predicted_mfu_gauge():
     return get_registry().gauge(
         "paddle_analysis_predicted_mfu",
         "static-cost-model MFU prediction vs chip peak")
+
+
+def cost_model_drift_gauge():
+    return get_registry().gauge(
+        "paddle_cost_model_drift_ratio",
+        "measured/predicted time ratio per op family from the latest "
+        "op attribution (1.0 = model exact; outside the PTCM001 band "
+        "means the cost model needs recalibration)")
 
 
 def serving_requests_counter():
@@ -569,8 +581,10 @@ def sample_device_memory(chrome_counter: bool = True) -> dict | None:
 # Chip roofline table (public TPU spec sheets, bf16 peak / HBM / ICI).
 # ``ici_bw`` is the per-chip aggregate interconnect bandwidth the ring
 # collective model divides wire bytes by; ``hbm_gb`` is the per-chip
-# capacity the OOM-before-compile gate defaults to. The cpu row is
-# nominal — it only keeps smoke-run ratios finite, never a baseline.
+# capacity the OOM-before-compile gate defaults to. The cpu row is a
+# fallback — chip_specs() replaces its compute/bandwidth constants with
+# measured ones from a one-shot microbenchmark on first use, so CPU
+# smoke-run rooflines reflect the actual host rather than fantasy.
 CHIP_SPECS = {
     "v4":  dict(peak_flops=275e12, hbm_bw=1228e9, ici_bw=268e9, hbm_gb=32),
     "v5p": dict(peak_flops=459e12, hbm_bw=2765e9, ici_bw=540e9, hbm_gb=95),
@@ -583,21 +597,79 @@ CHIP_SPECS = {
 }
 _DEFAULT_CHIP = "v5p"
 
+_cpu_bench_cache: dict | None = None
+
+
+def _cpu_microbench() -> dict:
+    """Measured compute/bandwidth constants for the host CPU, replacing
+    the table's placeholder row. One small GEMM (BLAS f32 peak proxy)
+    and one large-buffer copy (streaming bandwidth proxy), both clamped
+    to sane host ranges so a noisy scheduler can't produce a roofline
+    that is obviously wrong. Runs once per process (~10 ms), cached."""
+    global _cpu_bench_cache
+    if _cpu_bench_cache is not None:
+        return _cpu_bench_cache
+    import numpy as np
+    n, reps = 384, 4
+    a = np.full((n, n), 1.0 / n, np.float32)
+    b = np.full((n, n), 0.5, np.float32)
+    (a @ b)  # warm BLAS up outside the timed window
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        (a @ b)
+    gemm_s = max(time.perf_counter() - t0, 1e-7)
+    flops = 2.0 * n ** 3 * reps / gemm_s
+    src = np.zeros(4 << 20, np.float32)  # 16 MiB, beyond typical L2
+    dst = np.empty_like(src)
+    np.copyto(dst, src)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.copyto(dst, src)
+    copy_s = max(time.perf_counter() - t0, 1e-7)
+    bw = 2.0 * src.nbytes * reps / copy_s  # read + write streams
+    try:
+        ram_gb = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE") \
+            / float(1 << 30)
+    except (ValueError, OSError, AttributeError):
+        ram_gb = 8.0
+    _cpu_bench_cache = dict(
+        peak_flops=min(max(flops, 1e10), 5e13),
+        hbm_bw=min(max(bw, 1e9), 2e11),
+        hbm_gb=min(max(ram_gb, 1.0), 64.0),
+    )
+    return _cpu_bench_cache
+
 
 def chip_specs(kind: str | None = None) -> dict:
     """Roofline constants for ``kind`` (or the attached device when None):
     ``{name, peak_flops, hbm_bw, ici_bw, hbm_gb}``. Shared by the MFU
     gauge, bench.py, and the static cost model, so predicted and measured
-    MFU always divide by the same peak."""
+    MFU always divide by the same peak.
+
+    ``PADDLE_CHIP_KIND`` overrides the device probe so CPU smoke and
+    no-backend rounds can price any chip without code edits (an explicit
+    ``kind`` argument still wins). When ``PADDLE_COST_CALIBRATION``
+    names a fitted calibration for this chip, its constants are merged
+    in (``mxu_efficiency`` override, achieved-HBM-BW scaling) and the
+    row carries the ``calibration_id``."""
+    if kind is None:
+        kind = os.environ.get("PADDLE_CHIP_KIND") or None
     if kind is None:
         import jax
         d = jax.devices()[0]
         kind = getattr(d, "device_kind", "") or d.platform
     kind_l = str(kind).lower()
-    for k, spec in CHIP_SPECS.items():
+    spec = None
+    for k, row in CHIP_SPECS.items():
         if k in kind_l:
-            return dict(spec, name=k)
-    return dict(CHIP_SPECS[_DEFAULT_CHIP], name=_DEFAULT_CHIP)
+            spec = dict(row, name=k)
+            break
+    if spec is None:
+        spec = dict(CHIP_SPECS[_DEFAULT_CHIP], name=_DEFAULT_CHIP)
+    if spec["name"] == "cpu":
+        spec.update(_cpu_microbench())
+    from .calibration import active_calibration, apply_to_chip
+    return apply_to_chip(spec, active_calibration())
 
 
 def peak_flops_per_chip() -> float:

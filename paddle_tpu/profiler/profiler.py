@@ -248,7 +248,12 @@ class Profiler:
         if format == "pb":
             raise NotImplementedError(
                 "protobuf export is not implemented on this stack; use "
-                "format='json' (chrome://tracing / perfetto readable)")
+                "format='json' (chrome://tracing / perfetto readable), or "
+                "for machine-readable per-op measured-vs-predicted data "
+                "use the op-attribution JSON "
+                "(paddle_tpu.observability.opprof — "
+                "OpAttribution.save('attribution.json'), readable by "
+                "tools/perf_doctor.py --ops and tools/trace_summary.py)")
         assert format == "json", format
         events = []
         for name, tid, t0, t1, etype in self._events:

@@ -95,7 +95,7 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
                           quantize: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
-    from ..analysis.passes.cost import estimate_jaxpr_cost
+    from ..analysis.passes.cost import estimate_jaxpr_cost, site_rows
     from ..observability.instrument import chip_specs
     from .engine import decode_step_fn
 
@@ -128,6 +128,13 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
                    * _np.dtype(t.dtype).itemsize)
     weight_bytes = sum(_aval_bytes(t)
                        for t in jax.tree_util.tree_leaves(params))
+    # decode-tick time by op family (per-site predicted roofline times,
+    # rolled up) — the doctor splits its decode residual bucket along
+    # these shares when no measured decode attribution exists
+    family_ms: dict[str, float] = {}
+    for r in site_rows(cost):
+        family_ms[r["family"]] = round(
+            family_ms.get(r["family"], 0.0) + r["predicted_ms"], 6)
     return {
         "config": config,
         "concurrency": B,
@@ -141,8 +148,10 @@ def predicted_serving_row(config: str = "345m", concurrency: int = 8,
         "predicted_per_token_ms_p50": round(cost.step_ms, 3),
         "predicted_per_token_ms_p95": round(cost.step_ms, 3),
         "predicted_bound": cost.bound,
+        "predicted_decode_family_ms": family_ms,
         "kv_pool_mb": round(pool_bytes / 2 ** 20, 1),
         "chip_assumed": spec.get("name"),
+        "calibration_id": spec.get("calibration_id", "default"),
     }
 
 

@@ -26,6 +26,16 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
 
+# Pin the host-CPU roofline row to the historical table constants: the
+# live microbench (observability.instrument._cpu_microbench) measures the
+# box the suite happens to run on, and diagnostics that assert a specific
+# bound (PTCS001/PTCS003 on the cpu chip) must not flip with host speed.
+# test_opprof clears this cache where the microbench itself is under test.
+from paddle_tpu.observability import instrument as _instrument  # noqa: E402
+
+_instrument._cpu_bench_cache = dict(peak_flops=1e12, hbm_bw=50e9,
+                                    hbm_gb=8.0)
+
 # NOTE on suite wall-time (VERDICT r3 weak #12): the dominant cost is XLA
 # recompilation inside each test process. The persistent compilation
 # cache was evaluated here and stores nothing for the CPU backend

@@ -1,0 +1,481 @@
+"""Op-level profile↔prediction attribution + self-calibrating cost model.
+
+Covers the opprof tentpole end to end on CPU: the eqn-by-eqn replay
+harness (rows + `unattributed` residual sum EXACTLY to the measured
+step total), the site-tagging pass under jit, PTCM001 drift findings +
+the drift gauge, calibration fitting (post-fit mean |rel_err| of the
+predicted step time <= pre-fit, by construction), the
+PADDLE_COST_CALIBRATION / PADDLE_CHIP_KIND consumption paths, the
+checked-in ``tests/fixtures/opprof_run`` doctor gate (``--ops``), and
+the attribution-aware tools (trace_summary, bench_compare refusal).
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "opprof_run")
+
+from paddle_tpu.observability import opprof
+from paddle_tpu.observability.calibration import (
+    apply_to_chip, calibration_id, fit_calibration, load_calibration,
+    save_calibration,
+)
+from paddle_tpu.observability.instrument import chip_specs
+
+
+def _toy_fn(x, w):
+    h = jnp.tanh(x @ w)
+    return (h * h).sum()
+
+
+def _toy_args(n=64, k=32):
+    return (jnp.ones((n, 2 * n), jnp.float32),
+            jnp.ones((2 * n, k), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# replay harness + the join
+# ---------------------------------------------------------------------------
+
+def test_replay_attribution_sums_exactly_to_total():
+    attr = opprof.replay_attribution(_toy_fn, _toy_args())
+    row_sum, total = attr.sum_check()
+    # float addition of the very numbers in the table — exact, not approx
+    assert row_sum == pytest.approx(total, abs=1e-9)
+    assert total > 0
+    resid = [r for r in attr.rows if r["family"] == opprof.UNATTRIBUTED]
+    assert len(resid) == 1
+    # wall total >= sum of per-eqn windows by construction
+    assert resid[0]["measured_ms"] >= -1e-9
+    fams = {r["family"] for r in attr.rows}
+    assert "dot" in fams and "elementwise" in fams
+
+
+def test_replay_sites_stable_across_runs():
+    a1 = opprof.replay_attribution(_toy_fn, _toy_args())
+    a2 = opprof.replay_attribution(_toy_fn, _toy_args())
+    sites = lambda a: {r["site"] for r in a.rows}
+    assert sites(a1) == sites(a2)
+    # predictions are static — identical across replays
+    p = lambda a: {r["site"]: r["predicted_ms"] for r in a.rows}
+    assert p(a1) == p(a2)
+
+
+def test_replay_joins_predictions_and_rel_err():
+    attr = opprof.replay_attribution(_toy_fn, _toy_args(),
+                                     chip=chip_specs("v5e"))
+    assert attr.chip == "v5e"
+    dot = [r for r in attr.rows if r["family"] == "dot"]
+    assert dot and dot[0]["predicted_ms"] > 0 and dot[0]["flops"] > 0
+    for r in attr.rows:
+        if r["family"] == opprof.UNATTRIBUTED:
+            assert r["rel_err"] is None
+        elif r["predicted_ms"] > 0:
+            assert r["rel_err"] == pytest.approx(
+                (r["measured_ms"] - r["predicted_ms"]) / r["predicted_ms"])
+
+
+def test_replay_applies_family_corrections():
+    spec = chip_specs("v5e")
+    base = opprof.replay_attribution(_toy_fn, _toy_args(), chip=spec,
+                                     calibration={})
+    cal = {"family_correction": {"dot": 2.0}, "calibration_id": "x" * 12}
+    corr = opprof.replay_attribution(_toy_fn, _toy_args(), chip=spec,
+                                     calibration=cal)
+    assert corr.calibration_id == "x" * 12
+    p = lambda a: {r["site"]: r["predicted_ms"] for r in a.rows
+                   if r["family"] == "dot"}
+    for site, val in p(corr).items():
+        assert val == pytest.approx(2.0 * p(base)[site])
+
+
+def test_tag_sites_traces_and_matches_eager():
+    args = _toy_args()
+    closed = jax.make_jaxpr(_toy_fn)(*args)
+    tagged = jax.jit(opprof.tag_sites(closed))
+    assert float(tagged(*args)) == pytest.approx(float(_toy_fn(*args)))
+
+
+def test_attribution_roundtrip_and_views(tmp_path):
+    attr = opprof.replay_attribution(_toy_fn, _toy_args())
+    path = attr.save(str(tmp_path / "attribution.json"))
+    back = opprof.OpAttribution.load(path)
+    assert back.sum_check() == attr.sum_check()
+    assert back.by_family().keys() == attr.by_family().keys()
+    top = back.top_deviations(2)
+    assert len(top) == 2
+    assert all(r["family"] != opprof.UNATTRIBUTED for r in top)
+
+
+def test_attach_glue_cost_ranks_candidates():
+    attr = opprof.OpAttribution(rows=[
+        {"site": "a.py:L1:cumsum", "family": "scatter_gather",
+         "measured_ms": 3.0},
+        {"site": "a.py:L2:gather", "family": "scatter_gather",
+         "measured_ms": 1.0},
+    ], measured_total_ms=4.0)
+    cands = [{"glue_bytes": 1.0, "sites": ["a.py:L2:gather"]},
+             {"glue_bytes": 2.0,
+              "sites": ["a.py:L1:cumsum", "a.py:L2:gather"]},
+             {"glue_bytes": 3.0, "sites": ["missing.py:L9:sort"]}]
+    out = opprof.attach_glue_cost(cands, attr)
+    assert out[0]["measured_glue_ms"] == pytest.approx(4.0)
+    assert out[1]["measured_glue_ms"] == pytest.approx(1.0)
+    assert "measured_glue_ms" not in out[2]
+
+
+def test_ingest_profiler_trace_chrome_spans(tmp_path):
+    closed = jax.make_jaxpr(_toy_fn)(*_toy_args())
+    from paddle_tpu.analysis.passes.cost import (estimate_jaxpr_cost,
+                                                 site_rows)
+    rows = site_rows(estimate_jaxpr_cost(closed, chip=chip_specs("v5e")))
+    scope = opprof._scope_name(rows[0]["site"])
+    trace = {"traceEvents": [
+        {"ph": "X", "name": f"jit__fn/{scope}/fusion.1", "ts": 0.0,
+         "dur": 700.0},
+        {"ph": "X", "name": "jit__fn/unrelated.2", "ts": 700.0,
+         "dur": 300.0},
+    ]}
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(trace))
+    attr = opprof.ingest_profiler_trace(str(path), rows, chip="v5e")
+    assert attr.source == "jax_profiler"
+    row_sum, total = attr.sum_check()
+    assert row_sum == pytest.approx(total, abs=1e-9)
+    assert total == pytest.approx(1.0)  # wall extent of the trace, ms
+    hit = [r for r in attr.rows if r["site"] == rows[0]["site"]]
+    assert hit[0]["measured_ms"] == pytest.approx(0.7)
+    resid = [r for r in attr.rows
+             if r["family"] == opprof.UNATTRIBUTED][0]
+    assert resid["measured_ms"] == pytest.approx(0.3)
+
+
+# ---------------------------------------------------------------------------
+# PTCM001 drift
+# ---------------------------------------------------------------------------
+
+def _drifted_attr():
+    return {
+        "schema": "op_attribution", "measured_total_ms": 10.0,
+        "rows": [
+            {"site": "a.py:L1:dot_general", "family": "dot",
+             "measured_ms": 5.0, "predicted_ms": 5.2},
+            {"site": "a.py:L2:cumsum", "family": "scatter_gather",
+             "measured_ms": 4.0, "predicted_ms": 0.5},
+            {"site": "unattributed", "family": "unattributed",
+             "measured_ms": 1.0, "predicted_ms": 0.0},
+        ],
+    }
+
+
+def test_drift_findings_and_gauge():
+    findings = opprof.drift_findings(_drifted_attr(), publish=True)
+    assert [f["code"] for f in findings] == ["PTCM001"]
+    f = findings[0]
+    assert f["severity"] == "warning" and f["family"] == "scatter_gather"
+    assert f["ratio"] == pytest.approx(8.0)
+    from paddle_tpu.observability.metrics import get_registry
+    g = get_registry().get("paddle_cost_model_drift_ratio")
+    vals = {labels["family"]: state["value"] for labels, state
+            in g.collect()}
+    # every finite-ratio family lands on the gauge, drifted or not
+    assert vals["scatter_gather"] == pytest.approx(8.0)
+    assert vals["dot"] == pytest.approx(5.0 / 5.2, rel=1e-3)
+
+
+def test_drift_min_ms_suppresses_noise():
+    attr = _drifted_attr()
+    attr["rows"][1]["measured_ms"] = 0.01   # below DRIFT_MIN_MS
+    attr["rows"][1]["predicted_ms"] = 0.001
+    assert opprof.drift_findings(attr, publish=False) == []
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def test_fit_family_corrections_recovers_known_ratio():
+    rows = [{"family": "dot", "measured_ms": 2.0 * p, "predicted_ms": p}
+            for p in (1.0, 2.0, 5.0)]
+    cal = fit_calibration(rows=rows, chip="cpu")
+    assert cal["family_correction"]["dot"] == pytest.approx(2.0)
+    fit = cal["fit"]["families"]["dot"]
+    assert fit["post"] <= fit["pre"]
+    # pathological traces clamp instead of baking in a broken model
+    rows = [{"family": "other", "measured_ms": 100.0,
+             "predicted_ms": 1.0}]
+    cal = fit_calibration(rows=rows, chip="cpu")
+    assert cal["family_correction"]["other"] == pytest.approx(10.0)
+
+
+def test_calibration_id_stable_and_content_addressed(tmp_path):
+    cal = fit_calibration(rows=[{"family": "dot", "measured_ms": 2.0,
+                                 "predicted_ms": 1.0}], chip="v5e")
+    assert cal["calibration_id"] == calibration_id(cal)
+    path = save_calibration(cal, str(tmp_path / "calibration.json"))
+    back = load_calibration(path)
+    assert back["calibration_id"] == cal["calibration_id"]
+    # content change => id change (stale hand-edited ids are re-stamped)
+    doc = json.load(open(path))
+    doc["mxu_efficiency"] = 0.123
+    json.dump(doc, open(path, "w"))
+    assert load_calibration(path)["calibration_id"] \
+        != cal["calibration_id"]
+
+
+def _step_sweep():
+    """Tiny sweep: measured jit wall time next to the cost model's
+    roofline components for a few small programs of different bounds."""
+    progs = []
+    for n in (96, 160):
+        progs.append((lambda x, w: x @ w, _toy_args(n)))
+        progs.append((_toy_fn, _toy_args(n)))
+    spec = chip_specs("cpu")
+    from paddle_tpu.analysis.passes.cost import estimate_jaxpr_cost
+    pairs, closeds = [], []
+    for fn, args in progs:
+        closed = jax.make_jaxpr(fn)(*args)
+        cost = estimate_jaxpr_cost(closed, chip=spec)
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(*args))       # compile outside timing
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        measured_ms = (time.perf_counter() - t0) / reps * 1e3
+        pairs.append({"measured_ms": measured_ms,
+                      "compute_ms": cost.compute_ms,
+                      "hbm_ms": cost.hbm_ms, "comm_ms": cost.comm_ms})
+        closeds.append((closed, measured_ms))
+    return spec, pairs, closeds
+
+
+def test_calibration_improves_step_prediction():
+    spec, pairs, closeds = _step_sweep()
+    cal = fit_calibration(step_pairs=pairs, chip="cpu")
+    fit = cal["fit"]["step"]
+    # identity is always a candidate: post <= pre on the fit set, hard
+    assert fit["post"] <= fit["pre"]
+    # and on this hardware the hand constants are wrong enough that the
+    # fit strictly improves (unless the model was already within 2%)
+    assert fit["post"] < fit["pre"] or fit["pre"] <= 0.02
+
+    # end to end: re-pricing the sweep through chip_specs-style consumption
+    # (apply_to_chip) reduces the mean |rel_err| of predicted step_ms
+    from paddle_tpu.analysis.passes.cost import estimate_jaxpr_cost
+    cal_spec = apply_to_chip(spec, cal)
+    assert cal_spec["calibration_id"] == cal["calibration_id"]
+
+    def mean_err(chip):
+        errs = [abs(estimate_jaxpr_cost(c, chip=chip).step_ms - m) / m
+                for c, m in closeds]
+        return sum(errs) / len(errs)
+    assert mean_err(cal_spec) <= mean_err(spec) + 1e-9
+
+
+def test_calibration_env_consumed_by_chip_specs(tmp_path, monkeypatch):
+    cal = {"chip": "v5e", "mxu_efficiency": 0.3, "hbm_bw_fraction": 0.5,
+           "family_correction": {}}
+    path = save_calibration(cal, str(tmp_path / "calibration.json"))
+    monkeypatch.setenv("PADDLE_COST_CALIBRATION", path)
+    s = chip_specs("v5e")
+    assert s["mxu_efficiency"] == pytest.approx(0.3)
+    assert s["hbm_bw"] == pytest.approx(819e9 * 0.5)
+    assert s["calibration_id"] == load_calibration(path)["calibration_id"]
+    from paddle_tpu.observability.calibration import active_calibration_id
+    assert active_calibration_id() == s["calibration_id"]
+    # a v5e calibration never silently prices another part
+    v4 = chip_specs("v4")
+    assert "calibration_id" not in v4 and "mxu_efficiency" not in v4
+    # and estimate_jaxpr_cost picks the constants up through the spec
+    from paddle_tpu.analysis.passes.cost import estimate_jaxpr_cost
+    closed = jax.make_jaxpr(_toy_fn)(*_toy_args())
+    calibrated = estimate_jaxpr_cost(closed, chip=s).step_ms
+    monkeypatch.delenv("PADDLE_COST_CALIBRATION")
+    default = estimate_jaxpr_cost(closed, chip=chip_specs("v5e")).step_ms
+    assert calibrated != default
+
+
+def test_default_calibration_id_without_env(monkeypatch):
+    monkeypatch.delenv("PADDLE_COST_CALIBRATION", raising=False)
+    from paddle_tpu.observability.calibration import active_calibration_id
+    assert active_calibration_id() == "default"
+
+
+# ---------------------------------------------------------------------------
+# chip_specs satellites
+# ---------------------------------------------------------------------------
+
+def test_chip_kind_env_override(monkeypatch):
+    monkeypatch.setenv("PADDLE_CHIP_KIND", "v6e")
+    assert chip_specs()["name"] == "v6e"
+    # an explicit argument still wins over the env
+    assert chip_specs("v5p")["name"] == "v5p"
+
+
+def test_cpu_specs_are_microbenched_not_fantasy(monkeypatch):
+    # conftest pins the cpu row for suite determinism — clear the cache
+    # here, where the live microbench is the thing under test
+    from paddle_tpu.observability import instrument
+    monkeypatch.setattr(instrument, "_cpu_bench_cache", None)
+    s = chip_specs("cpu")
+    # the old placeholder row said exactly 1e12 / 50e9; the microbench
+    # replaces both with measured-but-clamped host numbers
+    assert 1e10 <= s["peak_flops"] <= 5e13
+    assert 1e9 <= s["hbm_bw"] <= 2e11
+    assert 1.0 <= s["hbm_gb"] <= 64.0
+    assert s["ici_bw"] == 10e9          # no interconnect to measure
+    # cached: a second call reuses the measurement
+    assert chip_specs("cpu")["peak_flops"] == s["peak_flops"]
+
+
+# ---------------------------------------------------------------------------
+# fixture doctor gate + tools
+# ---------------------------------------------------------------------------
+
+def test_perf_doctor_opprof_fixture_gate(capsys):
+    from tools.perf_doctor import main as doctor_main
+    assert doctor_main([FIXTURE, "--ops", "--no-write"]) == 0
+    out = capsys.readouterr().out
+    assert "rows sum 400.0000ms = measured total 400.0000ms" in out
+    assert "PTCM001" in out and "scatter_gather" in out
+    assert "measured glue" in out
+    assert not os.path.exists(os.path.join(FIXTURE, "run_summary.json"))
+
+
+def test_perf_doctor_opprof_fixture_json(tmp_path, capsys):
+    from tools.perf_doctor import main as doctor_main
+    run_dir = str(tmp_path / "run")
+    shutil.copytree(FIXTURE, run_dir)
+    assert doctor_main([run_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    attr = doc["op_attribution"]
+    assert sum(r["measured_ms"] for r in attr["rows"]) \
+        == attr["measured_total_ms"]
+    kinds = {f["kind"] for f in doc["findings"]}
+    assert "cost_model_drift" in kinds
+    assert "fusion_glue_measured" in kinds
+    glue = attr["fusion_candidates"][0]
+    assert glue["measured_glue_ms"] == 90.0 and len(glue["sites"]) == 2
+
+
+def test_doctor_flags_sum_contract_violation():
+    from paddle_tpu.observability.doctor import collect_findings
+    attr = _drifted_attr()
+    attr["rows"][0]["measured_ms"] += 0.5    # break the contract
+    findings = collect_findings({}, op_attribution=attr)
+    assert "attribution_sum_mismatch" in {f["kind"] for f in findings}
+
+
+def test_decode_subfamilies_scale_to_decode_bucket():
+    from paddle_tpu.observability.doctor import decode_subfamilies
+    sattr = {"buckets": {"decode": 2.0, "queue": 0.1}}
+    # measured attribution wins
+    sub = decode_subfamilies(sattr, op_attribution=_drifted_attr())
+    assert sum(sub.values()) == pytest.approx(2.0, abs=1e-6)
+    assert sub["scatter_gather"] == pytest.approx(2.0 * 4.0 / 9.0,
+                                                  abs=1e-3)
+    # predicted family split is the fallback
+    sub = decode_subfamilies(
+        sattr, serving_predicted={
+            "predicted_decode_family_ms": {"dot": 3.0, "elementwise": 1.0}})
+    assert sub["dot"] == pytest.approx(1.5)
+    assert sum(sub.values()) == pytest.approx(2.0, abs=1e-6)
+
+
+def test_serving_predicted_row_carries_family_split():
+    from paddle_tpu.serving.predict import predicted_serving_row
+    row = predicted_serving_row("tiny", concurrency=2, page_size=8)
+    fam = row["predicted_decode_family_ms"]
+    assert fam and "dot" in fam
+    assert all(v >= 0 for v in fam.values())
+    assert row["calibration_id"] == "default"
+
+
+def test_trace_summary_ops_and_diff(capsys):
+    from tools.trace_summary import main as ts_main
+    attr_path = os.path.join(FIXTURE, "attribution.json")
+    assert ts_main([attr_path, "--ops"]) == 0
+    out = capsys.readouterr().out
+    assert "rows sum 400.0000ms" in out
+    # attribution files ride the existing chrome-trace diff plumbing
+    assert ts_main(["--diff", attr_path, attr_path, "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "net span-time delta" in out and "+0.000ms" in out
+    # plain summarize treats rows as spans
+    assert ts_main([attr_path, "--top", "2"]) == 0
+    assert "train.py:L42:dot_general" in capsys.readouterr().out
+
+
+def test_bench_compare_refuses_cross_calibration_anchor():
+    from tools.bench_compare import compare
+    meas = {"metric": "gpt_345m_tokens_per_sec_per_chip",
+            "value": 30000.0, "unit": "tokens/s/chip",
+            "extras": {"calibration_id": "default"}}
+    pred = {"metric": "gpt_345m_predicted", "value": 40000.0,
+            "unit": "tokens/s/chip (static cost model)",
+            "extras": {"calibration_id": "default"}}
+    pred_refit = dict(pred, extras={"calibration_id": "deadbeef0123"})
+    rows = lambda p: {"gpt_345m_tokens_per_sec_per_chip": meas,
+                      "gpt_345m_predicted": p}
+    ok = compare(rows(pred), rows(pred))
+    rec = [m for m in ok["metrics"]
+           if m["metric"] == "gpt_345m_tokens_per_sec_per_chip"][0]
+    assert rec["anchored_ratio_a"] == pytest.approx(0.75)
+    refused = compare(rows(pred), rows(pred_refit))
+    rec = [m for m in refused["metrics"]
+           if m["metric"] == "gpt_345m_tokens_per_sec_per_chip"][0]
+    assert "anchored_ratio_a" not in rec
+    assert "calibration mismatch" in rec["anchor_refused"]
+    # rows that predate the stamp compare as "default" (back-compat)
+    from tools.bench_compare import _calibration_of
+    assert _calibration_of({"extras": {}}) == "default"
+
+
+def test_bench_rows_stamp_calibration_id(monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, "_CAL_ID", None)
+    monkeypatch.delenv("PADDLE_COST_CALIBRATION", raising=False)
+    printed = []
+    monkeypatch.setattr("builtins.print",
+                        lambda *a, **k: printed.append(a[0]))
+    bench.emit("toy_metric", 1.0, "unit", {"x": 1})
+    row = json.loads(printed[0])
+    assert row["extras"]["calibration_id"] == "default"
+    assert row["extras"]["x"] == 1
+
+
+def test_analysis_predicted_row_carries_calibration_id(monkeypatch):
+    monkeypatch.delenv("PADDLE_COST_CALIBRATION", raising=False)
+    from paddle_tpu.analysis.predict import predicted_row
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.models.gpt import GPTHybridTrainStep, gpt_tiny_config
+    mesh_mod._global_mesh, mesh_mod._hcg = None, None
+    hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=1, pp_degree=1)
+    step = GPTHybridTrainStep.abstract(gpt_tiny_config(), hcg, n_micro=1,
+                                       remat=False,
+                                       compute_dtype="float32")
+    row = predicted_row(step, 2, 64, chip="v5e")
+    assert row["calibration_id"] == "default"
+
+
+def test_profiler_pb_export_points_at_attribution(tmp_path):
+    from paddle_tpu.profiler.profiler import Profiler
+    with pytest.raises(NotImplementedError) as ei:
+        Profiler().export(str(tmp_path / "x.pb"), format="pb")
+    msg = str(ei.value)
+    assert "opprof" in msg and "attribution" in msg

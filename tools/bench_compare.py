@@ -17,6 +17,14 @@ Rows whose unit marks them non-metrics (skipped / error / timeout /
 info) are ignored, as are ``*_cpu_smoke`` vs TPU mismatches (a CPU
 fallback round never regresses a TPU number).
 
+Every row carries a ``calibration_id`` in its extras (hash of the
+active ``calibration.json``, or ``"default"``). A measured row is only
+anchor-normalized against a predicted row produced under the SAME
+calibration — a refit changes what "predicted" means, so crossing ids
+would book the calibration delta as an environment drift. Refused
+anchors are reported per-row (``anchor_refused``), never silently
+dropped.
+
 Exit codes: 0 = no regressions, 1 = regression(s) beyond threshold,
 2 = artifact unreadable.
 
@@ -105,6 +113,14 @@ _ANCHOR_MAP = {
 }
 
 
+def _calibration_of(row) -> str:
+    """The calibration id a row was produced under. Rows predate the
+    stamp or were emitted with no calibration active → "default"."""
+    extras = row.get("extras") or {}
+    return str(extras.get("calibration_id")
+               or row.get("calibration_id") or "default")
+
+
 def _predicted_anchor(metric, rows):
     """The *_predicted row anchoring a measured metric, if present
     (gpt_345m_tokens_per_sec_per_chip -> gpt_345m_predicted;
@@ -143,13 +159,26 @@ def compare(rows_a: dict, rows_b: dict, threshold=0.40,
         anchor_a = _predicted_anchor(metric, rows_a)
         anchor_b = _predicted_anchor(metric, rows_b)
         if anchor_a and anchor_b and not predicted:
-            # measured/predicted: the environment-independent view —
-            # predicted rows absorb intentional model/config changes
-            na = va / float(anchor_a["value"])
-            nb = vb / float(anchor_b["value"])
-            rec["anchored_ratio_a"] = round(na, 4)
-            rec["anchored_ratio_b"] = round(nb, 4)
-            rec["anchored_change_pct"] = round(100 * (nb - na) / na, 2)
+            mismatch = [
+                f"{side} measured={_calibration_of(row)} "
+                f"anchor={_calibration_of(anchor)}"
+                for side, row, anchor in (("A", a, anchor_a),
+                                          ("B", b, anchor_b))
+                if _calibration_of(row) != _calibration_of(anchor)]
+            if mismatch:
+                # predicted constants differ from the ones active when
+                # the measurement ran — the ratio would mix a refit into
+                # the environment story; refuse, visibly
+                rec["anchor_refused"] = ("calibration mismatch: "
+                                         + "; ".join(mismatch))
+            else:
+                # measured/predicted: the environment-independent view —
+                # predicted rows absorb intentional model/config changes
+                na = va / float(anchor_a["value"])
+                nb = vb / float(anchor_b["value"])
+                rec["anchored_ratio_a"] = round(na, 4)
+                rec["anchored_ratio_b"] = round(nb, 4)
+                rec["anchored_change_pct"] = round(100 * (nb - na) / na, 2)
         out["metrics"].append(rec)
         if regression:
             out["regressions"].append(rec)
@@ -165,6 +194,8 @@ def format_table(result) -> str:
         extra = ""
         if "anchored_change_pct" in rec:
             extra = f"  (vs-predicted {rec['anchored_change_pct']:+.1f}%)"
+        elif "anchor_refused" in rec:
+            extra = f"  (anchor refused: {rec['anchor_refused']})"
         lines.append(
             f"{rec['metric']:<46} {rec['a']:>12.1f} {rec['b']:>12.1f} "
             f"{rec['change_pct']:>+7.1f}%  {verdict}{extra}")

@@ -20,8 +20,16 @@ Usage::
 
     python tools/perf_doctor.py <run_dir>
     python tools/perf_doctor.py <run_dir> --predicted predicted.json
+    python tools/perf_doctor.py <run_dir> --ops            # op-deviation table
     python tools/perf_doctor.py <run_dir> --json           # machine-readable
     python tools/perf_doctor.py <run_dir> --strict         # rc=1 on crit
+
+``--ops`` appends the op-level attribution view when the run dir (or
+``--predicted`` source) carries an ``attribution.json``
+(:mod:`paddle_tpu.observability.opprof` output): the top-N sites by
+|measured − predicted| deviation, the per-family rollup feeding the
+PTCM001 drift finding, the exact sum-to-total line, and PTCS004 fusion
+candidates with their MEASURED glue cost.
 
 The predicted row is auto-discovered from ``<run_dir>/predicted.json``
 (drop the output of ``python -m paddle_tpu.analysis.predict`` there);
@@ -50,6 +58,11 @@ def main(argv=None):
                          "predicted row names none (default v5e)")
     ap.add_argument("--straggler-threshold", type=float, default=1.3,
                     help="min slow-rank/median skew to name a straggler")
+    ap.add_argument("--ops", action="store_true",
+                    help="append the op-attribution deviation table "
+                         "(needs <run_dir>/attribution.json)")
+    ap.add_argument("--ops-top", type=int, default=10,
+                    help="rows in the --ops deviation table")
     ap.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
     ap.add_argument("--no-write", action="store_true",
@@ -77,7 +90,12 @@ def main(argv=None):
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
-        print(format_report(report))
+        print(format_report(report,
+                            ops_top=args.ops_top if args.ops else None))
+    if args.ops and report.get("op_attribution") is None:
+        print("perf_doctor: --ops requested but no attribution.json in "
+              "the run dir (generate one with "
+              "paddle_tpu.observability.opprof)", file=sys.stderr)
     if args.strict and any(f["severity"] == "crit"
                            for f in report["findings"]):
         return 1

@@ -10,11 +10,20 @@ table of per-op-span total-time deltas, sorted by how much each name
 moved — the op-level view the perf doctor's step-level attribution
 points into.
 
+Op-attribution files (``paddle_tpu.observability.opprof`` output —
+``{"schema": "op_attribution", ...}``) are accepted everywhere a trace
+is: each row becomes a span named by its site with its measured time,
+so the same table/diff plumbing compares two attribution runs
+site-by-site. ``--ops`` switches to the richer attribution view
+(measured vs predicted, family rollup, sum-to-total line).
+
 Usage::
 
     python tools/trace_summary.py run/host_123.paddle_trace.json
     python tools/trace_summary.py trace.json --top 20 --unit us
     python tools/trace_summary.py --diff good.json slow.json --top 15
+    python tools/trace_summary.py attribution.json --ops
+    python tools/trace_summary.py --diff attr_a.json attr_b.json
 """
 import argparse
 import json
@@ -28,10 +37,28 @@ from paddle_tpu.profiler.profiler import (  # noqa: E402
 )
 
 
+def _is_attribution(doc) -> bool:
+    return isinstance(doc, dict) and (
+        doc.get("schema") == "op_attribution"
+        or ("rows" in doc and "measured_total_ms" in doc))
+
+
+def _attribution_spans(doc):
+    """Synthesized chrome spans from an op-attribution table: one span
+    per site, dur = measured time (ms → µs) — so the aggregate/diff
+    plumbing treats attribution files exactly like traces."""
+    return [{"ph": "X", "name": r.get("site", "?"), "ts": 0.0,
+             "dur": float(r.get("measured_ms") or 0.0) * 1e3}
+            for r in doc.get("rows") or ()]
+
+
 def load_trace(path):
-    """Return (span_events, counter_events) from a chrome-trace JSON."""
+    """Return (span_events, counter_events) from a chrome-trace JSON
+    (or an op-attribution JSON, rows synthesized into spans)."""
     with open(path) as f:
         doc = json.load(f)
+    if _is_attribution(doc):
+        return _attribution_spans(doc), []
     # both chrome-trace container forms: {"traceEvents": [...]} and bare array
     events = doc if isinstance(doc, list) else doc.get("traceEvents", [])
     spans = [e for e in events if e.get("ph") == "X"]
@@ -121,7 +148,24 @@ def main(argv=None):
     ap.add_argument("--diff", action="store_true",
                     help="compare exactly two traces: top-N op-span "
                          "total-time deltas (B − A)")
+    ap.add_argument("--ops", action="store_true",
+                    help="attribution files only: the measured-vs-"
+                         "predicted op table instead of the span table")
     args = ap.parse_args(argv)
+    if args.ops:
+        from paddle_tpu.observability.doctor import format_ops_table
+        rc = 0
+        for path in args.trace:
+            with open(path) as f:
+                doc = json.load(f)
+            if not _is_attribution(doc):
+                print(f"{path}: not an op-attribution file (generate one "
+                      f"with paddle_tpu.observability.opprof)",
+                      file=sys.stderr)
+                rc = 2
+                continue
+            print(format_ops_table(doc, top=args.top or 10))
+        return rc
     if args.diff:
         if len(args.trace) != 2:
             ap.error("--diff takes exactly two trace files")
