@@ -866,6 +866,68 @@ def emit_serving_predicted_row(timeout_s=180, quantize=None, mode=None):
         "vs_baseline": 0.0, "extras": row}), flush=True)
 
 
+def emit_autofusion_predicted_rows(timeout_s=300, export_dir=None):
+    """``autofusion_predicted`` plus one ``autofusion_<rule>_predicted``
+    row per fired rewrite rule: per-site predicted Δstep-ms of the
+    jaxpr auto-fusion pass (``analysis.rewrite``) over the tiny serving
+    engines' real traced programs. Trace + interpret-parity work in a
+    CPU subprocess, so the anchors land on CPU-smoke AND no-backend
+    rounds; calibration_id-stamped so bench_compare can anchor future
+    measured fused rows against them. ``export_dir`` (defaults to the
+    ``PADDLE_TELEMETRY_DIR`` launch-contract var) also receives the raw
+    match records as ``autofusion.json`` for the perf doctor."""
+    import subprocess
+    export_dir = export_dir or os.environ.get("PADDLE_TELEMETRY_DIR")
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.predict",
+           "--mode", "autofusion"]
+    if export_dir:
+        os.makedirs(export_dir, exist_ok=True)
+        cmd += ["--export-records",
+                os.path.join(export_dir, "autofusion.json")]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        row = None
+        for ln in r.stdout.splitlines():
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and (
+                    "error" in cand or "per_rule_delta_ms" in cand):
+                row = cand
+                break
+        if row is None:
+            raise RuntimeError(
+                f"no JSON row (rc={r.returncode}): {r.stderr[-200:]}")
+        if "error" in row:
+            raise RuntimeError(row["error"])
+    except Exception as e:
+        print(json.dumps({"metric": "autofusion_predicted_ERROR",
+                          "value": 0.0, "unit": "error",
+                          "vs_baseline": 0.0,
+                          "extras": {"error": repr(e)[:300]}}), flush=True)
+        return
+    cal = _calibration_id()
+    unit = ("ms/step predicted saving (static cost model, jaxpr "
+            "auto-fusion over the tiny serving-engine programs)")
+    print(json.dumps({
+        "metric": "autofusion_predicted",
+        "value": row.get("predicted_total_delta_ms", 0.0),
+        "unit": unit, "vs_baseline": 0.0,
+        "extras": {**row, "calibration_id": cal}}), flush=True)
+    for rule, delta in sorted(
+            (row.get("per_rule_delta_ms") or {}).items()):
+        sites = [s for s in row.get("sites") or ()
+                 if s.get("rule") == rule]
+        print(json.dumps({
+            "metric": f"autofusion_{rule}_predicted",
+            "value": delta, "unit": unit, "vs_baseline": 0.0,
+            "extras": {"rule": rule, "sites": sites,
+                       "calibration_id": cal}}), flush=True)
+
+
 def emit_collective_compression_predicted(dp=8, chip="v5e"):
     """``collective_compression_predicted``: ring-model wire bytes of the
     GPT-345M gradient all_reduce (the dp grad-sync — one full parameter
@@ -1095,6 +1157,8 @@ def bench_serving(args):
         emit_serving_predicted_row(mode="disagg")
         emit_serving_predicted_row(mode="moe")
         emit_serving_predicted_row(mode="fused_dispatch")
+        # the auto-fusion rewrite's predicted per-rule Δstep-ms anchors
+        emit_autofusion_predicted_rows()
 
 
 def bench_serving_moe(args, on_cpu):
@@ -1795,6 +1859,7 @@ def main():
         emit_serving_predicted_row(mode="fused_dispatch")
         emit_serving_predicted_row(mode="fleet")
         emit_serving_predicted_row(mode="migration")
+        emit_autofusion_predicted_rows()
         # pure arithmetic, no backend needed: the quantized-collective
         # wire-bytes anchor always lands in the artifact
         emit_collective_compression_predicted()

@@ -63,6 +63,14 @@ PTCS004 fusion opportunity: an unfused gate→dispatch chain (top-k
         dispatch shape) streams >2× the HBM a fused dispatch kernel
         would; ``kernels.moe_dispatch`` /
         ``MoELayer(fused_dispatch=True)`` is the fused path (info)
+PTCS005 auto-fused: the ``analysis.rewrite`` pattern-match pass
+        rewrote a PTCS004 chain into a template Pallas kernel
+        (ragged prefill / int8 dequant-matmul / MoE gate+dispatch)
+        with interpret-mode parity checked per rewrite; carries the
+        fired rule and predicted Δstep ms — the fused form is what
+        the cost walk priced; ``PADDLE_NO_AUTOFUSE=1`` /
+        ``PADDLE_AUTOFUSE_SUPPRESS=<site,...>`` restore the unfused
+        program (info)
 PTCM001 cost-model drift: an op family's measured/predicted time
         ratio (from an op-attribution run —
         ``observability.opprof``) left the [0.5, 2.0] band; refit

@@ -702,7 +702,7 @@ def eager_collective_cost(ledger, world_size: int,
 
 
 # ---------------------------------------------------------------------------
-# PTCS004: unfused MoE-dispatch chains (fusion opportunity)
+# PTCS004: unfused fusable chains (fusion opportunities, by kind)
 # ---------------------------------------------------------------------------
 
 # materializing glue the unfused dispatch streams through HBM between
@@ -716,7 +716,7 @@ _PTCS004_FLOOR = 1 << 20   # toy traces (tests, tiny zoo configs) stay quiet
 _PTCS004_RATIO = 2.0
 
 
-def _moe_fusion_opportunities(jaxpr, _found=None):
+def _moe_fusion_opportunities(jaxpr, _found=None, recurse=True):
     """Detect unfused gate→dispatch chains: a ``top_k`` (the routing
     decision) whose downstream dataflow materializes gather/scatter/
     cumsum glue charging > ``_PTCS004_RATIO``× the HBM traffic a fused
@@ -741,8 +741,9 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
         name = eqn.primitive.name
         if name == "pallas_call":
             continue  # fused already; neither taints nor recurses
-        for sub in _sub_jaxprs(eqn.params):
-            _moe_fusion_opportunities(sub, found)
+        if recurse:
+            for sub in _sub_jaxprs(eqn.params):
+                _moe_fusion_opportunities(sub, found)
         ins = [v for v in eqn.invars
                if not isinstance(v, jax.core.Literal)]
         hit = any(id(v) in tainted for v in ins)
@@ -771,9 +772,216 @@ def _moe_fusion_opportunities(jaxpr, _found=None):
         fused = big_out + big_in + (64 << 10)
         if glue_bytes >= _PTCS004_FLOOR \
                 and glue_bytes > _PTCS004_RATIO * fused:
-            found.append({"glue_bytes": glue_bytes,
+            found.append({"kind": "moe_dispatch",
+                          "glue_bytes": glue_bytes,
                           "fused_bytes": fused, "n_ops": n_ops,
                           "ratio": glue_bytes / fused, "sites": sites})
+    return found
+
+
+def _paged_gather_opportunities(jaxpr, _found=None, recurse=True):
+    """Detect dense paged-KV gathers: rank-4 page-pool operands gathered
+    whole-page (``slice_sizes == (1,) + pool.shape[1:]``) — the chunk
+    prefill program's ``k_pages[page_table]`` materialization. The walk
+    charges each such gather the full pool read plus the materialized
+    dense copy (written, then re-read by the attention dots); the
+    fused-kernel alternative streams only the touched pages, riding the
+    page table on scalar prefetch (``ragged_prefill_attention``)."""
+    found = [] if _found is None else _found
+    glue_bytes = 0.0
+    big_out = 0.0
+    n_ops = 0
+    sites = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            continue  # fused already
+        if recurse:
+            for sub in _sub_jaxprs(eqn.params):
+                _paged_gather_opportunities(sub, found)
+        if name != "gather":
+            continue
+        ins = [v for v in eqn.invars
+               if not isinstance(v, jax.core.Literal)]
+        if len(ins) != 2:
+            continue
+        op, idx = eqn.invars[0], eqn.invars[1]
+        if getattr(op.aval, "ndim", 0) != 4 \
+                or getattr(idx.aval, "ndim", 0) < 2:
+            continue
+        if np.dtype(idx.aval.dtype).kind not in "iu":
+            continue
+        ss = tuple(eqn.params.get("slice_sizes") or ())
+        if ss != (1,) + tuple(op.aval.shape[1:]):
+            continue
+        n_ops += 1
+        sid = eqn_site_id(eqn)
+        if sid not in sites:
+            sites.append(sid)
+        out_b = max([_nbytes(v.aval) for v in eqn.outvars] or [0])
+        glue_bytes += _nbytes(op.aval) + _nbytes(idx.aval) + 2 * out_b
+        big_out = max(big_out, out_b)
+    if n_ops:
+        fused = big_out + (64 << 10)
+        if glue_bytes >= _PTCS004_FLOOR \
+                and glue_bytes > _PTCS004_RATIO * fused:
+            found.append({"kind": "paged_attention",
+                          "glue_bytes": glue_bytes,
+                          "fused_bytes": fused, "n_ops": n_ops,
+                          "ratio": glue_bytes / fused, "sites": sites})
+    return found
+
+
+def _dequant_matmul_opportunities(jaxpr, _found=None, recurse=True):
+    """Detect unfused weight-only-int8 matmuls: ``convert(int8→float)``
+    whose result feeds a ``dot_general`` (the engines' ``_mm`` dequant
+    chain). The glue estimate is what an XLA backend without the
+    narrow-storage fusion would materialize: the dequantized f32 weight
+    (written + re-read) plus the pre-scale dot output round-trip; the
+    fused kernel (``int8_matmul``) dequantizes in registers and writes
+    the scaled result once."""
+    found = [] if _found is None else _found
+    cons: dict = {}
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            continue
+        if recurse:
+            for sub in _sub_jaxprs(eqn.params):
+                _dequant_matmul_opportunities(sub, found)
+        for v in eqn.invars:
+            if not isinstance(v, jax.core.Literal):
+                cons.setdefault(id(v), []).append(eqn)
+    glue_bytes = 0.0
+    big_out = 0.0
+    n_ops = 0
+    sites = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = eqn.invars[0]
+        if isinstance(src, jax.core.Literal) \
+                or str(getattr(src.aval, "dtype", "")) != "int8":
+            continue
+        outv = eqn.outvars[0]
+        if np.dtype(outv.aval.dtype).kind != "f":
+            continue
+        dots = [e for e in cons.get(id(outv), ())
+                if e.primitive.name == "dot_general"]
+        if not dots:
+            continue
+        n_ops += 1
+        sid = eqn_site_id(dots[0])
+        if sid not in sites:
+            sites.append(sid)
+        out_b = max([_nbytes(v.aval) for v in dots[0].outvars] or [0])
+        glue_bytes += _nbytes(outv.aval) + 2 * out_b
+        big_out = max(big_out, out_b)
+    if n_ops:
+        fused = big_out + (64 << 10)
+        if glue_bytes >= _PTCS004_FLOOR \
+                and glue_bytes > _PTCS004_RATIO * fused:
+            found.append({"kind": "dequant_matmul",
+                          "glue_bytes": glue_bytes,
+                          "fused_bytes": fused, "n_ops": n_ops,
+                          "ratio": glue_bytes / fused, "sites": sites})
+    return found
+
+
+def fusion_candidates(target, recurse=True):
+    """Every PTCS004 fusion candidate in ``target`` (a ``Jaxpr`` or
+    ``ClosedJaxpr``), all kinds pooled: ``moe_dispatch`` (gate→dispatch
+    glue), ``paged_attention`` (dense paged-KV gathers),
+    ``dequant_matmul`` (int8 dequant feeding a matmul). Each record is
+    ``{kind, glue_bytes, fused_bytes, n_ops, ratio, sites}``; byte-sum
+    descending (the heuristic ranking —
+    :func:`ranked_fusion_candidates` upgrades to measured glue cost).
+    ``recurse=False`` stays at this jaxpr level (the rewrite engine
+    plans level by level)."""
+    jaxpr = getattr(target, "jaxpr", target)
+    found: list = []
+    _moe_fusion_opportunities(jaxpr, found, recurse=recurse)
+    _paged_gather_opportunities(jaxpr, found, recurse=recurse)
+    _dequant_matmul_opportunities(jaxpr, found, recurse=recurse)
+    found.sort(key=lambda c: -c["glue_bytes"])
+    return found
+
+
+def _env_attribution():
+    """The op-attribution doc ``PADDLE_OP_ATTRIBUTION`` points at (a
+    path to an ``op_attribution`` JSON), or None."""
+    import json
+    import os
+    path = os.environ.get("PADDLE_OP_ATTRIBUTION")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") == "op_attribution":
+            return doc
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def ranked_fusion_candidates(target, attribution=None, recurse=True):
+    """:func:`fusion_candidates`, ranked the way the auto-fusion rewrite
+    should consume them: byte-count heuristics by default, upgraded to
+    MEASURED glue cost (``attach_glue_cost``'s ``measured_glue_ms``,
+    summed over each candidate's recorded sites) whenever an op
+    attribution is present — passed in, or found via
+    ``PADDLE_OP_ATTRIBUTION``. Chains that measurably burn wall-clock
+    time sort first; byte-heavy-but-cheap chains stop jumping the
+    queue."""
+    cands = fusion_candidates(target, recurse=recurse)
+    if attribution is None:
+        attribution = _env_attribution()
+    if attribution is None or not cands:
+        return cands
+    try:
+        from ...observability import opprof
+        attr = opprof.OpAttribution.from_dict(attribution) \
+            if isinstance(attribution, dict) else attribution
+        return opprof.attach_glue_cost(cands, attr)
+    except Exception:
+        return cands
+
+
+# ---------------------------------------------------------------------------
+# PTCS005: auto-fused kernels (the rewritten form of a PTCS004 chain)
+# ---------------------------------------------------------------------------
+
+# pallas_call names the auto-fusion rewrite templates stamp; programs
+# containing them are the REWRITTEN form — PTCS004 goes quiet (the
+# pallas_call skip above) and PTCS005 says which rule fired
+_AUTOFUSE_KERNELS = {
+    "autofuse_ragged_prefill": "ragged_prefill",
+    "autofuse_int8_matmul": "int8_dequant_matmul",
+    "autofuse_moe_gate_dispatch": "moe_gate_dispatch",
+}
+
+
+def _pallas_call_name(eqn) -> str:
+    info = eqn.params.get("name_and_src_info")
+    if info is not None:
+        return str(info).split(" ")[0]
+    return str(eqn.params.get("name") or "")
+
+
+def autofused_sites(target, _found=None):
+    """``[(site_id, rule, kernel_name), ...]`` for every auto-fusion
+    template ``pallas_call`` in ``target`` — the PTCS005 join key."""
+    jaxpr = getattr(target, "jaxpr", target)
+    found = [] if _found is None else _found
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            name = _pallas_call_name(eqn)
+            rule = _AUTOFUSE_KERNELS.get(name)
+            if rule is not None:
+                found.append((eqn_site_id(eqn), rule, name))
+            continue  # kernel bodies are opaque
+        for sub in _sub_jaxprs(eqn.params):
+            autofused_sites(sub, found)
     return found
 
 
@@ -850,18 +1058,60 @@ def cost_pass(ctx):
             f"elementwise chains, grow the batch, or store in bf16",
             extra={"cost": s.as_dict()}))
     if ctx.jaxpr is not None:
-        for opp in _moe_fusion_opportunities(ctx.jaxpr.jaxpr):
+        _KIND_MSG = {
+            "moe_dispatch": (
+                "an unfused gate→dispatch chain (top-k routing + {n} "
+                "materialized gather/scatter/cumsum ops)",
+                "tokens in + expert buffers out",
+                "kernels.moe_dispatch.fused_moe_dispatch / "
+                "MoELayer(fused_dispatch=True) is the fused path"),
+            "paged_attention": (
+                "a dense paged-KV gather ({n} whole-page gather(s) "
+                "materializing the page pool per step)",
+                "touched pages streamed via scalar prefetch",
+                "kernels.paged_attention.ragged_prefill_attention is "
+                "the fused path"),
+            "dequant_matmul": (
+                "an unfused int8 dequant-matmul ({n} "
+                "convert(int8)→dot chain(s) materializing the "
+                "dequantized weight)",
+                "int8 weight in + scaled result out",
+                "kernels.int8_matmul.int8_matmul is the fused path"),
+        }
+        for opp in ranked_fusion_candidates(ctx.jaxpr.jaxpr):
+            what, fused_what, fix = _KIND_MSG[opp["kind"]]
+            measured = opp.get("measured_glue_ms")
+            rank_note = (f" (measured glue: {measured:.3f} ms — ranked "
+                         f"by attributed wall-clock)"
+                         if measured is not None else "")
             out.append(Diagnostic(
                 "PTCS004", "cost", "info",
-                f"fusion opportunity: an unfused gate→dispatch chain "
-                f"(top-k routing + {opp['n_ops']} materialized "
-                f"gather/scatter/cumsum ops) streams "
-                f"{opp['glue_bytes'] / 2 ** 20:.1f} MiB of HBM glue — "
-                f"{opp['ratio']:.1f}x what a fused dispatch kernel "
-                f"would move (~{opp['fused_bytes'] / 2 ** 20:.1f} MiB: "
-                f"tokens in + expert buffers out). "
-                f"kernels.moe_dispatch.fused_moe_dispatch / "
-                f"MoELayer(fused_dispatch=True) is the fused path",
+                f"fusion opportunity: {what.format(n=opp['n_ops'])} "
+                f"streams {opp['glue_bytes'] / 2 ** 20:.1f} MiB of HBM "
+                f"glue — {opp['ratio']:.1f}x what a fused kernel would "
+                f"move (~{opp['fused_bytes'] / 2 ** 20:.1f} MiB: "
+                f"{fused_what}){rank_note}. {fix}; the "
+                f"analysis.rewrite auto-fusion pass applies it "
+                f"automatically",
                 extra={"fusion": {k: round(v, 1) if isinstance(v, float)
                                   else v for k, v in opp.items()}}))
+        for site, rule, kernel in autofused_sites(ctx.jaxpr.jaxpr):
+            delta = None
+            try:
+                from ..rewrite import fired_delta
+                delta = fired_delta(rule)
+            except Exception:
+                pass
+            dtxt = (f"predicted Δstep {delta:+.3f} ms vs the unfused "
+                    f"chain" if isinstance(delta, (int, float))
+                    else "predicted Δstep not recorded in this process")
+            out.append(Diagnostic(
+                "PTCS005", "cost", "info",
+                f"auto-fused: rule '{rule}' rewrote this program's "
+                f"glue chain into the {kernel} Pallas kernel at {site} "
+                f"({dtxt}); the fused form is what the walk priced — "
+                f"PADDLE_NO_AUTOFUSE=1 restores the unfused program",
+                extra={"autofusion": {"site": site, "rule": rule,
+                                      "kernel": kernel,
+                                      "predicted_delta_ms": delta}}))
     return out

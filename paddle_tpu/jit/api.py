@@ -40,6 +40,22 @@ def _bind_values(tensors, values):
             t._value = s
 
 
+def _maybe_autofuse(core, pyfunc):
+    """Rewrite-then-compile: wrap the traced core in the auto-fusion
+    pattern-match pass (``analysis.rewrite``) before ``jax.jit`` sees
+    it, so captured programs compile the same fused form the serving
+    engines do. The wrapper preserves positional structure (outer
+    ``static_argnums`` keep their meaning) and falls back to the
+    unfused core whenever nothing matches, interpret-mode parity
+    fails, or ``PADDLE_NO_AUTOFUSE`` / ``PADDLE_AUTOFUSE_SUPPRESS``
+    opt out."""
+    from ..analysis import rewrite as _rewrite
+    if not _rewrite.autofuse_enabled():
+        return core
+    label = f"jit.{getattr(pyfunc, '__name__', None) or 'program'}"
+    return _rewrite.autofuse(core, label=label)
+
+
 def functional_call(layer: Layer, params_and_buffers: dict, *args, **kwargs):
     """Run `layer` with parameter/buffer values taken from a pytree — the bridge
     from the stateful Layer API to jax's functional world (pjit, grad, shard_map)."""
@@ -92,7 +108,7 @@ class TracedProgram:
 
     def __call__(self, *args):
         if self._compiled_core is None:
-            core = self._build_core()
+            core = _maybe_autofuse(self._build_core(), self._pyfunc)
             # params are diff inputs; buffers/args ride through has_aux as needed
             self._jitted = jax.jit(core, static_argnums=(3,))
             self._compiled_core = core

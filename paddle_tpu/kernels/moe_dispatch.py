@@ -54,6 +54,7 @@ dims padded to the 128-lane width inside the wrapper.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 
@@ -70,6 +71,24 @@ __all__ = ["fused_moe_dispatch", "fused_moe_combine",
 _LANE = 128
 _NEG_INF = -1e30
 GATE_KINDS = ("naive", "switch", "gshard", "renorm")
+
+# kernel-name override: the auto-fusion rewrite (analysis.rewrite) tags
+# the dispatch ``pallas_call`` it instantiates ("autofuse_..."), so the
+# cost pass can tell a rewritten program (PTCS005) from the hand-wired
+# ``MoELayer(fused_dispatch=True)`` path, which stays unnamed
+_PALLAS_NAME = None
+
+
+@contextlib.contextmanager
+def pallas_kernel_name(name):
+    """Name the dispatch ``pallas_call``s traced inside this context."""
+    global _PALLAS_NAME
+    prev = _PALLAS_NAME
+    _PALLAS_NAME = name
+    try:
+        yield
+    finally:
+        _PALLAS_NAME = prev
 
 # CompilerParams is the jax>=0.6 name; 0.4.x calls it TPUCompilerParams
 _CP = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
@@ -332,6 +351,7 @@ def _dispatch_pallas(x, gate_w, gate_b, num_expert, capacity, top_k,
         compiler_params=_CP(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interp,
+        name=_PALLAS_NAME,
     )(xp, gwp, gbp)
     expert_in = out.reshape(E, C, M_pad)[:, :, :M]
     return (expert_in, comb[:S], val[:S],

@@ -188,6 +188,130 @@ def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens,
     return out.reshape(B, nh, -1)[..., :d]
 
 
+def _prefill_kernel(pt_ref, off_ref, q_ref, k_ref, v_ref, o_ref,
+                    m_scr, l_scr, acc_scr, *, page_size, scale):
+    """One (sequence b, head h, page block j) step of the ragged chunk
+    prefill: a whole C-row chunk attends one paged KV block per step,
+    online-softmax state in VMEM scratch, the causal rule applied with
+    the TRACED chunk offset (row ``off + i`` sees cols ``<= off + i``)."""
+    j = pl.program_id(2)
+    npg = pl.num_programs(2)
+    off = off_ref[0]
+    C = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # ragged early-out: page blocks wholly past the last chunk row's
+    # position (col_start > off + C - 1) are fully masked — skip them
+    run = j * np.int32(page_size) <= off + np.int32(C - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, :, 0, :]          # [C, d]
+        k = k_ref[0][:, 0, :]          # [page_size, d]
+        v = v_ref[0][:, 0, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)       # [C, page_size]
+        row = off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = j * np.int32(page_size) + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(col <= row, s, jnp.float32(_NEG_INF))
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = corr * acc_scr[:] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == npg - 1)
+    def _():
+        # col 0 is always <= every row (off >= 0), so l > 0 for real
+        # rows; padded chunk rows still produce finite garbage
+        l = jnp.maximum(l_scr[:], jnp.float32(1e-30))
+        o_ref[0, :, 0, :] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@_no_x64
+def ragged_prefill_attention(q, k_pages, v_pages, page_table, q_offset,
+                             scale=None, interpret=None):
+    """True ragged Pallas chunk-prefill attention over a paged KV cache.
+
+    Drop-in fused form of :func:`paged_prefill_attention` (same
+    signature, same numerics): instead of the dense page gather
+    (``k_pages[page_table]`` materializes every sequence's KV twice),
+    the page table rides :class:`pltpu.PrefetchScalarGridSpec` scalar
+    prefetch — exactly the decode kernel's scheme — and each grid step
+    DMAs one page into VMEM while online-softmax state (m/l/acc per
+    chunk row) lives in scratch. The causal rule uses the **traced**
+    ``q_offset``, so one compiled program covers every chunk position.
+
+    This is the target template of the ``ragged_prefill`` auto-fusion
+    rewrite rule (:mod:`paddle_tpu.analysis.rewrite`); the
+    ``pallas_call`` is named ``autofuse_ragged_prefill`` so the cost
+    pass recognizes rewritten programs (PTCS005). MQA/GQA grouping is
+    not supported here (``num_heads`` must equal ``num_kv_heads``).
+    """
+    B, C, nh, d = q.shape
+    _, ps, nkv, _ = k_pages.shape
+    if nh != nkv:
+        raise ValueError(f"ragged_prefill_attention needs num_heads "
+                         f"({nh}) == num_kv_heads ({nkv})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret()
+    Cp, dp = C, d
+    if not interpret:
+        # Mosaic tiling: chunk rows to the 8-sublane multiple, head dim
+        # to the 128-lane width; interpret mode skips both pads
+        Cp = -(-C // 8) * 8
+        dp = max(d, _LANE)
+        if dp != d:
+            k_pages = jnp.pad(k_pages, [(0, 0), (0, 0), (0, 0),
+                                        (0, dp - d)])
+            v_pages = jnp.pad(v_pages, [(0, 0), (0, 0), (0, 0),
+                                        (0, dp - d)])
+        if (Cp, dp) != (C, d):
+            q = jnp.pad(q, [(0, 0), (0, Cp - C), (0, 0), (0, dp - d)])
+    npt = page_table.shape[1]
+    off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, nh, npt),
+        in_specs=[
+            pl.BlockSpec((1, Cp, 1, dp),
+                         lambda b, h, j, pt, off: (b, 0, h, 0)),
+            pl.BlockSpec((1, ps, 1, dp),
+                         lambda b, h, j, pt, off: (pt[b, j], 0, h, 0)),
+            pl.BlockSpec((1, ps, 1, dp),
+                         lambda b, h, j, pt, off: (pt[b, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Cp, 1, dp),
+                               lambda b, h, j, pt, off: (b, 0, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Cp, 1), jnp.float32),
+            pltpu.VMEM((Cp, 1), jnp.float32),
+            pltpu.VMEM((Cp, dp), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, page_size=ps,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Cp, nh, dp), q.dtype),
+        compiler_params=_ARB3,
+        interpret=interpret,
+        name="autofuse_ragged_prefill",
+    )(page_table.astype(jnp.int32), off, q, k_pages, v_pages)
+    return out[:, :C, :, :d]
+
+
 def paged_prefill_attention(q, k_pages, v_pages, page_table, q_offset,
                             scale=None):
     """Chunk/suffix prefill attention over a paged KV cache (XLA path).

@@ -176,6 +176,33 @@ def load_serving_predicted(source) -> dict | None:
                            _SERVING_PREDICTED_BASENAMES)
 
 
+_AUTOFUSION_BASENAMES = ("autofusion.json",)
+
+
+def _normalize_autofusion(row) -> dict | None:
+    """An auto-fusion record export
+    (:func:`paddle_tpu.analysis.rewrite.export_records` output):
+    ``{"records": [{site, rule, status, predicted_delta_ms, ...}]}``."""
+    if not isinstance(row, dict):
+        return None
+    recs = row.get("records")
+    if not isinstance(recs, list):
+        return None
+    keep = [r for r in recs if isinstance(r, dict) and "status" in r]
+    return {"records": keep} if keep else None
+
+
+def load_autofusion(source) -> dict | None:
+    """Auto-fusion match records from: a dict, a JSON file, or a run
+    dir containing ``autofusion.json`` (the artifact
+    ``analysis.rewrite.export_records`` writes). A bare list of record
+    dicts is accepted too."""
+    if isinstance(source, list):
+        source = {"records": source}
+    return _load_first_row(source, _normalize_autofusion,
+                           _AUTOFUSION_BASENAMES)
+
+
 # ---------------------------------------------------------------------------
 # gap attribution
 # ---------------------------------------------------------------------------
@@ -431,7 +458,8 @@ _SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
 def collect_findings(summary: dict, attribution: dict | None = None,
                      flight_dumps=(),
                      serving_attribution: dict | None = None,
-                     op_attribution: dict | None = None) -> list[dict]:
+                     op_attribution: dict | None = None,
+                     autofusion: dict | None = None) -> list[dict]:
     """Ranked ``{severity, kind, detail}`` findings from the summary."""
     out = []
 
@@ -525,6 +553,58 @@ def collect_findings(summary: dict, attribution: dict | None = None,
                 f"{len(top_c.get('sites') or ())} glue site(s), "
                 f"{float(top_c.get('glue_bytes') or 0) / 2 ** 20:.1f} MiB "
                 f"streamed — ranked input for auto-fusion")
+
+    # ------------------------------------------------------- auto-fusion
+    af_recs = (autofusion or {}).get("records") or []
+    if af_recs:
+        fired = [r for r in af_recs if r.get("status") == "fired"]
+        if fired:
+            total = sum(float(r.get("predicted_delta_ms") or 0.0)
+                        for r in fired)
+            rules = sorted({str(r.get("rule")) for r in fired})
+            add("info", "autofusion_fired",
+                f"auto-fusion replaced {len(fired)} chain(s) with Pallas "
+                f"kernels ({', '.join(rules)}); predicted "
+                f"{total:.3f} ms/step saved in total")
+        # per-site fused-vs-unfused: the rewrite's predicted saving vs
+        # the glue cost the op profiler measured for the same chain kind
+        measured_glue = {}
+        for c in (op_attribution or {}).get("fusion_candidates") or ():
+            if c.get("measured_glue_ms") is not None:
+                measured_glue.setdefault(str(c.get("kind")),
+                                         float(c["measured_glue_ms"]))
+        for r in fired:
+            delta = r.get("predicted_delta_ms")
+            line = f"{r.get('site')}: rule {r.get('rule')} fused"
+            if delta is not None:
+                line += f", predicted -{float(delta):.3f} ms/step"
+            glue = measured_glue.get(str(r.get("kind"))) \
+                or measured_glue.get(str(r.get("rule")))
+            if glue is not None:
+                line += (f"; profiler measured {glue} ms/step of glue "
+                         f"on the unfused chain")
+            add("info", "autofusion_site", line)
+        failed = [r for r in af_recs if r.get("status") == "parity_failed"]
+        if failed:
+            add("warn", "autofusion_parity",
+                f"{len(failed)} rewrite(s) failed interpret-mode parity "
+                f"and were left unfused: " + ", ".join(sorted(
+                    {str(r.get("site")) for r in failed})))
+        errs = [r for r in af_recs if r.get("status") == "error"]
+        if errs:
+            add("warn", "autofusion_error",
+                f"auto-fusion plan building errored on {len(errs)} "
+                f"program(s) (rewrite skipped, original compiled): "
+                + ", ".join(sorted({str(r.get("label") or r.get("site"))
+                                    for r in errs})))
+        unmatched = sorted({str(r.get("site")) for r in af_recs
+                            if r.get("status") == "unmatched"})
+        if unmatched:
+            add("info", "autofusion_unmatched",
+                f"{len(unmatched)} PTCS004 chain(s) matched no rewrite "
+                f"rule — candidates for a new rule in "
+                f"analysis.rewrite: " + ", ".join(unmatched[:4])
+                + ("..." if len(unmatched) > 4 else ""))
 
     # ----------------------------------------------------------- serving
     sv = summary.get("serving") or {}
@@ -680,6 +760,7 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
     serving_attribution = attribute_serving_gap(summary, serving_predicted)
     op_attribution = load_attribution(pred_source) \
         or load_attribution(run_dir)
+    autofusion = load_autofusion(pred_source) or load_autofusion(run_dir)
     if serving_attribution:
         sub = decode_subfamilies(serving_attribution, op_attribution,
                                  serving_predicted)
@@ -688,7 +769,8 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
     dumps = sorted(glob.glob(os.path.join(run_dir, "flight.rank*.json")))
     findings = collect_findings(summary, attribution, flight_dumps=dumps,
                                 serving_attribution=serving_attribution,
-                                op_attribution=op_attribution)
+                                op_attribution=op_attribution,
+                                autofusion=autofusion)
     crit = [f for f in findings if f["severity"] == "crit"]
     if crit:
         verdict = crit[0]["detail"].split(" — ")[0]
@@ -723,6 +805,7 @@ def diagnose_run_dir(run_dir: str, predicted=None, chip=None,
         "attribution": attribution,
         "serving_attribution": serving_attribution,
         "op_attribution": op_attribution,
+        "autofusion": autofusion,
         "findings": findings,
         "flight_dumps": dumps,
         "summary": summary,

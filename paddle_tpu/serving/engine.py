@@ -340,7 +340,7 @@ class ServingEngine:
                  use_flash=None, use_kernel=True, aot=True, quantize=None,
                  prefill_chunk=None, prefix_cache=False,
                  disaggregated=False, prefill_devices=None,
-                 decode_devices=None):
+                 decode_devices=None, autofuse=None):
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.cfg: GPTConfig = config or gpt.config
         cfg = self.cfg
@@ -432,12 +432,22 @@ class ServingEngine:
         donate = jax.default_backend() != "cpu"
         eps = cfg.layer_norm_epsilon
         cdt = str(np.dtype(self.compute_dtype))
+        # auto-fusion: rewrite the decode/chunk programs before jit so
+        # PTCS004 glue chains (int8 dequant matmuls, the chunk program's
+        # dense page gather) compile as Pallas kernels; None defers to
+        # the PADDLE_NO_AUTOFUSE env gate
+        from ..analysis import rewrite as _rewrite
+        self.autofuse = (_rewrite.autofuse_enabled() if autofuse is None
+                         else bool(autofuse))
+        _fuse = ((lambda fn, label: _rewrite.autofuse(fn, label=label))
+                 if self.autofuse else (lambda fn, label: fn))
         self._decode_jit = jax.jit(
-            functools.partial(decode_step_fn, eps=eps,
-                              temperature=self.temperature,
-                              top_k=self.top_k,
-                              use_kernel=self.use_kernel,
-                              compute_dtype=cdt),
+            _fuse(functools.partial(decode_step_fn, eps=eps,
+                                    temperature=self.temperature,
+                                    top_k=self.top_k,
+                                    use_kernel=self.use_kernel,
+                                    compute_dtype=cdt),
+                  "serving.decode_step"),
             donate_argnums=(1, 2) if donate else ())
         self._prefill_jit = {
             sb: jax.jit(
@@ -453,9 +463,10 @@ class ServingEngine:
         # so every chunk of every prompt (and every cached-prefix
         # suffix) reuses the same executable
         self._chunk_jit = jax.jit(
-            functools.partial(chunk_prefill_fn, eps=eps,
-                              temperature=self.temperature,
-                              top_k=self.top_k, compute_dtype=cdt),
+            _fuse(functools.partial(chunk_prefill_fn, eps=eps,
+                                    temperature=self.temperature,
+                                    top_k=self.top_k, compute_dtype=cdt),
+                  "serving.chunk_prefill"),
             donate_argnums=(1, 2) if donate else ()) \
             if self.prefill_chunk is not None else None
         # COW boundary copy: one fixed-shape program per pool (donated
@@ -651,6 +662,7 @@ class ServingEngine:
         st = {
             "compute_dtype": str(np.dtype(self.compute_dtype)),
             "quantize": self.quantize,
+            "autofuse": self.autofuse,
             "weights_mb": round(self.weight_bytes() / 2 ** 20, 2),
             "decode_buckets": list(self.decode_buckets),
             "prefill_buckets": list(self.prefill_buckets),

@@ -221,7 +221,7 @@ class MoEServingEngine:
                  page_size=16, num_pages=None, max_seq_len=None,
                  decode_buckets=(1, 2, 4, 8), prefill_buckets=None,
                  temperature=0.0, top_k=0, seed=0, use_kernel=True,
-                 use_fused_moe=True, aot=True):
+                 use_fused_moe=True, aot=True, autofuse=None):
         if not isinstance(model, ErnieMoeForPretraining):
             raise TypeError("MoEServingEngine needs ErnieMoeForPretraining")
         self.cfg = config or model.ernie.config
@@ -259,20 +259,33 @@ class MoEServingEngine:
         self._last_token: dict = {}
         donate = jax.default_backend() != "cpu"
         eps = cfg.layer_norm_eps
+        # auto-fusion: rewrite the decode/prefill programs before jit —
+        # with use_fused_moe=False the gate+dispatch glue matches the
+        # moe_gate_dispatch rule and compiles as the fused Pallas kernel
+        # anyway (the rule identifies the gate variant by structure); the
+        # already-fused program has no dense [E,C,M] glue, so the rule
+        # leaves it alone
+        from ..analysis import rewrite as _rewrite
+        self.autofuse = (_rewrite.autofuse_enabled() if autofuse is None
+                         else bool(autofuse))
+        _fuse = ((lambda fn, label: _rewrite.autofuse(fn, label=label))
+                 if self.autofuse else (lambda fn, label: fn))
         self._decode_jit = jax.jit(
-            functools.partial(moe_decode_step_fn, kinds=self.kinds,
-                              eps=eps, top_k=self.moe_top_k,
-                              temperature=self.temperature,
-                              topk_sample=self.top_k,
-                              use_kernel=self.use_kernel,
-                              use_fused_moe=self.use_fused_moe),
+            _fuse(functools.partial(moe_decode_step_fn, kinds=self.kinds,
+                                    eps=eps, top_k=self.moe_top_k,
+                                    temperature=self.temperature,
+                                    topk_sample=self.top_k,
+                                    use_kernel=self.use_kernel,
+                                    use_fused_moe=self.use_fused_moe),
+                  "serving.moe_decode_step"),
             donate_argnums=(1, 2) if donate else ())
         self._prefill_jit = jax.jit(
-            functools.partial(moe_prefill_fn, kinds=self.kinds, eps=eps,
-                              top_k=self.moe_top_k,
-                              temperature=self.temperature,
-                              topk_sample=self.top_k,
-                              use_fused_moe=self.use_fused_moe),
+            _fuse(functools.partial(moe_prefill_fn, kinds=self.kinds,
+                                    eps=eps, top_k=self.moe_top_k,
+                                    temperature=self.temperature,
+                                    topk_sample=self.top_k,
+                                    use_fused_moe=self.use_fused_moe),
+                  "serving.moe_prefill"),
             donate_argnums=(1, 2) if donate else ())
         self._decode_exe: dict = {}
         self._prefill_exe: dict = {}
@@ -341,6 +354,7 @@ class MoEServingEngine:
             "moe_top_k": self.moe_top_k,
             "moe_layers": sum(1 for k in self.kinds if k == "moe"),
             "fused_moe_dispatch": self.use_fused_moe,
+            "autofuse": self.autofuse,
             "weights_mb": round(self.weight_bytes() / 2 ** 20, 2),
             "decode_buckets": list(self.decode_buckets),
             "prefill_buckets": list(self.prefill_buckets),

@@ -703,6 +703,73 @@ def predicted_fused_dispatch_row(tokens: int = 8192, d_model: int = 1024,
     }
 
 
+def predicted_autofusion_row(export_path: str | None = None) -> dict:
+    """``autofusion_predicted``: per-site predicted Δstep-ms of every
+    auto-fusion rewrite that fires on the tiny serving engines' REAL
+    traced programs — :mod:`paddle_tpu.analysis.rewrite` over the GPT
+    int8 chunked-prefill engine (``ragged_prefill`` +
+    ``int8_dequant_matmul``) and the unfused ERNIE-MoE engine
+    (``moe_gate_dispatch``). Trace + interpret-mode parity only, so a
+    TPU-less round still carries the anchor; future measured fused rows
+    anchor on these per-rule predictions via bench_compare.
+    ``export_path`` additionally writes the raw match records
+    (``autofusion.json``) for the perf doctor."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..analysis import rewrite
+    from ..models import (ErnieMoeForPretraining, ErnieMoeModel,
+                          ernie_moe_tiny_config)
+    from ..models.gpt import GPTForPretraining, GPTModel, gpt_tiny_config
+    from .engine import ServingEngine
+    from .moe_engine import MoEServingEngine
+
+    rewrite.reset_records()
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+
+    cfg = gpt_tiny_config()
+    eng = ServingEngine(GPTForPretraining(GPTModel(cfg)), cfg,
+                        page_size=8, decode_buckets=(1, 2), aot=False,
+                        prefill_chunk=16, quantize="int8", autofuse=True)
+    eng.prefill("a", rng.integers(0, cfg.vocab_size,
+                                  (23,)).astype(np.int32))
+    eng.pool.extend("a")
+    eng.decode(["a"])
+
+    mcfg = ernie_moe_tiny_config(
+        num_hidden_layers=2, hidden_size=32, num_attention_heads=2,
+        intermediate_size=64, num_experts=4, capacity_factor=100.0,
+        max_position_embeddings=64)
+    mm = ErnieMoeForPretraining(ErnieMoeModel(mcfg))
+    mm.eval()
+    moe = MoEServingEngine(mm, mcfg, page_size=8, decode_buckets=(1,),
+                           aot=False, use_fused_moe=False, autofuse=True)
+    moe.prefill("s", rng.integers(0, mcfg.vocab_size,
+                                  (11,)).astype(np.int32))
+    moe.pool.extend("s")
+    moe.decode(["s"])
+
+    sites = [{"label": r.get("label"), "site": r.get("site"),
+              "rule": r.get("rule"),
+              "predicted_delta_ms": r.get("predicted_delta_ms")}
+             for r in rewrite.fired_records()]
+    per_rule: dict = {}
+    for s in sites:
+        per_rule[s["rule"]] = round(
+            per_rule.get(s["rule"], 0.0)
+            + float(s["predicted_delta_ms"] or 0.0), 6)
+    if export_path:
+        rewrite.export_records(export_path)
+    return {
+        "n_fired": len(sites),
+        "rules_fired": sorted(per_rule),
+        "sites": sites,
+        "per_rule_delta_ms": per_rule,
+        "predicted_total_delta_ms": round(sum(per_rule.values()), 6),
+        "programs": sorted({s["label"] for s in sites}),
+    }
+
+
 def _main(argv=None):
     import os
     import subprocess
@@ -719,7 +786,8 @@ def _main(argv=None):
                          "(serving engine quantize='int8')")
     ap.add_argument("--mode", default="decode",
                     choices=["decode", "shared_prefix", "disagg", "moe",
-                             "fused_dispatch", "fleet", "migration"],
+                             "fused_dispatch", "fleet", "migration",
+                             "autofusion"],
                     help="decode = classic serving_predicted row; "
                          "shared_prefix = prefix-cache goodput/TTFT "
                          "anchor; disagg = disaggregated prefill/"
@@ -732,7 +800,13 @@ def _main(argv=None):
                          "hit-rate-split TTFT); migration = live "
                          "KV-page migration anchor (payload over the "
                          "interconnect roofline + resume cost vs "
-                         "full-prompt replay)")
+                         "full-prompt replay); autofusion = per-site "
+                         "predicted Δstep-ms of the jaxpr auto-fusion "
+                         "rewrites over the tiny engines' programs")
+    ap.add_argument("--export-records", default=None, metavar="PATH",
+                    help="autofusion mode: also write the raw match "
+                         "records (autofusion.json) to PATH for the "
+                         "perf doctor")
     ap.add_argument("--prompt-len", type=int, default=1024)
     ap.add_argument("--shared-fraction", type=float, default=0.75)
     ap.add_argument("--max-new", type=int, default=64)
@@ -763,6 +837,8 @@ def _main(argv=None):
                 args.concurrency, args.page_size, args.chip)
         elif args.mode == "fused_dispatch":
             row = predicted_fused_dispatch_row(chip=args.chip)
+        elif args.mode == "autofusion":
+            row = predicted_autofusion_row(args.export_records)
         elif args.mode == "fleet":
             row = predicted_fleet_row(
                 args.config, args.replicas, args.n_requests,

@@ -70,6 +70,8 @@ def test_bench_skip_lines_when_no_backend(monkeypatch, capsys):
                         lambda *a, **kw: None)
     monkeypatch.setattr(bench, "emit_collective_compression_predicted",
                         lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "emit_autofusion_predicted_rows",
+                        lambda *a, **kw: None)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     bench.main()
     out = capsys.readouterr().out
@@ -99,10 +101,16 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
     # the MoE rows trace the ERNIE-MoE base decode program — heavy
     # enough to time out under full-suite load; they must land as the
     # anchor OR an explicit *_ERROR row, never silently vanish
-    heavy = {"serving_moe_predicted", "moe_fused_dispatch_predicted"}
+    heavy = {"serving_moe_predicted", "moe_fused_dispatch_predicted",
+             "autofusion_predicted"}
+    # per-rule breakdown rows ride with the autofusion aggregate
+    autofusion_per_rule = {
+        f"autofusion_{r}_predicted"
+        for r in ("ragged_prefill", "int8_dequant_matmul",
+                  "moe_gate_dispatch")}
     metrics = {r["metric"] for r in predicted}
     assert required <= metrics
-    assert metrics <= required | heavy
+    assert metrics <= required | heavy | autofusion_per_rule
     all_metrics = {r["metric"] for r in recs}
     for m in heavy:
         assert m in all_metrics or f"{m}_ERROR" in all_metrics
@@ -121,6 +129,9 @@ def test_bench_no_backend_still_emits_predicted(monkeypatch, capsys):
             assert r["extras"]["predicted_peak_hbm_gb"] > 0
         elif r["metric"] == "moe_fused_dispatch_predicted":
             assert r["value"] > 1.0      # fused stage speedup
+        elif r["metric"].startswith("autofusion"):
+            assert r["value"] >= 0.0     # predicted Δstep-ms saving
+            assert r["extras"].get("calibration_id")
         elif r["metric"].startswith("serving"):
             assert r["extras"]["predicted_tokens_per_sec"] > 0
         else:

@@ -107,6 +107,15 @@ _ANCHOR_MAP = {
     "serving_fleet_migration": "serving_fleet_migration_predicted",
     "serving_fleet_migration_ms": "serving_fleet_migration_predicted",
     "collective_compression": "collective_compression_predicted",
+    # future measured auto-fusion rows (per-rule step-ms saved on TPU)
+    # anchor on the rewrite pass's predicted per-rule Δstep-ms rows
+    "autofusion": "autofusion_predicted",
+    "autofusion_ms_saved": "autofusion_predicted",
+    "autofusion_int8_dequant_matmul":
+        "autofusion_int8_dequant_matmul_predicted",
+    "autofusion_ragged_prefill": "autofusion_ragged_prefill_predicted",
+    "autofusion_moe_gate_dispatch":
+        "autofusion_moe_gate_dispatch_predicted",
     # a measured planner-config 13B run (TPU rounds) anchors on the
     # planner's own predicted row, not the hand-written config's
     "gpt_13b_planned_tokens_per_sec_per_chip": "gpt_13b_planned_predicted",

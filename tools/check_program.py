@@ -183,8 +183,18 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
                         aot=False)
     pool = eng.pool
     bucket = eng.decode_buckets[-1]
-    fn = functools.partial(decode_step_fn, eps=cfg.layer_norm_epsilon,
-                           temperature=0.0, top_k=0, use_kernel=False)
+    # lint the program the engine actually compiles: the engines wrap
+    # their step fns in the auto-fusion rewrite before jit, so the lint
+    # targets do too (a no-op when nothing matches or the env gate is
+    # off)
+    from paddle_tpu.analysis import rewrite
+    _fuse = (rewrite.autofuse if rewrite.autofuse_enabled()
+             else (lambda f, label=None: f))
+    fn = _fuse(functools.partial(decode_step_fn,
+                                 eps=cfg.layer_norm_epsilon,
+                                 temperature=0.0, top_k=0,
+                                 use_kernel=False),
+               label="serving.decode_step")
 
     def decode(kp, vp, tokens, positions, table, lens):
         # analyzer hands Tensor-wrapped tracers; the decode step is pure
@@ -281,8 +291,10 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
     # the only NEW serving-side program shape this engine family runs
     ceng = modes["chunked"][1]
     cpool = ceng.pool
-    cfn = functools.partial(chunk_prefill_fn, eps=cfg.layer_norm_epsilon,
-                            temperature=0.0, top_k=0)
+    cfn = _fuse(functools.partial(chunk_prefill_fn,
+                                  eps=cfg.layer_norm_epsilon,
+                                  temperature=0.0, top_k=0),
+                label="serving.chunk_prefill")
 
     def chunk_step(kp, vp, ids, off, clen, table, rows):
         a = [unwrap(t) for t in (kp, vp, ids, off, clen, table, rows)]
@@ -307,10 +319,11 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
     from paddle_tpu.serving.moe_engine import moe_decode_step_fn
     mpool = moe_eng.pool
     mbucket = moe_eng.decode_buckets[-1]
-    mfn = functools.partial(
+    mfn = _fuse(functools.partial(
         moe_decode_step_fn, kinds=moe_eng.kinds,
         eps=mcfg.layer_norm_eps, top_k=mcfg.top_k, temperature=0.0,
-        topk_sample=0, use_kernel=False, use_fused_moe=True)
+        topk_sample=0, use_kernel=False, use_fused_moe=True),
+        label="serving.moe_decode_step")
 
     def moe_decode(kp, vp, tokens, positions, table, lens):
         a = [unwrap(t) for t in (kp, vp, tokens, positions, table, lens)]
@@ -508,9 +521,85 @@ def lint_capture(world_size=None, hbm_budget_gb=None):
     return reports
 
 
+def lint_fusion(world_size=None, hbm_budget_gb=None):
+    """Auto-fusion gate, seeded both ways. A deliberately glue-heavy
+    unfused MoE gate+dispatch program (sizes over the PTCS004 floor) is
+    traced through the analyzer:
+
+    - rewrite ON (default): the auto-fusion pass must land — the lint
+      sees the REWRITTEN program, so PTCS004 must drop to zero and
+      PTCS005 must report the fused site (unless the site is explicitly
+      suppressed via PADDLE_AUTOFUSE_SUPPRESS);
+    - rewrite OFF (``--no-autofuse`` / PADDLE_NO_AUTOFUSE=1): the
+      pre-rewrite program must still carry >= 1 PTCS004 — the inventory
+      the rewrite consumes; losing it silently would blind the pass.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import ProgramAnalyzer
+    from paddle_tpu.analysis import rewrite
+    from paddle_tpu.analysis.core import Diagnostic, Report
+    from paddle_tpu.kernels.moe_dispatch import (reference_moe_combine,
+                                                 reference_moe_dispatch)
+    from paddle_tpu.ops._dispatch import unwrap
+
+    S, M, E, K = 4096, 512, 16, 2
+    C = int(1.2 * K * S / E)
+
+    def moe_glue(x, gw, gb, eo):
+        ei, comb, val, _, _ = reference_moe_dispatch(
+            x, gw, gb, num_expert=E, capacity=C, top_k=K,
+            gate_kind="renorm")
+        return ei, reference_moe_combine(eo, val, comb)
+
+    fused = rewrite.autofuse(moe_glue, label="fusion.moe_glue")
+
+    def entry(x, gw, gb, eo):
+        return fused(*(unwrap(t) for t in (x, gw, gb, eo)))
+
+    SDS = jax.ShapeDtypeStruct
+    rep = ProgramAnalyzer(
+        world_size=world_size, hbm_budget_gb=hbm_budget_gb).analyze(
+        entry, SDS((S, M), jnp.float32), SDS((M, E), jnp.float32),
+        SDS((E,), jnp.float32), SDS((E * C, M), jnp.float32),
+        name="fusion.moe_glue")
+    reports = [rep]
+    n004 = sum(1 for d in rep.diagnostics if d.code == "PTCS004")
+    n005 = sum(1 for d in rep.diagnostics if d.code == "PTCS005")
+    diags = []
+    if rewrite.autofuse_enabled():
+        suppressed = bool(rewrite.suppressed_sites())
+        if n004 and not suppressed:
+            diags.append(Diagnostic(
+                "PTCS004", "cost", "error",
+                f"auto-fusion is ON but the glue-heavy MoE probe still "
+                f"lints {n004} PTCS004 fusion opportunit"
+                f"{'y' if n004 == 1 else 'ies'} — the rewrite pass "
+                f"failed to consume its own inventory (match regression "
+                f"or parity reject)", op="fusion.moe_glue"))
+        if not n005 and not suppressed:
+            diags.append(Diagnostic(
+                "PTCS005", "cost", "error",
+                "auto-fusion is ON but the rewritten MoE probe carries "
+                "no PTCS005 annotation — either the rewrite did not "
+                "fire or the cost pass lost the fused-kernel join",
+                op="fusion.moe_glue"))
+    elif not n004:
+        diags.append(Diagnostic(
+            "PTCS004", "cost", "error",
+            "auto-fusion is OFF (--no-autofuse) but the pre-rewrite "
+            "glue-heavy MoE probe lints no PTCS004 — the fusion-"
+            "opportunity inventory the rewrite consumes went silent",
+            op="fusion.moe_glue"))
+    gate = Report("fusion.autofuse_gate", diags)
+    gate.emit()
+    reports.append(gate)
+    return reports
+
+
 MODELS = {"gpt": lint_gpt, "bert": lint_bert, "ernie_moe": lint_ernie_moe,
           "serving": lint_serving, "collectives": lint_collectives,
-          "capture": lint_capture}
+          "capture": lint_capture, "fusion": lint_fusion}
 
 
 def lint_model(name, world_size=None, hbm_budget_gb=None):
@@ -538,8 +627,15 @@ def main(argv=None):
     ap.add_argument("--errors-only", action="store_true",
                     help="exit 0 despite warnings (default: any "
                          "non-clean report fails, matching Report.clean)")
+    ap.add_argument("--no-autofuse", action="store_true",
+                    help="lint the PRE-rewrite programs (sets "
+                         "PADDLE_NO_AUTOFUSE=1): PTCS004 fusion "
+                         "opportunities stay visible instead of being "
+                         "consumed by the analysis.rewrite pass")
     args = ap.parse_args(argv)
     _force_platform()
+    if args.no_autofuse:
+        os.environ["PADDLE_NO_AUTOFUSE"] = "1"
 
     names = sorted(MODELS) if args.model == "all" else [args.model]
     reports = []
@@ -548,6 +644,26 @@ def main(argv=None):
                                   hbm_budget_gb=args.hbm_budget_gb or None))
 
     failed = False
+    # with the rewrite on, the zoo's whole PTCS004 inventory must be
+    # consumed (each chain either rewritten — flipping to PTCS005 — or
+    # explicitly suppressed); any survivor is a gate failure even
+    # though PTCS004 itself is only an info
+    from paddle_tpu.analysis import rewrite as _rewrite
+    if _rewrite.autofuse_enabled():
+        leftovers = []
+        for rep in reports:
+            for d in rep.diagnostics:
+                if d.code != "PTCS004" or d.severity == "error":
+                    continue
+                site = str((getattr(d, "extra", None) or {})
+                           .get("fusion", {}).get("site", ""))
+                if not _rewrite._is_suppressed(site):
+                    leftovers.append((rep.target_name, site))
+        if leftovers:
+            failed = True
+            print(f"FUSION GATE: {len(leftovers)} PTCS004 chain(s) "
+                  f"survived the auto-fusion rewrite: {leftovers}",
+                  flush=True)
     for rep in reports:
         # a failed trace checked nothing — always a gate failure, even
         # under --errors-only

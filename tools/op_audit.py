@@ -189,11 +189,60 @@ def audit(yaml_dir):
     return results
 
 
+def fusion_audit(timeout_s=600):
+    """``--fusion`` mode: every auto-fusion site in the zoo probe
+    programs (the tiny serving engines' traced programs, GPT int8 +
+    ERNIE-MoE) with its match status — fired / suppressed /
+    parity_failed / unmatched / error — and the predicted Δstep-ms per
+    fired rewrite. Sites come from ``analysis.rewrite``'s match
+    records: fired rows are PTCS005 rewrites, unmatched rows are the
+    PTCS004 chains no rule covers yet. Runs the probe in a CPU
+    subprocess (same respawn contract as ``serving.predict``); honors
+    ``PADDLE_NO_AUTOFUSE`` / ``PADDLE_AUTOFUSE_SUPPRESS`` so the
+    suppressed states are auditable too. Exit 0 always — an audit,
+    not a gate."""
+    import json
+    import subprocess
+    import tempfile
+
+    path = os.path.join(tempfile.mkdtemp(prefix="op_audit_fusion_"),
+                        "autofusion.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.serving.predict",
+         "--mode", "autofusion", "--export-records", path],
+        capture_output=True, text=True, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.exists(path):
+        print(f"fusion audit: probe failed (rc={r.returncode}): "
+              f"{r.stderr[-300:]}")
+        return []
+    with open(path) as f:
+        recs = json.load(f).get("records", [])
+    by_status = {}
+    print(f"{'status':<14} {'rule':<22} {'delta_ms':>10}  site (program)")
+    for rec in recs:
+        st = str(rec.get("status", "?"))
+        by_status[st] = by_status.get(st, 0) + 1
+        d = rec.get("predicted_delta_ms")
+        delta = f"{d:+.6f}" if isinstance(d, (int, float)) else "-"
+        print(f"{st:<14} {str(rec.get('rule') or '-'):<22} {delta:>10}  "
+              f"{rec.get('site')} ({rec.get('label')})")
+    print("totals: " + (", ".join(
+        f"{k}={v}" for k, v in sorted(by_status.items())) or "no sites"))
+    return recs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--yaml-dir",
                     default="/root/reference/paddle/phi/api/yaml")
+    ap.add_argument("--fusion", action="store_true",
+                    help="audit auto-fusion sites (PTCS004/PTCS005) in "
+                         "the zoo probe programs instead of op coverage")
     args = ap.parse_args()
+    if args.fusion:
+        fusion_audit()
+        return []
     results = audit(args.yaml_dir)
     all_missing = []
     for fname, rows in results.items():
