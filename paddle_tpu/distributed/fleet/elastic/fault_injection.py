@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import glob as glob_mod
 import os
+import random
 import signal
+import socket
 import threading
 import time
 
@@ -139,3 +141,207 @@ def resume_replica(router, replica_id):
     shed elsewhere finishes twice, and rid idempotency keeps the first
     terminal result. Returns the pid."""
     return router.kill_replica(replica_id, sig=signal.SIGCONT)
+
+
+# ---------------------------------------------------------------------------
+# chaos network proxy
+# ---------------------------------------------------------------------------
+
+# fault kinds a connection can draw, in the order probability knobs are
+# consulted (one seeded draw per knob per connection, enabled or not, so
+# the schedule is a pure function of (seed, accept order))
+CHAOS_FAULTS = ("drop", "delay", "duplicate", "truncate", "bitflip")
+
+
+class ChaosProxy:
+    """Seeded byte-level chaos on a TCP hop — the network-fault twin of
+    :class:`FaultInjector`'s process kills.
+
+    Listens on an ephemeral ``127.0.0.1`` port (``.addr``) and forwards
+    every accepted connection to ``upstream_addr``. Tests interpose it
+    on the fleet control plane by pointing a ``ReplicaHandle.rpc_addr``
+    at the proxy instead of the replica, so the router's newline-JSON
+    RPCs (submit / poll / checkpoint / migration chunks) cross a hostile
+    wire. Each connection draws ONE fault from a deterministic schedule:
+
+    - ``drop``      — accept, then close before forwarding anything
+      (the client sees a dead peer: connect succeeded, RPC did not)
+    - ``delay``     — sleep ``delay_s`` before forwarding the reply
+      (client-side timeout territory → hedged submit / breaker food)
+    - ``duplicate`` — forward the first reply chunk twice (a re-sent
+      response the line-oriented client must not double-apply)
+    - ``truncate``  — forward half the first reply chunk, then cut the
+      connection (torn JSON line at the client)
+    - ``bitflip``   — flip one bit mid-payload on the *request* path
+      (corrupted JSON or migration chunk — checksum territory)
+
+    Determinism: the schedule is a function of ``seed`` and accept
+    order only — an explicit ``schedule`` list (fault names, ``"ok"``
+    for faithful forwarding) is consumed first, then one seeded draw
+    per probability knob per connection. ``faults`` records
+    ``(conn_index, fault)`` in accept order; rerunning the same test
+    against the same seed replays the same fault sequence.
+    """
+
+    def __init__(self, upstream_addr, *, seed: int = 0, schedule=None,
+                 drop_p: float = 0.0, delay_p: float = 0.0,
+                 delay_s: float = 0.05, dup_p: float = 0.0,
+                 truncate_p: float = 0.0, bitflip_p: float = 0.0):
+        self.upstream = (str(upstream_addr[0]), int(upstream_addr[1]))
+        self.delay_s = float(delay_s)
+        self._rng = random.Random(int(seed))
+        self._schedule = list(schedule) if schedule is not None else None
+        self._probs = [("drop", float(drop_p)), ("delay", float(delay_p)),
+                       ("duplicate", float(dup_p)),
+                       ("truncate", float(truncate_p)),
+                       ("bitflip", float(bitflip_p))]
+        self._lock = threading.Lock()
+        self._conn_n = 0
+        self.faults: list = []      # (conn_index, fault) in accept order
+        self._closed = False
+        self._conns: list = []      # live (client, upstream) socket pairs
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.25)
+        self.addr = self._srv.getsockname()
+        self._acceptor = threading.Thread(
+            target=self._serve, name="chaos-proxy-accept", daemon=True)
+        self._acceptor.start()
+
+    # ------------------------------------------------------------ schedule
+    def _next_fault(self):
+        """Draw the next connection's fault (deterministic in accept
+        order; the rng consumes one draw per knob regardless of which
+        knobs are enabled, so schedules don't shift when a knob is
+        toggled off)."""
+        with self._lock:
+            n = self._conn_n
+            self._conn_n += 1
+            if self._schedule is not None and n < len(self._schedule):
+                fault = str(self._schedule[n])
+            else:
+                fault = "ok"
+                for name, p in self._probs:
+                    hit = self._rng.random() < p
+                    if hit and fault == "ok":
+                        fault = name
+            self.faults.append((n, fault))
+            return fault
+
+    def fault_counts(self) -> dict:
+        with self._lock:
+            out: dict = {}
+            for _, f in self.faults:
+                out[f] = out.get(f, 0) + 1
+            return out
+
+    # ------------------------------------------------------------- serving
+    def _serve(self):
+        while not self._closed:
+            try:
+                client, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            fault = self._next_fault()
+            if fault == "drop":
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(target=self._handle, args=(client, fault),
+                             name="chaos-proxy-conn", daemon=True).start()
+
+    def _handle(self, client, fault: str):
+        try:
+            up = socket.create_connection(self.upstream, timeout=10.0)
+        except OSError:
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self._conns.append((client, up))
+
+        def request_mut(data, i):
+            if fault == "bitflip" and i == 0 and data:
+                # one bit, mid-payload: past the JSON header bytes so it
+                # lands in the body (for a migration chunk, inside the
+                # checksummed base64 page data)
+                b = bytearray(data)
+                b[len(b) // 2] ^= 0x01
+                return [bytes(b)]
+            return [data]
+
+        def reply_mut(data, i):
+            if i == 0:
+                if fault == "delay":
+                    time.sleep(self.delay_s)
+                elif fault == "duplicate":
+                    return [data, data]
+                elif fault == "truncate":
+                    return [data[:max(1, len(data) // 2)], None]
+            return [data]
+
+        t = threading.Thread(target=self._pump, args=(client, up,
+                                                      request_mut),
+                             name="chaos-proxy-up", daemon=True)
+        t.start()
+        self._pump(up, client, reply_mut)
+        t.join(timeout=5.0)
+        with self._lock:
+            try:
+                self._conns.remove((client, up))
+            except ValueError:
+                pass
+
+    @staticmethod
+    def _pump(src, dst, mutate):
+        """Forward src→dst chunk-wise through ``mutate(data, i) ->
+        [bytes...]`` (a ``None`` element cuts the connection); closes
+        both directions on EOF/error so the peer never hangs."""
+        i = 0
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                for out in mutate(data, i):
+                    if out is None:
+                        return
+                    dst.sendall(out)
+                i += 1
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    # -------------------------------------------------------------- close
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for client, up in conns:
+            for s in (client, up):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._acceptor.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -366,8 +366,14 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
     # measured_ms itself is unchanged and the buckets still sum exactly
     migrate_b = float(sv.get("migrate_seconds_total") or 0.0) \
         / tokens * 1e3
+    # time requests spent under brownout/shedding accrues inside their
+    # wall seconds, so — like migration — degraded time is carved out
+    # of the decode residual: an overloaded run's slowness is attributed
+    # to the overload-control policy, not misread as "slow decode"
+    degraded_b = float(sv.get("degraded_seconds_total") or 0.0) \
+        / tokens * 1e3
     decode_b = (delta_ms - router_b - queue_b - prefill_b - compile_b
-                - migrate_b)
+                - migrate_b - degraded_b)
     buckets = {"queue": queue_b, "prefill": prefill_b,
                "compile": compile_b, "decode": decode_b}
     if router_b > 0:
@@ -376,6 +382,8 @@ def attribute_serving_gap(summary: dict, predicted: dict) -> dict | None:
         buckets["router_queue"] = router_b
     if migrate_b > 0:
         buckets["migration"] = migrate_b
+    if degraded_b > 0:
+        buckets["degraded"] = degraded_b
     out = {
         "measured_ms": round(measured_ms, 3),
         "predicted_ms": round(predicted_ms, 3),
@@ -628,6 +636,22 @@ def collect_findings(summary: dict, attribution: dict | None = None,
             sorted((sv.get("reject_reasons") or {}).items()))
         add("warn" if n_req and n_rej / n_req > 0.05 else "info",
             "rejected_requests", detail)
+    n_dl = int(sv.get("deadline_exceeded") or 0)
+    if n_dl:
+        wasted = int(sv.get("deadline_exceeded_tokens_total") or 0)
+        add("warn" if n_req and n_dl / n_req > 0.05 else "info",
+            "deadline_exceeded",
+            f"{n_dl} request(s) cancelled at their deadline with "
+            f"{wasted} token(s) of decode discarded — pages were "
+            f"reclaimed (lateness converted to capacity), but a "
+            f"sustained rate means arrival exceeds drain")
+    deg = float(sv.get("degraded_seconds_total") or 0.0)
+    if deg > 0:
+        add("info", "degraded_time",
+            f"{round(deg, 3)}s of request wall time ran under "
+            f"brownout/shedding (max_new_tokens clamped, cache-hit "
+            f"admission preferred) — the 'degraded' attribution "
+            f"bucket carries it")
     if serving_attribution and serving_attribution.get("fleet"):
         strag = serving_attribution["fleet"].get("straggler")
         if strag:
@@ -883,9 +907,15 @@ def format_report(report: dict, ops_top: int | None = None) -> str:
                     f"p99 {p['p99'] * scale:.2f}{unit}")
         lines.append(
             f"serving requests: {sv.get('finished', 0)} finished, "
-            f"{sv.get('rejected', 0)} rejected; "
+            f"{sv.get('rejected', 0)} rejected, "
+            f"{sv.get('deadline_exceeded', 0)} deadline-exceeded; "
             f"queue-wait {pcts('queue_wait_s')}; "
             f"ttft {pcts('ttft_s')}; per-token {pcts('per_token_s')}")
+        if sv.get("degraded_seconds_total"):
+            lines.append(
+                f"serving degraded: "
+                f"{sv['degraded_seconds_total']}s of request time under "
+                f"brownout/shedding")
         slo = sv.get("slo") or {}
         if slo:
             gf = slo.get("goodput_fraction")

@@ -410,6 +410,41 @@ def fleet_migrated_bytes_counter():
         "migration (uncached suffix only)")
 
 
+def serving_deadline_exceeded_counter():
+    return get_registry().counter(
+        "paddle_serving_deadline_exceeded_total",
+        "requests cancelled at tick because their deadline expired "
+        "(queued, prefilling, or mid-decode; pages reclaimed, prefix "
+        "cache still published)")
+
+
+def serving_overload_mode_gauge():
+    return get_registry().gauge(
+        "paddle_serving_overload_mode",
+        "overload-control mode (0 = healthy, 1 = brownout, 2 = "
+        "shedding), driven by SLO burn rates")
+
+
+def serving_degraded_seconds_counter():
+    return get_registry().counter(
+        "paddle_serving_degraded_seconds_total",
+        "wall-clock seconds spent serving in brownout or shedding mode")
+
+
+def fleet_breaker_events_counter():
+    return get_registry().counter(
+        "paddle_fleet_breaker_events_total",
+        "router circuit-breaker transitions per replica (open on "
+        "consecutive RPC failures, close on half-open probe success)")
+
+
+def fleet_hedged_submits_counter():
+    return get_registry().counter(
+        "paddle_fleet_hedged_submits_total",
+        "submits re-dispatched to the next-best affinity candidate "
+        "after the preferred replica timed out (idempotent by rid)")
+
+
 def record_predicted(step_ms=None, peak_hbm_mb=None, mfu=None,
                      target="step"):
     """Publish static-analysis predictions (cost/memory passes) as

@@ -167,6 +167,8 @@ def fold_request_records(records) -> dict | None:
         return None
     finished = [r for r in records if r.get("state") == "finished"]
     rejected = [r for r in records if r.get("state") == "rejected"]
+    deadline = [r for r in records
+                if r.get("state") == "deadline_exceeded"]
     reject_reasons: dict = {}
     for r in rejected:
         reason = str(r.get("reject_reason") or "?")
@@ -192,6 +194,21 @@ def fold_request_records(records) -> dict | None:
         "finished": len(finished),
         "rejected": sum(reject_reasons.values()),
         "reject_reasons": reject_reasons,
+        # overload control: deadline cancellations are their OWN
+        # terminal outcome (neither finished nor rejected), tokens they
+        # produced before cancellation are wasted work, and time any
+        # request spent under brownout/shedding is the doctor's
+        # "degraded" bucket input
+        "deadline_exceeded": len(deadline),
+        "deadline_exceeded_tokens_total": sum(
+            int(r.get("new_tokens") or 0) for r in deadline),
+        "degraded_seconds_total": round(sum(
+            float(r.get("degraded_s") or 0.0) for r in records), 6),
+        # backpressure hint distribution over priced rejects — the
+        # machine-readable retry_after_s the router handed back
+        "retry_after_s": _pcts(
+            [r["retry_after_s"] for r in rejected
+             if isinstance(r.get("retry_after_s"), (int, float))]),
         "new_tokens_total": sum(tokens),
         # prefix-cache reuse: prompt tokens whose prefill was SKIPPED —
         # the doctor's prefill bucket reads prefill_seconds_total next

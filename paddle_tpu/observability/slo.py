@@ -97,6 +97,8 @@ class SLOTracker:
         self.goodput_tokens = 0
         self.requests_met = 0
         self.requests_missed = 0
+        self.requests_deadline_exceeded = 0
+        self.requests_rejected = 0
         self.last_dump_thread = None    # in-flight async flight dump
 
     # ------------------------------------------------------------ intake
@@ -140,6 +142,19 @@ class SLOTracker:
         queue_wait = summary.get("queue_wait_s")
         per_token = (summary.get("per_token_s") or {}).get("p99")
         new_tokens = int(summary.get("new_tokens") or 0)
+        state = summary.get("state")
+        if state in ("deadline_exceeded", "rejected"):
+            # overload-control terminal outcomes are their own buckets:
+            # a cancelled or priced-out request is neither "met" nor an
+            # SLO "miss" — its tokens (if any) were produced but wasted,
+            # so they count toward total and never toward goodput
+            with self._lock:
+                self.total_tokens += new_tokens
+                if state == "deadline_exceeded":
+                    self.requests_deadline_exceeded += 1
+                else:
+                    self.requests_rejected += 1
+            return False
         with self._lock:
             met = None
             checks = {"ttft_p95": ttft, "queue_wait_p95": queue_wait,
@@ -257,6 +272,9 @@ class SLOTracker:
                 if self.violations else None,
                 "requests_met": self.requests_met,
                 "requests_missed": self.requests_missed,
+                "requests_deadline_exceeded":
+                    self.requests_deadline_exceeded,
+                "requests_rejected": self.requests_rejected,
                 "goodput_tokens": self.goodput_tokens,
                 "total_tokens": self.total_tokens,
                 "goodput_fraction": round(

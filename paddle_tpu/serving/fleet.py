@@ -360,12 +360,16 @@ def _fleet_replica_main(spec: dict):
                              int(msg["max_new"]), eos_id=msg.get("eos_id"),
                              rid=gid,
                              router_wait_s=float(msg.get("router_wait_s")
-                                                 or 0.0))
+                                                 or 0.0),
+                             deadline_s=msg.get("deadline_s"))
             if r.state == "rejected":
-                # surfaced synchronously; keep reap from re-reporting it
+                # surfaced synchronously; keep reap from re-reporting
+                # it; retry_after_s rides back so the router (and the
+                # client behind it) gets the machine-readable backoff
                 reported.add(r.rid)
                 return {"ok": True, "accepted": False,
-                        "reason": r.reject_reason}
+                        "reason": r.reject_reason,
+                        "retry_after_s": r.retry_after_s}
             submitted.add(gid)
             return {"ok": True, "accepted": True}
         if op == "withdraw":
@@ -447,12 +451,14 @@ def _fleet_replica_main(spec: dict):
         if op == "poll":
             done = []
             with sched._lock:
-                for r in sched.finished + sched.rejected:
+                for r in (sched.finished + sched.rejected
+                          + sched.deadline_exceeded):
                     if r.rid in reported:
                         continue
                     reported.add(r.rid)
                     done.append({"rid": r.rid, "state": r.state,
                                  "reject_reason": r.reject_reason,
+                                 "retry_after_s": r.retry_after_s,
                                  "tokens": [int(t) for t in r.tokens],
                                  "summary": r.summary()})
             st = sched.status()
@@ -534,6 +540,11 @@ class ReplicaHandle:
         self.poll_failures = 0              # consecutive failed polls
         self.last_shed_ts = 0.0
         self.drain_deadline = float("inf")
+        # circuit breaker: consecutive control-plane RPC failures
+        # (submit timeouts AND poll misses) open it; the regular poll
+        # doubles as the half-open probe — one success closes it
+        self.rpc_failures = 0
+        self.breaker_open = False
         self._ctx = spawn(_fleet_replica_main, args=(spec,), nprocs=1,
                           join=False,
                           job_id=f"fleet{os.getpid()}r{replica_id}")
@@ -642,6 +653,7 @@ class FleetRouter:
         self.migrations_failed = 0
         self.migration_bytes = 0
         self.shed_events: list = []
+        self.breaker_events: list = []  # recent open/close transitions
         self._lock = threading.RLock()
         self._boot_threads: list = []   # in-flight async relaunches
         self._started = False
@@ -717,27 +729,46 @@ class FleetRouter:
         return False
 
     # -------------------------------------------------------------- intake
-    def submit(self, prompt_ids, max_new_tokens: int, eos_id=None) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
+               deadline_s: float | None = None) -> int:
         """Queue one request with a fleet-global rid; dispatched to a
         replica on this call when one is routable, else held at the
         router (and counted in the router queue depth the autoscaler
-        watches)."""
+        watches). ``deadline_s`` (relative to now) rides the wire to
+        the replica — and is enforced at the router too, so a request
+        stuck behind open breakers still terminates."""
         if not self._started:
             raise FleetError("FleetRouter.start() first")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
+            now = time.monotonic()
             rec = {"rid": rid, "prompt": prompt,
                    "max_new": int(max_new_tokens), "eos_id": eos_id,
-                   "enqueued_ts": time.monotonic(), "requeues": 0}
+                   "enqueued_ts": now, "submit_ts": now, "requeues": 0,
+                   "deadline_s": float(deadline_s)
+                   if deadline_s is not None and deadline_s > 0
+                   else None}
             if len(self._queue) >= self.max_queue:
                 self._terminal(rec, state="rejected",
-                               reject_reason="router_queue_full")
+                               reject_reason="router_queue_full",
+                               retry_after_s=self._router_retry_after())
                 return rid
             self._queue.append(rec)
         self._dispatch_queued()
         return rid
+
+    def _router_retry_after(self) -> float:
+        """Router-level backpressure hint: prefer the max of what the
+        replicas themselves report (their estimate prices backlog
+        against the drain rate); fall back to the cap."""
+        cap = _env_float("PADDLE_FLEET_RETRY_AFTER_CAP_S", 30.0)
+        est = 0.0
+        for h in self.replicas.values():
+            ov = (h.last_status or {}).get("overload") or {}
+            est = max(est, float(ov.get("retry_after_s") or 0.0))
+        return round(min(est or cap, cap), 3)
 
     @property
     def outstanding(self) -> int:
@@ -780,6 +811,49 @@ class FleetRouter:
     def _straggler_polls() -> int:
         return max(int(_env_float("PADDLE_FLEET_STRAGGLER_POLLS", 3)), 1)
 
+    # ------------------------------------------------------ circuit breaker
+    @staticmethod
+    def _breaker_fails() -> int:
+        return max(int(_env_float("PADDLE_FLEET_BREAKER_FAILS", 3)), 1)
+
+    def _breaker_failure(self, h, op: str = "?"):
+        """One consecutive control-plane RPC failure against a replica
+        (submit timeout or poll miss). Past PADDLE_FLEET_BREAKER_FAILS
+        the breaker opens: routing skips the replica, but the regular
+        supervision poll keeps probing it — that poll IS the half-open
+        probe, and its first success closes the breaker."""
+        from ..observability import instrument as obs
+        h.rpc_failures += 1
+        if h.breaker_open or h.rpc_failures < self._breaker_fails():
+            return
+        h.breaker_open = True
+        obs.fleet_breaker_events_counter().inc(event="open")
+        ev = {"event": "open", "replica": h.replica_id,
+              "failures": h.rpc_failures, "op": op, "ts": time.time()}
+        with self._lock:
+            self.breaker_events.append(ev)
+            del self.breaker_events[:-64]
+        if self._logger is not None:
+            self._logger.log("fleet_breaker", transition="open",
+                             replica=h.replica_id,
+                             failures=h.rpc_failures, op=op)
+
+    def _breaker_success(self, h):
+        from ..observability import instrument as obs
+        h.rpc_failures = 0
+        if not h.breaker_open:
+            return
+        h.breaker_open = False
+        obs.fleet_breaker_events_counter().inc(event="close")
+        ev = {"event": "close", "replica": h.replica_id,
+              "ts": time.time()}
+        with self._lock:
+            self.breaker_events.append(ev)
+            del self.breaker_events[:-64]
+        if self._logger is not None:
+            self._logger.log("fleet_breaker", transition="close",
+                             replica=h.replica_id)
+
     def _snapshots(self) -> dict:
         """Routing view of the live, started replicas. A replica that
         missed ``PADDLE_FLEET_STRAGGLER_POLLS`` consecutive polls is
@@ -793,7 +867,8 @@ class FleetRouter:
             pool = st.get("kv_pool") or {}
             wedged = h.poll_failures >= self._straggler_polls()
             out[rid] = {
-                "healthy": st.get("healthy", True) and not wedged,
+                "healthy": st.get("healthy", True) and not wedged
+                and not h.breaker_open,
                 "draining": h.draining or st.get("draining", False),
                 "queue_depth": int(st.get("queue_depth") or 0),
                 "pending": int(st.get("queue_depth") or 0)
@@ -811,7 +886,16 @@ class FleetRouter:
         with self._lock:
             snaps = self._snapshots()
             still_queued = []
+            now = time.monotonic()
             for rec in self._queue:
+                dl = rec.get("deadline_s")
+                if dl is not None and rec.get("submit_ts") is not None \
+                        and now - rec["submit_ts"] > dl:
+                    # expired while held at the router (saturated fleet,
+                    # open breakers): terminal NOW — a deadline bounds
+                    # the wait wherever the request is waiting
+                    self._terminal(rec, state="deadline_exceeded")
+                    continue
                 pages = -(-(len(rec["prompt"]) + rec["max_new"])
                           // self.page_size)
                 target = self.policy.route(rec["prompt"], snaps,
@@ -838,41 +922,93 @@ class FleetRouter:
             self._queue = still_queued
             obs.fleet_router_queue_gauge().set(float(len(self._queue)))
 
+    def _submit_rpc(self, handle, rec: dict) -> dict:
+        wait_s = time.monotonic() - rec["enqueued_ts"]
+        return handle.rpc({
+            "op": "submit", "rid": rec["rid"],
+            "prompt": [int(t) for t in rec["prompt"]],
+            "max_new": rec["max_new"], "eos_id": rec["eos_id"],
+            "router_wait_s": round(wait_s, 6),
+            "deadline_s": rec.get("deadline_s")})
+
+    def _hedge_candidates(self, rec: dict, exclude: int) -> list:
+        """Next-best affinity candidates for a hedged submit: the
+        rendezvous order after the preferred replica, restricted to
+        healthy, non-draining peers. The global rid dedup makes a
+        double-submit (original landed but its ACK timed out) land as
+        ``duplicate: True`` — hedging is idempotent by construction."""
+        from .router import affinity_key, rendezvous_order
+        snaps = self._snapshots()
+        ids = [rid for rid, s in snaps.items()
+               if rid != exclude and s.get("healthy", True)
+               and not s.get("draining")]
+        if not ids:
+            return []
+        key = affinity_key(rec["prompt"], self.policy.block_tokens)
+        return rendezvous_order(key, ids)
+
     def _dispatch(self, rec: dict, target: int) -> str:
         """Send one request to one replica. Returns ``"accepted"``
         (in-flight there), ``"queued"`` (transient refusal / dead
         replica — keep it at the router), or ``"rejected"`` (permanent:
         a terminal rejected result was recorded — no replica in this
-        fleet can ever serve it)."""
+        fleet can ever serve it, or the fleet is pushing back with a
+        ``retry_after_s`` hint the client must honor).
+
+        A submit that times out feeds the replica's circuit breaker
+        and HEDGES: the same rid is offered to the next-best affinity
+        candidates (idempotent by the global rid dedup), so one wedged
+        replica costs one timeout, not one lost dispatch round."""
+        from ..observability import instrument as obs
         handle = self.replicas.get(target)
         if handle is None:
             return "queued"
-        wait_s = time.monotonic() - rec["enqueued_ts"]
         try:
-            reply = handle.rpc({
-                "op": "submit", "rid": rec["rid"],
-                "prompt": [int(t) for t in rec["prompt"]],
-                "max_new": rec["max_new"], "eos_id": rec["eos_id"],
-                "router_wait_s": round(wait_s, 6)})
+            reply = self._submit_rpc(handle, rec)
+            self._breaker_success(handle)
         except Exception:
-            return "queued"  # dead or wedged: _supervise decides
+            self._breaker_failure(handle, op="submit")
+            reply = None
+            for cand in self._hedge_candidates(rec, exclude=target):
+                h2 = self.replicas.get(cand)
+                if h2 is None:
+                    continue
+                obs.fleet_hedged_submits_counter().inc()
+                if self._logger is not None:
+                    self._logger.log("fleet_hedge", rid=rec["rid"],
+                                     timed_out=target, hedged_to=cand)
+                try:
+                    reply = self._submit_rpc(h2, rec)
+                    self._breaker_success(h2)
+                    target = cand
+                    break
+                except Exception:
+                    self._breaker_failure(h2, op="submit")
+            if reply is None:
+                return "queued"  # dead or wedged: _supervise decides
         if reply.get("accepted"):
             with self._lock:
                 rec["replica"] = target
                 self._inflight[rec["rid"]] = rec
             return "accepted"
         reason = str(reply.get("reason") or "?")
-        if reason in ("draining", "queue_full"):
+        if reason == "draining":
             return "queued"  # transient: another replica / next tick
-        self._terminal(rec, state="rejected", reject_reason=reason)
+        # retry_after / shed ARE terminal here: the routing policy
+        # already picked the least-loaded viable replica, so its
+        # backpressure speaks for the fleet — the hint reaches the
+        # client instead of the request bouncing between full queues
+        self._terminal(rec, state="rejected", reject_reason=reason,
+                       retry_after_s=reply.get("retry_after_s"))
         return "rejected"
 
     def _terminal(self, rec: dict, state: str, reject_reason=None,
-                  tokens=(), summary=None):
+                  tokens=(), summary=None, retry_after_s=None):
         with self._lock:
             self.results[rec["rid"]] = {
                 "rid": rec["rid"], "state": state,
                 "reject_reason": reject_reason,
+                "retry_after_s": retry_after_s,
                 "tokens": list(tokens),
                 "replica": rec.get("replica"),
                 "requeues": rec.get("requeues", 0),
@@ -906,8 +1042,10 @@ class FleetRouter:
                               retries=0)
             except Exception:
                 h.poll_failures += 1
+                self._breaker_failure(h, op="poll")
                 continue  # _supervise decides dead-vs-slow by the process
             h.poll_failures = 0
+            self._breaker_success(h)  # poll doubles as half-open probe
             h.last_status = reply.get("status") or {}
             with self._lock:
                 for done in reply.get("done") or ():
@@ -1381,6 +1519,22 @@ class FleetRouter:
             },
             "pool_aggregate": agg,
             "burn_rate": round(self._burn_rate(), 4),
+            # fleet-level overload view: per-replica brownout modes +
+            # breaker state, total deadline cancellations, and the
+            # backpressure hint a rejected client would get right now
+            "overload": {
+                "modes": {str(rid): ((h.last_status or {})
+                                     .get("overload") or {})
+                          .get("mode", "?") for rid, h in replicas},
+                "deadline_exceeded": sum(
+                    int((h.last_status or {}).get("deadline_exceeded")
+                        or 0) for _, h in replicas),
+                "retry_after_s": self._router_retry_after(),
+                "breakers": {str(rid): {"open": h.breaker_open,
+                                        "rpc_failures": h.rpc_failures}
+                             for rid, h in replicas},
+                "breaker_events": self.breaker_events[-8:],
+            },
         }
 
     def _federated_metrics(self) -> str:
@@ -1456,6 +1610,7 @@ class FleetRouter:
                 "migrated_rids": sorted(set(self.migrated_rids)),
             },
             "shed_events": list(self.shed_events),
+            "breaker_events": list(self.breaker_events),
             "autoscaler": self.autoscaler.snapshot()
             if self.autoscaler is not None else None,
         }

@@ -423,6 +423,113 @@ def predicted_fleet_row(config: str = "345m", replicas: int = 2,
     }
 
 
+def predicted_overload_row(config: str = "345m", concurrency: int = 8,
+                           prompt_len: int = 1024, max_new: int = 64,
+                           prefill_chunk: int = 256, page_size: int = 64,
+                           chip: str = "v5e", dtype: str = "bfloat16",
+                           overload_factor: float = 2.0,
+                           deadline_s: float | None = None,
+                           window_s: float = 60.0) -> dict:
+    """``serving_overload_predicted``: the overload-control static
+    anchor — deadline-met goodput at ``overload_factor``× the engine's
+    admission capacity, WITH the control layer (deadlines + cost-aware
+    admission + brownout) vs the uncontrolled FIFO baseline, from the
+    same roofline both sides share so the ratio is noise-free.
+
+    Workload model: requests (``prompt_len`` prompt, ``max_new`` new
+    tokens, each carrying ``deadline_s`` — default 4× the unloaded
+    request latency) arrive at rate λ = f × capacity for ``window_s``
+    seconds, where capacity is the pipeline's bottleneck stage rate
+    (serialized chunk prefills vs the B-wide batched decode).
+
+    WITHOUT control the FIFO queue grows at (f−1)·capacity, so a
+    request arriving at time t waits (f−1)·t: only arrivals before
+    t* = deadline/(f−1) finish inside their deadline, goodput collapses
+    as the window grows, and p99 TTFT tracks the window length — queue
+    wait IS the tail. WITH control, admission sheds the excess with a
+    priced ``retry_after`` (reject fraction 1−1/f), the brownout clamp
+    keeps admitted work inside the token budget, and the deadline sweep
+    bounds wasted decode: goodput holds at ~capacity minus a small
+    control overhead and p99 TTFT is bounded by the deadline.
+    ``predicted_goodput_ratio`` (control / no-control) is the
+    acceptance number the measured ``serving_overload`` row must echo
+    (≥ 1)."""
+    from ..observability.instrument import chip_specs
+
+    cfg = _gpt_config(config)
+    B = int(concurrency)
+    ps = int(page_size)
+    chunk = max(int(prefill_chunk) // ps, 1) * ps
+    pages_per_seq = math.ceil(cfg.max_position_embeddings / ps)
+    num_pages = B * pages_per_seq + 1
+    spec = chip_specs(chip)
+    chunk_ms = _chunk_step_ms(cfg, dtype, None, chunk, pages_per_seq,
+                              num_pages, ps, spec)
+    decode = predicted_serving_row(config, concurrency, page_size, chip,
+                                   dtype)
+    step_ms = decode["predicted_decode_step_ms"]
+    f = max(float(overload_factor), 1.0 + 1e-9)
+    T = max(float(window_s), 1.0)
+    prefill_ms = math.ceil(prompt_len / chunk) * chunk_ms
+    req_ms = prefill_ms + max_new * step_ms        # unloaded latency
+    # capacity = the slower pipeline stage: one serialized prefill lane
+    # vs B decode streams each holding a slot for max_new steps
+    cap_rps = 1e3 * min(1.0 / prefill_ms, B / (max_new * step_ms))
+    cap_tps = cap_rps * max_new
+    lam = f * cap_rps
+    dl = float(deadline_s) if deadline_s else 4.0 * req_ms / 1e3
+    # ---- no control: FIFO backlog grows at (f-1)*cap; arrival at t
+    # waits (f-1)*t, so the met set is the arrivals before t*
+    t_star = dl / (f - 1.0)
+    met_frac_nc = min(t_star, T) / T
+    goodput_nc_tps = min(lam * met_frac_nc * max_new, cap_tps)
+    miss_nc = 1.0 - met_frac_nc
+    p99_ttft_nc_ms = (f - 1.0) * 0.99 * T * 1e3 + prefill_ms
+    # ---- with control: admission keeps queue wait under the deadline
+    # and sheds the rest; brownout/cancel bookkeeping is a small tax
+    ctrl_overhead = 0.02
+    goodput_c_tps = cap_tps * (1.0 - ctrl_overhead)
+    reject_frac = 1.0 - 1.0 / f
+    miss_c = 0.01           # boundary admissions the deadline sweep eats
+    p99_ttft_c_ms = min(p99_ttft_nc_ms,
+                        max(prefill_ms, dl * 1e3 - max_new * step_ms))
+    return {
+        "config": config,
+        "concurrency": B,
+        "prompt_len": int(prompt_len),
+        "max_new": int(max_new),
+        "page_size": ps,
+        "dtype": dtype,
+        "overload_factor": round(f, 2),
+        "window_s": round(T, 1),
+        "deadline_s": round(dl, 4),
+        "capacity_rps": round(cap_rps, 3),
+        "capacity_tokens_per_sec": round(cap_tps, 1),
+        # headline value: deadline-met goodput WITH the control layer
+        "predicted_tokens_per_sec": round(goodput_c_tps, 1),
+        "predicted_goodput_tokens_per_sec_no_control": round(
+            goodput_nc_tps, 1),
+        "predicted_goodput_ratio": round(
+            goodput_c_tps / goodput_nc_tps, 3) if goodput_nc_tps else 0.0,
+        "predicted_deadline_miss_rate": round(miss_c, 4),
+        "predicted_deadline_miss_rate_no_control": round(miss_nc, 4),
+        "predicted_reject_fraction": round(reject_frac, 4),
+        "predicted_p99_ttft_ms": round(p99_ttft_c_ms, 3),
+        "predicted_p99_ttft_ms_no_control": round(p99_ttft_nc_ms, 3),
+        # sustained f x capacity keeps the burn above threshold for the
+        # overloaded share of the window
+        "predicted_brownout_share": round(1.0 - 1.0 / f, 4),
+        # steady-state backlog at the admission cap drains in about one
+        # deadline — the hint a priced reject carries
+        "predicted_retry_after_s": round(dl, 3),
+        "predicted_decode_step_ms": step_ms,
+        "predicted_chunk_ms": round(chunk_ms, 3),
+        "predicted_request_ms_unloaded": round(req_ms, 3),
+        "chip_assumed": spec.get("name"),
+        "calibration_id": decode.get("calibration_id", "default"),
+    }
+
+
 def predicted_migration_row(config: str = "345m", prompt_len: int = 1024,
                             decoded: int = 32,
                             cached_fraction: float = 0.5,
@@ -787,7 +894,7 @@ def _main(argv=None):
     ap.add_argument("--mode", default="decode",
                     choices=["decode", "shared_prefix", "disagg", "moe",
                              "fused_dispatch", "fleet", "migration",
-                             "autofusion"],
+                             "overload", "autofusion"],
                     help="decode = classic serving_predicted row; "
                          "shared_prefix = prefix-cache goodput/TTFT "
                          "anchor; disagg = disaggregated prefill/"
@@ -800,7 +907,10 @@ def _main(argv=None):
                          "hit-rate-split TTFT); migration = live "
                          "KV-page migration anchor (payload over the "
                          "interconnect roofline + resume cost vs "
-                         "full-prompt replay); autofusion = per-site "
+                         "full-prompt replay); overload = overload-"
+                         "control anchor (deadline-met goodput at "
+                         "2x-capacity arrival, control vs FIFO "
+                         "baseline); autofusion = per-site "
                          "predicted Δstep-ms of the jaxpr auto-fusion "
                          "rewrites over the tiny engines' programs")
     ap.add_argument("--export-records", default=None, metavar="PATH",
@@ -816,6 +926,12 @@ def _main(argv=None):
     ap.add_argument("--n-requests", type=int, default=16,
                     help="fleet mode: total requests in the workload "
                          "model")
+    ap.add_argument("--overload-factor", type=float, default=2.0,
+                    help="overload mode: arrival rate as a multiple of "
+                         "the predicted admission capacity")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="overload mode: per-request deadline (default "
+                         "4x the unloaded request latency)")
     args = ap.parse_args(argv)
     if not os.environ.get("_PREDICT_RESPAWNED"):
         # same contract as analysis.predict: force the CPU backend in a
@@ -850,6 +966,12 @@ def _main(argv=None):
                 args.config, args.prompt_len, args.max_new,
                 args.shared_fraction, args.prefill_chunk,
                 args.page_size, args.chip)
+        elif args.mode == "overload":
+            row = predicted_overload_row(
+                args.config, args.concurrency, args.prompt_len,
+                args.max_new, args.prefill_chunk, args.page_size,
+                args.chip, overload_factor=args.overload_factor,
+                deadline_s=args.deadline_s)
         elif args.mode == "shared_prefix":
             row = predicted_shared_prefix_row(
                 args.config, args.concurrency, args.prompt_len,

@@ -40,6 +40,7 @@ Telemetry — aggregate AND request-scoped:
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -49,6 +50,15 @@ import numpy as np
 
 __all__ = ["Request", "ContinuousBatchingScheduler",
            "simulate_decode_signatures"]
+
+
+def _env_pos_float(name: str):
+    """Positive-float env knob; unset / 0 / garbage → None."""
+    try:
+        v = float(os.environ.get(name, "") or 0.0)
+    except ValueError:
+        v = 0.0
+    return v if v > 0 else None
 
 
 @dataclass
@@ -69,11 +79,18 @@ class Request:
     migrations: int = 0                # fleet: live-migration hops
     migrate_s: float = 0.0             # fleet: transfer+restore walltime
     migrate_bytes: int = 0             # fleet: K/V payload moved
+    deadline_s: float | None = None    # relative to submit_time; an
+    #                                    expired request cancels at the
+    #                                    next tick wherever it lives
+    retry_after_s: float | None = None  # backpressure hint on rejects
+    degraded_s: float = 0.0            # decode walltime spent while the
+    #                                    scheduler was in brownout/shed
     tokens: list = field(default_factory=list)   # generated ids
     state: str = "queued"              # queued|prefilling|running|
-    #                                    finished|rejected
-    reject_reason: str | None = None   # max_new<1|too_long|queue_full|
-    #                                    pool_too_small|draining
+    #                                    finished|rejected|
+    #                                    deadline_exceeded
+    reject_reason: str | None = None   # max_new<1|too_long|retry_after|
+    #                                    pool_too_small|draining|shed
     slo_met: bool | None = None        # stamped at finish by the tracker
     trace: object = None               # observability.reqtrace.RequestTrace
 
@@ -88,6 +105,13 @@ class Request:
             return True
         return bool(self.eos_id is not None and self.tokens
                     and self.tokens[-1] == self.eos_id)
+
+    def expired(self, now: float) -> bool:
+        """Deadline check against the request's own clock (deadline_s
+        is RELATIVE to submit_time, so it survives a live migration's
+        clock rebuild)."""
+        return self.deadline_s is not None \
+            and (now - self.submit_time) > self.deadline_s
 
     def summary(self) -> dict:
         """Per-request serving record (times in seconds). ``is not
@@ -121,6 +145,12 @@ class Request:
             out["migrations"] = self.migrations
             out["migrate_s"] = round(self.migrate_s, 6)
             out["migrate_bytes"] = self.migrate_bytes
+        if self.deadline_s is not None:
+            out["deadline_s"] = self.deadline_s
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(self.retry_after_s, 3)
+        if self.degraded_s:
+            out["degraded_s"] = round(self.degraded_s, 6)
         if self.trace is not None and self.trace.token_samples:
             out["per_token_s"] = self.trace.per_token_stats()
         return out
@@ -162,6 +192,7 @@ class ContinuousBatchingScheduler:
         self.max_retained = int(max_retained)
         self.finished: list = []
         self.rejected: list = []
+        self.deadline_exceeded: list = []
         self.step_times: list = []        # decode-step walltimes (s)
         self.steps = 0
         self.slo = None
@@ -171,6 +202,31 @@ class ContinuousBatchingScheduler:
                                 else SLOConfig())
         self.healthy = True
         self.last_error: str | None = None
+        # ---- overload control (deadlines / admission / brownout) ----
+        # env knobs so a whole fleet tunes the policy without code:
+        # PADDLE_FLEET_DEADLINE_DEFAULT_S (0/unset = no default
+        # deadline), PADDLE_FLEET_BROWNOUT_BURN (burn rate that enters
+        # brownout; shedding at 2x, hysteretic exits at half),
+        # PADDLE_FLEET_RETRY_AFTER_CAP_S (ceiling on the backpressure
+        # hint)
+        self.default_deadline_s = _env_pos_float(
+            "PADDLE_FLEET_DEADLINE_DEFAULT_S")
+        self.brownout_burn = _env_pos_float(
+            "PADDLE_FLEET_BROWNOUT_BURN") or 1.0
+        self.retry_after_cap_s = _env_pos_float(
+            "PADDLE_FLEET_RETRY_AFTER_CAP_S") or 30.0
+        self.mode = "healthy"             # healthy|brownout|shedding
+        self.mode_transitions = 0
+        self.mode_seconds = {"healthy": 0.0, "brownout": 0.0,
+                             "shedding": 0.0}
+        self._mode_since = time.perf_counter()
+        self.degraded_s_total = 0.0       # decode walltime off-healthy
+        self.deadline_cancelled = 0
+        # speculative/background work (cache warmers, draft models,
+        # prefetch) registers callables here; brownout and shedding
+        # pause them — cache RECLAIM stays on (it frees capacity)
+        self.background_hooks: list = []
+        self._finish_ts: deque = deque(maxlen=64)  # drain-rate window
         # drain-then-retire (fleet scale-in): a draining scheduler
         # finishes queued + running work but accepts no new submits —
         # /healthz reports "draining" so a router can tell retiring
@@ -184,12 +240,22 @@ class ContinuousBatchingScheduler:
 
     # ----------------------------------------------------------- intake
     def submit(self, prompt_ids, max_new_tokens: int, eos_id=None,
-               rid=None, router_wait_s: float = 0.0) -> Request:
+               rid=None, router_wait_s: float = 0.0,
+               deadline_s: float | None = None) -> Request:
         """Queue one request. ``rid`` lets a fleet router thread its
         GLOBAL request id through (re-enqueues stay idempotent by id
         and the federated ``requests.jsonl`` speaks one id space);
         ``router_wait_s`` stamps the time the request already waited at
-        that router, so fleet-level latency attribution sees it."""
+        that router, so fleet-level latency attribution sees it.
+        ``deadline_s`` (relative to now; default from
+        ``PADDLE_FLEET_DEADLINE_DEFAULT_S``) cancels the request at
+        the first tick past the deadline, wherever it lives.
+
+        Overload backpressure replaces the old binary ``queue_full``:
+        a request refused for capacity is priced against the recent
+        drain rate and rejected with reason ``retry_after`` plus a
+        machine-readable ``retry_after_s`` hint; in shedding mode all
+        cache-miss traffic is refused the same way (reason ``shed``)."""
         from ..observability import instrument as obs
         from ..observability.reqtrace import RequestTrace
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -197,6 +263,9 @@ class ContinuousBatchingScheduler:
             r = Request(next(self._rid) if rid is None else int(rid),
                         prompt, int(max_new_tokens), eos_id=eos_id,
                         router_wait_s=float(router_wait_s))
+            r.deadline_s = float(deadline_s) \
+                if deadline_s is not None and deadline_s > 0 \
+                else self.default_deadline_s
             r.trace = RequestTrace(r.rid, r.submit_time)
             pool = self.engine.pool
             total = prompt.shape[0] + r.max_new_tokens
@@ -211,9 +280,16 @@ class ContinuousBatchingScheduler:
             elif total > pool.max_seq_len:
                 reason = "too_long"
             elif len(self._queue) >= self.max_queue:
-                reason = "queue_full"
+                reason = "retry_after"
+                r.retry_after_s = self._retry_after_estimate()
             elif pool.pages_needed(total) > pool.num_pages - 1:
                 reason = "pool_too_small"
+            elif self.mode == "shedding" \
+                    and not self._cache_hit_tokens(prompt):
+                # shedding: only traffic the prefix cache makes cheap
+                # still gets in — everything else backs off
+                reason = "shed"
+                r.retry_after_s = self._retry_after_estimate()
             if reason is not None:
                 r.state = "rejected"
                 r.reject_reason = reason
@@ -223,6 +299,8 @@ class ContinuousBatchingScheduler:
                 del self.rejected[:-self.max_retained]
                 obs.serving_requests_counter().inc(event="rejected",
                                                    reason=reason)
+                if self.slo is not None:
+                    self.slo.observe_request(r.summary())
                 self._log_request(r)
                 return r
             self._queue.append(r)
@@ -242,6 +320,165 @@ class ContinuousBatchingScheduler:
         never drops a request."""
         with self._lock:
             self.draining = True
+
+    # ------------------------------------------------- overload control
+    def _cache_hit_tokens(self, prompt) -> int:
+        """Side-effect-free prefix-cache probe (``match`` moves no
+        refcounts and records no stats): how many prompt tokens would
+        be served from cache. Brownout prefers hits at admission;
+        shedding rejects misses outright."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return 0
+        try:
+            return int(cache.match(prompt)[2])
+        except Exception:
+            return 0
+
+    def _drain_rate(self) -> float:
+        """Recent completion throughput (requests/s) over the finish-
+        timestamp window — the denominator of ``retry_after_s``."""
+        ts = self._finish_ts
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            return (len(ts) - 1) / (ts[-1] - ts[0])
+        return 0.0
+
+    def _retry_after_estimate(self) -> float:
+        """Backpressure hint: time for the present backlog to drain at
+        the observed completion rate, scaled up by the SLO burn rate
+        (a burning replica wants MORE backoff than its queue length
+        alone says), capped at ``PADDLE_FLEET_RETRY_AFTER_CAP_S``."""
+        backlog = (len(self._queue) + len(self._prefilling)
+                   + len(self._running)) or 1
+        rate = self._drain_rate()
+        est = backlog / rate if rate > 0 else self.retry_after_cap_s
+        if self.slo is not None:
+            rates = self.slo.burn_rates()
+            if rates:
+                est *= max(1.0, max(rates.values()))
+        return round(min(max(est, 0.05), self.retry_after_cap_s), 3)
+
+    def _update_mode(self, now: float):
+        """``healthy → brownout → shedding`` policy machine on the SLO
+        burn rates. Brownout enters at ``PADDLE_FLEET_BROWNOUT_BURN``
+        (1.0 = burning the error budget exactly), shedding at 2x;
+        exits are hysteretic (half the entry threshold) so a burn rate
+        hovering at the line doesn't flap the mode every tick. Each
+        transition is a runlog event + gauge flip."""
+        self.mode_seconds[self.mode] += now - self._mode_since
+        self._mode_since = now
+        if self.slo is None:
+            return
+        rates = self.slo.burn_rates()
+        burn = max(rates.values()) if rates else 0.0
+        prev = self.mode
+        if burn >= 2 * self.brownout_burn:
+            self.mode = "shedding"
+        elif self.mode == "shedding":
+            if burn < self.brownout_burn:
+                self.mode = "brownout"
+        elif burn >= self.brownout_burn:
+            self.mode = "brownout"
+        elif self.mode == "brownout" \
+                and burn < 0.5 * self.brownout_burn:
+            self.mode = "healthy"
+        if self.mode != prev:
+            from ..observability import instrument as obs
+            from ..observability.runlog import get_run_logger
+            self.mode_transitions += 1
+            obs.serving_overload_mode_gauge().set(float(
+                {"healthy": 0, "brownout": 1, "shedding": 2}[self.mode]))
+            logger = get_run_logger()
+            if logger is not None:
+                logger.log("overload_mode", mode=self.mode, prev=prev,
+                           burn_rate=round(burn, 4))
+
+    def _cancel_locked(self, r: Request, now: float, phase: str):
+        """Shared terminal path for deadline expiry and explicit
+        cancel: reclaim whatever the phase holds (queued = nothing;
+        prefilling = withdraw-style release; running = the finished
+        path's release, which still publishes the decoded prefix to
+        the cache — a cancelled request's prefix stays warm), then
+        stamp the ``deadline_exceeded`` terminal state. Cancel is an
+        EVICTION, never a recompile: no new program shapes — the
+        closure replay's cancellation mix proves it."""
+        from ..observability import instrument as obs
+        rid = r.rid
+        if phase == "prefilling":
+            if rid in self._begun:
+                self._begun.discard(rid)
+                held = len(self.engine.pool.table(rid))
+                self._reserved_pages -= self._completion_pages(r) - held
+                self.engine.release(rid)
+            else:
+                self._reserved_pages -= self._completion_pages(r)
+        elif phase == "running":
+            held = len(self.engine.pool.table(rid))
+            self._reserved_pages -= self._completion_pages(r) - held
+            self.engine.release(rid, token_ids=np.concatenate(
+                [r.prompt, np.asarray(r.tokens[:-1], np.int32)]))
+        r.state = "deadline_exceeded"
+        r.finish_time = now
+        if r.trace is not None:
+            start = r.first_token_time
+            if start is None:
+                start = r.admit_time
+            if start is None:
+                start = r.submit_time
+            r.trace.span("deadline_exceeded", start, now,
+                         cancelled_in=phase, tokens=len(r.tokens))
+        if self.slo is not None:
+            r.slo_met = self.slo.observe_request(r.summary())
+        self.deadline_exceeded.append(r)
+        del self.deadline_exceeded[:-self.max_retained]
+        self.deadline_cancelled += 1
+        obs.serving_requests_counter().inc(event="deadline_exceeded")
+        obs.serving_deadline_exceeded_counter().inc(phase=phase)
+        self._log_request(r)
+
+    def _cancel_expired(self, now: float):
+        """Per-tick deadline sweep: expired requests cancel wherever
+        they live — queued, mid-prefill, or mid-decode — converting
+        lateness into freed pages instead of compounding queue wait."""
+        if self._queue and any(r.deadline_s is not None
+                               for r in self._queue):
+            expired = [r for r in self._queue if r.expired(now)]
+            if expired:
+                keep = [r for r in self._queue if not r.expired(now)]
+                self._queue.clear()
+                self._queue.extend(keep)
+                for r in expired:
+                    self._cancel_locked(r, now, "queued")
+        for rid in [rid for rid, r in self._prefilling.items()
+                    if r.expired(now)]:
+            self._cancel_locked(self._prefilling.pop(rid), now,
+                                "prefilling")
+        for rid in [rid for rid, r in self._running.items()
+                    if r.expired(now) and not r.done]:
+            self._cancel_locked(self._running.pop(rid), now, "running")
+
+    def cancel(self, rid) -> bool:
+        """Cancel one request wherever it lives (queued / prefilling /
+        running), through the exact terminal path a deadline expiry
+        takes. Returns False for unknown, already-terminal, or
+        done-this-tick rids (those finish normally)."""
+        with self._lock:
+            now = time.perf_counter()
+            for i, r in enumerate(self._queue):
+                if r.rid == rid:
+                    del self._queue[i]
+                    self._cancel_locked(r, now, "queued")
+                    return True
+            r = self._prefilling.pop(rid, None)
+            if r is not None:
+                self._cancel_locked(r, now, "prefilling")
+                return True
+            r = self._running.get(rid)
+            if r is None or r.done:
+                return False
+            del self._running[rid]
+            self._cancel_locked(r, now, "running")
+            return True
 
     # ------------------------------------------------------------ phases
     def _completion_pages(self, r: Request) -> int:
@@ -272,6 +509,7 @@ class ContinuousBatchingScheduler:
                 [r.prompt, np.asarray(r.tokens[:-1], np.int32)]))
             r.state = "finished"
             r.finish_time = time.perf_counter()
+            self._finish_ts.append(r.finish_time)
             if r.trace is not None and r.first_token_time is not None:
                 r.trace.span("decode", r.first_token_time, r.finish_time,
                              tokens=max(len(r.tokens) - 1, 0))
@@ -294,6 +532,25 @@ class ContinuousBatchingScheduler:
                 if hasattr(self.engine, "reclaim_cache_pages") else 0
         return avail >= need
 
+    def _next_admit_index(self) -> int:
+        """Head-of-line normally; under brownout/shedding prefer the
+        first queued request with a cached prefix — the cheapest
+        goodput per page when capacity is what's scarce. Falls back to
+        index 0, so the healthy path stays deterministic."""
+        if self.mode == "healthy" or not self._queue:
+            return 0
+        for i, r in enumerate(self._queue):
+            if self._cache_hit_tokens(r.prompt):
+                return i
+        return 0
+
+    def _brownout_clamp(self, r: Request):
+        """Brownout halves the completion budget at admission (floor
+        1) — shorter answers under pressure, never dropped ones. Done
+        once, at the admission that actually takes the request."""
+        if self.mode != "healthy":
+            r.max_new_tokens = max(1, (r.max_new_tokens + 1) // 2)
+
     def _admit_chunked(self):
         """Chunked admission: reserve the full completion and hand the
         request to the prefill phase — page allocation AND the prefix-
@@ -303,11 +560,14 @@ class ContinuousBatchingScheduler:
         while self._queue and (len(self._running) + len(self._prefilling)
                                + len(self._migrating_in)
                                < self.max_concurrency):
-            r = self._queue[0]
+            i = self._next_admit_index()
+            r = self._queue[i]
             need = self._completion_pages(r)
             if not self._page_room(need):
                 break  # head-of-line: keep arrival order deterministic
-            self._queue.popleft()
+            del self._queue[i]
+            self._brownout_clamp(r)
+            need = self._completion_pages(r)
             r.admit_time = time.perf_counter()
             r.state = "prefilling"
             r.prefill_s = 0.0
@@ -382,11 +642,14 @@ class ContinuousBatchingScheduler:
         while self._queue and (len(self._running)
                                + len(self._migrating_in)
                                < self.max_concurrency):
-            r = self._queue[0]
+            i = self._next_admit_index()
+            r = self._queue[i]
             need = self._completion_pages(r)
             if not self._page_room(need):
                 break  # head-of-line: keep arrival order deterministic
-            self._queue.popleft()
+            del self._queue[i]
+            self._brownout_clamp(r)
+            need = self._completion_pages(r)
             r.admit_time = time.perf_counter()
             # the prefill IS part of the serving hot path: time it, so
             # it reaches the histogram, the flight recorder, and the
@@ -440,10 +703,22 @@ class ContinuousBatchingScheduler:
 
     def _step_locked(self) -> bool:
         from ..observability import instrument as obs
+        now = time.perf_counter()
+        self._update_mode(now)
+        self._cancel_expired(now)
         self._evict_finished()
         self._admit()
         if self.chunked:
             self._prefill_tick()
+        if self.mode == "healthy":
+            # speculative/background work runs only with headroom;
+            # brownout/shedding pause it (cache reclaim stays on — it
+            # frees capacity, it doesn't spend it)
+            for hook in self.background_hooks:
+                try:
+                    hook()
+                except Exception:
+                    pass  # background work must never take the loop down
         obs.serving_queue_depth_gauge().set(float(len(self._queue)))
         obs.serving_kv_pages_gauge().set(
             float(self.engine.pool.pages_in_use))
@@ -470,6 +745,13 @@ class ContinuousBatchingScheduler:
             per_token.observe(dt)
         if self.slo is not None:
             self.slo.observe_tokens([r.rid for r in active], dt)
+        if self.mode != "healthy":
+            # degraded time is attributable: the doctor carves it out
+            # of the decode residual exactly like migration cost
+            self.degraded_s_total += dt
+            obs.serving_degraded_seconds_counter().inc(dt)
+            for r in active:
+                r.degraded_s += dt
         self.steps += 1
         self.step_times.append(dt)
         obs.serving_tokens_out_counter().inc(float(len(active)))
@@ -544,6 +826,7 @@ class ContinuousBatchingScheduler:
                 "migrations": r.migrations + 1,
                 "migrate_s": r.migrate_s,
                 "migrate_bytes": r.migrate_bytes,
+                "deadline_s": r.deadline_s,
             }
 
     def abort_migration(self, rid) -> bool:
@@ -681,6 +964,11 @@ class ContinuousBatchingScheduler:
             r.migrations = int(meta.get("migrations") or 1)
             r.migrate_s = float(meta.get("migrate_s") or 0.0)
             r.migrate_bytes = int(meta.get("migrate_bytes") or 0)
+            # deadline_s is relative to submit_time, which was just
+            # rebuilt shifted by elapsed_s — so the deadline keeps
+            # counting the request's WHOLE life across the hop
+            if meta.get("deadline_s"):
+                r.deadline_s = float(meta["deadline_s"])
             r.tokens = tokens
             r.state = "running"
             r.trace = RequestTrace(rid, r.submit_time)
@@ -711,10 +999,12 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------- observability
     def request_records(self) -> list:
-        """Terminal per-request summaries (finished + rejected) — the
-        records bench percentiles and post-hoc analysis read."""
+        """Terminal per-request summaries (finished + rejected +
+        deadline_exceeded) — the records bench percentiles and
+        post-hoc analysis read."""
         with self._lock:
-            return [r.summary() for r in self.finished + self.rejected]
+            return [r.summary() for r in (self.finished + self.rejected
+                                          + self.deadline_exceeded)]
 
     def status(self) -> dict:
         """JSON snapshot for the ``/status`` endpoint: queue and request
@@ -736,11 +1026,40 @@ class ContinuousBatchingScheduler:
                 "migrations_in": self.migrations_in,
                 "finished": len(self.finished),
                 "rejected": len(self.rejected),
+                "deadline_exceeded": len(self.deadline_exceeded),
                 "steps": self.steps,
                 "kv_pool": self.engine.pool.stats(),
                 "decode_buckets": list(self.buckets),
                 "slo": self.slo.snapshot() if self.slo is not None
                 else None,
+            }
+            # overload-control snapshot: the mode machine, the current
+            # backpressure hint, and the admission-pricing inputs — a
+            # client that gets a retry_after reject can see the same
+            # numbers the scheduler priced it with
+            mode_s = dict(self.mode_seconds)
+            mode_s[self.mode] += time.perf_counter() - self._mode_since
+            burn = 0.0
+            if self.slo is not None:
+                rates = self.slo.burn_rates()
+                burn = max(rates.values()) if rates else 0.0
+            st["overload"] = {
+                "mode": self.mode,
+                "mode_transitions": self.mode_transitions,
+                "mode_seconds": {k: round(v, 3)
+                                 for k, v in mode_s.items()},
+                "degraded_s_total": round(self.degraded_s_total, 6),
+                "deadline_cancelled": self.deadline_cancelled,
+                "retry_after_s": self._retry_after_estimate(),
+                "admission_cost": {
+                    "backlog": len(self._queue) + len(self._prefilling)
+                    + len(self._running),
+                    "drain_rate_rps": round(self._drain_rate(), 4),
+                    "free_pages": self.engine.pool.free_pages,
+                    "reserved_pages": self._reserved_pages,
+                    "prefill_token_budget": self.prefill_token_budget,
+                    "burn_rate": round(burn, 4),
+                },
             }
             if hasattr(self.engine, "status"):
                 st["engine"] = self.engine.status()
@@ -839,7 +1158,7 @@ class _ShapeProbeEngine:
 def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
                                num_pages, max_seq_len, n_requests=200,
                                seed=0, arrival_p=0.35, prefill_chunk=None,
-                               disaggregated=False):
+                               disaggregated=False, cancel_p=0.0):
     """Replay the REAL scheduler over a randomized admission mix (ragged
     prompt lengths, random completion budgets, bursty arrivals) with a
     shape-probe engine. Returns ``(decode_sigs_used, prefill_sigs_used,
@@ -848,7 +1167,15 @@ def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
     request mix can retrace at serving time. ``prefill_chunk`` /
     ``disaggregated`` replay the chunked (prefix-cache) and
     disaggregated engine modes, whose prefill-side program sets differ
-    (one chunk signature; per-bucket prefill + scatter)."""
+    (one chunk signature; per-bucket prefill + scatter).
+
+    ``cancel_p`` mixes randomized deadline-style cancellations into
+    the replay: after each tick, with that probability, one live
+    request (running, else prefilling, else queued) is cancelled
+    through :meth:`ContinuousBatchingScheduler.cancel` — the exact
+    code path a deadline expiry takes. Cancellation must introduce
+    ZERO new signatures (cancel = evict, never a recompile), which is
+    what the ``check_program`` gate asserts."""
     rng = np.random.default_rng(seed)
     eng = _ShapeProbeEngine(decode_buckets, prefill_buckets, page_size,
                             num_pages, max_seq_len,
@@ -864,6 +1191,13 @@ def simulate_decode_signatures(decode_buckets, prefill_buckets, page_size,
             submitted += 1
         if sched.pending:
             sched.step()
+        # short-circuit keeps the rng stream byte-identical for the
+        # cancel_p=0 replays (their signature sets are golden)
+        if cancel_p and rng.random() < cancel_p:
+            live = (sorted(sched._running) or sorted(sched._prefilling)
+                    or [r.rid for r in sched._queue])
+            if live:
+                sched.cancel(live[int(rng.integers(len(live)))])
     pages_per_seq = eng.pool.max_pages_per_seq
     allowed_decode = {(b, pages_per_seq) for b in eng.decode_buckets}
     if prefill_chunk:

@@ -111,7 +111,7 @@ def test_request_summary_zero_clock_is_not_missing():
 def test_reject_reasons_and_counter_labels():
     base = {r: _counter_value("paddle_serving_requests_total",
                               event="rejected", reason=r)
-            for r in ("max_new<1", "too_long", "queue_full",
+            for r in ("max_new<1", "too_long", "retry_after",
                       "pool_too_small")}
     sched = _probe_sched(num_pages=5, max_seq_len=64)
     cases = [
@@ -125,8 +125,11 @@ def test_reject_reasons_and_counter_labels():
         assert r.summary()["reject_reason"] == want
     full = _probe_sched(max_queue=0)
     r = full.submit(np.zeros(8, np.int32), 4)
-    assert r.reject_reason == "queue_full"
-    for reason in ("max_new<1", "too_long", "queue_full",
+    # cost-aware admission: the old binary queue_full is a priced
+    # retry_after reject with a machine-readable backoff hint
+    assert r.reject_reason == "retry_after"
+    assert r.retry_after_s is not None and r.retry_after_s > 0
+    for reason in ("max_new<1", "too_long", "retry_after",
                    "pool_too_small"):
         assert _counter_value("paddle_serving_requests_total",
                               event="rejected", reason=reason) \

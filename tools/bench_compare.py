@@ -106,6 +106,11 @@ _ANCHOR_MAP = {
     # resume speedup) anchors on the payload-over-interconnect model
     "serving_fleet_migration": "serving_fleet_migration_predicted",
     "serving_fleet_migration_ms": "serving_fleet_migration_predicted",
+    # the overload-control A/B (deadline-met goodput at 2x-capacity
+    # arrival) anchors on the control-vs-FIFO roofline model
+    "serving_overload": "serving_overload_predicted",
+    "serving_overload_goodput_tokens_per_sec":
+        "serving_overload_predicted",
     "collective_compression": "collective_compression_predicted",
     # future measured auto-fusion rows (per-rule step-ms saved on TPU)
     # anchor on the rewrite pass's predicted per-rule Δstep-ms rows

@@ -283,6 +283,33 @@ def lint_serving(world_size=None, hbm_budget_gb=None):
                     f"{sorted(ok, key=str)} — every such shape "
                     f"retraces at serving time; widen the bucket "
                     f"config", op=f"serving.{what}"))
+        # cancellation mix: the same replay with randomized mid-decode
+        # deadline cancellations through the real scheduler's cancel()
+        # path. Cancel is an EVICTION — it must introduce ZERO program
+        # signatures outside the AOT set (never a recompile), and the
+        # probe's allowed set must not move
+        cd, cp, okd_c, okp_c = simulate_decode_signatures(
+            mode_eng.decode_buckets, mode_eng.prefill_buckets,
+            mode_eng.pool.page_size, mode_eng.pool.num_pages,
+            mode_eng.max_seq_len, n_requests=200, seed=0,
+            cancel_p=0.15, **sim_kw)
+        if (okd_c, okp_c) != (ok_d, ok_p):
+            diags.append(Diagnostic(
+                "PTRC002", "recompile", "error",
+                f"[{mode}+cancel] probe allowed set changed under the "
+                f"cancellation mix — the cancel path must not alter "
+                f"what the engine compiles", op="serving.cancel"))
+        for used, ok, what in ((cd, ok_d, "decode"),
+                               (cp, ok_p, "prefill")):
+            escaped = sorted(used - ok, key=str)
+            if escaped:
+                diags.append(Diagnostic(
+                    "PTRC002", "recompile", "error",
+                    f"[{mode}+cancel] mid-decode cancellations drove "
+                    f"{what} shape(s) {escaped} outside the AOT bucket "
+                    f"set {sorted(ok, key=str)} — cancel must be an "
+                    f"eviction, never a recompile",
+                    op=f"serving.{what}"))
     rep = Report("serving.decode_buckets", diags)
     rep.emit()
     reports.append(rep)
