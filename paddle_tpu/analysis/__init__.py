@@ -30,6 +30,13 @@ cost       sharding-aware per-device FLOPs / HBM bytes / ring-model
 memory     liveness peak-HBM sweep (donation- and remat-aware) gated
            against the chip budget
 donation   buffer-donation sanitizer over ``donate_argnums`` aliasing
+concurrency host-side lock discipline over the package's own Python
+           source (AST, inter-procedural): lock-order cycles, blocking
+           calls under a lock, plain ``Lock`` on signal/atexit/
+           excepthook paths, cross-thread writes with no common guard,
+           leak-prone thread spawns — plus an opt-in runtime lock
+           witness (``PADDLE_LOCK_WITNESS=1``) that confirms static
+           PTCY001 cycles from observed acquisition order
 ========== =============================================================
 
 Diagnostic codes (severity in parentheses):
@@ -84,6 +91,21 @@ PTBD002 donated-but-never-aliased: no matching output, donation is
         silently dropped (warning)
 PTBD003 donatable-but-not-donated train-step state on the hot path
         (warning)
+PTCY000 allowlist pragma without a written justification (error)
+PTCY001 lock-order inversion cycle across threads/call chains, or a
+        plain ``Lock`` re-acquired while held — potential deadlock;
+        carries witness names so the runtime lock witness can confirm
+        it (``analysis.concurrency.confirm_with_witness``) (error)
+PTCY002 blocking call (sleep / socket / subprocess / ``.join()`` /
+        queue ``get`` / device sync) while holding a lock, directly or
+        through the call graph (error)
+PTCY003 non-reentrant ``threading.Lock`` acquired on a signal/atexit/
+        excepthook path — re-entry self-deadlocks the handler; use
+        ``RLock`` (error)
+PTCY004 attribute written from 2+ thread entrypoints with no common
+        guarding lock (warning)
+PTCY005 non-daemon thread spawned with no ``join`` on any shutdown
+        path (info)
 ======= ===============================================================
 
 Surfaces::
@@ -102,6 +124,9 @@ Surfaces::
 
     python -m paddle_tpu.analysis.predict     # bench-config *_predicted rows
     python tools/mem_probe.py --compare-static --compute-dtype float32
+
+    python tools/check_concurrency.py paddle_tpu   # host lock-discipline
+    # gate (PTCY codes) — exit 0 iff zero unsuppressed findings
 
     python tools/plan.py --model gpt_13b --devices 64   # the cost model as a
     # DECISION-MAKER: distributed/auto_parallel/planner.py sweeps (dp, mp,

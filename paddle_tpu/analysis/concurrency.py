@@ -1,0 +1,1237 @@
+"""Host concurrency sanitizer: an AST-based, inter-procedural lint over
+the ``paddle_tpu`` package itself — the host-side Python control plane
+(fleet router, schedulers, checkpoint/preemption, chaos tooling,
+observability), not traced programs.
+
+The pass builds, per module, a call graph and a lock-acquisition graph
+from ``with lock:`` blocks and ``acquire()``/``release()`` call sites,
+resolves calls across the package where it can, and reports:
+
+========  ========================================================  ========
+code      meaning                                                   severity
+========  ========================================================  ========
+PTCY001   lock-order inversion: a cycle in the "acquires B while    error
+          holding A" graph across call paths (two threads taking
+          the same locks in opposite orders can deadlock)
+PTCY002   blocking call while holding a lock: socket send/recv/     error
+          connect, ``subprocess``, ``Thread.join``, ``queue.get``,
+          ``time.sleep``, ``.block_until_ready()`` / ``.numpy()``
+          device syncs — directly or via any resolved callee
+PTCY003   non-reentrant ``threading.Lock`` acquired on a path       error
+          reachable from a registered signal handler,
+          ``sys.excepthook`` / ``threading.excepthook``, or an
+          ``atexit`` callback (re-entry self-deadlocks)
+PTCY004   attribute written from >= 2 thread entrypoints with no    warn
+          common guarding lock
+PTCY005   non-daemon thread spawned with no ``join`` on any         info
+          shutdown path
+PTCY000   ``# ptcy: allow(...)`` pragma without a written           error
+          justification (allowlist entries must say why)
+========  ========================================================  ========
+
+Lock-discipline rules for this codebase (the contract the lint checks):
+
+1. **Lock order.** A fixed partial order: take coarse control-plane
+   locks (router, scheduler, pool) before fine leaf locks (runlog,
+   metrics, flight recorder), never the reverse. Any cycle in the
+   acquisition graph — static (PTCY001) or witnessed at runtime
+   (:mod:`paddle_tpu.observability.lockwitness`) — is a bug.
+2. **What may run under a lock.** Only bounded, in-memory work. No
+   sockets, no subprocesses, no sleeps, no joins, no device syncs
+   (PTCY002): snapshot state under the lock, do the slow thing outside,
+   re-take the lock to commit.
+3. **Signal-path reentrancy.** Anything reachable from a signal
+   handler, excepthook, or atexit callback uses ``threading.RLock``,
+   never ``threading.Lock`` (PTCY003) — the handler may fire while the
+   same thread already holds the lock.
+4. **Thread hygiene.** Every spawned thread is ``daemon=True`` AND
+   joined with a bounded timeout on the owner's close/retire path
+   (PTCY005); shared attributes are written under one designated lock
+   (PTCY004).
+
+Findings are suppressed inline, never in a side file::
+
+    with self._lock:          # ptcy: allow(PTCY002) bounded local pipe, audited
+        self._sock.sendall(b)
+
+The pragma must carry a justification (>= 8 chars) or the lint emits
+PTCY000. Suppressed findings are still collected and reported (with
+their justification) by ``tools/check_concurrency.py`` — nothing is
+silently dropped.
+
+The runtime half lives in :mod:`paddle_tpu.observability.lockwitness`;
+:func:`confirm_with_witness` upgrades a static PTCY001 cycle whose
+edges were actually observed at runtime with the witnessed stacks.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Diagnostic, Report
+
+__all__ = ["lint_paths", "analyze_package", "confirm_with_witness",
+           "LockDef", "FnInfo"]
+
+_PASS = "concurrency"
+
+# Method names too common to resolve via the unique-name fallback: a
+# call to e.g. ``q.get()`` must not be "resolved" to some unrelated
+# package method that happens to be the only ``get`` we indexed.
+_COMMON_NAMES = {
+    "get", "put", "pop", "append", "add", "remove", "close", "start",
+    "run", "join", "send", "recv", "log", "submit", "stop", "step",
+    "status", "read", "write", "flush", "acquire", "release", "set",
+    "clear", "update", "poll", "tick", "free", "alloc", "reset",
+    "open", "next", "items", "keys", "values", "copy", "count",
+    "index", "insert", "extend", "sort", "wait", "notify", "cancel",
+    "name", "state", "snapshot", "stats", "check", "emit", "handle",
+    "main", "init", "call", "apply", "dump", "load", "save",
+}
+
+# stdlib-ish module names whose calls we classify as blocking rather
+# than try to resolve into the package
+_BLOCKING_SLEEP = {("time", "sleep")}
+_SOCKET_METHODS = {"sendall", "recv", "recvfrom", "connect", "accept",
+                   "connect_ex", "sendto"}
+_PRAGMA_RE = re.compile(
+    r"#\s*ptcy:\s*allow\(\s*([A-Z0-9,\s]+?)\s*\)\s*(.*)$")
+
+
+@dataclass
+class LockDef:
+    """A lock *identity*: where a Lock/RLock is created and bound."""
+    lock_id: str            # e.g. "paddle_tpu.serving.fleet.FleetRouter._lock"
+    kind: str               # "Lock" | "RLock" | "unknown"
+    witness_name: Optional[str] = None   # named_lock("...") string arg
+    file: str = ""
+    line: int = 0
+
+
+@dataclass
+class FnInfo:
+    """Per-function facts gathered in one AST walk."""
+    qual: str               # "module.Class.method" or "module.func"
+    module: str
+    cls: Optional[str]
+    name: str
+    file: str
+    line: int
+    # (lock_id, line, held_before: tuple of lock_ids)
+    acquires: List[Tuple[str, int, tuple]] = field(default_factory=list)
+    # (blocking-kind label, line, held)
+    blocking: List[Tuple[str, int, tuple]] = field(default_factory=list)
+    # (descriptor, line, held)
+    calls: List[Tuple[tuple, int, tuple]] = field(default_factory=list)
+    # (attr_key "Class.attr" or "module:<name>", line, held)
+    writes: List[Tuple[str, int, tuple]] = field(default_factory=list)
+    # (target descriptor, daemon: bool|None, line, binding name|None)
+    spawns: List[Tuple[tuple, Optional[bool], int, Optional[str]]] = \
+        field(default_factory=list)
+    # (kind: "signal"|"atexit"|"excepthook", target descriptor, line)
+    registers: List[Tuple[str, tuple, int]] = field(default_factory=list)
+    # names joined: local var names and "self.attr" strings
+    joins: Set[str] = field(default_factory=set)
+
+
+class _ModuleFacts:
+    def __init__(self, module: str, file: str):
+        self.module = module
+        self.file = file
+        self.functions: Dict[str, FnInfo] = {}   # qual -> FnInfo
+        self.locks: Dict[str, LockDef] = {}      # lock_id -> LockDef
+        self.classes: Dict[str, dict] = {}       # cls -> {"bases": [...],
+        #   "methods": set, "attr_types": {attr: (module, Class)}}
+        self.imports: Dict[str, str] = {}        # alias -> module path
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name ->
+        #   (module path, original name)
+        self.global_types: Dict[str, Tuple[str, str]] = {}  # var ->
+        #   (module, Class)
+        self.source_lines: List[str] = []
+
+
+def _is_threading_lock_ctor(node: ast.AST, facts: "_ModuleFacts"):
+    """Return ("Lock"|"RLock", witness_name|None) if `node` constructs a
+    lock, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = f.value.id
+        mod = facts.imports.get(base, base)
+        if mod == "threading" and f.attr in ("Lock", "RLock"):
+            name = f.attr
+        elif f.attr in ("named_lock", "named_rlock") and (
+                mod.endswith("lockwitness") or base == "lockwitness"):
+            name = "Lock" if f.attr == "named_lock" else "RLock"
+    elif isinstance(f, ast.Name):
+        tgt = facts.from_imports.get(f.id)
+        if tgt and tgt[0] == "threading" and tgt[1] in ("Lock", "RLock"):
+            name = tgt[1]
+        elif f.id in ("named_lock", "named_rlock"):
+            tgt = facts.from_imports.get(f.id)
+            if tgt is None or tgt[0].endswith("lockwitness"):
+                name = "Lock" if f.id == "named_lock" else "RLock"
+    if name is None:
+        return None
+    wname = None
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        wname = node.args[0].value
+    return name, wname
+
+
+def _ctor_class(node: ast.AST, facts: "_ModuleFacts"):
+    """If `node` is ``Class(...)`` or ``mod.Class(...)`` for a class we
+    might know, return (module_guess, ClassName) else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name) and f.id[:1].isupper():
+        tgt = facts.from_imports.get(f.id)
+        if tgt:
+            return tgt[0], tgt[1]
+        if f.id in facts.classes:
+            return facts.module, f.id
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.attr[:1].isupper():
+        mod = facts.imports.get(f.value.id)
+        if mod:
+            return mod, f.attr
+    return None
+
+
+_LOCKNAME_RE = re.compile(r"(^|_)(lock|mu|mutex)$|lock$", re.I)
+
+
+def _looks_like_lock(attr: str) -> bool:
+    return bool(_LOCKNAME_RE.search(attr))
+
+
+class _FnScanner:
+    """One function body -> one FnInfo, with lexical held-lock
+    tracking through ``with`` blocks and statement-level
+    ``acquire()``/``release()`` calls."""
+
+    def __init__(self, facts: _ModuleFacts, qual: str,
+                 cls: Optional[str], node: ast.AST, all_facts: dict):
+        self.facts = facts
+        self.cls = cls
+        self.node = node
+        self.all_facts = all_facts
+        self.info = FnInfo(qual=qual, module=facts.module, cls=cls,
+                           name=node.name, file=facts.file,
+                           line=node.lineno)
+        self.local_types: Dict[str, Tuple[str, str]] = {}
+        self.local_locks: Dict[str, str] = {}
+        self.consumed: Set[int] = set()
+
+    # ---- lock identity -------------------------------------------------
+    def _lock_id_of(self, expr: ast.AST) -> Optional[str]:
+        facts = self.facts
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            lid = f"{facts.module}.{expr.id}"
+            if lid in facts.locks or (expr.id in facts.module_globals
+                                      and _looks_like_lock(expr.id)):
+                facts.locks.setdefault(lid, LockDef(
+                    lid, "unknown", None, facts.file, expr.lineno))
+                return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.cls:
+                lid = f"{facts.module}.{self.cls}.{expr.attr}"
+                # defined on a base class in this module?
+                if lid not in facts.locks:
+                    for b in facts.classes.get(self.cls, {}).get(
+                            "bases", []):
+                        alt = f"{facts.module}.{b}.{expr.attr}"
+                        if alt in facts.locks:
+                            return alt
+                if lid in facts.locks or _looks_like_lock(expr.attr):
+                    facts.locks.setdefault(lid, LockDef(
+                        lid, "unknown", None, facts.file, expr.lineno))
+                    return lid
+                return None
+            if isinstance(base, ast.Name):
+                t = self.local_types.get(base.id) or \
+                    facts.global_types.get(base.id)
+                if t and _looks_like_lock(expr.attr):
+                    return f"{t[0]}.{t[1]}.{expr.attr}"
+                mod = facts.imports.get(base.id)
+                if mod and _looks_like_lock(expr.attr):
+                    return f"{mod}.{expr.attr}"
+        return None
+
+    # ---- descriptors ---------------------------------------------------
+    def _desc_of(self, expr: ast.AST):
+        facts = self.facts
+        if isinstance(expr, ast.Call):
+            # functools.partial(f, ...) -> descriptor of f
+            f = expr.func
+            is_partial = (isinstance(f, ast.Name) and f.id == "partial") \
+                or (isinstance(f, ast.Attribute) and f.attr == "partial")
+            if is_partial and expr.args:
+                return self._desc_of(expr.args[0])
+            return None
+        if isinstance(expr, ast.Name):
+            tgt = facts.from_imports.get(expr.id)
+            if tgt:
+                return ("mod_attr", tgt[0], tgt[1])
+            return ("name", expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    return ("self_attr", expr.attr)
+                mod = facts.imports.get(base.id)
+                if mod:
+                    return ("mod_attr", mod, expr.attr)
+                return ("var_attr", base.id, expr.attr)
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                return ("selfattr_attr", base.attr, expr.attr)
+        return None
+
+    def _recv_type(self, desc):
+        """(module, Class) hint for a call receiver, if inferable."""
+        if not desc:
+            return None
+        if desc[0] == "var_attr":
+            return self.local_types.get(desc[1]) or \
+                self.facts.global_types.get(desc[1])
+        if desc[0] == "selfattr_attr" and self.cls:
+            return self.facts.classes.get(self.cls, {}).get(
+                "attr_types", {}).get(desc[1])
+        if desc[0] == "self_attr" and self.cls:
+            return (self.facts.module, self.cls)
+        return None
+
+    # ---- expression walk ----------------------------------------------
+    def _expr(self, node, held: tuple):
+        if node is None or not isinstance(node, ast.AST):
+            return
+        if isinstance(node, ast.Call) and id(node) not in self.consumed:
+            self.consumed.add(id(node))
+            self._call(node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword,
+                                  ast.comprehension)):
+                self._expr(child, held)
+            elif isinstance(child, ast.arguments):
+                for d in list(child.defaults) + list(child.kw_defaults):
+                    self._expr(d, held)
+
+    def _is_thread_ctor(self, f: ast.AST) -> Optional[str]:
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if self.facts.imports.get(f.value.id, f.value.id) == \
+                    "threading" and f.attr in ("Thread", "Timer"):
+                return f.attr
+        if isinstance(f, ast.Name):
+            tgt = self.facts.from_imports.get(f.id)
+            if tgt and tgt[0] == "threading" and \
+                    tgt[1] in ("Thread", "Timer"):
+                return tgt[1]
+        return None
+
+    def _record_spawn(self, call: ast.Call, binding: Optional[str]):
+        target = daemon = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = self._desc_of(kw.value)
+                if target and target[0] == "name" and \
+                        target[1] in getattr(self, "nested_names", {}):
+                    target = ("nested", self.nested_names[target[1]])
+            elif kw.arg == "daemon" and \
+                    isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        self.info.spawns.append((target, daemon, call.lineno, binding))
+
+    def _call(self, node: ast.Call, held: tuple):
+        f = node.func
+        facts = self.facts
+        # thread spawn (possibly chained: Thread(...).start())
+        if self._is_thread_ctor(f):
+            self._record_spawn(node, None)
+            return
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Call) \
+                and self._is_thread_ctor(f.value.func) and \
+                f.attr == "start":
+            self.consumed.add(id(f.value))
+            self._record_spawn(f.value, None)
+            return
+        desc = self._desc_of(f)
+        # handler registrations
+        if desc and desc[0] == "mod_attr":
+            mod, attr = desc[1], desc[2]
+            if mod == "signal" and attr == "signal" and \
+                    len(node.args) >= 2:
+                h = self._desc_of(node.args[1])
+                if h:
+                    self.info.registers.append(
+                        ("signal", h, node.lineno))
+                return
+            if mod == "atexit" and attr == "register" and node.args:
+                h = self._desc_of(node.args[0])
+                if h:
+                    self.info.registers.append(
+                        ("atexit", h, node.lineno))
+                return
+        # join bookkeeping (PTCY005 evidence)
+        if isinstance(f, ast.Attribute) and f.attr == "join":
+            if isinstance(f.value, ast.Name):
+                self.info.joins.add(f.value.id)
+            elif isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                self.info.joins.add("self." + f.value.attr)
+        if desc is None:
+            return
+        nargs = len(node.args)
+        meta = {"nargs": nargs, "recv_type": self._recv_type(desc),
+                "attr": desc[-1] if desc[0] != "name" else None}
+        self.info.calls.append((desc, node.lineno, held, meta))
+
+    # ---- statement walk ------------------------------------------------
+    def _stmts(self, stmts, held: list):
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _stmt(self, st, held: list):
+        if isinstance(st, ast.With) or isinstance(st, ast.AsyncWith):
+            inner = list(held)
+            for item in st.items:
+                lid = self._lock_id_of(item.context_expr)
+                if lid is not None:
+                    self.info.acquires.append(
+                        (lid, st.lineno, tuple(inner)))
+                    inner.append(lid)
+                else:
+                    self._expr(item.context_expr, tuple(held))
+            self._stmts(st.body, inner)
+            return
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("acquire", "release"):
+                lid = self._lock_id_of(f.value)
+                if lid is not None:
+                    if f.attr == "acquire":
+                        self.info.acquires.append(
+                            (lid, st.lineno, tuple(held)))
+                        held.append(lid)
+                    elif lid in held:
+                        held.remove(lid)
+                    return
+            self._expr(st.value, tuple(held))
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(st, held)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{self.info.qual}.{st.name}"
+            if not hasattr(self, "nested_names"):
+                self.nested_names = {}
+            self.nested_names[st.name] = qual
+            sub = _FnScanner(self.facts, qual, self.cls, st,
+                             self.all_facts)
+            sub.local_types = dict(self.local_types)
+            sub.scan()
+            return
+        if isinstance(st, ast.If):
+            self._expr(st.test, tuple(held))
+            self._stmts(st.body, list(held))
+            self._stmts(st.orelse, list(held))
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, tuple(held))
+            self._stmts(st.body, list(held))
+            self._stmts(st.orelse, list(held))
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, tuple(held))
+            self._stmts(st.body, list(held))
+            self._stmts(st.orelse, list(held))
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, list(held))
+            for h in st.handlers:
+                self._stmts(h.body, list(held))
+            self._stmts(st.orelse, list(held))
+            self._stmts(st.finalbody, list(held))
+            return
+        if isinstance(st, (ast.Return, ast.Raise, ast.Assert,
+                           ast.Delete)):
+            for child in ast.iter_child_nodes(st):
+                self._expr(child, tuple(held))
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value, tuple(held))
+            return
+        # Pass/Break/Continue/Global/Nonlocal/Import...: nothing to do
+
+    def _assign(self, st, held: list):
+        value = getattr(st, "value", None)
+        targets = st.targets if isinstance(st, ast.Assign) else \
+            [st.target]
+        facts = self.facts
+        # local / global type + lock inference from the RHS (thread
+        # ctors checked first: Thread/Timer are spawns, not types)
+        lk = _is_threading_lock_ctor(value, facts) if value else None
+        spawn = (not lk and isinstance(value, ast.Call)
+                 and self._is_thread_ctor(value.func))
+        ctor = None if (lk or spawn) else (
+            _ctor_class(value, facts) if value else None)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if lk:
+                    if tgt.id in facts.module_globals:
+                        lid = f"{facts.module}.{tgt.id}"
+                        facts.locks[lid] = LockDef(
+                            lid, lk[0], lk[1], facts.file, st.lineno)
+                    else:
+                        lid = f"{self.info.qual}.<{tgt.id}>"
+                        facts.locks[lid] = LockDef(
+                            lid, lk[0], lk[1], facts.file, st.lineno)
+                        self.local_locks[tgt.id] = lid
+                elif ctor:
+                    if tgt.id in facts.module_globals:
+                        facts.global_types[tgt.id] = ctor
+                    else:
+                        self.local_types[tgt.id] = ctor
+                elif value is not None and isinstance(value, ast.Call) \
+                        and self._is_thread_ctor(value.func):
+                    self.consumed.add(id(value))
+                    self._record_spawn(value, tgt.id)
+                if tgt.id in facts.module_globals and \
+                        self.info.name != "__init__":
+                    self.info.writes.append(
+                        (f"{facts.module}:{tgt.id}", st.lineno,
+                         tuple(held)))
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name):
+                base = tgt.value.id
+                if base == "self" and self.cls:
+                    if lk:
+                        lid = f"{facts.module}.{self.cls}.{tgt.attr}"
+                        facts.locks[lid] = LockDef(
+                            lid, lk[0], lk[1], facts.file, st.lineno)
+                    elif ctor:
+                        facts.classes.setdefault(self.cls, {
+                            "bases": [], "methods": set(),
+                            "attr_types": {}})["attr_types"][
+                                tgt.attr] = ctor
+                    elif value is not None and \
+                            isinstance(value, ast.Call) and \
+                            self._is_thread_ctor(value.func):
+                        self.consumed.add(id(value))
+                        self._record_spawn(value, "self." + tgt.attr)
+                    if self.info.name != "__init__" and not lk:
+                        self.info.writes.append(
+                            (f"{facts.module}.{self.cls}.{tgt.attr}",
+                             st.lineno, tuple(held)))
+                elif tgt.attr == "daemon" and value is not None and \
+                        isinstance(value, ast.Constant):
+                    for i in range(len(self.info.spawns) - 1, -1, -1):
+                        t, d, ln, b = self.info.spawns[i]
+                        if b == base:
+                            self.info.spawns[i] = (
+                                t, bool(value.value), ln, b)
+                            break
+                elif base in ("sys", "threading") or \
+                        facts.imports.get(base) in ("sys", "threading"):
+                    if tgt.attr == "excepthook" and value is not None:
+                        h = self._desc_of(value)
+                        if h:
+                            self.info.registers.append(
+                                ("excepthook", h, st.lineno))
+        if value is not None and not lk and \
+                id(value) not in self.consumed:
+            self._expr(value, tuple(held))
+
+    def scan(self) -> FnInfo:
+        self._stmts(self.node.body, [])
+        self.facts.functions[self.info.qual] = self.info
+        return self.info
+
+
+# ---------------------------------------------------------------------------
+# module scan driver
+# ---------------------------------------------------------------------------
+
+def _rel_base(module_name: str, level: int, is_init: bool) -> str:
+    parts = module_name.split(".")
+    keep = len(parts) - (level - 1 if is_init else level)
+    return ".".join(parts[:max(keep, 0)])
+
+
+def _scan_module(path: str, module_name: str) -> _ModuleFacts:
+    facts = _ModuleFacts(module_name, path)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    facts.source_lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    facts.module_globals = set()
+    is_init = os.path.basename(path) == "__init__.py"
+    # imports anywhere in the module (function-level imports included)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                facts.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                base = _rel_base(module_name, node.level, is_init)
+                mod = f"{base}.{mod}" if mod else base
+            for a in node.names:
+                asname = a.asname or a.name
+                facts.from_imports[asname] = (mod, a.name)
+                # names imported from a package are often submodules
+                facts.imports.setdefault(asname, f"{mod}.{a.name}")
+    # module-global names, classes, module-level locks/types
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    facts.module_globals.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            facts.module_globals.add(node.target.id)
+        elif isinstance(node, ast.ClassDef):
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            facts.classes[node.name] = {
+                "bases": bases,
+                "methods": {m.name for m in node.body if isinstance(
+                    m, (ast.FunctionDef, ast.AsyncFunctionDef))},
+                "attr_types": {}}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            lk = _is_threading_lock_ctor(node.value, facts)
+            ctor = None if lk else _ctor_class(node.value, facts)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if lk:
+                    lid = f"{module_name}.{t.id}"
+                    facts.locks[lid] = LockDef(
+                        lid, lk[0], lk[1], path, node.lineno)
+                elif ctor:
+                    facts.global_types[t.id] = ctor
+    # function bodies
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FnScanner(facts, f"{module_name}.{node.name}", None,
+                       node, {}).scan()
+        elif isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _FnScanner(
+                        facts, f"{module_name}.{node.name}.{m.name}",
+                        node.name, m, {}).scan()
+    return facts
+
+
+def _module_name_for(path: str) -> str:
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    parts.reverse()
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# global index: call resolution + closures
+# ---------------------------------------------------------------------------
+
+def _short(lock_id: str) -> str:
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 2 else lock_id
+
+
+class _Index:
+    def __init__(self, facts_list):
+        self.functions: Dict[str, FnInfo] = {}
+        self.locks: Dict[str, LockDef] = {}
+        self.modules: Dict[str, _ModuleFacts] = {}
+        for facts in facts_list:
+            self.modules[facts.module] = facts
+            self.functions.update(facts.functions)
+            for lid, ld in facts.locks.items():
+                cur = self.locks.get(lid)
+                if cur is None or (cur.kind == "unknown"
+                                   and ld.kind != "unknown"):
+                    self.locks[lid] = ld
+        self.method_names: Dict[str, List[str]] = {}
+        for qual, fn in self.functions.items():
+            self.method_names.setdefault(fn.name, []).append(qual)
+        self._blk: Dict[str, list] = {}
+        self._acq: Dict[str, list] = {}
+
+    def kind_of(self, lock_id: str) -> str:
+        ld = self.locks.get(lock_id)
+        return ld.kind if ld else "unknown"
+
+    def witness_name_of(self, lock_id: str) -> Optional[str]:
+        ld = self.locks.get(lock_id)
+        return ld.witness_name if ld else None
+
+    def _method(self, module: str, cls: Optional[str], name: str):
+        seen = set()
+        stack = [(module, cls)]
+        while stack:
+            m, c = stack.pop()
+            if not c or (m, c) in seen:
+                continue
+            seen.add((m, c))
+            q = f"{m}.{c}.{name}"
+            if q in self.functions:
+                return q
+            mf = self.modules.get(m)
+            ci = mf.classes.get(c) if mf else None
+            if not ci:
+                continue
+            for b in ci["bases"]:
+                tgt = mf.from_imports.get(b)
+                stack.append((tgt[0], tgt[1]) if tgt else (m, b))
+        return None
+
+    def _unique(self, name: str):
+        if name in _COMMON_NAMES or name.startswith("__"):
+            return None
+        quals = self.method_names.get(name, [])
+        return quals[0] if len(quals) == 1 else None
+
+    def resolve(self, fn: FnInfo, desc, meta=None):
+        if desc is None:
+            return None
+        k = desc[0]
+        facts = self.modules.get(fn.module)
+        if k == "nested":
+            return desc[1] if desc[1] in self.functions else None
+        if k == "name":
+            for q in (f"{fn.qual}.{desc[1]}", f"{fn.module}.{desc[1]}"):
+                if q in self.functions:
+                    return q
+            return self._unique(desc[1])
+        if k == "mod_attr":
+            q = f"{desc[1]}.{desc[2]}"
+            if q in self.functions:
+                return q
+            # from-import of a class: "pkg.mod.Class" + method
+            head, _, cls = desc[1].rpartition(".")
+            if head in self.modules and cls[:1].isupper():
+                return self._method(head, cls, desc[2])
+            return None
+        if k == "self_attr":
+            got = self._method(fn.module, fn.cls, desc[1])
+            return got or self._unique(desc[1])
+        rt = (meta or {}).get("recv_type")
+        if rt is None and facts is not None:
+            if k == "var_attr":
+                rt = facts.global_types.get(desc[1])
+            elif k == "selfattr_attr" and fn.cls:
+                rt = facts.classes.get(fn.cls, {}).get(
+                    "attr_types", {}).get(desc[1])
+        if rt is not None:
+            got = self._method(rt[0], rt[1], desc[-1])
+            if got:
+                return got
+        if k in ("var_attr", "selfattr_attr") and rt is None:
+            return self._unique(desc[-1])
+        return None
+
+    # -- transitive facts ------------------------------------------------
+    def blocking_closure(self, qual: str, _stack=()):
+        if qual in self._blk:
+            return self._blk[qual]
+        if qual in _stack:
+            return []
+        fn = self.functions.get(qual)
+        if fn is None:
+            return []
+        out, seen = [], set()
+        for (desc, line, held, meta) in fn.calls:
+            tgt = self.resolve(fn, desc, meta)
+            if tgt is None:
+                bk = _classify_blocking(desc, meta)
+                if bk and (bk, fn.file, line) not in seen:
+                    seen.add((bk, fn.file, line))
+                    out.append((bk, fn.file, line, (qual,)))
+            else:
+                for (bk, f2, l2, path) in self.blocking_closure(
+                        tgt, _stack + (qual,)):
+                    if (bk, f2, l2) not in seen and len(out) < 20:
+                        seen.add((bk, f2, l2))
+                        out.append((bk, f2, l2, (qual,) + path))
+        if not _stack:
+            self._blk[qual] = out
+        return out
+
+    def acquired_closure(self, qual: str, _stack=()):
+        if qual in self._acq:
+            return self._acq[qual]
+        if qual in _stack:
+            return []
+        fn = self.functions.get(qual)
+        if fn is None:
+            return []
+        out, seen = [], set()
+        for (lock, line, _held) in fn.acquires:
+            if lock not in seen:
+                seen.add(lock)
+                out.append((lock, fn.file, line, (qual,)))
+        for (desc, line, held, meta) in fn.calls:
+            tgt = self.resolve(fn, desc, meta)
+            if tgt is not None:
+                for (lk, f2, l2, path) in self.acquired_closure(
+                        tgt, _stack + (qual,)):
+                    if lk not in seen and len(out) < 40:
+                        seen.add(lk)
+                        out.append((lk, f2, l2, (qual,) + path))
+        if not _stack:
+            self._acq[qual] = out
+        return out
+
+
+def _classify_blocking(desc, meta):
+    """Blocking label for an UNRESOLVED call, else None. Resolution into
+    the package always wins — ``self._send`` that we resolved is judged
+    by its body, not its name."""
+    if desc is None:
+        return None
+    if desc[0] == "mod_attr":
+        mod, attr = desc[1], desc[2]
+        if (mod, attr) == ("time", "sleep"):
+            return "time.sleep"
+        if mod == "subprocess":
+            return f"subprocess.{attr}"
+        if mod == "socket" and attr in ("create_connection",
+                                        "create_server"):
+            return f"socket.{attr}"
+        if attr == "urlopen":
+            return "urllib urlopen"
+        if mod == "os" and attr in ("system", "waitpid"):
+            return f"os.{attr}"
+        return None
+    meta = meta or {}
+    attr = meta.get("attr") or (desc[-1] if desc[0] != "name" else None)
+    if attr is None:
+        return None
+    nargs = meta.get("nargs", 1)
+    rt = meta.get("recv_type")
+    if attr in _SOCKET_METHODS and desc[0] in ("var_attr",
+                                               "selfattr_attr"):
+        return f"socket .{attr}()"
+    if attr == "join" and nargs == 0:
+        return "Thread.join"
+    if attr == "get" and nargs == 0 and rt and rt[0] == "queue":
+        return "queue.get"
+    if attr == "block_until_ready":
+        return ".block_until_ready() device sync"
+    if attr == "numpy" and nargs == 0:
+        return ".numpy() device sync"
+    if attr == "wait" and nargs == 0 and desc[0] == "var_attr":
+        return ".wait()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+def _d(code, severity, message, file, line, **extra):
+    return Diagnostic(code=code, pass_name=_PASS, severity=severity,
+                      message=message, file=file, line=line, extra=extra)
+
+
+def _check_blocking_under_lock(idx: _Index):
+    out, seen = [], set()
+    for fn in idx.functions.values():
+        for (desc, line, held, meta) in fn.calls:
+            if not held:
+                continue
+            locks = ", ".join(_short(h) for h in held)
+            tgt = idx.resolve(fn, desc, meta)
+            if tgt is None:
+                bk = _classify_blocking(desc, meta)
+                if bk and (fn.file, line, bk) not in seen:
+                    seen.add((fn.file, line, bk))
+                    out.append(_d(
+                        "PTCY002", "error",
+                        f"{bk} while holding {locks} in {fn.qual}",
+                        fn.file, line, locks=list(held), kind=bk))
+            else:
+                for (bk, f2, l2, path) in idx.blocking_closure(tgt):
+                    key = (fn.file, line, bk, f2, l2)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = " -> ".join(path)
+                    out.append(_d(
+                        "PTCY002", "error",
+                        f"{bk} (via {via} at "
+                        f"{os.path.basename(f2)}:{l2}) while holding "
+                        f"{locks} in {fn.qual}",
+                        fn.file, line, locks=list(held), kind=bk,
+                        via=list(path), site=[f2, l2]))
+                    break  # one transitive finding per call site
+    return out
+
+
+def _check_lock_order(idx: _Index):
+    # edge (src held -> dst acquired), with one representative site
+    edges: Dict[tuple, dict] = {}
+
+    def add_edge(src, dst, fn, line, via=None):
+        if src == dst:
+            # re-acquire of the same lock: only a bug for plain Locks
+            if idx.kind_of(src) != "Lock":
+                return
+        edges.setdefault((src, dst), {
+            "fn": fn.qual, "file": fn.file, "line": line,
+            "via": list(via or ())})
+
+    for fn in idx.functions.values():
+        for (lock, line, held) in fn.acquires:
+            for h in held:
+                add_edge(h, lock, fn, line)
+        for (desc, line, held, meta) in fn.calls:
+            if not held:
+                continue
+            tgt = idx.resolve(fn, desc, meta)
+            if tgt is None:
+                continue
+            for (lk, f2, l2, path) in idx.acquired_closure(tgt):
+                for h in held:
+                    add_edge(h, lk, fn, line, via=path)
+
+    out = []
+    # self-deadlocks (Lock re-acquired while held) reported directly
+    for (src, dst), site in sorted(edges.items()):
+        if src != dst:
+            continue
+        out.append(_d(
+            "PTCY001", "error",
+            f"non-reentrant {_short(src)} re-acquired while already "
+            f"held (self-deadlock) in {site['fn']}",
+            site["file"], site["line"], cycle=[src],
+            witness_names=[idx.witness_name_of(src)],
+            edges=[{"src": src, "dst": dst, **site}]))
+    # cycles among distinct locks
+    from ..observability.lockwitness import cycles as _cycles
+    pairs = [(s, d) for (s, d) in edges if s != d]
+    for cyc in _cycles(pairs):
+        nodes = cyc[:-1]  # drop repeated first node
+        cyc_edges = []
+        for i, a in enumerate(nodes):
+            b = nodes[(i + 1) % len(nodes)]
+            site = edges.get((a, b), {})
+            cyc_edges.append({"src": a, "dst": b, **site})
+        first = cyc_edges[0]
+        chain = " -> ".join(_short(n) for n in nodes + [nodes[0]])
+        out.append(_d(
+            "PTCY001", "error",
+            f"lock-order inversion cycle: {chain} (e.g. "
+            f"{first.get('fn', '?')} acquires {_short(nodes[1])} while "
+            f"holding {_short(nodes[0])})",
+            first.get("file"), first.get("line"), cycle=nodes,
+            witness_names=[idx.witness_name_of(n) for n in nodes],
+            edges=cyc_edges))
+    return out
+
+
+_HANDLER_KIND = {"signal": "signal-handler", "atexit": "atexit",
+                 "excepthook": "excepthook"}
+
+
+def _check_signal_safety(idx: _Index):
+    out, seen = [], set()
+    roots = []
+    for fn in idx.functions.values():
+        for (kind, hdesc, line) in fn.registers:
+            tgt = idx.resolve(fn, hdesc, None)
+            if tgt:
+                roots.append((kind, tgt, fn.qual, line))
+    for (kind, root, regfn, regline) in roots:
+        stack = [(root, (root,))]
+        visited = set()
+        while stack:
+            qual, path = stack.pop()
+            if qual in visited:
+                continue
+            visited.add(qual)
+            fn = idx.functions.get(qual)
+            if fn is None:
+                continue
+            for (lock, line, _held) in fn.acquires:
+                if idx.kind_of(lock) != "Lock":
+                    continue
+                key = (lock, kind, root)
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = " -> ".join(path)
+                out.append(_d(
+                    "PTCY003", "error",
+                    f"non-reentrant threading.Lock {_short(lock)} "
+                    f"acquired on a {_HANDLER_KIND[kind]} path "
+                    f"({via}); use RLock — re-entry self-deadlocks "
+                    f"(registered at {regfn}:{regline})",
+                    fn.file, line, lock=lock, handler_kind=kind,
+                    path=list(path)))
+            for (desc, line, held, meta) in fn.calls:
+                tgt = idx.resolve(fn, desc, meta)
+                if tgt and tgt not in visited:
+                    stack.append((tgt, path + (tgt,)))
+    return out
+
+
+def _thread_roots(idx: _Index):
+    """Entrypoints that run on their own thread: spawn targets,
+    registered handlers, HTTP do_* methods."""
+    roots = set()
+    for fn in idx.functions.values():
+        for (target, _daemon, _line, _b) in fn.spawns:
+            tgt = idx.resolve(fn, target, None) if target else None
+            if tgt:
+                roots.add(tgt)
+        for (_kind, hdesc, _line) in fn.registers:
+            tgt = idx.resolve(fn, hdesc, None)
+            if tgt:
+                roots.add(tgt)
+        if fn.cls and re.match(r"do_[A-Z]+$", fn.name):
+            roots.add(fn.qual)
+    return roots
+
+
+def _check_unguarded_writes(idx: _Index):
+    roots = _thread_roots(idx)
+    # reach(root) -> {qual: held-along-path (first discovery)}
+    def reach(root):
+        got = {root: frozenset()}
+        stack = [(root, frozenset())]
+        while stack:
+            qual, pheld = stack.pop()
+            fn = idx.functions.get(qual)
+            if fn is None:
+                continue
+            for (desc, _line, held, meta) in fn.calls:
+                tgt = idx.resolve(fn, desc, meta)
+                if tgt and tgt not in got:
+                    nh = pheld | frozenset(held)
+                    got[tgt] = nh
+                    stack.append((tgt, nh))
+        return got
+
+    # key -> {root: [effective-held sets]}, plus a sample site
+    by_key: Dict[str, dict] = {}
+    site: Dict[str, tuple] = {}
+    for root in sorted(roots):
+        for qual, pheld in reach(root).items():
+            fn = idx.functions.get(qual)
+            if fn is None:
+                continue
+            for (key, line, held) in fn.writes:
+                eff = pheld | frozenset(held)
+                by_key.setdefault(key, {}).setdefault(
+                    root, []).append(eff)
+                site.setdefault(key, (fn.file, line))
+    out = []
+    for key, per_root in sorted(by_key.items()):
+        if len(per_root) < 2:
+            continue
+        all_sets = [s for sets in per_root.values() for s in sets]
+        common = frozenset.intersection(*all_sets) if all_sets else \
+            frozenset()
+        if common:
+            continue
+        f, ln = site[key]
+        out.append(_d(
+            "PTCY004", "warning",
+            f"{key} written from {len(per_root)} thread entrypoints "
+            f"({', '.join(sorted(per_root))}) with no common guarding "
+            f"lock",
+            f, ln, attr=key, roots=sorted(per_root)))
+    return out
+
+
+def _check_thread_shutdown(idx: _Index):
+    out = []
+    for fn in idx.functions.values():
+        for (target, daemon, line, binding) in fn.spawns:
+            if daemon is True:
+                continue
+            joined = False
+            if binding:
+                if binding.startswith("self."):
+                    joined = any(
+                        binding in g.joins
+                        for g in idx.functions.values()
+                        if g.module == fn.module and g.cls == fn.cls)
+                else:
+                    joined = binding in fn.joins
+            if joined and daemon is None:
+                # joined but non-daemon: acceptable shutdown story
+                continue
+            what = "non-daemon thread" if daemon is False or \
+                daemon is None else "thread"
+            tdesc = target[-1] if target else "?"
+            out.append(_d(
+                "PTCY005", "info",
+                f"{what} (target={tdesc}) spawned in {fn.qual} with no "
+                f"join on a shutdown path; daemonize AND join with a "
+                f"bounded timeout on close/retire",
+                fn.file, line, target=str(tdesc), binding=binding,
+                daemon=daemon))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+def _collect_pragmas(facts_list):
+    pragmas: Dict[tuple, tuple] = {}
+    diags = []
+    for facts in facts_list:
+        for i, text in enumerate(facts.source_lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",")
+                     if c.strip()}
+            just = m.group(2).strip()
+            pragmas[(facts.file, i)] = (codes, just)
+            if len(just) < 8:
+                diags.append(_d(
+                    "PTCY000", "error",
+                    "allowlist entry without justification: every "
+                    "'# ptcy: allow(...)' pragma must say WHY the "
+                    "finding is safe",
+                    facts.file, i, codes=sorted(codes)))
+    return pragmas, diags
+
+
+def _apply_pragmas(diags, pragmas):
+    active, suppressed = [], []
+    for d in diags:
+        just = None
+        if d.file and d.line:
+            for ln in (d.line, d.line - 1):
+                p = pragmas.get((d.file, ln))
+                if p and d.code in p[0] and len(p[1]) >= 8:
+                    just = p[1]
+                    break
+        if just is None:
+            active.append(d)
+        else:
+            d.extra["suppressed"] = True
+            d.extra["justification"] = just
+            suppressed.append(d)
+    return active, suppressed
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def lint_paths(paths, package_root=None):
+    """Lint the given files/directories. Returns ``(active,
+    suppressed)`` — both lists of :class:`Diagnostic`; suppressed
+    findings carry ``extra["justification"]``."""
+    files = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        files.append(os.path.join(dirpath, fname))
+        elif p.endswith(".py"):
+            files.append(p)
+    facts_list = []
+    for f in sorted(set(files)):
+        facts_list.append(_scan_module(f, _module_name_for(f)))
+    idx = _Index(facts_list)
+    diags = []
+    diags += _check_lock_order(idx)
+    diags += _check_blocking_under_lock(idx)
+    diags += _check_signal_safety(idx)
+    diags += _check_unguarded_writes(idx)
+    diags += _check_thread_shutdown(idx)
+    pragmas, pragma_diags = _collect_pragmas(facts_list)
+    active, suppressed = _apply_pragmas(diags, pragmas)
+    active += pragma_diags
+    active.sort(key=lambda d: (_SEV_ORDER.get(d.severity, 3),
+                               d.file or "", d.line or 0, d.code))
+    suppressed.sort(key=lambda d: (d.file or "", d.line or 0))
+    return active, suppressed
+
+
+def analyze_package(root=None) -> Report:
+    """Self-lint: run the concurrency sanitizer over the ``paddle_tpu``
+    package (or ``root``). The returned Report gains a ``.suppressed``
+    list of allowlisted findings (with justifications)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    active, suppressed = lint_paths([root])
+    rep = Report(target_name=os.path.basename(root.rstrip(os.sep)),
+                 diagnostics=active)
+    rep.suppressed = suppressed
+    return rep
+
+
+def confirm_with_witness(diagnostics, witness_snapshot) -> int:
+    """Upgrade static PTCY001 cycles whose every edge was actually
+    observed by the runtime lock witness: sets
+    ``extra["witnessed"]=True`` and attaches the observed stacks.
+    Returns the number of upgraded findings. Matching is by witness
+    name (``lockwitness.named_lock("...")``), so only named locks can
+    be confirmed."""
+    observed = {}
+    for e in witness_snapshot.get("edges", []):
+        observed[(e["src"], e["dst"])] = e
+    n = 0
+    for d in diagnostics:
+        if d.code != "PTCY001":
+            continue
+        names = (d.extra or {}).get("witness_names") or []
+        if not names or any(x is None for x in names):
+            continue
+        if len(names) == 1:
+            pairs = [(names[0], names[0])]
+        else:
+            pairs = [(names[i], names[(i + 1) % len(names)])
+                     for i in range(len(names))]
+        if all(p in observed for p in pairs):
+            d.extra["witnessed"] = True
+            d.extra["observed_stacks"] = {
+                f"{a} -> {b}": observed[(a, b)].get("stack", "")
+                for (a, b) in pairs}
+            n += 1
+    return n

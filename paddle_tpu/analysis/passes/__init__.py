@@ -9,3 +9,4 @@ from . import deadcode     # noqa: F401
 from . import cost         # noqa: F401
 from . import memory       # noqa: F401
 from . import donation     # noqa: F401
+from . import concurrency  # noqa: F401

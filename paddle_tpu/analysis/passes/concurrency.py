@@ -1,0 +1,24 @@
+"""Concurrency pass adapter: registers the host concurrency sanitizer
+(:mod:`..concurrency`) with the pass registry.
+
+Unlike the trace-based passes, this one lints *source trees*, not
+jaxprs — it only fires when the analysis context carries
+``concurrency_roots`` (a list of files/directories to lint). The
+normal entrypoints are ``analysis.concurrency.analyze_package()`` and
+``tools/check_concurrency.py``; this adapter exists so a Report built
+through the standard analyzer can fold host-concurrency findings next
+to the trace-based ones.
+"""
+from __future__ import annotations
+
+from ..concurrency import lint_paths
+from ..core import register_pass
+
+
+@register_pass("concurrency", order=90)
+def concurrency_pass(ctx):
+    roots = getattr(ctx, "concurrency_roots", None)
+    if not roots:
+        return []
+    active, _suppressed = lint_paths(list(roots))
+    return active

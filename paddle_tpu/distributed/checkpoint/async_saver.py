@@ -55,7 +55,11 @@ class AsyncSaver:
         self.name = name
         self._thread = None
         self._error = None
-        self._lock = threading.Lock()
+        # RLock: emergency_save from the SIGTERM handler reaches
+        # submit() and may interrupt the main thread mid-submit() with
+        # the lock held — re-entry on a plain Lock self-deadlocks the
+        # grace window (PTCY003)
+        self._lock = threading.RLock()
         self.last_save_seconds = None
         self.saves_submitted = 0
 

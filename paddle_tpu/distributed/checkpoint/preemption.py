@@ -43,7 +43,11 @@ class PreemptionHandler:
         self.triggered = False
         self._installed = False
         self._previous = {}
-        self._lock = threading.Lock()
+        # RLock: _handle runs inside a signal handler that may have
+        # interrupted a thread already holding this lock (check()/
+        # install() on the main thread) — re-entry on a plain Lock
+        # self-deadlocks the grace window (PTCY003)
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------ install
     def install(self):

@@ -20,6 +20,8 @@ import socket
 import threading
 import time
 
+from ....observability import lockwitness
+
 
 class FaultInjector:
     """Inject process faults into a launcher's worker pod.
@@ -195,11 +197,12 @@ class ChaosProxy:
                        ("duplicate", float(dup_p)),
                        ("truncate", float(truncate_p)),
                        ("bitflip", float(bitflip_p))]
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("chaos.proxy")
         self._conn_n = 0
         self.faults: list = []      # (conn_index, fault) in accept order
         self._closed = False
         self._conns: list = []      # live (client, upstream) socket pairs
+        self._threads: list = []    # per-connection workers (joined in close)
         self._srv = socket.create_server(("127.0.0.1", 0))
         self._srv.settimeout(0.25)
         self.addr = self._srv.getsockname()
@@ -250,8 +253,11 @@ class ChaosProxy:
                 except OSError:
                     pass
                 continue
-            threading.Thread(target=self._handle, args=(client, fault),
-                             name="chaos-proxy-conn", daemon=True).start()
+            t = threading.Thread(target=self._handle, args=(client, fault),
+                                 name="chaos-proxy-conn", daemon=True)
+            with self._lock:
+                self._threads.append(t)
+            t.start()
 
     def _handle(self, client, fault: str):
         try:
@@ -338,6 +344,13 @@ class ChaosProxy:
                 except OSError:
                     pass
         self._acceptor.join(timeout=2.0)
+        # bounded join of every per-connection worker: daemonized AND
+        # joined, so test teardown can't leak threads (PTCY005)
+        with self._lock:
+            workers = list(self._threads)
+            self._threads.clear()
+        for t in workers:
+            t.join(timeout=2.0)
 
     def __enter__(self):
         return self

@@ -34,6 +34,7 @@ def _load_lib():
             # per-pid temp output: N launcher ranks may compile concurrently
             tmp = f"{_SO}.{os.getpid()}.tmp"
             try:
+                # ptcy: allow(PTCY002) one-time bounded (timeout=120) g++ build; _lib_lock is a leaf lock that exists to serialize exactly this compile
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      "-pthread", _SRC, "-o", tmp],

@@ -511,6 +511,30 @@ def collect_findings(summary: dict, attribution: dict | None = None,
         add("warn", "restarts",
             f"{summary['restarts']} elastic relaunch(es) — step series "
             f"span multiple generations")
+    lw = summary.get("lock_witness") or {}
+    for cyc in lw.get("cycles") or []:
+        add("crit", "lock_order_cycle",
+            "witnessed lock-order cycle " + " -> ".join(cyc)
+            + " — two threads actually took these locks in opposite "
+              "orders at runtime (PTCY001 confirmed); see the "
+              "lock_witness edges' stacks in the run events")
+    worst = None
+    for name, w in (lw.get("waits") or {}).items():
+        acq = int(w.get("acquires") or 0)
+        rate = (w.get("contended", 0) / acq) if acq else 0.0
+        hot = float(w.get("wait_max") or 0.0) > 1.0 or \
+            (acq > 100 and rate > 0.2)
+        if hot and (worst is None or w.get("wait_sum", 0.0) >
+                    worst[1].get("wait_sum", 0.0)):
+            worst = (name, w)
+    if worst:
+        name, w = worst
+        acq = int(w.get("acquires") or 0)
+        add("warn", "lock_contention",
+            f"lock '{name}' is contended: {w.get('contended', 0)}/{acq} "
+            f"acquires waited, max wait {w.get('wait_max', 0.0):.3f}s "
+            f"(total {w.get('wait_sum', 0.0):.3f}s) — threads serialize "
+            f"on it; shrink its critical section or split the lock")
     steps = int((summary.get("step_time") or {}).get("count") or 0)
     skips = int(summary.get("loss_scale_skips") or 0)
     if steps and skips and skips / steps > 0.05:

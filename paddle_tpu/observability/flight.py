@@ -39,6 +39,8 @@ import threading
 import time
 import traceback as _tb
 
+from . import lockwitness
+
 DEFAULT_CAPACITY = int(os.environ.get("PADDLE_FLIGHT_CAPACITY", 256))
 # throttle for soft reasons (anomaly storms must not turn the run into
 # an I/O benchmark); hard reasons (exception/preemption) always dump
@@ -74,7 +76,7 @@ class FlightRecorder:
         # RLock: dump() may re-enter from a SIGTERM handler that
         # interrupted record()/record_step() on the main thread mid-
         # critical-section — a plain Lock would deadlock the grace window
-        self._lock = threading.RLock()
+        self._lock = lockwitness.named_rlock("flight.recorder")
         self._step_seq = 0
         self._last_soft_dump: dict = {}   # reason -> last dump monotonic
         self._installed_excepthook = False
@@ -217,7 +219,10 @@ class FlightRecorder:
 
 
 _recorder: FlightRecorder | None = None
-_recorder_lock = threading.Lock()
+# RLock: dump_on_preemption() runs in the SIGTERM handler and calls
+# get_flight_recorder(); the signal may interrupt a first-call
+# get_flight_recorder() already inside this lock (PTCY003)
+_recorder_lock = threading.RLock()
 
 
 def get_flight_recorder() -> FlightRecorder:

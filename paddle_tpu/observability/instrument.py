@@ -76,6 +76,8 @@ paddle_fleet_rpc_retries_total        counter    op
 paddle_fleet_migrations_total         counter    outcome={completed,failed,
                                                  requeue_fallback}
 paddle_fleet_migrated_bytes_total     counter    —
+paddle_lock_wait_seconds              histogram  lock
+paddle_lock_contention_total          counter    lock
 ====================================  =========  =============================
 
 Serving decode steps additionally ride ``record_train_step`` with
@@ -98,6 +100,26 @@ from .metrics import get_registry
 # step-time buckets from 0.5ms to 2min, tuned around training step scales
 STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 120.0)
+# lock-wait buckets from 1µs to 10s: uncontended acquires land in the
+# first buckets, anything past ~100ms is a contention finding
+LOCK_WAIT_BUCKETS = (1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05,
+                     0.1, 0.5, 1.0, 5.0, 10.0)
+
+
+def lock_wait_histogram():
+    """Per-lock acquire wait (runtime lock witness,
+    ``PADDLE_LOCK_WITNESS=1``)."""
+    return get_registry().histogram(
+        "paddle_lock_wait_seconds",
+        "seconds spent waiting to acquire a witnessed lock",
+        buckets=LOCK_WAIT_BUCKETS)
+
+
+def lock_contention_counter():
+    """Contended acquires (a non-blocking probe failed first)."""
+    return get_registry().counter(
+        "paddle_lock_contention_total",
+        "witnessed lock acquires that had to wait")
 
 
 def step_seconds():

@@ -1,0 +1,276 @@
+"""Runtime lock witness: instrumented locks that record what actually
+happens at runtime — the acquisition-order graph and per-lock wait
+times — as the dynamic half of the static concurrency lint
+(:mod:`paddle_tpu.analysis.concurrency`).
+
+Opt-in via ``PADDLE_LOCK_WITNESS=1``. When the flag is off (the
+default), :func:`named_lock` / :func:`named_rlock` return plain
+``threading.Lock()`` / ``threading.RLock()`` objects — zero overhead,
+zero behavior change. When it is on, they return :class:`WitnessLock`
+wrappers that, on every successful acquire:
+
+- record one **acquisition-order edge** ``held → acquired`` for every
+  lock the acquiring thread already holds (the first observation of an
+  edge keeps a sample stack, so a witnessed lock-order cycle comes with
+  the two call paths that formed it);
+- record the **wait time** (contended iff a non-blocking probe failed
+  first) into the ``paddle_lock_wait_seconds`` histogram and the
+  ``paddle_lock_contention_total`` counter, labeled by lock name, plus
+  a process-local tally exported with the graph.
+
+:func:`snapshot` returns the witnessed graph; :func:`cycles` runs the
+lock-order cycle check over it (an acyclic witnessed graph is the
+runtime PTCY001 contract the chaos acceptance test asserts); and
+:func:`publish` writes one ``lock_witness`` runlog event that
+``merge_run_dir`` folds across ranks — a witnessed edge pair matching a
+static PTCY001 cycle upgrades the finding with the observed stacks
+(``analysis.concurrency.confirm_with_witness``). ``RunLogger.close``
+publishes automatically, so any witnessed run leaves its graph in the
+run dir without extra wiring.
+
+The witness's own bookkeeping lock is an RLock and every metrics /
+runlog call from inside the wrapper is guarded by a thread-local
+re-entrancy flag: witnessed locks are used by the telemetry stack
+itself (RunLogger, FlightRecorder), and the witness must never deadlock
+or recurse through the very locks it watches.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+__all__ = ["enabled", "named_lock", "named_rlock", "WitnessLock",
+           "snapshot", "cycles", "publish", "reset"]
+
+
+def enabled() -> bool:
+    return os.environ.get("PADDLE_LOCK_WITNESS", "").strip() in (
+        "1", "true", "on", "yes")
+
+
+_tls = threading.local()
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+class _WitnessState:
+    """Process-global witnessed graph + wait tallies."""
+
+    def __init__(self):
+        # RLock: witnessed locks wrap telemetry locks, and a metrics/
+        # runlog call made while recording could re-enter the witness.
+        self._mu = threading.RLock()
+        # (src, dst) -> {"count": n, "stack": sample formatted stack}
+        self.edges: dict = {}
+        # name -> {"acquires", "wait_sum", "wait_max", "contended"}
+        self.waits: dict = {}
+
+    def record(self, name: str, wait_s: float, contended: bool,
+               held: list):
+        stack = None
+        with self._mu:
+            w = self.waits.setdefault(name, {
+                "acquires": 0, "wait_sum": 0.0, "wait_max": 0.0,
+                "contended": 0})
+            w["acquires"] += 1
+            w["wait_sum"] += wait_s
+            w["wait_max"] = max(w["wait_max"], wait_s)
+            if contended:
+                w["contended"] += 1
+            for src in held:
+                if src == name:
+                    continue
+                key = (src, name)
+                e = self.edges.get(key)
+                if e is None:
+                    if stack is None:
+                        stack = "".join(
+                            traceback.format_stack(limit=12)[:-2])
+                    self.edges[key] = {"count": 1, "stack": stack}
+                else:
+                    e["count"] += 1
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "edges": [{"src": s, "dst": d, "count": e["count"],
+                           "stack": e["stack"]}
+                          for (s, d), e in sorted(self.edges.items())],
+                "waits": {n: dict(w)
+                          for n, w in sorted(self.waits.items())},
+            }
+
+    def reset(self):
+        with self._mu:
+            self.edges.clear()
+            self.waits.clear()
+
+
+_state = _WitnessState()
+
+
+class WitnessLock:
+    """A named Lock/RLock wrapper feeding the witness graph. Exposes
+    the stdlib lock surface (``acquire``/``release``/context manager/
+    ``locked``), so it drops in anywhere a plain lock is used."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = str(name)
+        self.reentrant = bool(reentrant)
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.monotonic()
+        got = self._inner.acquire(blocking=False)
+        contended = not got
+        if not got:
+            if not blocking:
+                return False
+            got = self._inner.acquire(True, timeout)
+        if not got:
+            return False
+        wait_s = time.monotonic() - t0
+        # re-entrancy guard: metrics/tally code below may itself take
+        # witnessed locks (telemetry stack); never record recursively
+        if not getattr(_tls, "in_witness", False):
+            _tls.in_witness = True
+            try:
+                held = list(_held_stack())
+                _state.record(self.name, wait_s, contended, held)
+                self._observe(wait_s, contended)
+            finally:
+                _tls.in_witness = False
+        _held_stack().append(self.name)
+        return True
+
+    def _observe(self, wait_s: float, contended: bool):
+        try:
+            from .instrument import (lock_contention_counter,
+                                     lock_wait_histogram)
+            lock_wait_histogram().observe(wait_s, lock=self.name)
+            if contended:
+                lock_contention_counter().inc(lock=self.name)
+        except Exception:
+            pass  # telemetry must never break the lock it watches
+
+    def release(self):
+        st = _held_stack()
+        # pop the LAST occurrence: re-entrant acquires stack up
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == self.name:
+                del st[i]
+                break
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"WitnessLock({self.name!r}, "
+                f"{'RLock' if self.reentrant else 'Lock'})")
+
+
+def named_lock(name: str):
+    """A non-reentrant lock, witnessed when ``PADDLE_LOCK_WITNESS=1``
+    (else a plain ``threading.Lock`` — zero overhead)."""
+    if enabled():
+        return WitnessLock(name, reentrant=False)
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    """A reentrant lock, witnessed when ``PADDLE_LOCK_WITNESS=1``
+    (else a plain ``threading.RLock``)."""
+    if enabled():
+        return WitnessLock(name, reentrant=True)
+    return threading.RLock()
+
+
+# ---------------------------------------------------------------------------
+# graph access / export
+# ---------------------------------------------------------------------------
+
+def snapshot() -> dict:
+    """The witnessed graph so far: ``{"edges": [{src, dst, count,
+    stack}], "waits": {name: {acquires, wait_sum, wait_max,
+    contended}}}``."""
+    return _state.snapshot()
+
+
+def reset():
+    """Drop all witnessed state (test isolation)."""
+    _state.reset()
+
+
+def cycles(edges=None) -> list:
+    """Lock-order cycles in the witnessed graph (each as a list of lock
+    names ``[a, b, ..., a]``); an empty list is the acyclic runtime
+    PTCY001 contract. Accepts either snapshot()-style edge dicts or
+    bare ``(src, dst)`` pairs."""
+    if edges is None:
+        edges = _state.snapshot()["edges"]
+    adj: dict = {}
+    for e in edges:
+        s, d = (e["src"], e["dst"]) if isinstance(e, dict) else tuple(e)
+        adj.setdefault(s, set()).add(d)
+    out, done = [], set()
+    for root in sorted(adj):
+        if root in done:
+            continue
+        # DFS with an explicit path: report each back-edge cycle once
+        stack = [(root, iter(sorted(adj.get(root, ()))))]
+        path, on_path = [root], {root}
+        while stack:
+            node, it = stack[-1]
+            nxt = next(it, None)
+            if nxt is None:
+                stack.pop()
+                on_path.discard(path.pop())
+                done.add(node)
+                continue
+            if nxt in on_path:
+                cyc = path[path.index(nxt):] + [nxt]
+                if tuple(sorted(set(cyc))) not in {
+                        tuple(sorted(set(c))) for c in out}:
+                    out.append(cyc)
+            elif nxt not in done:
+                stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                path.append(nxt)
+                on_path.add(nxt)
+    return out
+
+
+def publish(logger=None):
+    """Write the witnessed graph as ONE ``lock_witness`` runlog event
+    (no-op when the witness is off, empty, or no logger is active).
+    ``RunLogger.close`` calls this, so witnessed runs always leave
+    their graph in the run dir for ``merge_run_dir`` to fold."""
+    snap = _state.snapshot()
+    if not snap["edges"] and not snap["waits"]:
+        return None
+    if logger is None:
+        from .runlog import get_run_logger
+        logger = get_run_logger()
+    if logger is None:
+        return None
+    # stacks ride the event (truncated): a witnessed edge confirming a
+    # static PTCY001 cycle upgrades the finding with the observed stacks
+    edges = [{"src": e["src"], "dst": e["dst"], "count": e["count"],
+              "stack": (e.get("stack") or "")[-2000:]}
+             for e in snap["edges"]]
+    return logger.log("lock_witness", edges=edges, waits=snap["waits"])

@@ -28,6 +28,7 @@ import re
 import threading
 import time
 
+from . import lockwitness
 from .metrics import get_registry
 
 
@@ -57,7 +58,7 @@ class RunLogger:
         # mid-write; a plain Lock would deadlock the grace window. The
         # worst re-entry artifact is one interleaved/torn line, which
         # _read_jsonl already tolerates and counts.
-        self._lock = threading.RLock()
+        self._lock = lockwitness.named_rlock("runlog.logger")
         os.makedirs(run_dir, exist_ok=True)
         self._events_path = os.path.join(
             run_dir, f"events.rank{self.rank}.jsonl")
@@ -114,6 +115,12 @@ class RunLogger:
 
     def close(self):
         try:
+            # witnessed lock graph (PADDLE_LOCK_WITNESS=1) rides out as
+            # one final event; no-op when the witness is off or empty
+            lockwitness.publish(self)
+        except Exception:
+            pass
+        try:
             self.flush_metrics()
         except Exception:
             pass
@@ -133,7 +140,10 @@ class RunLogger:
 
 
 _run_logger: RunLogger | None = None
-_run_logger_lock = threading.Lock()
+# RLock: the SIGTERM emergency-save path reaches get_run_logger() (via
+# record_checkpoint_save) and the signal may interrupt a first-call
+# get_run_logger() already inside this lock (PTCY003)
+_run_logger_lock = threading.RLock()
 
 
 def get_run_logger(run_dir: str | None = None) -> RunLogger | None:
@@ -279,6 +289,7 @@ def merge_run_dir(run_dir: str, write: bool = True,
         "corrupt_lines": 0,
         "straggler": None,
         "serving": None,
+        "lock_witness": None,
     }
     st = summary["step_time"]
     counter_anomalies = {}  # rank -> {kind: n} from flushed counter series
@@ -289,6 +300,8 @@ def merge_run_dir(run_dir: str, write: bool = True,
     # its next metrics flush still reports the violations it logged
     counter_slo = {}        # rank -> {slo: n}
     event_slo = {}          # rank -> {slo: n}
+    lw_edges = {}           # (src, dst) -> {"count", "stack"}
+    lw_waits = {}           # lock name -> wait tallies
 
     for path in sorted(glob.glob(os.path.join(run_dir, "metrics.rank*.jsonl"))):
         m = re.search(r"metrics\.rank(-?\d+)(?:\.gen-?\d+)?\.jsonl$", path)
@@ -369,6 +382,28 @@ def merge_run_dir(run_dir: str, write: bool = True,
                     slo = rec.get("slo") or kind[len("slo_"):]
                     d = event_slo.setdefault(rec.get("rank", -1), {})
                     d[slo] = d.get(slo, 0) + 1
+            elif ev == "lock_witness":
+                # fold the per-process witnessed lock graphs: edge
+                # counts sum, the first observed stack per edge is
+                # kept, wait tallies merge per lock name
+                for e in rec.get("edges") or []:
+                    key = (e.get("src"), e.get("dst"))
+                    cur = lw_edges.get(key)
+                    if cur is None:
+                        lw_edges[key] = {
+                            "count": int(e.get("count", 1)),
+                            "stack": e.get("stack") or ""}
+                    else:
+                        cur["count"] += int(e.get("count", 1))
+                for name, w in (rec.get("waits") or {}).items():
+                    cur = lw_waits.setdefault(name, {
+                        "acquires": 0, "wait_sum": 0.0,
+                        "wait_max": 0.0, "contended": 0})
+                    cur["acquires"] += int(w.get("acquires", 0))
+                    cur["wait_sum"] += float(w.get("wait_sum", 0.0))
+                    cur["wait_max"] = max(cur["wait_max"],
+                                          float(w.get("wait_max", 0.0)))
+                    cur["contended"] += int(w.get("contended", 0))
             gen = rec.get("generation")
             if gen is not None and gen not in summary["generations"]:
                 summary["generations"].append(gen)
@@ -414,6 +449,15 @@ def merge_run_dir(run_dir: str, write: bool = True,
         serving["slo_violations"] = slo_violations
         summary["serving"] = serving
 
+    if lw_edges or lw_waits:
+        from .lockwitness import cycles as _lw_cycles
+        summary["lock_witness"] = {
+            "edges": [{"src": s, "dst": d, "count": e["count"],
+                       "stack": e["stack"]}
+                      for (s, d), e in sorted(lw_edges.items())],
+            "waits": {n: dict(w) for n, w in sorted(lw_waits.items())},
+            "cycles": _lw_cycles(list(lw_edges)),
+        }
     summary["straggler"] = _straggler_pass(st["per_rank"],
                                            straggler_threshold)
     if write:

@@ -81,6 +81,8 @@ import time
 
 import numpy as np
 
+from ..observability import lockwitness
+
 __all__ = ["FleetRouter", "ReplicaHandle", "FleetError"]
 
 _RPC_TIMEOUT_S = 60.0
@@ -654,7 +656,7 @@ class FleetRouter:
         self.migration_bytes = 0
         self.shed_events: list = []
         self.breaker_events: list = []  # recent open/close transitions
-        self._lock = threading.RLock()
+        self._lock = lockwitness.named_rlock("fleet.router")
         self._boot_threads: list = []   # in-flight async relaunches
         self._started = False
         self._logger = None
@@ -794,8 +796,11 @@ class FleetRouter:
                        "prompt": np.arange(4, dtype=np.int32),
                        "max_new": int(max_new_tokens), "eos_id": None,
                        "enqueued_ts": time.monotonic(), "requeues": 0}
-                if self._dispatch(rec, t) == "accepted":
-                    rids.append(rid)
+            # dispatch is a blocking RPC — never under the router lock
+            # (PTCY002): a stalled replica would freeze submit/status
+            # on every other thread for the RPC timeout
+            if self._dispatch(rec, t) == "accepted":
+                rids.append(rid)
         deadline = time.monotonic() + timeout
         while any(r not in self.results for r in rids):
             if time.monotonic() > deadline:
@@ -883,27 +888,49 @@ class FleetRouter:
 
     def _dispatch_queued(self):
         from ..observability import instrument as obs
-        with self._lock:
-            snaps = self._snapshots()
-            still_queued = []
-            now = time.monotonic()
-            for rec in self._queue:
-                dl = rec.get("deadline_s")
-                if dl is not None and rec.get("submit_ts") is not None \
-                        and now - rec["submit_ts"] > dl:
-                    # expired while held at the router (saturated fleet,
-                    # open breakers): terminal NOW — a deadline bounds
-                    # the wait wherever the request is waiting
-                    self._terminal(rec, state="deadline_exceeded")
-                    continue
-                pages = -(-(len(rec["prompt"]) + rec["max_new"])
-                          // self.page_size)
-                target = self.policy.route(rec["prompt"], snaps,
-                                           pages_needed=pages)
-                if target is None:
+        # _dispatch is a blocking RPC — hold the lock only to pick the
+        # next routable request, drop it across the RPC (PTCY002: a
+        # stalled replica must not freeze submit/status/tick for the
+        # RPC timeout), re-take it to commit the outcome. `attempted`
+        # gives each rid at most one attempt per call (the old one-pass
+        # semantics), so a transiently-refused request can't spin here.
+        attempted = set()
+        snaps = None
+        while True:
+            with self._lock:
+                if snaps is None:
+                    snaps = self._snapshots()
+                now = time.monotonic()
+                still_queued = []
+                pick = target = None
+                pages = 0
+                for rec in self._queue:
+                    dl = rec.get("deadline_s")
+                    if dl is not None and rec.get("submit_ts") is not None \
+                            and now - rec["submit_ts"] > dl:
+                        # expired while held at the router (saturated
+                        # fleet, open breakers): terminal NOW — a
+                        # deadline bounds the wait wherever the request
+                        # is waiting
+                        self._terminal(rec, state="deadline_exceeded")
+                        continue
+                    if pick is None and rec["rid"] not in attempted:
+                        need = -(-(len(rec["prompt"]) + rec["max_new"])
+                                 // self.page_size)
+                        tgt = self.policy.route(rec["prompt"], snaps,
+                                                pages_needed=need)
+                        if tgt is not None:
+                            pick, target, pages = rec, tgt, need
+                            continue   # held out of the queue in flight
                     still_queued.append(rec)
-                    continue
-                outcome = self._dispatch(rec, target)
+                self._queue = still_queued
+                if pick is None:
+                    obs.fleet_router_queue_gauge().set(
+                        float(len(self._queue)))
+                    return
+                attempted.add(pick["rid"])
+            outcome = self._dispatch(pick, target)
+            with self._lock:
                 if outcome == "accepted":
                     obs.fleet_routed_counter().inc(
                         outcome=self.policy.last_outcome or "?")
@@ -915,12 +942,10 @@ class FleetRouter:
                         snaps[target]["free_pages"] = max(
                             snaps[target]["free_pages"] - pages, 0)
                 elif outcome == "queued":
-                    still_queued.append(rec)
-                    snaps = self._snapshots()
+                    self._queue.append(pick)
+                    snaps = None   # stale after a refusal: refresh
                 # "rejected": terminal result recorded; neither routed
                 # nor load-updated — the replica refused it
-            self._queue = still_queued
-            obs.fleet_router_queue_gauge().set(float(len(self._queue)))
 
     def _submit_rpc(self, handle, rec: dict) -> dict:
         wait_s = time.monotonic() - rec["enqueued_ts"]

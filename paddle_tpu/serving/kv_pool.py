@@ -30,12 +30,27 @@ pool never trips this guard).
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import lockwitness
+
 __all__ = ["PagePool", "PagePoolError", "PagePoolOOM"]
+
+
+def _locked(fn):
+    """Run a bookkeeping method under the pool's internal RLock —
+    the scheduler tick, admission, cancel, and the prefix cache all
+    mutate one pool, possibly from different threads. Reentrant:
+    alloc_prefixed -> incref and free -> decref nest."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._mu:
+            return fn(self, *args, **kwargs)
+    return wrapper
 
 
 class PagePoolError(RuntimeError):
@@ -70,6 +85,9 @@ class PagePool:
                  self.num_kv_heads, self.head_dim)
         self.k_pages = jnp.zeros(shape, dtype=dtype)
         self.v_pages = jnp.zeros(shape, dtype=dtype)
+        # internal lock: every bookkeeping mutator/reader below runs
+        # under it (witness-named for the runtime lock witness)
+        self._mu = lockwitness.named_rlock("serving.page_pool")
         # LIFO free list, deterministic: lowest page ids hand out first
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._tables: dict = {}   # seq_id -> [page, ...]
@@ -94,6 +112,7 @@ class PagePool:
         return (self.num_pages - 1) - len(self._free)
 
     @property
+    @_locked
     def live_tokens(self) -> int:
         return sum(self._lens.values())
 
@@ -102,11 +121,13 @@ class PagePool:
         return len(self._tables)
 
     @property
+    @_locked
     def pages_shared(self) -> int:
         """Pages mapped by more than one holder (sequences and/or the
         prefix-cache trie) — >0 proves physical page reuse."""
         return sum(1 for c in self._refs.values() if c > 1)
 
+    @_locked
     def note_prefix_lookup(self, tokens_reused: int):
         """Prefix-cache reuse accounting (called by the cache on every
         admission match attempt): a lookup reusing >0 tokens is a hit."""
@@ -115,6 +136,7 @@ class PagePool:
             self._prefix_hits += 1
             self._tokens_reused += int(tokens_reused)
 
+    @_locked
     def stats(self) -> dict:
         """Fragmentation + sharing accounting: ``utilization`` = the
         PHYSICALLY occupied share of allocated page slots, so
@@ -161,6 +183,7 @@ class PagePool:
                 f"unknown or already-freed sequence {seq_id!r} "
                 f"({self.live_sequences} live)")
 
+    @_locked
     def _take_page(self) -> int:
         """Pop one page off the free list at refcount 1 (caller owns it
         — used for COW boundary copies before a table exists)."""
@@ -170,6 +193,7 @@ class PagePool:
         self._refs[p] = 1
         return p
 
+    @_locked
     def incref(self, pages):
         """Add one reference per page (prefix-cache node adoption or
         mapping a cached page into a new sequence's table). Validates
@@ -185,6 +209,7 @@ class PagePool:
         for p in pages:
             self._refs[p] += 1
 
+    @_locked
     def decref(self, pages):
         """Drop one reference per page; pages reaching zero return to
         the free list (lowest ids reused first)."""
@@ -201,6 +226,7 @@ class PagePool:
         self._free.extend(sorted(freed, reverse=True))
         return freed
 
+    @_locked
     def page_ref(self, page: int) -> int:
         return self._refs.get(page, 0)
 
@@ -208,6 +234,7 @@ class PagePool:
         """Register a new sequence holding ``n_tokens`` and hand it pages."""
         return self.alloc_prefixed(seq_id, n_tokens, (), 0)
 
+    @_locked
     def alloc_prefixed(self, seq_id, n_tokens: int, prefix_pages,
                        prefix_len: int):
         """Register a new sequence whose first ``prefix_len`` tokens
@@ -252,6 +279,7 @@ class PagePool:
         self._lens[seq_id] = n_tokens
         return list(self._tables[seq_id])
 
+    @_locked
     def extend(self, seq_id, n_new: int = 1) -> int:
         """Grow a sequence by ``n_new`` tokens, allocating pages as the
         length crosses page boundaries. Returns the new length. The
@@ -290,6 +318,7 @@ class PagePool:
         self._lens[seq_id] = new_len
         return new_len
 
+    @_locked
     def free(self, seq_id):
         """Drop the sequence's reference on its pages; pages held by no
         other sequence (or prefix-cache node) return to the pool."""
@@ -298,15 +327,18 @@ class PagePool:
         del self._lens[seq_id]
         self.decref(pages)
 
+    @_locked
     def seq_len(self, seq_id) -> int:
         self._require(seq_id)
         return self._lens[seq_id]
 
+    @_locked
     def table(self, seq_id) -> list:
         self._require(seq_id)
         return list(self._tables[seq_id])
 
     # ---------------------------------------------- device-facing arrays
+    @_locked
     def table_array(self, seq_ids) -> np.ndarray:
         """Dense int32 page-table batch ``[B, max_pages_per_seq]`` for
         the decode kernel; missing/short entries point at the sink."""
@@ -318,6 +350,7 @@ class PagePool:
                 out[i, :len(pages)] = pages
         return out
 
+    @_locked
     def lens_array(self, seq_ids) -> np.ndarray:
         """True lengths ``[B]`` int32 (0 for idle/unknown slots)."""
         return np.asarray([self._lens.get(sid, 0) for sid in seq_ids],
@@ -330,6 +363,7 @@ class PagePool:
         positions (``t >= seq_len``) land in the sink page."""
         return self.chunk_rows(seq_id, 0, bucket_len)
 
+    @_locked
     def chunk_rows(self, seq_id, start: int, bucket_len: int) -> np.ndarray:
         """Destination rows for a prefill *chunk*: positions ``[start,
         start + bucket_len)`` of the sequence map to their page slots;
@@ -349,6 +383,7 @@ class PagePool:
                 rows[i] = self.SINK * ps + (t % ps)
         return rows
 
+    @_locked
     def token_rows(self, seq_id, start: int, stop: int) -> np.ndarray:
         """Flattened page rows (into the ``[num_pages*page_size]`` view)
         for token positions ``[start, stop)`` of a live sequence — the
@@ -368,6 +403,7 @@ class PagePool:
         return np.asarray([pages[t // ps] * ps + (t % ps)
                            for t in range(start, stop)], dtype=np.int32)
 
+    @_locked
     def bind(self, k_pages, v_pages):
         """Rebind the device arrays after a functional update (the jitted
         step returns the new pool contents)."""

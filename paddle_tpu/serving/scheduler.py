@@ -48,6 +48,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..observability import lockwitness
+
 __all__ = ["Request", "ContinuousBatchingScheduler",
            "simulate_decode_signatures"]
 
@@ -235,7 +237,7 @@ class ContinuousBatchingScheduler:
         # one coarse lock makes /status (and concurrent submit) a
         # consistent cut of queue/pool state; step() holds it for the
         # tick, so a scrape waits at most one decode step
-        self._lock = threading.Lock()
+        self._lock = lockwitness.named_lock("serving.scheduler")
         self._start_ts = time.time()
 
     # ----------------------------------------------------------- intake

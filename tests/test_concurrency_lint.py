@@ -1,0 +1,525 @@
+"""Concurrency sanitizer: per-code seeded fixtures, pragma allowlist,
+runtime lock witness, and the package self-lint gate.
+
+Acceptance (ISSUE 20): each PTCY code has a fixture that fires exactly
+that diagnostic; ``tools/check_concurrency.py paddle_tpu`` (here via
+``analyze_package``) is clean on the final tree with every allowlist
+entry justified; the witness records edges/waits, detects cycles, and
+its published event folds through ``merge_run_dir`` into a doctor
+finding."""
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.observability import lockwitness
+
+
+def _lint_fixture(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(source)
+    return concurrency.lint_paths([str(tmp_path)])
+
+
+def _codes(diags):
+    return sorted(d.code for d in diags)
+
+
+# ===========================================================================
+# one seeded fixture per PTCY code, firing exactly that diagnostic
+# ===========================================================================
+
+def test_ptcy001_lock_order_inversion(tmp_path):
+    active, suppressed = _lint_fixture(tmp_path, "inv.py", """\
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def backward():
+    with b_lock:
+        with a_lock:
+            pass
+""")
+    assert _codes(active) == ["PTCY001"] and not suppressed
+    d = active[0]
+    assert d.severity == "error"
+    assert set(d.extra["cycle"]) == {"inv.a_lock", "inv.b_lock"}
+
+
+def test_ptcy001_transitive_through_callee(tmp_path):
+    """The inversion only exists inter-procedurally: f holds A and
+    calls g which takes B; h holds B and takes A."""
+    active, _ = _lint_fixture(tmp_path, "trans.py", """\
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def helper():
+    with b_lock:
+        pass
+
+
+def f():
+    with a_lock:
+        helper()
+
+
+def h():
+    with b_lock:
+        with a_lock:
+            pass
+""")
+    assert _codes(active) == ["PTCY001"]
+
+
+def test_ptcy001_self_deadlock_plain_lock_only(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "selfdead.py", """\
+import threading
+
+mu = threading.Lock()
+remu = threading.RLock()
+
+
+def bad():
+    with mu:
+        with mu:
+            pass
+
+
+def fine():
+    with remu:
+        with remu:
+            pass
+""")
+    assert _codes(active) == ["PTCY001"]
+    assert "self-deadlock" in active[0].message
+    assert active[0].extra["cycle"] == ["selfdead.mu"]
+
+
+def test_ptcy002_blocking_under_lock(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "blk.py", """\
+import threading
+import time
+
+mu = threading.Lock()
+
+
+def slow():
+    with mu:
+        time.sleep(0.5)
+""")
+    assert _codes(active) == ["PTCY002"]
+    assert "time.sleep" in active[0].message
+
+
+def test_ptcy002_transitive_blocking_reports_via_path(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "blk2.py", """\
+import socket
+import threading
+
+mu = threading.Lock()
+
+
+def dial(host):
+    return socket.create_connection((host, 80), timeout=5)
+
+
+def rpc(host):
+    with mu:
+        return dial(host)
+""")
+    assert _codes(active) == ["PTCY002"]
+    assert "via" in active[0].message and active[0].extra["via"]
+
+
+def test_ptcy003_plain_lock_on_signal_path(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "sig.py", """\
+import signal
+import threading
+
+
+class Handler:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        with self._lock:
+            pass
+""")
+    assert _codes(active) == ["PTCY003"]
+    assert active[0].extra["handler_kind"] == "signal"
+
+
+def test_ptcy003_rlock_on_signal_path_is_clean(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "sigok.py", """\
+import signal
+import threading
+
+
+class Handler:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        with self._lock:
+            pass
+""")
+    assert active == []
+
+
+def test_ptcy004_unguarded_cross_thread_write(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "race.py", """\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+        t1 = threading.Thread(target=self._bump, daemon=True)
+        t1.start()
+        t2 = threading.Thread(target=self._bump_twice, daemon=True)
+        t2.start()
+
+    def _bump(self):
+        self.count += 1
+
+    def _bump_twice(self):
+        self.count += 2
+""")
+    assert _codes(active) == ["PTCY004"]
+    assert active[0].severity == "warning"
+    assert len(active[0].extra["roots"]) == 2
+
+
+def test_ptcy004_common_lock_is_clean(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "guarded.py", """\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        t1 = threading.Thread(target=self._bump, daemon=True)
+        t1.start()
+        t2 = threading.Thread(target=self._bump_twice, daemon=True)
+        t2.start()
+
+    def _bump(self):
+        with self._lock:
+            self.count += 1
+
+    def _bump_twice(self):
+        with self._lock:
+            self.count += 2
+""")
+    assert active == []
+
+
+def test_ptcy005_non_daemon_unjoined_thread(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "orphan.py", """\
+import threading
+
+
+def work():
+    pass
+
+
+def spawn():
+    t = threading.Thread(target=work)
+    t.start()
+""")
+    assert _codes(active) == ["PTCY005"]
+    assert active[0].severity == "info"
+
+
+def test_ptcy005_joined_or_daemon_is_clean(tmp_path):
+    active, _ = _lint_fixture(tmp_path, "tidy.py", """\
+import threading
+
+
+def work():
+    pass
+
+
+def joined():
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(timeout=2.0)
+
+
+def daemonized():
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+""")
+    assert active == []
+
+
+# ===========================================================================
+# pragma allowlist
+# ===========================================================================
+
+def test_pragma_suppresses_with_justification(tmp_path):
+    active, suppressed = _lint_fixture(tmp_path, "allowed.py", """\
+import threading
+import time
+
+mu = threading.Lock()
+
+
+def slow():
+    with mu:
+        # ptcy: allow(PTCY002) bounded 10ms backoff under a leaf lock
+        time.sleep(0.01)
+""")
+    assert active == []
+    assert _codes(suppressed) == ["PTCY002"]
+    assert suppressed[0].extra["suppressed"] is True
+    assert "leaf lock" in suppressed[0].extra["justification"]
+
+
+def test_pragma_without_justification_is_ptcy000(tmp_path):
+    active, suppressed = _lint_fixture(tmp_path, "lazy.py", """\
+import threading
+import time
+
+mu = threading.Lock()
+
+
+def slow():
+    with mu:
+        time.sleep(0.01)  # ptcy: allow(PTCY002) ok
+""")
+    # the naked pragma does NOT buy suppression, and is itself an error
+    assert _codes(active) == ["PTCY000", "PTCY002"]
+    assert not suppressed
+
+
+def test_pragma_only_covers_named_codes(tmp_path):
+    active, suppressed = _lint_fixture(tmp_path, "partial.py", """\
+import threading
+import time
+
+mu = threading.Lock()
+
+
+def slow():
+    with mu:
+        # ptcy: allow(PTCY001) suppresses a code this line never fires
+        time.sleep(0.01)
+""")
+    assert _codes(active) == ["PTCY002"] and not suppressed
+
+
+# ===========================================================================
+# runtime lock witness
+# ===========================================================================
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("PADDLE_LOCK_WITNESS", "1")
+    lockwitness.reset()
+    yield
+    lockwitness.reset()
+
+
+def test_witness_disabled_returns_plain_locks(monkeypatch):
+    monkeypatch.delenv("PADDLE_LOCK_WITNESS", raising=False)
+    assert not isinstance(lockwitness.named_lock("x"),
+                          lockwitness.WitnessLock)
+    assert not isinstance(lockwitness.named_rlock("x"),
+                          lockwitness.WitnessLock)
+
+
+def test_witness_records_edges_and_waits(witness):
+    a = lockwitness.named_lock("A")
+    b = lockwitness.named_lock("B")
+    with a:
+        with b:
+            pass
+    snap = lockwitness.snapshot()
+    assert [(e["src"], e["dst"], e["count"]) for e in snap["edges"]] \
+        == [("A", "B", 1)]
+    assert snap["waits"]["A"]["acquires"] == 1
+    assert snap["waits"]["B"]["acquires"] == 1
+    assert lockwitness.cycles() == []
+
+
+def test_witness_detects_inversion_cycle(witness):
+    a = lockwitness.named_lock("A")
+    b = lockwitness.named_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycs = lockwitness.cycles()
+    assert len(cycs) == 1 and set(cycs[0]) == {"A", "B"}
+    lockwitness.reset()
+    assert lockwitness.snapshot() == {"edges": [], "waits": {}}
+
+
+def test_witness_rlock_reentry_is_not_an_edge(witness):
+    r = lockwitness.named_rlock("R")
+    with r:
+        with r:
+            pass
+    assert lockwitness.snapshot()["edges"] == []
+
+
+def test_witness_contention_counted(witness):
+    mu = lockwitness.named_lock("hot")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with mu:
+            entered.set()
+            release.wait(timeout=5.0)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(timeout=5.0)
+    waiter_done = threading.Event()
+
+    def waiter():
+        with mu:
+            pass
+        waiter_done.set()
+
+    w = threading.Thread(target=waiter, daemon=True)
+    w.start()
+    # the waiter is blocked on the held lock -> contended acquire
+    release.set()
+    assert waiter_done.wait(timeout=5.0)
+    t.join(timeout=5.0)
+    w.join(timeout=5.0)
+    stats = lockwitness.snapshot()["waits"]["hot"]
+    assert stats["acquires"] == 2
+    assert stats["contended"] >= 1
+
+
+def test_witness_publish_folds_into_summary_and_doctor(witness, tmp_path):
+    from paddle_tpu.observability import doctor
+    from paddle_tpu.observability.runlog import RunLogger, merge_run_dir
+    a = lockwitness.named_lock("A")
+    b = lockwitness.named_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    logger = RunLogger(str(tmp_path), rank=0)
+    logger.close()  # close() publishes the witness snapshot
+    summ = merge_run_dir(str(tmp_path))
+    lw = summ["lock_witness"]
+    assert {(e["src"], e["dst"]) for e in lw["edges"]} \
+        == {("A", "B"), ("B", "A")}
+    assert lw["cycles"] and set(lw["cycles"][0]) == {"A", "B"}
+    findings = doctor.collect_findings(summ)
+    crits = [f for f in findings if f["kind"] == "lock_order_cycle"]
+    assert len(crits) == 1 and crits[0]["severity"] == "crit"
+
+
+def test_confirm_with_witness_upgrades_static_cycle(tmp_path, witness):
+    active, _ = _lint_fixture(tmp_path, "named.py", """\
+from paddle_tpu.observability import lockwitness
+
+a_lock = lockwitness.named_lock("A")
+b_lock = lockwitness.named_lock("B")
+
+
+def forward():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def backward():
+    with b_lock:
+        with a_lock:
+            pass
+""")
+    assert _codes(active) == ["PTCY001"]
+    assert sorted(active[0].extra["witness_names"]) == ["A", "B"]
+    # runtime observes the same inversion
+    a = lockwitness.named_lock("A")
+    b = lockwitness.named_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    n = concurrency.confirm_with_witness(active, lockwitness.snapshot())
+    assert n == 1
+    assert active[0].extra["witnessed"] is True
+    assert active[0].extra["observed_stacks"]
+
+
+def test_confirm_with_witness_needs_every_edge(witness):
+    active = []
+    from paddle_tpu.analysis.core import Diagnostic
+    active.append(Diagnostic(
+        code="PTCY001", pass_name="concurrency", severity="error",
+        message="m", extra={"witness_names": ["A", "B"]}))
+    a = lockwitness.named_lock("A")
+    b = lockwitness.named_lock("B")
+    with a:
+        with b:
+            pass  # only A->B observed, never B->A
+    assert concurrency.confirm_with_witness(
+        active, lockwitness.snapshot()) == 0
+    assert "witnessed" not in active[0].extra
+
+
+# ===========================================================================
+# package self-lint gate
+# ===========================================================================
+
+def test_package_self_lint_is_clean():
+    """The final tree carries zero active findings; every allowlisted
+    finding has a written justification (the ISSUE acceptance gate)."""
+    rep = concurrency.analyze_package()
+    assert rep.diagnostics == [], "\n".join(
+        f"{d.code} {d.file}:{d.line}: {d.message}"
+        for d in rep.diagnostics)
+    for d in rep.suppressed:
+        assert len(d.extra.get("justification", "")) >= 8
+
+
+@pytest.mark.slow
+def test_check_concurrency_cli_gate(tmp_path):
+    """tools/check_concurrency.py exits 0 and emits valid JSON."""
+    import paddle_tpu
+    import os
+    pkg = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+    tools = os.path.join(os.path.dirname(pkg), "tools",
+                         "check_concurrency.py")
+    proc = subprocess.run(
+        [sys.executable, tools, pkg, "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is True and doc["findings"] == []

@@ -1,0 +1,339 @@
+"""Seeded-interleaving stress tests + regressions for the fixes the
+concurrency sanitizer forced (ISSUE 20 satellites 1-3).
+
+Stress: many threads hammer one ``ContinuousBatchingScheduler``
+(submit/cancel/step) and one ``PagePool`` (alloc_prefixed / incref /
+decref / extend / free) behind a start barrier with per-thread seeded
+RNGs, then the pool invariants are checked: every page returned, no
+refcount residue, no sequence leaked. Regressions: the signal-path
+locks really are reentrant, the fleet router lock is NOT held across
+the dispatch RPC, and ChaosProxy.close() leaves no live worker
+threads."""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.kv_pool import PagePool, PagePoolError, PagePoolOOM
+from paddle_tpu.serving.scheduler import (ContinuousBatchingScheduler,
+                                          _ShapeProbeEngine)
+
+
+def _probe_sched(num_pages=64, max_seq_len=64, max_queue=4096):
+    eng = _ShapeProbeEngine(decode_buckets=(1, 2, 4),
+                            prefill_buckets=(8, 64), page_size=8,
+                            num_pages=num_pages, max_seq_len=max_seq_len)
+    return ContinuousBatchingScheduler(eng, max_queue=max_queue)
+
+
+# ===========================================================================
+# scheduler: concurrent submit / cancel / step
+# ===========================================================================
+
+def _scheduler_stress(n_submitters, per_thread, seed=0):
+    sched = _probe_sched()
+    barrier = threading.Barrier(n_submitters + 2)
+    submitted: list = []
+    sub_lock = threading.Lock()
+    errors: list = []
+    stop = threading.Event()
+
+    def submitter(tid):
+        rng = np.random.default_rng(seed + tid)
+        try:
+            barrier.wait(timeout=10.0)
+            for _ in range(per_thread):
+                prompt = rng.integers(0, 100,
+                                      (int(rng.integers(1, 24)),))
+                r = sched.submit(prompt.astype(np.int32),
+                                 int(rng.integers(1, 6)))
+                if r.reject_reason is None:
+                    with sub_lock:
+                        submitted.append(r.rid)
+                if rng.random() < 0.2:
+                    time.sleep(0)   # yield: vary the interleaving
+        except Exception as e:      # pragma: no cover - failure path
+            errors.append(e)
+
+    def canceller():
+        rng = np.random.default_rng(seed + 10_000)
+        barrier.wait(timeout=10.0)
+        while not stop.is_set():
+            with sub_lock:
+                pool = list(submitted)
+            if pool:
+                sched.cancel(pool[int(rng.integers(0, len(pool)))])
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(n_submitters)]
+    threads.append(threading.Thread(target=canceller, daemon=True))
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=10.0)
+    # the main thread is the scheduler tick loop, racing the submitters
+    for _ in range(5000):
+        busy = sched.step()
+        if not busy and all(not t.is_alive() for t in threads[:-1]):
+            break
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors
+    # drain whatever is still in flight
+    for _ in range(5000):
+        if not sched.step():
+            break
+    return sched, submitted
+
+
+def _assert_scheduler_quiescent(sched, submitted):
+    pool = sched.engine.pool
+    assert pool.live_sequences == 0
+    assert pool.free_pages == pool.num_pages - 1  # all but the sink
+    assert pool.pages_in_use == 0
+    with sched._lock:
+        assert not sched._queue and not sched._running \
+            and not sched._prefilling
+    # every accepted request reached a terminal state (completed or
+    # cancelled — cancel routes through the deadline_exceeded terminal)
+    done = {r.rid for r in sched.finished} \
+        | {r.rid for r in sched.deadline_exceeded}
+    assert set(submitted) <= done
+
+
+def test_scheduler_submit_cancel_step_stress():
+    sched, submitted = _scheduler_stress(n_submitters=4, per_thread=40)
+    assert submitted   # the stress actually exercised admissions
+    _assert_scheduler_quiescent(sched, submitted)
+
+
+@pytest.mark.slow
+def test_scheduler_submit_cancel_step_stress_wide():
+    for seed in (0, 1, 2):
+        sched, submitted = _scheduler_stress(
+            n_submitters=8, per_thread=150, seed=seed)
+        assert submitted
+        _assert_scheduler_quiescent(sched, submitted)
+
+
+# ===========================================================================
+# PagePool: concurrent alloc_prefixed / incref / decref / extend / free
+# ===========================================================================
+
+def _pool_stress(n_threads, per_thread, seed=0):
+    pool = PagePool(num_pages=129, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=2)
+    # a shared cached prefix every thread increfs against
+    prefix_pages = pool.alloc("prefix", 8)
+    barrier = threading.Barrier(n_threads)
+    errors: list = []
+
+    def worker(tid):
+        rng = np.random.default_rng(seed + tid)
+        try:
+            barrier.wait(timeout=10.0)
+            for i in range(per_thread):
+                sid = f"t{tid}.{i}"
+                n = int(rng.integers(1, 20))
+                try:
+                    if rng.random() < 0.5 and n > 8:
+                        pool.alloc_prefixed(sid, n, prefix_pages, 8)
+                    else:
+                        pool.alloc(sid, n)
+                except PagePoolOOM:
+                    continue    # transiently full: fine, move on
+                if rng.random() < 0.5:
+                    try:
+                        pool.extend(sid)
+                    except (PagePoolOOM, PagePoolError):
+                        pass
+                # transient cache-style pin on the shared prefix
+                pool.incref(prefix_pages)
+                pool.page_ref(prefix_pages[0])
+                pool.decref(prefix_pages)
+                pool.stats()
+                pool.free(sid)
+        except Exception as e:      # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    # only the shared prefix survives; freeing it must return the pool
+    # to pristine (zero leaked pages, zero refcount residue)
+    assert pool.live_sequences == 1
+    pool.free("prefix")
+    assert pool.live_sequences == 0
+    assert pool.pages_in_use == 0
+    assert pool.free_pages == pool.num_pages - 1
+    assert all(c == 0 for c in pool._refs.values())
+
+
+def test_page_pool_concurrent_ref_stress():
+    _pool_stress(n_threads=4, per_thread=60)
+
+
+@pytest.mark.slow
+def test_page_pool_concurrent_ref_stress_wide():
+    for seed in (0, 1, 2):
+        _pool_stress(n_threads=8, per_thread=250, seed=seed)
+
+
+# ===========================================================================
+# regressions: the locks the sanitizer forced to RLock really reenter
+# ===========================================================================
+
+def _assert_reentrant(lock, what):
+    assert lock.acquire(blocking=False), f"{what}: not acquirable"
+    try:
+        # a plain Lock fails here; the signal-path contract needs RLock
+        assert lock.acquire(blocking=False), f"{what}: not reentrant"
+        lock.release()
+    finally:
+        lock.release()
+
+
+def test_signal_path_locks_are_reentrant():
+    from paddle_tpu.distributed.checkpoint.async_saver import AsyncSaver
+    from paddle_tpu.distributed.checkpoint.preemption import \
+        PreemptionHandler
+    from paddle_tpu.observability import flight, runlog
+    h = PreemptionHandler(manager=None, state_fn=lambda: (None, -1))
+    _assert_reentrant(h._lock, "PreemptionHandler._lock")
+    _assert_reentrant(AsyncSaver()._lock, "AsyncSaver._lock")
+    _assert_reentrant(flight._recorder_lock, "flight._recorder_lock")
+    _assert_reentrant(runlog._run_logger_lock, "runlog._run_logger_lock")
+    _assert_reentrant(flight.FlightRecorder()._lock,
+                      "FlightRecorder._lock")
+
+
+def test_preemption_handler_fires_while_lock_held(monkeypatch, tmp_path):
+    """The exact PTCY003 scenario: SIGTERM arrives while another frame
+    already holds the handler lock. With the RLock this completes; the
+    old plain Lock deadlocked the grace window."""
+    import signal as _signal
+
+    from paddle_tpu.distributed.checkpoint import preemption
+
+    class _Mgr:
+        def __init__(self):
+            self.saved = []
+
+        def emergency_save(self, state, step, partitions=None):
+            self.saved.append((step, partitions))
+
+    exits = []
+    monkeypatch.setattr(preemption, "_exit", exits.append)
+    mgr = _Mgr()
+    h = preemption.PreemptionHandler(mgr, lambda: ({"w": 1}, 7))
+    with h._lock:     # simulate the interrupted critical section
+        h._handle(int(_signal.SIGTERM), None)
+    assert h.triggered
+    assert mgr.saved == [(7, None)]
+    assert exits == [preemption.EMERGENCY_EXIT_CODE]
+
+
+# ===========================================================================
+# regression: fleet router lock is dropped across the dispatch RPC
+# ===========================================================================
+
+def test_fleet_router_lock_not_held_during_dispatch():
+    """_dispatch_queued must release the router lock around the
+    (blocking) dispatch RPC: submit/status on other threads cannot be
+    frozen by one wedged replica for the whole RPC timeout."""
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    router = FleetRouter.__new__(FleetRouter)
+    router._lock = threading.RLock()
+    router.page_size = 8
+    router.replicas = {}
+    router._inflight = {}
+    router.results = {}
+    router._queue = [{"rid": 1, "prompt": [1, 2, 3], "max_new": 4,
+                      "eos_id": None, "deadline_s": None,
+                      "submit_ts": None,
+                      "enqueued_ts": time.monotonic()}]
+
+    class _Policy:
+        last_outcome = "affinity"
+
+        def route(self, prompt, snaps, pages_needed=0):
+            return 0
+
+    router.policy = _Policy()
+    in_rpc = threading.Event()
+    release = threading.Event()
+    lock_free_during_rpc = []
+
+    def fake_dispatch(rec, target):
+        in_rpc.set()
+        release.wait(timeout=10.0)
+        return "accepted"
+
+    router._dispatch = fake_dispatch
+    t = threading.Thread(target=router._dispatch_queued, daemon=True)
+    t.start()
+    assert in_rpc.wait(timeout=10.0)
+    # mid-RPC: the router lock must be acquirable from another thread
+    got = router._lock.acquire(timeout=2.0)
+    lock_free_during_rpc.append(got)
+    if got:
+        router._lock.release()
+    release.set()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert lock_free_during_rpc == [True]
+    assert router._queue == []  # the accepted request left the queue
+
+
+# ===========================================================================
+# regression: ChaosProxy.close() joins its per-connection workers
+# ===========================================================================
+
+def test_chaos_proxy_close_leaves_no_threads():
+    from paddle_tpu.distributed.fleet.elastic.fault_injection import \
+        ChaosProxy
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(5.0)
+    stop = threading.Event()
+
+    def upstream():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    data = conn.recv(1024)
+                    if data:
+                        conn.sendall(data)
+                except OSError:
+                    pass
+
+    ut = threading.Thread(target=upstream, daemon=True)
+    ut.start()
+    proxy = ChaosProxy(srv.getsockname(), schedule=["ok", "ok", "ok"])
+    try:
+        for _ in range(3):
+            with socket.create_connection(proxy.addr, timeout=5.0) as c:
+                c.sendall(b"ping\n")
+                assert c.recv(1024) == b"ping\n"
+    finally:
+        proxy.close()
+        stop.set()
+        ut.join(timeout=10.0)
+        srv.close()
+    leftover = [t.name for t in threading.enumerate()
+                if t.name.startswith("chaos-proxy")]
+    assert leftover == []

@@ -586,9 +586,17 @@ def test_chaos_fleet_acceptance(tmp_path, monkeypatch):
     migration chunk), a deadline on EVERY request. Every request
     reaches a terminal state, nothing hangs, the KV pools drain to
     zero pages in use, and the breaker opens and closes."""
+    from paddle_tpu.observability import lockwitness
     from paddle_tpu.serving.fleet import FleetRouter, _rpc_request
     _drain_env(monkeypatch)
     monkeypatch.setenv("PADDLE_FLEET_BREAKER_FAILS", "1")
+    # ISSUE 20: the whole chaos scenario runs under the runtime lock
+    # witness — at the end the witnessed lock-order graph must be
+    # acyclic (the runtime complement of the PTCY001 static check).
+    # The env must be set BEFORE the router exists so its named locks
+    # construct as witnessed.
+    monkeypatch.setenv("PADDLE_LOCK_WITNESS", "1")
+    lockwitness.reset()
     cfg = _fleet_cfg()
     fleet = FleetRouter(cfg, n_replicas=2,
                         engine_kwargs=dict(CHAOS_ENGINE_KW),
@@ -705,6 +713,14 @@ def test_chaos_fleet_acceptance(tmp_path, monkeypatch):
             assert time.monotonic() < deadline, f"leaked pages: {pools}"
             time.sleep(0.05)
         assert fleet.outstanding == 0
+
+        # lock witness: the run exercised real lock nesting, and the
+        # witnessed graph has no lock-order cycle
+        snap = lockwitness.snapshot()
+        assert snap["waits"], "witness observed no lock activity"
+        assert lockwitness.cycles() == [], (
+            f"witnessed lock-order cycle: {lockwitness.cycles()} "
+            f"(edges: {[(e['src'], e['dst']) for e in snap['edges']]})")
     finally:
         for rid, h in fleet.replicas.items():
             if rid in real_addr:
@@ -712,6 +728,7 @@ def test_chaos_fleet_acceptance(tmp_path, monkeypatch):
         fleet.shutdown(federate=False)
         for p in proxies:
             p.close()
+        lockwitness.reset()
 
 
 @pytest.mark.slow

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Self-lint CLI for the host concurrency sanitizer
+(``paddle_tpu.analysis.concurrency``) — the verify-skill gate.
+
+    python tools/check_concurrency.py paddle_tpu
+    python tools/check_concurrency.py paddle_tpu --json
+    python tools/check_concurrency.py path/to/file.py other/dir
+
+Exit codes:
+    0  clean — zero unsuppressed findings of ANY severity (infos
+       included: every finding on the tree must be fixed or carry an
+       inline ``# ptcy: allow(...)`` justification)
+    1  findings remain
+    2  the linter itself crashed
+
+Suppressed (allowlisted) findings are always printed with their
+justification — an audited exception is visible, never silent.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Host concurrency sanitizer (PTCY001-005)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the paddle_tpu "
+                         "package next to this script)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON object on stdout")
+    ap.add_argument("--errors-only", action="store_true",
+                    help="print (and gate on) errors only")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "paddle_tpu")]
+    # bare package name -> directory next to the repo root
+    paths = [p if os.path.exists(p) else
+             os.path.join(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))), p)
+             for p in paths]
+
+    from paddle_tpu.analysis.concurrency import lint_paths
+    active, suppressed = lint_paths(paths)
+    if args.errors_only:
+        active = [d for d in active if d.severity == "error"]
+
+    def row(d):
+        return {"code": d.code, "severity": d.severity,
+                "file": os.path.relpath(d.file) if d.file else None,
+                "line": d.line, "message": d.message,
+                "suppressed": bool(d.extra.get("suppressed")),
+                "justification": d.extra.get("justification"),
+                "extra": {k: v for k, v in d.extra.items()
+                          if k not in ("suppressed", "justification")
+                          and isinstance(v, (str, int, float, bool,
+                                             list, dict, type(None)))}}
+
+    if args.as_json:
+        print(json.dumps({
+            "clean": not active,
+            "counts": {
+                "error": sum(d.severity == "error" for d in active),
+                "warning": sum(d.severity == "warning" for d in active),
+                "info": sum(d.severity == "info" for d in active),
+                "suppressed": len(suppressed)},
+            "findings": [row(d) for d in active],
+            "suppressed": [row(d) for d in suppressed]}))
+    else:
+        for d in active:
+            loc = f"{os.path.relpath(d.file)}:{d.line}" if d.file \
+                else "<?>"
+            print(f"[{d.severity.upper()}] {d.code} {loc}: {d.message}")
+        for d in suppressed:
+            loc = f"{os.path.relpath(d.file)}:{d.line}" if d.file \
+                else "<?>"
+            print(f"[allowed] {d.code} {loc}: {d.message}")
+            print(f"          justification: "
+                  f"{d.extra.get('justification')}")
+        n = len(active)
+        print(f"{n} finding(s), {len(suppressed)} allowlisted "
+              f"({'clean' if not n else 'NOT clean'})")
+    return 0 if not active else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as exc:  # harness crash, not a lint failure
+        print(f"check_concurrency: internal error: {exc!r}",
+              file=sys.stderr)
+        import traceback
+        traceback.print_exc()
+        sys.exit(2)
